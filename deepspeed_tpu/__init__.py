@@ -1,0 +1,85 @@
+"""deepspeed_tpu — a TPU-native large-model training & inference framework.
+
+Capability parity with DeepSpeed (reference ``deepspeed/__init__.py``): a single
+``initialize(...)`` entry point building a training engine from model + config
+(``deepspeed/__init__.py:64``), ``init_inference`` (``:269``), plus the comm, ops,
+checkpoint, monitor and launcher subsystems — all re-designed for JAX/XLA on TPU:
+device meshes + named shardings instead of process groups and hooks, XLA collectives
+over ICI/DCN instead of NCCL, Pallas kernels instead of CUDA.
+"""
+
+__version__ = "0.1.0"
+version = __version__
+
+from deepspeed_tpu.config import DeepSpeedTPUConfig, ConfigError
+from deepspeed_tpu import comm
+from deepspeed_tpu import ops  # noqa: F401
+from deepspeed_tpu.utils.logging import logger
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               distributed_port: int = 29500,
+               mesh_topology=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               config_params=None,
+               rngs=None):
+    """Initialize the training engine.
+
+    Parity: ``deepspeed.initialize`` (``deepspeed/__init__.py:64``). Returns a tuple
+    of ``(engine, optimizer, dataloader, lr_scheduler)``.
+
+    TPU-first differences: ``model`` is a flax module (or any (init_fn, apply_fn)
+    pair); the engine owns a jitted, sharded train step rather than wrapping an
+    nn.Module with hooks.
+    """
+    # import + config validation first: no side effects (init_distributed) before
+    # anything that can raise
+    from deepspeed_tpu.runtime.engine import DeepSpeedTPUEngine
+
+    config = DeepSpeedTPUConfig.load(config if config is not None else config_params)
+    comm.init_distributed()
+    engine = DeepSpeedTPUEngine(
+        args=args,
+        model=model,
+        optimizer=optimizer,
+        model_parameters=model_parameters,
+        training_data=training_data,
+        lr_scheduler=lr_scheduler,
+        mesh_topology=mesh_topology,
+        collate_fn=collate_fn,
+        config=config,
+        rngs=rngs,
+    )
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Parity: ``deepspeed.init_inference`` (``deepspeed/__init__.py:269``)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.config import InferenceConfig
+    cfg = InferenceConfig.load(config, **kwargs)
+    return InferenceEngine(model=model, config=cfg)
+
+
+def add_config_arguments(parser):
+    """Parity: ``deepspeed.add_config_arguments`` (``deepspeed/__init__.py:246``)."""
+    group = parser.add_argument_group("DeepSpeedTPU", "DeepSpeedTPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeedTPU (helper flag for config scripts)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to DeepSpeedTPU json configuration")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help=argparse_suppress())
+    return parser
+
+
+def argparse_suppress():
+    import argparse
+    return argparse.SUPPRESS

@@ -1,0 +1,41 @@
+"""deepspeed_tpu.comm — collectives + mesh topology.
+
+Parity: the ``deepspeed.comm`` package (``deepspeed/comm/comm.py``) and the
+process-group factory (``deepspeed/utils/groups.py``), rebuilt on jax device meshes
+and XLA collectives.
+"""
+
+from deepspeed_tpu.comm.comm import (
+    all_reduce,
+    all_gather,
+    reduce_scatter,
+    all_to_all,
+    broadcast,
+    ppermute,
+    ring_shift,
+    axis_index,
+    axis_size,
+    barrier,
+    get_rank,
+    get_world_size,
+    init_distributed,
+    is_initialized,
+    configure,
+    log_summary,
+)
+from deepspeed_tpu.comm.mesh import (
+    MeshTopology,
+    build_topology,
+    get_topology,
+    set_topology,
+    reset_topology,
+    PIPE_AXIS,
+    DATA_AXIS,
+    FSDP_AXIS,
+    EXPERT_AXIS,
+    SEQ_AXIS,
+    TENSOR_AXIS,
+    BATCH_AXES,
+    ALL_AXES,
+)
+from deepspeed_tpu.comm.logging import CommsLogger, get_comms_logger, calc_bw_log

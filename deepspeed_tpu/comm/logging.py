@@ -1,0 +1,117 @@
+"""Communication logger.
+
+Parity: ``deepspeed/utils/comms_logging.py`` — ``CommsLogger`` (:67) and
+``calc_bw_log`` (:34). On TPU, collectives run inside jit so per-op wall timing is
+not observable from Python; instead we record per-call (op, bytes, axis) at trace
+time and, when the user provides measured latencies (e.g. from the XLA profiler or
+whole-step timing), derive algorithmic and bus bandwidth with the same formulas the
+reference uses (allreduce busbw factor 2(n-1)/n, allgather/rs (n-1)/n).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def calc_bw_log(comm_op: str, size_bytes: int, duration_s: float, n: int) -> tuple:
+    """Return (msg_size, algbw GB/s, busbw GB/s). Parity: comms_logging.py:34."""
+    duration_s = max(duration_s, 1e-12)
+    if comm_op in ("all_to_all_single", "all_to_all"):
+        algbw = size_bytes / duration_s
+        busbw = algbw * ((n - 1) / max(n, 1))
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter",
+                     "reduce_scatter_tensor", "all_gather_object"):
+        size_bytes = size_bytes * n
+        algbw = size_bytes / duration_s
+        busbw = algbw * ((n - 1) / max(n, 1))
+    elif comm_op in ("all_reduce", "inference_all_reduce"):
+        algbw = size_bytes / duration_s
+        busbw = algbw * (2 * (n - 1) / max(n, 1))
+    else:  # pt2pt, broadcast, ppermute
+        algbw = size_bytes / duration_s
+        busbw = algbw
+    return size_bytes, algbw / 1e9, busbw / 1e9
+
+
+class CommsLogger:
+    """Records collective call sites; parity: ``CommsLogger`` comms_logging.py:67."""
+
+    def __init__(self):
+        self.enabled = False
+        self.verbose = False
+        self.prof_all = True
+        self.prof_ops: List[str] = []
+        # op -> msg_size -> [count, total_bytes, latencies...]
+        self.comms_dict: Dict[str, Dict[int, List]] = defaultdict(lambda: defaultdict(lambda: [0, 0, []]))
+
+    def configure(self, enabled=None, verbose=None, prof_all=None, prof_ops=None):
+        if enabled is not None:
+            self.enabled = enabled
+        if verbose is not None:
+            self.verbose = verbose
+        if prof_all is not None:
+            self.prof_all = prof_all
+        if prof_ops is not None:
+            self.prof_ops = prof_ops
+
+    def _should_log(self, op_name: str, log_name: Optional[str]) -> bool:
+        if not self.enabled:
+            return False
+        if self.prof_all:
+            return True
+        name = log_name or op_name
+        return name in self.prof_ops or op_name in self.prof_ops
+
+    def record(self, op_name: str, size_bytes: int, axis_name: Any = None,
+               log_name: Optional[str] = None, duration_s: Optional[float] = None):
+        if not self._should_log(op_name, log_name):
+            return
+        rec = self.comms_dict[log_name or op_name][size_bytes]
+        rec[0] += 1
+        rec[1] += size_bytes
+        if duration_s is not None:
+            rec[2].append(duration_s)
+        if self.verbose:
+            logger.info(f"comm op: {op_name} | axis: {axis_name} | msg size: {size_bytes}")
+
+    def append(self, record_name: str, latency: float, msg_size: int):
+        """Direct record with measured latency (host-level collectives).
+        Parity: ``CommsLogger.append`` (comms_logging.py)."""
+        self.record(record_name, msg_size, duration_s=latency)
+
+    def log_summary(self, show_straggler: bool = False, world_size: Optional[int] = None):
+        if world_size is None:
+            try:
+                from deepspeed_tpu.comm.mesh import get_topology
+                world_size = get_topology().world_size
+            except Exception:
+                world_size = 1
+        lines = [f"{'Op':<28}{'MsgSize':>14}{'Count':>8}{'TotalBytes':>16}{'AvgLat(ms)':>12}"
+                 f"{'algbw(GB/s)':>12}{'busbw(GB/s)':>12}"]
+        for op, by_size in sorted(self.comms_dict.items()):
+            for size, (count, total, lats) in sorted(by_size.items()):
+                if lats:
+                    avg = sum(lats) / len(lats)
+                    _, algbw, busbw = calc_bw_log(op, size, avg, world_size)
+                    lines.append(f"{op:<28}{size:>14}{count:>8}{total:>16}{avg*1e3:>12.3f}"
+                                 f"{algbw:>12.2f}{busbw:>12.2f}")
+                else:
+                    lines.append(f"{op:<28}{size:>14}{count:>8}{total:>16}{'n/a':>12}{'n/a':>12}{'n/a':>12}")
+        log_dist("\n".join(lines), ranks=[0])
+
+    def reset(self):
+        self.comms_dict.clear()
+
+
+_LOGGER: Optional[CommsLogger] = None
+
+
+def get_comms_logger() -> CommsLogger:
+    global _LOGGER
+    if _LOGGER is None:
+        _LOGGER = CommsLogger()
+    return _LOGGER

@@ -1,0 +1,70 @@
+"""Config keys and defaults for the deepspeed_tpu config tree.
+
+Capability parity with the reference's ``deepspeed/runtime/constants.py`` (453 LoC of
+string keys): we keep the same JSON key spellings so a DeepSpeed-style config dict can
+be consumed unchanged, while the typed tree itself lives in ``deepspeed_tpu/config.py``.
+"""
+
+#############################################
+# Batch / schedule
+#############################################
+TRAIN_BATCH_SIZE = "train_batch_size"
+TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
+GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
+STEPS_PER_PRINT = "steps_per_print"
+STEPS_PER_PRINT_DEFAULT = 10
+
+#############################################
+# Optimizer / scheduler
+#############################################
+OPTIMIZER = "optimizer"
+OPTIMIZER_TYPE = "type"
+OPTIMIZER_PARAMS = "params"
+SCHEDULER = "scheduler"
+MAX_GRAD_NORM = "max_grad_norm"
+GRADIENT_CLIPPING = "gradient_clipping"
+GRADIENT_CLIPPING_DEFAULT = 0.0
+PRESCALE_GRADIENTS = "prescale_gradients"
+GRADIENT_PREDIVIDE_FACTOR = "gradient_predivide_factor"
+
+#############################################
+# Precision
+#############################################
+FP16 = "fp16"
+BF16 = "bf16"
+FP32 = "fp32"
+
+#############################################
+# ZeRO
+#############################################
+ZERO_OPTIMIZATION = "zero_optimization"
+
+#############################################
+# Sub-systems
+#############################################
+ACTIVATION_CHECKPOINTING = "activation_checkpointing"
+COMMS_LOGGER = "comms_logger"
+MONITOR_TENSORBOARD = "tensorboard"
+MONITOR_WANDB = "wandb"
+MONITOR_CSV = "csv_monitor"
+FLOPS_PROFILER = "flops_profiler"
+AUTOTUNING = "autotuning"
+ELASTICITY = "elasticity"
+COMPRESSION_TRAINING = "compression_training"
+DATA_EFFICIENCY = "data_efficiency"
+CURRICULUM_LEARNING_LEGACY = "curriculum_learning"
+WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
+MEMORY_BREAKDOWN = "memory_breakdown"
+DUMP_STATE = "dump_state"
+
+#############################################
+# TPU-specific (no reference analog: mesh geometry replaces process groups)
+#############################################
+MESH = "mesh"
+
+#############################################
+# Routing / misc defaults
+#############################################
+GRADIENT_ACCUMULATION_STEPS_DEFAULT = None
+TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT = None
+TRAIN_BATCH_SIZE_DEFAULT = None

@@ -1,0 +1,23 @@
+from deepspeed_tpu.utils.logging import logger, log_dist, print_rank_0, warning_once
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+from deepspeed_tpu.utils.tree import (
+    tree_size_bytes,
+    tree_param_count,
+    global_norm,
+    tree_cast,
+    tree_zeros_like,
+)
+
+__all__ = [
+    "logger",
+    "log_dist",
+    "print_rank_0",
+    "warning_once",
+    "SynchronizedWallClockTimer",
+    "ThroughputTimer",
+    "tree_size_bytes",
+    "tree_param_count",
+    "global_norm",
+    "tree_cast",
+    "tree_zeros_like",
+]

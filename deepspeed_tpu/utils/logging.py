@@ -1,0 +1,70 @@
+"""Rank-aware logging. Parity: reference ``deepspeed/utils/logging.py``
+(``logger``, ``log_dist``, ``LoggerFactory``)."""
+
+import functools
+import logging
+import os
+import sys
+from typing import List, Optional
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class LoggerFactory:
+
+    @staticmethod
+    def create_logger(name: str = "DeepSpeedTPU", level=logging.INFO) -> logging.Logger:
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(filename)s:%(lineno)d:%(funcName)s] %(message)s")
+        lg = logging.getLogger(name)
+        lg.setLevel(level)
+        lg.propagate = False
+        if not lg.handlers:
+            handler = logging.StreamHandler(stream=sys.stdout)
+            handler.setLevel(level)
+            handler.setFormatter(formatter)
+            lg.addHandler(handler)
+        return lg
+
+
+logger = LoggerFactory.create_logger(
+    level=log_levels.get(os.environ.get("DSTPU_LOG_LEVEL", "info").lower(), logging.INFO))
+
+
+def _process_index() -> int:
+    # Avoid importing jax at module import time; jax.process_index() needs backend init.
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("JAX_PROCESS_INDEX", os.environ.get("RANK", "0")))
+
+
+@functools.lru_cache(None)
+def _warn_once(msg: str):
+    logger.warning(msg)
+
+
+def warning_once(msg: str):
+    _warn_once(msg)
+
+
+def log_dist(message: str, ranks: Optional[List[int]] = None, level: int = logging.INFO):
+    """Log only on the given process indices (None or [-1] -> all).
+
+    Parity: ``deepspeed/utils/logging.py log_dist``, with jax.process_index()
+    replacing torch.distributed.get_rank()."""
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def print_rank_0(message: str):
+    if _process_index() == 0:
+        logger.info(message)

@@ -1,0 +1,138 @@
+"""Wall-clock and throughput timers.
+
+Parity: reference ``deepspeed/utils/timer.py`` (``SynchronizedWallClockTimer``,
+``ThroughputTimer``). On TPU, "synchronized" means blocking on device arrays
+(``jax.block_until_ready``) instead of CUDA events.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+
+def _sync(obj: Any = None):
+    if obj is not None:
+        try:
+            import jax
+            jax.block_until_ready(obj)
+        except Exception:
+            pass
+
+
+class Timer:
+
+    def __init__(self, name: str):
+        self.name = name
+        self.started = False
+        self._start = 0.0
+        self._elapsed = 0.0
+        self._record: List[float] = []
+
+    def start(self, sync_obj: Any = None):
+        _sync(sync_obj)
+        self._start = time.time()
+        self.started = True
+
+    def stop(self, record: bool = True, sync_obj: Any = None):
+        if not self.started:
+            return
+        _sync(sync_obj)
+        dt = time.time() - self._start
+        self._elapsed += dt
+        if record:
+            self._record.append(dt)
+        self.started = False
+
+    def reset(self):
+        self.started = False
+        self._elapsed = 0.0
+        self._record.clear()
+
+    def elapsed(self, reset: bool = True) -> float:
+        now = time.time()
+        out = self._elapsed
+        if self.started:
+            out += now - self._start
+        if reset:
+            self._elapsed = 0.0
+            if self.started:
+                # restart the running interval so the reported span isn't re-counted
+                self._start = now
+        return out
+
+    def mean(self) -> float:
+        return sum(self._record) / max(1, len(self._record))
+
+
+class SynchronizedWallClockTimer:
+    """Group of named timers; log a breakdown line like the reference's
+    ``wall_clock_breakdown`` output."""
+
+    def __init__(self):
+        self.timers: Dict[str, Timer] = {}
+
+    def __call__(self, name: str) -> Timer:
+        if name not in self.timers:
+            self.timers[name] = Timer(name)
+        return self.timers[name]
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False, ranks: Optional[List[int]] = None):
+        assert normalizer > 0.0
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}")
+        if parts:
+            log_dist("time (ms) | " + " | ".join(parts), ranks=ranks or [0])
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0) -> Dict[str, float]:
+        return {n: self.timers[n].mean() * 1000.0 / normalizer for n in names if n in self.timers}
+
+
+class ThroughputTimer:
+    """Samples/sec + tokens/sec tracking. Parity: ``utils/timer.py ThroughputTimer``."""
+
+    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50,
+                 monitor_memory: bool = False, logging_fn=None):
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.total_elapsed_time = 0.0
+        self.step_count = 0
+        self.started = False
+        self._start = 0.0
+        self.logging = logging_fn or (lambda msg: log_dist(msg, ranks=[0]))
+
+    def start(self):
+        self._start = time.time()
+        self.started = True
+
+    def stop(self, global_step: bool = True, report_speed: bool = True, sync_obj: Any = None):
+        if not self.started:
+            return
+        self.started = False
+        if global_step:
+            self.step_count += 1
+        if self.step_count > self.start_step:
+            _sync(sync_obj)
+            self.total_elapsed_time += time.time() - self._start
+            if report_speed and self.steps_per_output and self.step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"step={self.step_count}, samples/sec={self.avg_samples_per_sec():.2f}")
+
+    def avg_samples_per_sec(self) -> float:
+        if self.total_elapsed_time <= 0 or self.step_count <= self.start_step:
+            return 0.0
+        return (self.step_count - self.start_step) * self.batch_size / self.total_elapsed_time

@@ -1,0 +1,67 @@
+"""Pytree utilities: sizes, norms, casting.
+
+Parity: reference ``deepspeed/runtime/utils.py`` helpers (``get_global_norm``,
+``clip_grad_norm_``, flatten/unflatten) — on TPU these are pytree one-liners that XLA
+fuses, so no native flatten op is needed (reference ``csrc/utils/flatten_unflatten.cpp``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_param_count(tree: Any) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "size"))
+
+
+def tree_size_bytes(tree: Any) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(tree)
+               if hasattr(x, "size") and hasattr(x, "dtype"))
+
+
+def global_norm(tree: Any) -> jax.Array:
+    """L2 norm over all leaves, computed in fp32.
+
+    Parity: ``get_global_norm`` / ``clip_grad_norm_`` (``runtime/utils.py``); the TP
+    awareness of the reference (avoiding double counting replicated params) is not
+    needed under jit: grads live once per logical tensor in SPMD.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(sum(jnp.sum(jnp.asarray(x, jnp.float32) ** 2) for x in leaves))
+
+
+def clip_by_global_norm(tree: Any, max_norm: float, norm: Optional[jax.Array] = None):
+    """Scale the tree so its global norm is <= max_norm. Returns (tree, norm)."""
+    if norm is None:
+        norm = global_norm(tree)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda x: (x * scale).astype(x.dtype), tree), norm
+
+
+def tree_cast(tree: Any, dtype) -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x, tree)
+
+
+def tree_zeros_like(tree: Any, dtype=None) -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: jnp.zeros(x.shape, dtype or x.dtype), tree)
+
+
+def see_memory_usage(message: str, force: bool = False):
+    """Parity: ``runtime/utils.py see_memory_usage``; reports per-device HBM stats."""
+    if not force:
+        return
+    from deepspeed_tpu.utils.logging import logger
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        in_use = stats.get("bytes_in_use", 0) / 2**30
+        limit = stats.get("bytes_limit", 0) / 2**30
+        logger.info(f"{message} | HBM in use {in_use:.2f} GB / {limit:.2f} GB")
+    except Exception:
+        logger.info(f"{message} | memory stats unavailable on this backend")

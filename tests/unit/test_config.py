@@ -1,0 +1,149 @@
+"""Config-system tests (parity: reference tests/unit/runtime/test_ds_config_dict.py)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.config import (
+    ConfigError,
+    DeepSpeedTPUConfig,
+    OffloadDeviceEnum,
+)
+
+
+def test_minimal_config():
+    cfg = DeepSpeedTPUConfig.load({"train_batch_size": 8})
+    assert cfg.train_batch_size == 8
+    assert cfg.zero_optimization.stage == 0
+    assert not cfg.bf16.enabled
+
+
+def test_full_deepspeed_style_config():
+    cfg = DeepSpeedTPUConfig.load({
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "steps_per_print": 100,
+        "gradient_clipping": 1.0,
+        "optimizer": {"type": "AdamW", "params": {"lr": "1e-4", "betas": [0.9, 0.95]}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 100}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {
+            "stage": 3,
+            "reduce_bucket_size": "5e8",
+            "stage3_prefetch_bucket_size": 5e7,
+            "offload_optimizer": {"device": "cpu", "pin_memory": True},
+        },
+        "wall_clock_breakdown": True,
+    })
+    assert cfg.optimizer.type == "AdamW"
+    assert cfg.optimizer.params["lr"] == "1e-4"  # optimizer params stay raw dicts
+    assert cfg.zero_optimization.stage == 3
+    assert cfg.zero_optimization.reduce_bucket_size == 500_000_000
+    assert cfg.zero_optimization.stage3_prefetch_bucket_size == 50_000_000
+    assert cfg.zero_optimization.offload_optimizer.device == OffloadDeviceEnum.cpu
+    assert cfg.bf16.enabled and not cfg.fp16.enabled
+
+
+def test_batch_resolution_two_of_three():
+    cfg = DeepSpeedTPUConfig.load({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2})
+    tb, mb, gas = cfg.resolve_batch(dp_world_size=4)
+    assert (tb, mb, gas) == (32, 2, 4)
+
+    cfg = DeepSpeedTPUConfig.load({"train_micro_batch_size_per_gpu": 2,
+                                   "gradient_accumulation_steps": 3})
+    tb, mb, gas = cfg.resolve_batch(dp_world_size=4)
+    assert (tb, mb, gas) == (24, 2, 3)
+
+
+def test_batch_resolution_inconsistent_raises():
+    cfg = DeepSpeedTPUConfig.load({
+        "train_batch_size": 32, "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2})
+    with pytest.raises(ConfigError):
+        cfg.resolve_batch(dp_world_size=4)  # 2*2*4 != 32
+
+
+def test_batch_resolution_none_raises():
+    cfg = DeepSpeedTPUConfig.load({})
+    with pytest.raises(ConfigError):
+        cfg.resolve_batch(dp_world_size=2)
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(ConfigError):
+        DeepSpeedTPUConfig.load({"train_batch_size": 4, "bf16": {"enabled": True},
+                                 "fp16": {"enabled": True}})
+
+
+def test_zero_stage_bounds():
+    with pytest.raises(ConfigError):
+        DeepSpeedTPUConfig.load({"train_batch_size": 4, "zero_optimization": {"stage": 4}})
+
+
+def test_deprecated_alias_migration():
+    cfg = DeepSpeedTPUConfig.load({
+        "train_batch_size": 4,
+        "zero_optimization": {"stage": 3,
+                              "stage3_gather_fp16_weights_on_model_save": True}})
+    assert cfg.zero_optimization.stage3_gather_16bit_weights_on_model_save
+
+
+def test_json_file_load(tmp_path):
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps({"train_batch_size": 8, "bf16": {"enabled": True}}))
+    cfg = DeepSpeedTPUConfig.load(str(p))
+    assert cfg.train_batch_size == 8 and cfg.bf16.enabled
+
+
+def test_unknown_keys_ignored_with_warning():
+    cfg = DeepSpeedTPUConfig.load({"train_batch_size": 8, "no_such_key": 1,
+                                   "zero_optimization": {"bogus": True}})
+    assert cfg.train_batch_size == 8
+
+
+def test_mesh_resolution():
+    cfg = DeepSpeedTPUConfig.load({"train_batch_size": 8,
+                                   "mesh": {"fsdp": 4, "tensor": 2}})
+    sizes = cfg.mesh.resolve(8)
+    assert sizes == {"pipe": 1, "data": 1, "fsdp": 4, "expert": 1, "seq": 1, "tensor": 2}
+
+
+def test_mesh_bad_product():
+    cfg = DeepSpeedTPUConfig.load({"train_batch_size": 8, "mesh": {"fsdp": 3, "data": 1}})
+    with pytest.raises(ConfigError):
+        cfg.mesh.resolve(8)
+
+
+def test_to_dict_roundtrip():
+    src = {"train_batch_size": 8, "bf16": {"enabled": True},
+           "zero_optimization": {"stage": 2}}
+    cfg = DeepSpeedTPUConfig.load(src)
+    d = cfg.to_dict()
+    assert d["train_batch_size"] == 8
+    assert d["bf16"]["enabled"] is True
+    assert d["zero_optimization"]["stage"] == 2
+    # roundtrips through load again
+    cfg2 = DeepSpeedTPUConfig.load(d)
+    assert cfg2.zero_optimization.stage == 2
+
+
+def test_legacy_bool_cpu_offload_migration():
+    cfg = DeepSpeedTPUConfig.load({
+        "train_batch_size": 4,
+        "zero_optimization": {"stage": 2, "cpu_offload": True}})
+    assert cfg.zero_optimization.offload_optimizer.device == OffloadDeviceEnum.cpu
+    cfg = DeepSpeedTPUConfig.load({
+        "train_batch_size": 4,
+        "zero_optimization": {"stage": 2, "cpu_offload": False}})
+    assert cfg.zero_optimization.offload_optimizer is None
+
+
+def test_legacy_fp16_enabled_migration():
+    cfg = DeepSpeedTPUConfig.load({"train_batch_size": 4, "fp16_enabled": True})
+    assert cfg.fp16.enabled
+
+
+def test_bad_numeric_string_raises_config_error():
+    with pytest.raises(ConfigError, match="train_batch_size"):
+        DeepSpeedTPUConfig.load({"train_batch_size": "abc"})
