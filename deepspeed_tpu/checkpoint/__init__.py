@@ -1,0 +1,9 @@
+"""Checkpointing (parity: reference ``deepspeed/checkpoint/`` + engine save/load)."""
+
+from deepspeed_tpu.checkpoint.state import (
+    save_engine_checkpoint,
+    load_engine_checkpoint,
+    read_latest_tag,
+    flatten_tree,
+    unflatten_into,
+)

@@ -1,0 +1,150 @@
+"""Engine checkpoint save/load.
+
+Parity: ``DeepSpeedEngine.save_checkpoint`` / ``load_checkpoint``
+(reference ``runtime/engine.py:3028/2679``): tagged directories under the save dir,
+a ``latest`` tag file, model states and optimizer states in separate files.
+
+TPU-native difference that *simplifies* elasticity: the reference saves per-rank
+shard files (``zero_pp_rank_X_mp_rank_XX_optim_states.pt``) and needs merge logic to
+resize dp (``_get_all_zero_checkpoints`` engine.py:2998) plus an offline universal
+converter; here every tensor is a logical (global) jax Array, so ``jax.device_get``
+assembles the full value and any mesh/world-size can reload it — dp-resize,
+stage-change and mesh-change resume come for free. (Per-shard distributed writes for
+multi-host scale live in ``deepspeed_tpu.checkpoint.sharded``.)
+
+Layout::
+
+    save_dir/
+      latest                      <- text file holding the newest tag
+      <tag>/
+        model_states.npz          <- master fp32 params, '/'-joined key paths
+        optim_states.npz          <- optimizer moments + step + loss-scale state
+        client_state.json         <- counters + user dict
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+MODEL_FILE = "model_states.npz"
+OPTIM_FILE = "optim_states.npz"
+CLIENT_FILE = "client_state.json"
+LATEST = "latest"
+
+_SEP = "/"
+
+
+def flatten_tree(tree: Any, prefix: str = "") -> Dict[str, Any]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = _SEP.join(_path_str(p) for p in path)
+        flat[prefix + key] = leaf
+    return flat
+
+
+def _path_str(entry) -> str:
+    if hasattr(entry, "key"):
+        return str(entry.key)
+    if hasattr(entry, "idx"):
+        return str(entry.idx)
+    if hasattr(entry, "name"):
+        return str(entry.name)
+    return str(entry)
+
+
+def unflatten_into(template: Any, flat: Dict[str, np.ndarray], prefix: str = "") -> Any:
+    """Rebuild a tree congruent with ``template`` from flat key -> array."""
+    paths = jax.tree_util.tree_flatten_with_path(template)[0]
+    treedef = jax.tree_util.tree_structure(template)
+    leaves = []
+    for path, leaf in paths:
+        key = prefix + _SEP.join(_path_str(p) for p in path)
+        if key not in flat:
+            raise KeyError(f"checkpoint missing tensor '{key}'")
+        arr = flat[key]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ValueError(f"checkpoint tensor '{key}' shape {arr.shape} != "
+                             f"expected {np.shape(leaf)}")
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def save_engine_checkpoint(save_dir: str, tag: str, state: Dict[str, Any],
+                           client_state: Dict[str, Any], save_latest: bool = True):
+    ckpt_dir = os.path.join(save_dir, tag)
+    os.makedirs(ckpt_dir, exist_ok=True)
+
+    model_flat = {k: np.asarray(jax.device_get(v))
+                  for k, v in flatten_tree(state["master"]).items()}
+    np.savez(os.path.join(ckpt_dir, MODEL_FILE), **model_flat)
+
+    optim_state = {"opt": state["opt"], "step": state["step"],
+                   "scaler": state["scaler"], "skipped": state["skipped"]}
+    optim_flat = {k: np.asarray(jax.device_get(v))
+                  for k, v in flatten_tree(optim_state).items()}
+    np.savez(os.path.join(ckpt_dir, OPTIM_FILE), **optim_flat)
+
+    with open(os.path.join(ckpt_dir, CLIENT_FILE), "w") as f:
+        json.dump(client_state, f, indent=2, default=str)
+
+    if save_latest:
+        with open(os.path.join(save_dir, LATEST), "w") as f:
+            f.write(tag)
+    log_dist(f"saved checkpoint {ckpt_dir}", ranks=[0])
+
+
+def read_latest_tag(load_dir: str) -> Optional[str]:
+    p = os.path.join(load_dir, LATEST)
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return f.read().strip()
+
+
+def load_engine_checkpoint(load_dir: str, tag: Optional[str], state: Dict[str, Any],
+                           shardings: Dict[str, Any],
+                           load_optimizer_states: bool = True,
+                           load_module_only: bool = False
+                           ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    tag = tag or read_latest_tag(load_dir)
+    if tag is None:
+        raise FileNotFoundError(f"no 'latest' file in {load_dir}; pass an explicit tag")
+    ckpt_dir = os.path.join(load_dir, tag)
+
+    model_flat = dict(np.load(os.path.join(ckpt_dir, MODEL_FILE)))
+    master = unflatten_into(state["master"], model_flat)
+    new_state = dict(state)
+    new_state["master"] = jax.device_put(master, shardings["master"])
+
+    if load_optimizer_states and not load_module_only:
+        optim_flat = dict(np.load(os.path.join(ckpt_dir, OPTIM_FILE)))
+        optim_template = {"opt": state["opt"], "step": state["step"],
+                          "scaler": state["scaler"], "skipped": state["skipped"]}
+        optim = unflatten_into(optim_template, optim_flat)
+        new_state["opt"] = jax.device_put(optim["opt"], shardings["opt"])
+        new_state["step"] = jax.device_put(optim["step"], shardings["step"])
+        new_state["scaler"] = jax.device_put(optim["scaler"], shardings["scaler"])
+        new_state["skipped"] = jax.device_put(optim["skipped"], shardings["skipped"])
+
+    if "params" in state:
+        # recompute compute-dtype params from the loaded master
+        dtype = jax.tree_util.tree_leaves(state["params"])[0].dtype
+        from deepspeed_tpu.utils.tree import tree_cast
+        new_state["params"] = jax.jit(
+            lambda m: tree_cast(m, dtype),
+            out_shardings=shardings["params"])(new_state["master"])
+
+    client_path = os.path.join(ckpt_dir, CLIENT_FILE)
+    client_state = {}
+    if os.path.exists(client_path):
+        with open(client_path) as f:
+            client_state = json.load(f)
+    log_dist(f"loaded checkpoint {ckpt_dir}", ranks=[0])
+    return new_state, client_state

@@ -1,0 +1,3 @@
+"""Model zoo for the BASELINE config ladder (gpt2, bert, llama, mixtral, neox)."""
+
+from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead

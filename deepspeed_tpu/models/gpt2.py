@@ -1,0 +1,123 @@
+"""GPT-2 in flax.linen (BASELINE ladder config #1).
+
+The reference has no in-repo GPT-2 (it trains HF/Megatron models through the
+engine); this model zoo exists so the framework is runnable end-to-end standalone,
+like the reference's ``tests/unit/simple_model.py`` fixtures but production-shaped.
+Design: pre-LN transformer, learned positions, causal attention routed through
+``deepspeed_tpu.ops.attention`` (jnp today, Pallas flash-attention when available).
+
+The module maps a batch (dict with ``input_ids`` [B, T] and optional ``labels``)
+to the mean next-token cross-entropy — matching the engine convention that
+``model.apply(params, batch)`` returns the loss.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.attention import dot_product_attention
+
+
+@dataclass
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+    # activation checkpointing (parity: reference
+    # runtime/activation_checkpointing/checkpointing.py; on TPU = jax.checkpoint
+    # around each block, letting XLA re-materialise instead of storing activations)
+    remat: bool = False
+
+    @classmethod
+    def small(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def tiny(cls, **kw):
+        """Test-sized config (fixture-model analog of tests/unit/simple_model.py)."""
+        defaults = dict(vocab_size=256, n_positions=128, n_embd=64, n_layer=2, n_head=4)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+class CausalSelfAttention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        B, T, C = x.shape
+        qkv = nn.Dense(3 * cfg.n_embd, dtype=cfg.dtype, name="c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        heads = lambda t: t.reshape(B, T, cfg.n_head, C // cfg.n_head)
+        out = dot_product_attention(heads(q), heads(k), heads(v), causal=True)
+        out = out.reshape(B, T, C)
+        return nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="c_proj")(out)
+
+
+class MLP(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.Dense(cfg.mlp_ratio * cfg.n_embd, dtype=cfg.dtype, name="c_fc")(x)
+        h = nn.gelu(h)
+        return nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="c_proj")(h)
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        x = x + CausalSelfAttention(cfg, name="attn")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x), deterministic)
+        x = x + MLP(cfg, name="mlp")(nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x))
+        return x
+
+
+class GPT2LMHead(nn.Module):
+    """Returns loss when batch has labels (or from shifted input_ids), else logits."""
+
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, batch, deterministic: bool = True):
+        cfg = self.config
+        if isinstance(batch, dict):
+            input_ids = batch["input_ids"]
+            labels = batch.get("labels")
+        else:
+            input_ids, labels = batch, None
+        B, T = input_ids.shape
+        wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype, name="wte")
+        wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype, name="wpe")
+        x = wte(input_ids) + wpe(jnp.arange(T)[None, :])
+        block_cls = nn.remat(Block, static_argnums=(2,)) if cfg.remat else Block
+        for i in range(cfg.n_layer):
+            x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        logits = wte.attend(x.astype(jnp.float32))  # tied LM head, fp32 logits
+
+        if labels is None and isinstance(batch, dict) and "input_ids" in batch:
+            labels = input_ids  # LM objective: predict next token of the same ids
+        if labels is None:
+            return logits
+        # shift: predict token t+1 from position t
+        logits_s = logits[:, :-1, :]
+        labels_s = labels[:, 1:]
+        logp = jax.nn.log_softmax(logits_s, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels_s[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)

@@ -1,0 +1,53 @@
+"""Adagrad (host-offloadable).
+
+Parity: ``DeepSpeedCPUAdagrad`` (reference ``deepspeed/ops/adagrad/cpu_adagrad.py``,
+``csrc/adagrad/cpu_adagrad.cpp``): sum-of-squares accumulator, used with
+ZeRO-Offload for sparse-ish embedding-heavy models.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optimizer import TPUOptimizer
+
+
+class DeepSpeedCPUAdagrad(TPUOptimizer):
+
+    def __init__(self, lr: float = 1e-2, eps: float = 1e-10, weight_decay: float = 0.0):
+        super().__init__(lr=lr)
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.host_offload = True
+
+    def init(self, params: Any) -> Dict[str, Any]:
+        return {"step": jnp.zeros((), jnp.int32),
+                "exp_avg_sq": jax.tree_util.tree_map(
+                    lambda x: jnp.zeros(x.shape, jnp.float32), params)}
+
+    def update(self, grads, state, params, lr: Optional[jax.Array] = None):
+        lr = self.lr if lr is None else lr
+
+        def upd(p, g, ss):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if self.weight_decay > 0.0:
+                g = g + self.weight_decay * p32
+            ss = ss + g * g
+            new_p = p32 - lr * g / (jnp.sqrt(ss) + self.eps)
+            return new_p.astype(p.dtype), ss, ss
+
+        mapped = jax.tree_util.tree_map(upd, params, grads, state["exp_avg_sq"])
+        new_params, new_ss, _ = self._split3(mapped)
+        return new_params, {"step": state["step"] + 1, "exp_avg_sq": new_ss}
+
+
+class Adagrad(DeepSpeedCPUAdagrad):
+    """Device-resident Adagrad."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.host_offload = False

@@ -1,0 +1,60 @@
+"""Attention dispatch: jnp reference implementation + Pallas flash kernel routing.
+
+Parity role: the reference's fused attention kernels (``csrc/transformer/inference``
+softmax/attention ops, blocked flash in ``inference/v2/kernels/ragged_ops``) — on
+TPU the training fast path is a Pallas flash-attention kernel (``ops/pallas/
+flash_attention.py``) with this jnp fallback for CPU tests and odd shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _use_pallas() -> bool:
+    if os.environ.get("DSTPU_DISABLE_PALLAS"):
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                          causal: bool = False,
+                          bias: Optional[jax.Array] = None,
+                          segment_ids: Optional[jax.Array] = None,
+                          softmax_scale: Optional[float] = None) -> jax.Array:
+    """[B, T, H, D] attention. Routes to the Pallas flash kernel on TPU."""
+    if _use_pallas() and bias is None:
+        try:
+            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+            return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                                   softmax_scale=softmax_scale)
+        except Exception:  # pragma: no cover - fall back if kernel unavailable
+            pass
+    return reference_attention(q, k, v, causal=causal, bias=bias,
+                               segment_ids=segment_ids, softmax_scale=softmax_scale)
+
+
+def reference_attention(q, k, v, causal=False, bias=None, segment_ids=None,
+                        softmax_scale=None):
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        scores = scores + bias
+    if causal:
+        mask = jnp.tril(jnp.ones((Tq, Tk), dtype=bool), k=Tk - Tq)
+        scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        scores = jnp.where(seg_mask[:, None], scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

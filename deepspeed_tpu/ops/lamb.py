@@ -1,0 +1,68 @@
+"""Fused LAMB (layer-wise adaptive moments with trust ratio).
+
+Parity: ``FusedLamb`` (reference ``deepspeed/ops/lamb/fused_lamb.py``, CUDA
+``csrc/lamb/fused_lamb_cuda_kernel.cu``): Adam moments + per-tensor trust ratio
+``||p|| / ||update||`` scaling the step, with max_coeff/min_coeff clamps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optimizer import TPUOptimizer
+
+
+class FusedLamb(TPUOptimizer):
+
+    def __init__(self, lr: float = 1e-3, bias_correction: bool = True,
+                 betas: Tuple[float, float] = (0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, max_grad_norm: float = 0.0,
+                 max_coeff: float = 10.0, min_coeff: float = 0.01):
+        super().__init__(lr=lr)
+        self.bias_correction = bias_correction
+        self.betas = tuple(betas)
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.max_grad_norm = max_grad_norm
+        self.max_coeff = max_coeff
+        self.min_coeff = min_coeff
+
+    def init(self, params: Any) -> Dict[str, Any]:
+        zeros = lambda t: jax.tree_util.tree_map(lambda x: jnp.zeros(x.shape, jnp.float32), t)
+        return {"step": jnp.zeros((), jnp.int32), "exp_avg": zeros(params),
+                "exp_avg_sq": zeros(params)}
+
+    def update(self, grads, state, params, lr: Optional[jax.Array] = None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        if self.max_grad_norm > 0.0:
+            from deepspeed_tpu.utils.tree import clip_by_global_norm
+            grads, _ = clip_by_global_norm(grads, self.max_grad_norm)
+        step = state["step"] + 1
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** step.astype(jnp.float32)
+            bc2 = 1.0 - b2 ** step.astype(jnp.float32)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        def upd(p, g, m, v):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * (g * g)
+            upd_dir = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps) + self.weight_decay * p32
+            p_norm = jnp.linalg.norm(p32.reshape(-1))
+            u_norm = jnp.linalg.norm(upd_dir.reshape(-1))
+            trust = jnp.where(u_norm > 0.0, p_norm / jnp.maximum(u_norm, 1e-12), 1.0)
+            trust = jnp.where(p_norm > 0.0, trust, 1.0)
+            trust = jnp.clip(trust, self.min_coeff, self.max_coeff)
+            new_p = p32 - lr * trust * upd_dir
+            return new_p.astype(p.dtype), m, v
+
+        mapped = jax.tree_util.tree_map(upd, params, grads, state["exp_avg"],
+                                        state["exp_avg_sq"])
+        new_params, new_m, new_v = self._split3(mapped)
+        return new_params, {"step": step, "exp_avg": new_m, "exp_avg_sq": new_v}

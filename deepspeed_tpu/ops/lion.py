@@ -1,0 +1,53 @@
+"""Fused Lion (evolved sign momentum).
+
+Parity: ``FusedLion`` / ``DeepSpeedCPULion`` (reference ``deepspeed/ops/lion/``,
+``csrc/lion/``): update = sign(b1*m + (1-b1)*g), momentum = b2*m + (1-b2)*g,
+decoupled weight decay.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optimizer import TPUOptimizer
+
+
+class FusedLion(TPUOptimizer):
+
+    def __init__(self, lr: float = 1e-4, betas: Tuple[float, float] = (0.9, 0.99),
+                 weight_decay: float = 0.0):
+        super().__init__(lr=lr)
+        self.betas = tuple(betas)
+        self.weight_decay = weight_decay
+
+    def init(self, params: Any) -> Dict[str, Any]:
+        return {"step": jnp.zeros((), jnp.int32),
+                "exp_avg": jax.tree_util.tree_map(
+                    lambda x: jnp.zeros(x.shape, jnp.float32), params)}
+
+    def update(self, grads, state, params, lr: Optional[jax.Array] = None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+
+        def upd(p, g, m):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            direction = jnp.sign(b1 * m + (1.0 - b1) * g)
+            new_p = p32 * (1.0 - lr * self.weight_decay) - lr * direction
+            new_m = b2 * m + (1.0 - b2) * g
+            return new_p.astype(p.dtype), new_m, new_m  # third slot unused
+
+        mapped = jax.tree_util.tree_map(upd, params, grads, state["exp_avg"])
+        new_params, new_m, _ = self._split3(mapped)
+        return new_params, {"step": state["step"] + 1, "exp_avg": new_m}
+
+
+class DeepSpeedCPULion(FusedLion):
+    """Host-offloaded Lion (parity: ``deepspeed/ops/lion/cpu_lion.py``)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.host_offload = True

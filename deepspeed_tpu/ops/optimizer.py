@@ -1,0 +1,61 @@
+"""Optimizer base protocol.
+
+The TPU analog of the reference's optimizer zoo (``deepspeed/ops/{adam,lamb,lion,
+adagrad}``): each optimizer is a pure, jittable (init, update) pair over the fp32
+master pytree. ``update`` returns *new params* directly (not an optax delta) because
+the engine owns the master-weight flow: grads (any dtype) -> fp32 master update ->
+cast back to compute dtype. All state lives in a plain dict with torch-style key
+names so checkpoints align with the reference layout.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+
+class TPUOptimizer:
+
+    def __init__(self, lr: float = 1e-3):
+        self.lr = lr
+        self.host_offload = False
+
+    # -- jittable ------------------------------------------------------- #
+
+    def init(self, params: Any) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def update(self, grads: Any, state: Dict[str, Any], params: Any,
+               lr: Optional[jax.Array] = None) -> Tuple[Any, Dict[str, Any]]:
+        """Return (new_params, new_state); lr overrides the static default."""
+        raise NotImplementedError
+
+    # -- helpers -------------------------------------------------------- #
+
+    @staticmethod
+    def _split3(mapped_tree: Any) -> Tuple[Any, Any, Any]:
+        is_tup = lambda t: isinstance(t, tuple)
+        return tuple(
+            jax.tree_util.tree_map(lambda t, i=i: t[i], mapped_tree, is_leaf=is_tup)
+            for i in range(3))
+
+
+class OptaxWrapper(TPUOptimizer):
+    """Adapt any ``optax.GradientTransformation`` to the engine's optimizer protocol,
+    so users can pass client optimizers the way the reference accepts a
+    ``torch.optim.Optimizer`` (``deepspeed.initialize(optimizer=...)``)."""
+
+    def __init__(self, tx, lr: float = 0.0):
+        super().__init__(lr=lr)
+        self.tx = tx
+
+    def init(self, params):
+        return {"optax": self.tx.init(params)}
+
+    def update(self, grads, state, params, lr=None):
+        # Note: lr is baked into the optax transformation; the `lr` arg is ignored.
+        import optax
+        updates, new_inner = self.tx.update(grads, state["optax"], params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, {"optax": new_inner}

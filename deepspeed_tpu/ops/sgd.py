@@ -1,0 +1,54 @@
+"""SGD with momentum (the reference delegates to ``torch.optim.SGD``;
+engine parity requires a named 'sgd' optimizer in the registry)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.optimizer import TPUOptimizer
+
+
+class SGD(TPUOptimizer):
+
+    def __init__(self, lr: float = 1e-2, momentum: float = 0.0,
+                 weight_decay: float = 0.0, nesterov: bool = False):
+        super().__init__(lr=lr)
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+
+    def init(self, params: Any) -> Dict[str, Any]:
+        state: Dict[str, Any] = {"step": jnp.zeros((), jnp.int32)}
+        if self.momentum:
+            state["momentum_buffer"] = jax.tree_util.tree_map(
+                lambda x: jnp.zeros(x.shape, jnp.float32), params)
+        return state
+
+    def update(self, grads, state, params, lr: Optional[jax.Array] = None):
+        lr = self.lr if lr is None else lr
+
+        if not self.momentum:
+            def upd(p, g):
+                g = g.astype(jnp.float32)
+                p32 = p.astype(jnp.float32)
+                if self.weight_decay:
+                    g = g + self.weight_decay * p32
+                return (p32 - lr * g).astype(p.dtype)
+            new_params = jax.tree_util.tree_map(upd, params, grads)
+            return new_params, {"step": state["step"] + 1}
+
+        def updm(p, g, buf):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if self.weight_decay:
+                g = g + self.weight_decay * p32
+            buf = self.momentum * buf + g
+            step_dir = g + self.momentum * buf if self.nesterov else buf
+            return (p32 - lr * step_dir).astype(p.dtype), buf, buf
+
+        mapped = jax.tree_util.tree_map(updm, params, grads, state["momentum_buffer"])
+        new_params, new_buf, _ = self._split3(mapped)
+        return new_params, {"step": state["step"] + 1, "momentum_buffer": new_buf}

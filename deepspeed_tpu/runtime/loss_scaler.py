@@ -1,0 +1,79 @@
+"""fp16 loss scaling.
+
+Parity: reference ``deepspeed/runtime/fp16/loss_scaler.py`` (``LossScaler``,
+``DynamicLossScaler``) — here the scaler state is a small pytree living inside the
+jitted train step, updated with ``jnp.where`` instead of Python branches so skipped
+steps stay on-device (no host sync per step).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+MIN_LOSS_SCALE = "min_scale"
+
+
+def make_loss_scale_state(enabled: bool, static_scale: float = 0.0,
+                          initial_scale_power: int = 16,
+                          hysteresis: int = 2) -> Dict[str, Any]:
+    """Dynamic if static_scale == 0 (parity: fp16.loss_scale semantics).
+
+    ``hysteresis`` seeds the counter at the configured delayed_shift so the first
+    overflow is absorbed rather than backing off immediately (parity:
+    DynamicLossScaler.cur_hysteresis init)."""
+    if not enabled:
+        return {"scale": jnp.float32(1.0), "growth_tracker": jnp.int32(0),
+                "hysteresis": jnp.int32(hysteresis), "dynamic": False}
+    scale = static_scale if static_scale > 0 else float(2 ** initial_scale_power)
+    return {"scale": jnp.float32(scale), "growth_tracker": jnp.int32(0),
+            "hysteresis": jnp.int32(hysteresis), "dynamic": static_scale == 0}
+
+
+def update_loss_scale(state: Dict[str, Any], overflow: jax.Array,
+                      loss_scale_window: int = 1000, hysteresis: int = 2,
+                      min_loss_scale: float = 1.0,
+                      scale_factor: float = 2.0) -> Dict[str, Any]:
+    """One DynamicLossScaler.update_scale step, branch-free.
+
+    Parity: ``DynamicLossScaler.update_scale`` (loss_scaler.py): on overflow consume
+    hysteresis, then halve (not below min); after `loss_scale_window` clean steps,
+    double and reset the tracker.
+    """
+    if not state.get("dynamic", True):
+        return state
+    scale = state["scale"]
+    tracker = state["growth_tracker"]
+    hyst = state["hysteresis"]
+
+    # overflow path
+    new_hyst = jnp.where(overflow, jnp.maximum(hyst - 1, 0), jnp.int32(hysteresis))
+    do_backoff = overflow & (hyst <= 1)
+    scale_after_overflow = jnp.maximum(scale / scale_factor, min_loss_scale)
+
+    # clean path
+    new_tracker = jnp.where(overflow, 0, tracker + 1)
+    do_growth = (~overflow) & (new_tracker >= loss_scale_window)
+    new_scale = jnp.where(do_backoff, scale_after_overflow,
+                          jnp.where(do_growth, scale * scale_factor, scale))
+    new_tracker = jnp.where(do_growth, 0, new_tracker)
+    return {"scale": new_scale, "growth_tracker": new_tracker,
+            "hysteresis": new_hyst, "dynamic": state["dynamic"]}
+
+
+def has_overflow(grads: Any) -> jax.Array:
+    """Global non-finite scan. Parity: ``CheckOverflow`` (runtime/utils.py) — under
+    SPMD the any() is already global, no serialized multi-rank check needed."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.bool_(False)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(x.astype(jnp.float32)))) for x in leaves]
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_or(out, f)
+    return out

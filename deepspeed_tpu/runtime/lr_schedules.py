@@ -1,0 +1,137 @@
+"""Learning-rate schedules.
+
+Parity: reference ``deepspeed/runtime/lr_schedules.py`` — the same registry names
+(``LRRangeTest``, ``OneCycle``, ``WarmupLR``, ``WarmupDecayLR``, ``WarmupCosineLR``)
+with the same parameter spellings, but each schedule is a pure jittable function of
+the step counter (a traced int32) so it lives inside the compiled train step instead
+of mutating optimizer param groups per step.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+LRSchedule = Callable[[Any], Any]  # step (int array) -> lr (float array)
+
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+WARMUP_COSINE_LR = "WarmupCosineLR"
+
+
+def _warmup_factor(step, warmup_num_steps: int, warmup_type: str):
+    t = jnp.clip(step.astype(jnp.float32) / max(1, warmup_num_steps), 0.0, 1.0)
+    if warmup_type == "log":
+        # parity: reference uses log warmup by default for WarmupLR
+        return jnp.where(t > 0, jnp.log1p(t * (math.e - 1.0)), 0.0)
+    return t
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000, warmup_type: str = "log",
+              last_batch_iteration: int = -1) -> LRSchedule:
+    """Parity: ``WarmupLR`` (lr_schedules.py:635): warm up then hold."""
+
+    def schedule(step):
+        f = _warmup_factor(step, warmup_num_steps, warmup_type)
+        return warmup_min_lr + f * (warmup_max_lr - warmup_min_lr)
+
+    return schedule
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                    warmup_type: str = "log", last_batch_iteration: int = -1) -> LRSchedule:
+    """Parity: ``WarmupDecayLR``: warmup then linear decay to 0 at total_num_steps."""
+
+    def schedule(step):
+        f = _warmup_factor(step, warmup_num_steps, warmup_type)
+        warm = warmup_min_lr + f * (warmup_max_lr - warmup_min_lr)
+        decay_span = max(1, total_num_steps - warmup_num_steps)
+        decay = jnp.clip(
+            (total_num_steps - step.astype(jnp.float32)) / decay_span, 0.0, 1.0)
+        return jnp.where(step < warmup_num_steps, warm, warmup_max_lr * decay)
+
+    return schedule
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_min_ratio: float = 0.0,
+                     warmup_num_steps: int = 1000, cos_min_ratio: float = 0.0001,
+                     warmup_type: str = "linear", lr: float = 0.001,
+                     last_batch_iteration: int = -1) -> LRSchedule:
+    """Parity: ``WarmupCosineLR``: ratio-based warmup then cosine to cos_min_ratio."""
+
+    def schedule(step):
+        f = _warmup_factor(step, warmup_num_steps, warmup_type)
+        warm_ratio = warmup_min_ratio + f * (1.0 - warmup_min_ratio)
+        span = max(1, total_num_steps - warmup_num_steps)
+        progress = jnp.clip((step.astype(jnp.float32) - warmup_num_steps) / span, 0.0, 1.0)
+        cos_ratio = cos_min_ratio + 0.5 * (1.0 - cos_min_ratio) * (1.0 + jnp.cos(jnp.pi * progress))
+        return lr * jnp.where(step < warmup_num_steps, warm_ratio, cos_ratio)
+
+    return schedule
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float, decay_lr_rate: float = 0.0,
+              cycle_first_step_size: int = 2000, cycle_second_step_size: Optional[int] = None,
+              cycle_first_stair_count: int = 0, cycle_second_stair_count: Optional[int] = None,
+              decay_step_size: int = 0, cycle_momentum: bool = True,
+              cycle_min_mom: float = 0.85, cycle_max_mom: float = 0.99,
+              decay_mom_rate: float = 0.0, last_batch_iteration: int = -1) -> LRSchedule:
+    """Parity: ``OneCycle`` (lr_schedules.py:403): triangular up, down, then decay.
+    (Momentum cycling is not applied — the fused optimizers take static betas.)"""
+    second = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+    total_cycle = cycle_first_step_size + second
+
+    def schedule(step):
+        s = step.astype(jnp.float32)
+        up = jnp.clip(s / cycle_first_step_size, 0.0, 1.0)
+        down = jnp.clip((s - cycle_first_step_size) / max(1, second), 0.0, 1.0)
+        in_cycle_lr = jnp.where(
+            s < cycle_first_step_size,
+            cycle_min_lr + up * (cycle_max_lr - cycle_min_lr),
+            cycle_max_lr - down * (cycle_max_lr - cycle_min_lr))
+        post = s - total_cycle
+        decay_steps = jnp.where(decay_step_size > 0,
+                                jnp.floor(post / max(1, decay_step_size)), post)
+        decayed = cycle_min_lr / (1.0 + decay_lr_rate * jnp.maximum(decay_steps, 0.0))
+        return jnp.where(s <= total_cycle, in_cycle_lr, decayed)
+
+    return schedule
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3, lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0, lr_range_test_staircase: bool = False,
+                  last_batch_iteration: int = -1) -> LRSchedule:
+    """Parity: ``LRRangeTest`` (lr_schedules.py:283): linearly/staircase increasing lr."""
+
+    def schedule(step):
+        s = step.astype(jnp.float32) / max(1, lr_range_test_step_size)
+        if lr_range_test_staircase:
+            s = jnp.floor(s)
+        return lr_range_test_min_lr * (1.0 + s * lr_range_test_step_rate)
+
+    return schedule
+
+
+SCHEDULE_REGISTRY: Dict[str, Callable[..., LRSchedule]] = {
+    WARMUP_LR: warmup_lr,
+    WARMUP_DECAY_LR: warmup_decay_lr,
+    WARMUP_COSINE_LR: warmup_cosine_lr,
+    ONE_CYCLE: one_cycle,
+    LR_RANGE_TEST: lr_range_test,
+}
+
+
+def build_lr_schedule(sched_type: Optional[str], params: Dict[str, Any],
+                      base_lr: float) -> LRSchedule:
+    """Build a schedule from the config ``scheduler`` block; None -> constant lr."""
+    if sched_type is None:
+        return lambda step: jnp.float32(base_lr)
+    if sched_type not in SCHEDULE_REGISTRY:
+        raise ValueError(f"unknown scheduler '{sched_type}'; known: {sorted(SCHEDULE_REGISTRY)}")
+    return SCHEDULE_REGISTRY[sched_type](**params)

@@ -1,0 +1,1 @@
+from deepspeed_tpu.runtime.zero.partition import ZeroPartitioner, shard_dim_for, xla_bucket_flags
