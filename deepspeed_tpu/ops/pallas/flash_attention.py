@@ -1,0 +1,336 @@
+"""Flash attention for TPU (Pallas).
+
+Role in the framework: the training-side fused attention kernel — the TPU
+replacement for the reference's CUDA attention stack (softmax/attention kernels in
+``csrc/transformer/inference`` and the CUTLASS blocked-flash wrapper in
+``inference/v2/kernels/ragged_ops/blocked_flash``). Online-softmax tiling (flash-2
+style): O(T) memory, statistics kept in VMEM scratch across the KV grid dimension.
+
+Supports: causal masking, packed-sequence ``segment_ids``, GQA (kv heads repeated in
+the wrapper), bf16/f32 inputs with f32 accumulation, and a custom VJP whose backward
+recomputes probabilities from the saved logsumexp — no [T, T] materialisation in
+either direction.
+
+Layouts: q, k, v are [B, T, H, D] publicly, [B, H, T, D] in-kernel; lse [B, H, T, 1].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    # CPU (tests) runs kernels through the Pallas interpreter; TPU compiles them.
+    return jax.default_backend() != "tpu"
+
+
+def _pick_block(t: int, preferred: int) -> int:
+    b = min(preferred, t)
+    while t % b != 0:
+        b //= 2
+    return max(b, 1)
+
+
+# --------------------------------------------------------------------------- #
+# forward
+# --------------------------------------------------------------------------- #
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_sc, m_sc, l_sc, *, scale, causal, block_q, block_k, nk, H):
+    h, iq, ik = pl.program_id(1), pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    should_run = True
+    if causal:
+        # skip blocks strictly above the diagonal
+        should_run = ik * block_k <= iq * block_q + block_q - 1
+
+    @pl.when(should_run)
+    def _():
+        q = q_ref[0, 0, :, :]  # [bq, d]
+        k = k_ref[0, 0, :, :]  # [bk, d]
+        v = v_ref[0, 0, :, :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_idx = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_idx = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_idx >= k_idx, s, NEG_INF)
+        m_prev = m_sc[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_sc[:, 0:1] = l_sc[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_sc[:, 0:1] = m_new
+        acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _():
+        l = l_sc[:, 0:1]
+        # guard fully-masked rows (l == 0)
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0, 0, :, :] = (acc_sc[:] / safe_l).astype(o_ref.dtype)
+        lse = m_sc[:, 0:1] + jnp.log(safe_l)
+        lse_ref[0, 0, :, :] = jnp.where(l > 0.0, lse, NEG_INF)
+
+
+def _fwd(q, k, v, scale: float, causal: bool,
+         block_q: int, block_k: int) -> Tuple[jax.Array, jax.Array]:
+    # internal layout: [B, H, T, D] (blocks must keep the last two dims tileable)
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    bq = _pick_block(T, block_q)
+    bk = _pick_block(Tk, block_k)
+    nq, nk = T // bq, Tk // bk
+    grid = (B, H, nq, nk)
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_q=bq, block_k=bk, nk=nk, H=H)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik: (b, h, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+# --------------------------------------------------------------------------- #
+# backward
+# --------------------------------------------------------------------------- #
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_sc, *, scale, causal, block_q, block_k, nk):
+    h, iq, ik = pl.program_id(1), pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    should_run = True
+    if causal:
+        should_run = ik * block_k <= iq * block_q + block_q - 1
+
+    @pl.when(should_run)
+    def _():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, :]                # [bq, 1]
+        delta = delta_ref[0, 0, :, :]            # [bq, 1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_idx = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_idx = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_idx >= k_idx, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_sc[:] += jax.lax.dot_general(ds.astype(k.dtype), k,
+                                        (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _():
+        dq_ref[0, 0, :, :] = dq_sc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_sc, dv_sc,
+                    *, scale, causal, block_q, block_k, nq):
+    h, ik, iq = pl.program_id(1), pl.program_id(2), pl.program_id(3)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    should_run = True
+    if causal:
+        # block contributes only if some q >= some k
+        should_run = iq * block_q + block_q - 1 >= ik * block_k
+
+    @pl.when(should_run)
+    def _():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, :]
+        delta = delta_ref[0, 0, :, :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_idx = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_idx = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_idx >= k_idx, s, NEG_INF)
+        p = jnp.exp(s - lse)                                  # [bq, bk]
+        dv_sc[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                        (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                         # [bq, bk]
+        dk_sc[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
+                                        (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0, 0, :, :] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, residuals, g):
+    q, k, v, o, lse = residuals
+    do = g
+    B, H, T, D = q.shape
+    Tk = k.shape[2]
+    bq = _pick_block(T, block_q)
+    bk = _pick_block(Tk, block_k)
+    nq, nk = T // bq, Tk // bk
+
+    # delta = rowsum(do * o): [B, H, T] (small, XLA fuses this fine)
+    delta = jnp.einsum("bhtd,bhtd->bht", do.astype(jnp.float32),
+                       o.astype(jnp.float32))[..., None]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, nk=nk),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, iq, ik: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, nq=nq),
+        grid=(B, H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, ik, iq: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, ik, iq: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, ik, iq: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, ik, iq: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, ik, iq: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, ik, iq: (b, h, iq, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, ik, iq: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda b, h, ik, iq: (b, h, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------- #
+# public entry
+# --------------------------------------------------------------------------- #
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    o, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False,
+                    segment_ids: Optional[jax.Array] = None,
+                    softmax_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+    """Flash attention over [B, T, H, D] tensors.
+
+    GQA: if k/v have fewer heads than q, they are repeated to match (the kernel
+    itself is per-head, so this costs HBM reads, not extra FLOPs on the MXU).
+    ``segment_ids`` packing falls back to the jnp reference path for now (the
+    ragged/paged Pallas kernel in ``ops/pallas/paged_attention.py`` is the
+    long-sequence packed path).
+    """
+    if segment_ids is not None:
+        from deepspeed_tpu.ops.attention import reference_attention
+        return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                                   softmax_scale=softmax_scale)
+    B, T, H, D = q.shape
+    Hkv = k.shape[2]
+    if Hkv != H:
+        assert H % Hkv == 0, f"GQA heads {H} not divisible by kv heads {Hkv}"
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+    q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))  # -> [B, H, T, D]
+    out = _flash(q, k, v, scale, causal, block_q, block_k)
+    return jnp.swapaxes(out, 1, 2)

@@ -1,0 +1,59 @@
+"""Quantizer op tests (parity: reference tests/unit/ops/quantizer)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.quantizer import (quantize, dequantize,
+                                         quantize_dequantize, ste_quantize)
+
+
+def test_int8_roundtrip_error_bounded():
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 256))
+    out = quantize_dequantize(x, num_bits=8, group_size=256)
+    err = jnp.abs(out - x)
+    # max error per group is scale/2 = max|x|/127/2
+    assert float(err.max()) < float(jnp.abs(x).max()) / 127.0
+    assert out.dtype == x.dtype
+
+
+def test_int4_coarser_than_int8():
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 512))
+    e8 = jnp.abs(quantize_dequantize(x, 8) - x).mean()
+    e4 = jnp.abs(quantize_dequantize(x, 4) - x).mean()
+    assert float(e4) > float(e8) > 0.0
+
+
+def test_asymmetric_handles_offset_data():
+    x = jax.random.uniform(jax.random.PRNGKey(2), (4, 256)) + 10.0
+    sym = quantize_dequantize(x, 8, symmetric=True)
+    asym = quantize_dequantize(x, 8, symmetric=False)
+    assert float(jnp.abs(asym - x).mean()) < float(jnp.abs(sym - x).mean())
+
+
+def test_quantize_shapes_and_dtype():
+    x = jax.random.normal(jax.random.PRNGKey(3), (8, 128))
+    q, s, z = quantize(x, 8, group_size=128)
+    assert q.dtype == jnp.int8
+    assert q.shape == (8, 128)
+    assert s.shape == (8,)
+    back = dequantize(q, s, z, x.shape)
+    assert back.shape == x.shape
+
+
+def test_zero_group_safe():
+    x = jnp.zeros((2, 256))
+    out = quantize_dequantize(x)
+    np.testing.assert_allclose(np.asarray(out), 0.0)
+
+
+def test_ste_gradient_is_identity():
+    x = jax.random.normal(jax.random.PRNGKey(4), (256,))
+    g = jax.grad(lambda t: jnp.sum(ste_quantize(t) * 3.0))(x)
+    np.testing.assert_allclose(np.asarray(g), 3.0, rtol=1e-6)
+
+
+def test_indivisible_group_raises():
+    with pytest.raises(ValueError, match="not divisible"):
+        quantize(jnp.ones((3, 100)), group_size=256)
