@@ -29,7 +29,9 @@ def initialize(args=None,
                collate_fn=None,
                config=None,
                config_params=None,
-               rngs=None):
+               rngs=None,
+               tp_rules=None,
+               model_family=None):
     """Initialize the training engine.
 
     Parity: ``deepspeed.initialize`` (``deepspeed/__init__.py:64``). Returns a tuple
@@ -56,6 +58,8 @@ def initialize(args=None,
         collate_fn=collate_fn,
         config=config,
         rngs=rngs,
+        tp_rules=tp_rules,
+        model_family=model_family,
     )
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 

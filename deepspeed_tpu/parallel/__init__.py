@@ -1,0 +1,12 @@
+"""Parallelism engines (parity: reference runtime/pipe, moe/, sequence/,
+module_inject/auto_tp — see each module's docstring)."""
+
+from deepspeed_tpu.parallel.ulysses import (DistributedAttention, ulysses_attention,
+                                            single_all_to_all)
+from deepspeed_tpu.parallel.ring import ring_attention
+from deepspeed_tpu.parallel.tensor_parallel import (derive_tp_specs, tp_rules_for,
+                                                    COLUMN, ROW, VOCAB, REPLICATE,
+                                                    MODEL_TP_RULES, GENERIC_TP_RULES)
+from deepspeed_tpu.parallel.moe import MoE, Experts, top1_gating, topk_gating, derive_ep_specs
+from deepspeed_tpu.parallel.pipeline import (PipelineModule, gpipe_apply,
+                                             partition_uniform, partition_balanced)

@@ -1,0 +1,202 @@
+"""Mixture of Experts with expert parallelism.
+
+Parity: ``deepspeed.moe`` — ``MoE`` (``moe/layer.py:16``), ``MOELayer``
+(``moe/sharded_moe.py:425``), ``TopKGate`` (:348) with ``top1gating`` (:184) /
+``top2gating`` (:282), einsum dispatch, and the ``_AllToAll`` expert exchange
+(:95). TPU-native form (GShard-style): expert weights carry an 'expert' mesh-axis
+sharding; dispatch/combine are einsums against capacity-limited one-hot masks, and
+constraining the dispatched tensor to P('expert', ...) makes XLA emit the same
+all-to-all the reference issues through torch.distributed — under jit, overlapped
+with the gating compute.
+
+Gating math follows the reference: softmax gates, capacity
+ceil(k * tokens / experts) * capacity_factor, load-balancing aux loss
+l_aux = E * mean(me * ce) (sharded_moe.py top1gating), optional random token
+priority (rts) dropped in favor of plain position priority here.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import EXPERT_AXIS, get_topology
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int) -> int:
+    # ceil, matching reference _capacity (sharded_moe.py:168)
+    cap = math.ceil(num_tokens / num_experts * capacity_factor)
+    return max(cap, min_capacity)
+
+
+def top1_gating(logits: jax.Array, capacity: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Parity: ``top1gating`` (sharded_moe.py:184).
+
+    Returns (combine [N,E,C], dispatch bool [N,E,C], l_aux scalar)."""
+    N, E = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)                    # [N, E]
+    idx = jnp.argmax(gates, axis=-1)                           # [N]
+    mask = jax.nn.one_hot(idx, E, dtype=gates.dtype)           # [N, E]
+
+    # aux loss: E * sum_e(mean_tokens(gate_e) * mean_tokens(mask_e))
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    # position within expert queue (cumsum over tokens), capacity dropping
+    pos = jnp.cumsum(mask, axis=0) * mask - mask               # rank of token in its expert
+    keep = (pos < capacity).astype(gates.dtype) * mask         # [N, E]
+    gate_val = jnp.sum(gates * keep, axis=-1, keepdims=True)   # [N, 1]
+    pos_in_cap = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)
+    cap_oh = jax.nn.one_hot(pos_in_cap, capacity, dtype=gates.dtype)  # [N, C]
+    combine = (gate_val * keep)[:, :, None] * cap_oh[:, None, :]      # [N, E, C]
+    dispatch = combine > 0.0
+    return combine, dispatch, l_aux
+
+
+def topk_gating(logits: jax.Array, k: int, capacity: int
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Parity: ``top2gating`` (sharded_moe.py:282), generalised to k: successive
+    argmax with masking, shared capacity queues, gate renormalisation over kept
+    experts."""
+    if k == 1:
+        return top1_gating(logits, capacity)
+    N, E = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    masks = []
+    g = gates
+    for _ in range(k):
+        idx = jnp.argmax(g, axis=-1)
+        m = jax.nn.one_hot(idx, E, dtype=gates.dtype)
+        masks.append(m)
+        g = g * (1.0 - m)
+
+    # aux loss uses the top-1 mask (reference top2gating uses mask1)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(masks[0], axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    # Pass 1: capacity-drop each choice (shared per-expert queues), recording the
+    # surviving gate values. Pass 2: renormalise over the *kept* experts only —
+    # parity with reference top2gating, which drops before computing denom_s.
+    keeps, gate_vals, cap_ohs = [], [], []
+    prev_counts = jnp.zeros((E,), gates.dtype)
+    for m in masks:
+        pos = (jnp.cumsum(m, axis=0) - 1.0) * m + prev_counts[None, :] * m
+        keep = (pos < capacity).astype(gates.dtype) * m
+        pos_in_cap = jnp.sum(pos * keep, axis=-1).astype(jnp.int32)
+        keeps.append(keep)
+        gate_vals.append(jnp.sum(gates * keep, axis=-1))       # 0 if dropped
+        cap_ohs.append(jax.nn.one_hot(pos_in_cap, capacity, dtype=gates.dtype))
+        prev_counts = prev_counts + jnp.sum(m, axis=0)
+    denom = jnp.maximum(sum(gate_vals), 1e-9)
+    combine = jnp.zeros((N, E, capacity), gates.dtype)
+    for keep, gate_val, cap_oh in zip(keeps, gate_vals, cap_ohs):
+        w = gate_val / denom
+        combine = combine + (w[:, None] * keep)[:, :, None] * cap_oh[:, None, :]
+    dispatch = combine > 0.0
+    return combine, dispatch, l_aux
+
+
+class Experts(nn.Module):
+    """Parity: ``Experts`` (moe/experts.py) — E FFNs evaluated batched on the MXU;
+    weights [E, ...] sharded over the 'expert' axis by the TP/EP spec rules."""
+
+    num_experts: int
+    d_model: int
+    d_ff: int
+    activation: Callable = nn.gelu
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):  # x: [E, C, d_model]
+        wi = self.param("wi", nn.initializers.normal(0.02),
+                        (self.num_experts, self.d_model, self.d_ff), jnp.float32)
+        wo = self.param("wo", nn.initializers.normal(0.02),
+                        (self.num_experts, self.d_ff, self.d_model), jnp.float32)
+        h = jnp.einsum("ecd,edf->ecf", x, wi.astype(self.dtype))
+        h = self.activation(h)
+        return jnp.einsum("ecf,efd->ecd", h, wo.astype(self.dtype))
+
+
+class MoE(nn.Module):
+    """Parity: ``MoE`` (moe/layer.py:16) + ``MOELayer.forward``
+    (sharded_moe.py:477): gate -> dispatch einsum -> expert-sharded FFN ->
+    combine einsum. Returns (output, l_aux)."""
+
+    d_model: int
+    d_ff: int
+    num_experts: int = 8
+    k: int = 1
+    capacity_factor: float = 1.25
+    min_capacity: int = 4
+    activation: Callable = nn.gelu
+    dtype: Any = jnp.float32
+    use_ep_sharding: bool = True
+
+    @nn.compact
+    def __call__(self, x):  # x: [B, S, d]
+        B, S, D = x.shape
+        N = B * S
+        tokens = x.reshape(N, D)
+        gate_logits = nn.Dense(self.num_experts, use_bias=False, dtype=jnp.float32,
+                               name="gate")(tokens.astype(jnp.float32))
+        cap = _capacity(N, self.num_experts, self.capacity_factor * self.k,
+                        self.min_capacity)
+        combine, dispatch, l_aux = topk_gating(gate_logits, self.k, cap)
+
+        # dispatch: [N,d] x [N,E,C] -> [E,C,d]  (reference einsum "sec,sm->ecm")
+        expert_in = jnp.einsum("nd,nec->ecd", tokens, dispatch.astype(x.dtype))
+        if self.use_ep_sharding:
+            expert_in = _constrain_expert(expert_in)  # -> all-to-all over 'expert'
+        expert_out = Experts(self.num_experts, D, self.d_ff, self.activation,
+                             self.dtype, name="experts")(expert_in)
+        if self.use_ep_sharding:
+            expert_out = _constrain_expert(expert_out)
+        # combine: [E,C,d] x [N,E,C] -> [N,d]
+        out = jnp.einsum("ecd,nec->nd", expert_out, combine.astype(x.dtype))
+        return out.reshape(B, S, D), l_aux
+
+
+def _constrain_expert(t: jax.Array) -> jax.Array:
+    try:
+        topo = get_topology()
+    except Exception:
+        return t
+    if topo.ep_world_size <= 1:
+        return t
+    sh = NamedSharding(topo.mesh, P(EXPERT_AXIS, *([None] * (t.ndim - 1))))
+    return jax.lax.with_sharding_constraint(t, sh)
+
+
+# EP sharding rules for the ZeroPartitioner tp_specs slot: expert weights shard
+# their leading E dim over the 'expert' axis (parity: expert params grouped into
+# expert-parallel process groups, utils/groups.py:113).
+MOE_EP_RULES = [
+    (r".*experts/wi", "expert_dim0"),
+    (r".*experts/wo", "expert_dim0"),
+]
+
+
+def derive_ep_specs(params: Any, ep_size: int) -> Any:
+    """PartitionSpec tree sharding expert leading dims over 'expert'."""
+    from deepspeed_tpu.parallel.tensor_parallel import walk_path_rules
+
+    def spec_fn(kind, shape, pathstr):
+        if shape and shape[0] % ep_size == 0:
+            return P(EXPERT_AXIS, *([None] * (len(shape) - 1)))
+        return P()
+
+    return walk_path_rules(params, MOE_EP_RULES, spec_fn)
+
+
+def is_moe_param(path: str) -> bool:
+    """Parity: ``is_moe_param`` (moe/utils.py) — by path convention."""
+    return "experts/" in path or path.endswith("/gate/kernel")

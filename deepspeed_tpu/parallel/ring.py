@@ -1,0 +1,77 @@
+"""Ring attention (context parallelism) over ICI.
+
+The reference snapshot has **no** ring attention (SURVEY.md §2.3: CP absent; its
+long-context story is Ulysses + sparse attention). This module adds the
+TPU-idiomatic context-parallel strategy: KV blocks rotate around the 'seq' mesh
+axis via ``lax.ppermute`` while each device accumulates online-softmax partial
+attention for its local Q shard — comm is neighbor-to-neighbor on the ICI ring and
+fully overlappable with the per-step attention compute.
+
+Causal correctness across ranks comes from masking on *global* token indices
+(q_global >= k_global); fully-masked future blocks contribute nothing through the
+online-softmax algebra.
+
+Usable inside shard_map over the 'seq' axis: q, k, v are local shards
+[B, T/P, H, D]. Gradients flow through ppermute/online-softmax natively (jax AD).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.comm.mesh import SEQ_AXIS
+
+_NEG_INF = -1e30
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   causal: bool = True, axis_name: str = SEQ_AXIS,
+                   softmax_scale: Optional[float] = None) -> jax.Array:
+    """Blockwise ring attention for local shards [B, T/P, H, D] (inside shard_map).
+
+    Python-unrolled over the P ring steps (P is static mesh geometry), so XLA can
+    overlap each ppermute with the previous block's attention compute.
+    """
+    P_ = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+
+    m_run = jnp.full((B, H, T, 1), _NEG_INF, jnp.float32)
+    l_run = jnp.zeros((B, H, T, 1), jnp.float32)
+    acc = jnp.zeros((B, H, T, D), jnp.float32)
+
+    q_local = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
+    k_local = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
+
+    perm = [(i, (i + 1) % P_) for i in range(P_)]
+    cur_k, cur_v = k, v
+    for step in range(P_):
+        # kv block currently held was originally owned by rank (my_idx - step) % P
+        kv_idx = (my_idx - step) % P_
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, cur_k).astype(jnp.float32) * scale
+        if causal:
+            q_glob = my_idx * T + q_local
+            k_glob = kv_idx * T + k_local
+            s = jnp.where((q_glob >= k_glob)[None, None], s, _NEG_INF)
+        m_b = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_run, m_b)
+        # clamp so fully-masked steps (m_b == -inf) don't produce exp(-inf - -inf)
+        m_new = jnp.maximum(m_new, _NEG_INF / 2)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(jnp.maximum(m_run, _NEG_INF / 2) - m_new)
+        l_run = l_run * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bhqk,bkhd->bhqd", p, cur_v.astype(jnp.float32))
+        m_run = m_new
+
+        if step != P_ - 1:
+            cur_k = lax.ppermute(cur_k, axis_name, perm)
+            cur_v = lax.ppermute(cur_v, axis_name, perm)
+
+    safe_l = jnp.where(l_run > 0.0, l_run, 1.0)
+    out = (acc / safe_l).astype(q.dtype)                         # [B,H,T,D]
+    return jnp.transpose(out, (0, 2, 1, 3))                      # -> [B,T,H,D]

@@ -1,0 +1,78 @@
+"""DeepSpeed-Ulysses sequence parallelism, TPU-native.
+
+Parity: ``DistributedAttention`` (reference ``deepspeed/sequence/layer.py:60``) with
+``_SeqAllToAll`` (:44) / ``single_all_to_all`` (:15): all-to-all #1 converts
+sequence-sharded QKV [s/P, h] to head-sharded full-sequence [s, h/P], any local
+attention runs, all-to-all #2 converts back. Comm volume O(N·h/P) per link vs
+allgather O(N·h) (blogs/deepspeed-ulysses).
+
+Two TPU forms are provided:
+
+- ``ulysses_attention`` — GSPMD form: two ``with_sharding_constraint`` resharding
+  annotations around the attention call; XLA lowers the seq<->head resharding to
+  exactly the two all-to-alls, scheduled/overlapped by the compiler. This is the
+  idiomatic form used by the models.
+- ``DistributedAttention`` — explicit shard_map form with ``lax.all_to_all`` for
+  call-discipline parity with the reference (usable inside custom shard_map code).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import BATCH_AXES, SEQ_AXIS, get_topology
+
+
+def ulysses_attention(attn_fn: Callable, q: jax.Array, k: jax.Array, v: jax.Array,
+                      *args, mesh=None, **kwargs) -> jax.Array:
+    """GSPMD Ulysses: q/k/v are logically [B, T, H, D] with T sharded over 'seq';
+    constrain to head-sharded for the attention, back to seq-sharded after.
+
+    Works under plain jit: XLA inserts all-to-all pairs on the 'seq' axis.
+    """
+    mesh = mesh or get_topology().mesh
+    seq_sharded = NamedSharding(mesh, P(BATCH_AXES, SEQ_AXIS, None, None))
+    head_sharded = NamedSharding(mesh, P(BATCH_AXES, None, SEQ_AXIS, None))
+
+    q, k, v = (lax.with_sharding_constraint(t, head_sharded) for t in (q, k, v))
+    out = attn_fn(q, k, v, *args, **kwargs)
+    return lax.with_sharding_constraint(out, seq_sharded)
+
+
+def single_all_to_all(x: jax.Array, scatter_idx: int, gather_idx: int,
+                      axis_name: str = SEQ_AXIS) -> jax.Array:
+    """Parity: ``single_all_to_all`` (sequence/layer.py:15). For use inside
+    shard_map: scatter local dim ``scatter_idx`` across the axis, gather the axis
+    into dim ``gather_idx``."""
+    return lax.all_to_all(x, axis_name, split_axis=scatter_idx,
+                          concat_axis=gather_idx, tiled=True)
+
+
+class DistributedAttention:
+    """Parity: ``DistributedAttention`` (sequence/layer.py:60).
+
+    Explicit all-to-all wrapper for shard_map code: ``__call__(q, k, v)`` where the
+    tensors are the local sequence shards [B, T/P, H, D]; returns the local shard
+    of the attention output.
+    """
+
+    def __init__(self, local_attention: Callable, axis_name: str = SEQ_AXIS,
+                 scatter_idx: int = 2, gather_idx: int = 1):
+        self.local_attn = local_attention
+        self.axis_name = axis_name
+        self.scatter_idx = scatter_idx  # head dim of [B, T, H, D]
+        self.gather_idx = gather_idx    # seq dim
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        a = self.axis_name
+        q = single_all_to_all(query, self.scatter_idx, self.gather_idx, a)
+        k = single_all_to_all(key, self.scatter_idx, self.gather_idx, a)
+        v = single_all_to_all(value, self.scatter_idx, self.gather_idx, a)
+        ctx = self.local_attn(q, k, v, *args, **kwargs)
+        # reverse: scatter seq, gather heads
+        return single_all_to_all(ctx, self.gather_idx, self.scatter_idx, a)

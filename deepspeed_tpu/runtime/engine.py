@@ -77,7 +77,9 @@ class DeepSpeedTPUEngine:
                  collate_fn=None,
                  config: Optional[DeepSpeedTPUConfig] = None,
                  rngs: Optional[jax.Array] = None,
-                 loss_fn: Optional[Callable] = None):
+                 loss_fn: Optional[Callable] = None,
+                 tp_rules=None,
+                 model_family: Optional[str] = None):
         self.config = config if isinstance(config, DeepSpeedTPUConfig) else DeepSpeedTPUConfig.load(config)
         self.topology = mesh_topology or set_topology(build_topology(self.config.mesh))
         self.train_batch_size_, self.micro_batch_size_, self.gas_ = \
@@ -90,6 +92,11 @@ class DeepSpeedTPUEngine:
         self.compute_dtype = self.config.compute_dtype
         self.mixed_precision = self.compute_dtype != jnp.float32
         self.zero_stage = self.config.zero_optimization.stage
+        # tensor parallelism: first-class for training (unlike the reference, which
+        # delegates training TP to an external Megatron mpu — SURVEY §2.3)
+        self._tp_rules = tp_rules
+        self._model_family = model_family
+        self._tp_specs = None
         self.partitioner = ZeroPartitioner(
             self.zero_stage, self.topology,
             persistence_threshold=self.config.zero_optimization.stage3_param_persistence_threshold)
@@ -163,12 +170,33 @@ class DeepSpeedTPUEngine:
         explicit out_shardings so every tensor materialises directly in its
         partitioned layout — no full-model replication transient."""
         topo = self.topology
-        master_sh = self.partitioner.master_sharding(model_parameters)
-        param_sh = self.partitioner.param_sharding(model_parameters)
+        if self._tp_specs is None and (topo.tp_world_size > 1 or topo.ep_world_size > 1):
+            specs = None
+            if topo.tp_world_size > 1:
+                from deepspeed_tpu.parallel.tensor_parallel import (derive_tp_specs,
+                                                                    tp_rules_for)
+                rules = (tp_rules_for(self._model_family) if self._tp_rules is None
+                         else self._tp_rules)  # [] means "shard nothing"
+                specs = derive_tp_specs(model_parameters, rules, topo.tp_world_size)
+            if topo.ep_world_size > 1:
+                # expert weights shard their leading E dim over 'expert' (parity:
+                # expert-parallel groups, utils/groups.py:113); merged with TP specs
+                from deepspeed_tpu.parallel.moe import derive_ep_specs
+                ep = derive_ep_specs(model_parameters, topo.ep_world_size)
+                if specs is None:
+                    specs = ep
+                else:
+                    specs = jax.tree_util.tree_map(
+                        lambda t, e: e if tuple(e) != () else t, specs, ep,
+                        is_leaf=lambda s: isinstance(s, P))
+            self._tp_specs = specs
+        master_sh = self.partitioner.master_sharding(model_parameters, self._tp_specs)
+        param_sh = self.partitioner.param_sharding(model_parameters, self._tp_specs)
         opt_template = jax.eval_shape(self.optimizer.init,
                                       jax.eval_shape(lambda t: tree_cast(t, jnp.float32),
                                                      model_parameters))
-        opt_spec = self.partitioner.opt_state_spec(opt_template, model_parameters)
+        opt_spec = self.partitioner.opt_state_spec(opt_template, model_parameters,
+                                                   self._tp_specs)
         opt_sh = jax.tree_util.tree_map(
             lambda s: NamedSharding(topo.mesh, s), opt_spec,
             is_leaf=lambda s: isinstance(s, P))
@@ -226,7 +254,7 @@ class DeepSpeedTPUEngine:
         return loss / scale, grads
 
     def _constrain_grads(self, grads):
-        spec = self.partitioner.grad_spec(grads)
+        spec = self.partitioner.grad_spec(grads, self._tp_specs)
         return jax.lax.with_sharding_constraint(
             grads, jax.tree_util.tree_map(
                 lambda s: NamedSharding(self.topology.mesh, s), spec,
