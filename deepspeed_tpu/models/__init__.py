@@ -1,3 +1,5 @@
-"""Model zoo for the BASELINE config ladder (gpt2, bert, llama, mixtral, neox)."""
+"""Model zoo for the BASELINE config ladder (gpt2, llama/mistral, mixtral)."""
 
 from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM, init_cache
+from deepspeed_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM

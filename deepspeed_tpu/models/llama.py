@@ -1,0 +1,313 @@
+"""Llama-family decoder models in flax.linen (Llama-2 / Mistral via config).
+
+Parity role: the reference ships these families as *inference containers and
+model implementations* (``module_inject/containers/llama.py``, ``llama2.py``,
+``inference/v2/model_implementations/{llama_v2,mistral}``) over HF weights; this
+framework is standalone, so the families live here as first-class flax models used
+by both the training engine (BASELINE ladder config #3: Llama-2-7B ZeRO-3 bf16)
+and the inference engines.
+
+Architecture (Llama-2 / Mistral lineage): RMSNorm pre-norm, rotary position
+embeddings, grouped-query attention (``num_key_value_heads < num_attention_heads``),
+SwiGLU MLP, untied LM head, optional sliding-window attention (Mistral).
+
+Two call paths:
+  - ``__call__(batch)``: training convention — mean next-token cross-entropy
+    (or logits when no labels can be formed), matching the engine contract.
+  - ``decode(input_ids, cache, positions)``: incremental decoding with an explicit
+    KV-cache pytree (see ``init_cache``) — the inference engines jit this. The
+    cache is an explicit function argument, not flax mutable state, so it shards
+    and donates cleanly under jit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.attention import dot_product_attention, reference_attention
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32        # < num_attention_heads => GQA (Mistral: 8)
+    max_position_embeddings: int = 4096
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    sliding_window: Optional[int] = None  # Mistral: 4096
+    dtype: Any = jnp.float32
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def llama2_7b(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def llama2_13b(cls, **kw):
+        defaults = dict(hidden_size=5120, intermediate_size=13824,
+                        num_hidden_layers=40, num_attention_heads=40,
+                        num_key_value_heads=40)
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def llama2_70b(cls, **kw):
+        defaults = dict(hidden_size=8192, intermediate_size=28672,
+                        num_hidden_layers=80, num_attention_heads=64,
+                        num_key_value_heads=8)
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def mistral_7b(cls, **kw):
+        defaults = dict(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                        num_hidden_layers=32, num_attention_heads=32,
+                        num_key_value_heads=8, max_position_embeddings=32768,
+                        rope_theta=1e6, sliding_window=4096)
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def tiny(cls, **kw):
+        """Fixture-sized config (analog of tests/unit/simple_model.py fixtures)."""
+        defaults = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=2, max_position_embeddings=128)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("weight", nn.initializers.ones, (x.shape[-1],))
+        xf = x.astype(jnp.float32)
+        var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        y = xf * jax.lax.rsqrt(var + self.eps)
+        return (y * w).astype(self.dtype)
+
+
+def rope_frequencies(head_dim: int, theta: float) -> jax.Array:
+    return 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding, interleaved-pair convention. x: [B, T, H, D],
+    positions: [B, T] (int). Parity: the reference's apply_rotary_pos_emb kernel
+    (csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu) — on TPU a pure
+    jnp rotation that XLA fuses into the surrounding matmuls."""
+    D = x.shape[-1]
+    freqs = rope_frequencies(D, theta)                     # [D/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]                   # [B, T, 1, D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    r1 = x1 * cos - x2 * sin
+    r2 = x2 * cos + x1 * sin
+    out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[B, T, H_kv, D] -> [B, T, H_kv*n_rep, D] (GQA head expansion)."""
+    if n_rep == 1:
+        return x
+    B, T, H, D = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (B, T, H, n_rep, D)).reshape(B, T, H * n_rep, D)
+
+
+def _window_bias(q_positions: jax.Array, k_positions: jax.Array,
+                 window: Optional[int]) -> jax.Array:
+    """Additive bias [B, 1, Tq, Tk]: causal (key pos <= query pos), optionally
+    restricted to the sliding window [q - window + 1, q]. Per-batch-row positions
+    so left-padded / ragged batches mask correctly."""
+    delta = q_positions[:, :, None] - k_positions[:, None, :]
+    ok = delta >= 0
+    if window is not None:
+        ok = ok & (delta < window)
+    return jnp.where(ok, 0.0, jnp.finfo(jnp.float32).min)[:, None]
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    def setup(self):
+        cfg = self.config
+        dense = lambda feats, name: nn.Dense(feats, use_bias=False, dtype=cfg.dtype,
+                                             name=name)
+        self.q_proj = dense(cfg.num_attention_heads * cfg.head_dim, "q_proj")
+        self.k_proj = dense(cfg.num_key_value_heads * cfg.head_dim, "k_proj")
+        self.v_proj = dense(cfg.num_key_value_heads * cfg.head_dim, "v_proj")
+        self.o_proj = dense(cfg.hidden_size, "o_proj")
+
+    def _qkv(self, x, positions):
+        cfg = self.config
+        B, T, _ = x.shape
+        q = self.q_proj(x).reshape(B, T, cfg.num_attention_heads, cfg.head_dim)
+        k = self.k_proj(x).reshape(B, T, cfg.num_key_value_heads, cfg.head_dim)
+        v = self.v_proj(x).reshape(B, T, cfg.num_key_value_heads, cfg.head_dim)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        return q, k, v
+
+    def __call__(self, x, positions):
+        cfg = self.config
+        B, T, _ = x.shape
+        q, k, v = self._qkv(x, positions)
+        n_rep = cfg.num_attention_heads // cfg.num_key_value_heads
+        k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
+        if cfg.sliding_window is not None and T > cfg.sliding_window:
+            bias = _window_bias(positions, positions, cfg.sliding_window)
+            out = reference_attention(q, k, v, bias=bias)
+        else:
+            out = dot_product_attention(q, k, v, causal=True)
+        return self.o_proj(out.reshape(B, T, cfg.num_attention_heads * cfg.head_dim))
+
+    def decode(self, x, positions, layer_cache, cache_index):
+        """Incremental step: append this step's K/V at ``cache_index`` and attend
+        over the filled prefix. layer_cache: {"k","v"}: [B, S_max, H_kv, D]."""
+        cfg = self.config
+        B, T, _ = x.shape
+        q, k, v = self._qkv(x, positions)
+        ck = jax.lax.dynamic_update_slice(layer_cache["k"], k.astype(layer_cache["k"].dtype),
+                                          (0, cache_index, 0, 0))
+        cv = jax.lax.dynamic_update_slice(layer_cache["v"], v.astype(layer_cache["v"].dtype),
+                                          (0, cache_index, 0, 0))
+        S = ck.shape[1]
+        n_rep = cfg.num_attention_heads // cfg.num_key_value_heads
+        kk, vv = repeat_kv(ck, n_rep), repeat_kv(cv, n_rep)
+        # mask: key slot j visible iff its position <= this row's query position
+        # (covers prefill + decode), within the sliding window when configured
+        k_pos = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+        bias = _window_bias(positions, k_pos, cfg.sliding_window)
+        out = reference_attention(q, kk, vv, bias=bias)
+        out = self.o_proj(out.reshape(B, T, cfg.num_attention_heads * cfg.head_dim))
+        return out, {"k": ck, "v": cv}
+
+
+class LlamaMLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        gate = nn.Dense(cfg.intermediate_size, use_bias=False, dtype=cfg.dtype,
+                        name="gate_proj")(x)
+        up = nn.Dense(cfg.intermediate_size, use_bias=False, dtype=cfg.dtype,
+                      name="up_proj")(x)
+        h = nn.silu(gate) * up
+        return nn.Dense(cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
+                        name="down_proj")(h)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    def setup(self):
+        cfg = self.config
+        self.input_layernorm = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")
+        self.post_attention_layernorm = RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                                                name="post_attention_layernorm")
+        self.self_attn = LlamaAttention(cfg, name="self_attn")
+        self.mlp = LlamaMLP(cfg, name="mlp")
+
+    def __call__(self, x, positions):
+        x = x + self.self_attn(self.input_layernorm(x), positions)
+        return x + self.mlp(self.post_attention_layernorm(x))
+
+    def decode(self, x, positions, layer_cache, cache_index):
+        a, new_cache = self.self_attn.decode(self.input_layernorm(x), positions,
+                                             layer_cache, cache_index)
+        x = x + a
+        return x + self.mlp(self.post_attention_layernorm(x)), new_cache
+
+
+class LlamaForCausalLM(nn.Module):
+    """Training: ``__call__(batch)`` -> loss (engine contract). Inference:
+    ``apply(..., method='forward_logits'/'decode')``."""
+
+    config: LlamaConfig
+
+    def setup(self):
+        cfg = self.config
+        self.embed_tokens = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                                     dtype=cfg.dtype, name="embed_tokens")
+        block = nn.remat(LlamaBlock) if cfg.remat else LlamaBlock
+        self.layers = [block(cfg, name=f"layers_{i}")
+                       for i in range(cfg.num_hidden_layers)]
+        self.norm = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")
+        self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                                name="lm_head")
+
+    def _trunk(self, input_ids, positions):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, positions)
+        return self.norm(x)
+
+    def forward_logits(self, input_ids, positions=None):
+        B, T = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        x = self._trunk(input_ids, positions)
+        return self.lm_head(x).astype(jnp.float32)
+
+    def __call__(self, batch, deterministic: bool = True):
+        if isinstance(batch, dict):
+            input_ids = batch["input_ids"]
+            labels = batch.get("labels", input_ids)
+        else:
+            input_ids, labels = batch, batch
+        logits = self.forward_logits(input_ids)
+        logits_s = logits[:, :-1, :]
+        labels_s = labels[:, 1:]
+        logp = jax.nn.log_softmax(logits_s, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels_s[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    def decode(self, input_ids, cache, cache_index, positions=None):
+        """One incremental step (prefill or single-token decode).
+
+        input_ids: [B, T]; cache: pytree from ``init_cache`` — {"k","v"}:
+        [L, B, S_max, H_kv, D]; cache_index: int32 write offset.
+        Returns (logits [B, T, V] fp32, new_cache)."""
+        B, T = input_ids.shape
+        if positions is None:
+            positions = cache_index + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        x = self.embed_tokens(input_ids)
+        new_k, new_v = [], []
+        for i, layer in enumerate(self.layers):
+            layer_cache = {"k": cache["k"][i], "v": cache["v"][i]}
+            x, nc = layer.decode(x, positions, layer_cache, cache_index)
+            new_k.append(nc["k"])
+            new_v.append(nc["v"])
+        x = self.norm(x)
+        logits = self.lm_head(x).astype(jnp.float32)
+        return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+
+def init_cache(config: LlamaConfig, batch_size: int, max_len: int,
+               dtype: Any = None) -> Dict[str, jax.Array]:
+    """Dense per-sequence KV cache (inference v1 path; the v2 engine uses the
+    blocked/paged cache in deepspeed_tpu.inference.ragged instead)."""
+    dtype = dtype or config.dtype
+    shape = (config.num_hidden_layers, batch_size, max_len,
+             config.num_key_value_heads, config.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}

@@ -1,0 +1,176 @@
+"""Mixtral (sparse-MoE Llama lineage) in flax.linen.
+
+Parity role: the reference serves Mixtral through
+``inference/v2/model_implementations/mixtral`` (MoE over its CUTLASS grouped-GEMM
+kernels) and trains MoE models through ``deepspeed.moe`` (``moe/sharded_moe.py``).
+Here the family is a first-class model: the Llama backbone with each MLP replaced
+by a top-k routed MoE of SwiGLU experts (BASELINE ladder config #4:
+Mixtral-8x7B ZeRO-3 + EP).
+
+TPU-native dispatch: capacity-limited one-hot combine/dispatch einsums (GShard
+style, shared with ``parallel/moe.py``) — expert weights carry a leading [E, ...]
+dim that the EP spec shards over the 'expert' mesh axis; XLA emits the all-to-all
+the reference issues by hand (sharded_moe.py:95 _AllToAll).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.llama import (LlamaAttention, LlamaConfig, RMSNorm,
+                                        init_cache)
+from deepspeed_tpu.parallel.moe import _capacity, _constrain_expert, topk_gating
+
+
+@dataclass
+class MixtralConfig(LlamaConfig):
+    num_local_experts: int = 8
+    num_experts_per_tok: int = 2
+    router_aux_loss_coef: float = 0.02
+    capacity_factor: float = 2.0
+    min_capacity: int = 4
+
+    @classmethod
+    def mixtral_8x7b(cls, **kw):
+        defaults = dict(vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                        num_hidden_layers=32, num_attention_heads=32,
+                        num_key_value_heads=8, max_position_embeddings=32768,
+                        rope_theta=1e6, num_local_experts=8, num_experts_per_tok=2)
+        defaults.update(kw)
+        return cls(**defaults)
+
+    @classmethod
+    def tiny(cls, **kw):
+        defaults = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=2, max_position_embeddings=128,
+                        num_local_experts=4, num_experts_per_tok=2)
+        defaults.update(kw)
+        return cls(**defaults)
+
+
+class MixtralSparseMoeBlock(nn.Module):
+    """Top-k routed SwiGLU experts. Returns (out, l_aux)."""
+
+    config: MixtralConfig
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[jax.Array, jax.Array]:
+        cfg = self.config
+        B, T, C = x.shape
+        E = cfg.num_local_experts
+        N = B * T
+        tokens = x.reshape(N, C)
+
+        router = nn.Dense(E, use_bias=False, dtype=jnp.float32, name="gate")
+        logits = router(tokens.astype(jnp.float32))           # fp32 routing
+        cap = _capacity(N, E, cfg.capacity_factor * cfg.num_experts_per_tok,
+                        cfg.min_capacity)
+        combine, dispatch, l_aux = topk_gating(logits, cfg.num_experts_per_tok, cap)
+
+        # dispatch: [N, E, C_cap] bool -> expert inputs [E, C_cap, d]; the
+        # sharding constraint over 'expert' makes XLA emit the EP all-to-all
+        xs = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), tokens)
+        xs = _constrain_expert(xs)
+
+        init = nn.initializers.normal(0.02)
+        w_gate = self.param("w_gate", init, (E, C, cfg.intermediate_size), cfg.dtype)
+        w_up = self.param("w_up", init, (E, C, cfg.intermediate_size), cfg.dtype)
+        w_down = self.param("w_down", init, (E, cfg.intermediate_size, C), cfg.dtype)
+        h = nn.silu(jnp.einsum("ecd,edf->ecf", xs, w_gate)) * \
+            jnp.einsum("ecd,edf->ecf", xs, w_up)
+        ys = _constrain_expert(jnp.einsum("ecf,efd->ecd", h, w_down))  # [E, C_cap, d]
+
+        out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), ys)
+        return out.reshape(B, T, C), l_aux.astype(jnp.float32)
+
+
+class MixtralBlock(nn.Module):
+    config: MixtralConfig
+
+    def setup(self):
+        cfg = self.config
+        self.input_layernorm = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")
+        self.post_attention_layernorm = RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                                                name="post_attention_layernorm")
+        self.self_attn = LlamaAttention(cfg, name="self_attn")
+        self.block_sparse_moe = MixtralSparseMoeBlock(cfg, name="block_sparse_moe")
+
+    def __call__(self, x, positions):
+        x = x + self.self_attn(self.input_layernorm(x), positions)
+        m, l_aux = self.block_sparse_moe(self.post_attention_layernorm(x))
+        return x + m, l_aux
+
+    def decode(self, x, positions, layer_cache, cache_index):
+        a, new_cache = self.self_attn.decode(self.input_layernorm(x), positions,
+                                             layer_cache, cache_index)
+        x = x + a
+        m, _ = self.block_sparse_moe(self.post_attention_layernorm(x))
+        return x + m, new_cache
+
+
+class MixtralForCausalLM(nn.Module):
+    config: MixtralConfig
+
+    def setup(self):
+        cfg = self.config
+        self.embed_tokens = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                                     dtype=cfg.dtype, name="embed_tokens")
+        block = nn.remat(MixtralBlock) if cfg.remat else MixtralBlock
+        self.layers = [block(cfg, name=f"layers_{i}")
+                       for i in range(cfg.num_hidden_layers)]
+        self.norm = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")
+        self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                                name="lm_head")
+
+    def forward_logits(self, input_ids, positions=None):
+        logits, _ = self._forward(input_ids, positions)
+        return logits
+
+    def _forward(self, input_ids, positions=None):
+        B, T = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        x = self.embed_tokens(input_ids)
+        aux_total = jnp.float32(0.0)
+        for layer in self.layers:
+            x, l_aux = layer(x, positions)
+            aux_total = aux_total + l_aux
+        x = self.norm(x)
+        return self.lm_head(x).astype(jnp.float32), aux_total
+
+    def __call__(self, batch, deterministic: bool = True):
+        if isinstance(batch, dict):
+            input_ids = batch["input_ids"]
+            labels = batch.get("labels", input_ids)
+        else:
+            input_ids, labels = batch, batch
+        logits, aux_total = self._forward(input_ids)
+        logp = jax.nn.log_softmax(logits[:, :-1, :], axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[:, 1:][..., None], axis=-1)[..., 0]
+        loss = jnp.mean(nll)
+        cfg = self.config
+        return loss + cfg.router_aux_loss_coef * aux_total / cfg.num_hidden_layers
+
+    def decode(self, input_ids, cache, cache_index, positions=None):
+        B, T = input_ids.shape
+        if positions is None:
+            positions = cache_index + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        x = self.embed_tokens(input_ids)
+        new_k, new_v = [], []
+        for i, layer in enumerate(self.layers):
+            layer_cache = {"k": cache["k"][i], "v": cache["v"][i]}
+            x, nc = layer.decode(x, positions, layer_cache, cache_index)
+            new_k.append(nc["k"])
+            new_v.append(nc["v"])
+        x = self.norm(x)
+        return self.lm_head(x).astype(jnp.float32), {"k": jnp.stack(new_k),
+                                                     "v": jnp.stack(new_v)}
+
+
+__all__ = ["MixtralConfig", "MixtralForCausalLM", "init_cache"]

@@ -182,6 +182,10 @@ def _constrain_expert(t: jax.Array) -> jax.Array:
 MOE_EP_RULES = [
     (r".*experts/wi", "expert_dim0"),
     (r".*experts/wo", "expert_dim0"),
+    # Mixtral SwiGLU experts (models/mixtral.py MixtralSparseMoeBlock)
+    (r".*block_sparse_moe/w_gate", "expert_dim0"),
+    (r".*block_sparse_moe/w_up", "expert_dim0"),
+    (r".*block_sparse_moe/w_down", "expert_dim0"),
 ]
 
 
@@ -198,5 +202,8 @@ def derive_ep_specs(params: Any, ep_size: int) -> Any:
 
 
 def is_moe_param(path: str) -> bool:
-    """Parity: ``is_moe_param`` (moe/utils.py) — by path convention."""
-    return "experts/" in path or path.endswith("/gate/kernel")
+    """Parity: ``is_moe_param`` (moe/utils.py) — True for *expert* params only.
+    The router gate is a dense (replicated, data-parallel) param, explicitly not
+    an expert param in the reference."""
+    return "experts/" in path or any(
+        path.endswith(f"block_sparse_moe/{w}") for w in ("w_gate", "w_up", "w_down"))

@@ -61,8 +61,9 @@ def partition_balanced(weights: Sequence[float], n_parts: int) -> List[int]:
 
 
 def partition_uniform(n_layers: int, n_parts: int) -> List[int]:
-    """Parity: ``partition_method='uniform'`` (module.py:130)."""
-    return [round(i * n_layers / n_parts) for i in range(n_parts + 1)]
+    """Parity: ``partition_method='uniform'`` (module.py:130). Balanced integer
+    bounds (sizes differ by at most 1, never empty when n_layers >= n_parts)."""
+    return [(i * n_layers) // n_parts for i in range(n_parts + 1)]
 
 
 def gpipe_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
