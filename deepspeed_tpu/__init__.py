@@ -64,12 +64,17 @@ def initialize(args=None,
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 
 
-def init_inference(model=None, config=None, **kwargs):
-    """Parity: ``deepspeed.init_inference`` (``deepspeed/__init__.py:269``)."""
+def init_inference(model=None, config=None, model_parameters=None,
+                   mesh_topology=None, init_cache_fn=None, **kwargs):
+    """Parity: ``deepspeed.init_inference`` (``deepspeed/__init__.py:269``).
+    Extra kwargs are config overrides (reference accepts flat kwargs too)."""
     from deepspeed_tpu.inference.engine import InferenceEngine
     from deepspeed_tpu.inference.config import InferenceConfig
     cfg = InferenceConfig.load(config, **kwargs)
-    return InferenceEngine(model=model, config=cfg)
+    return InferenceEngine(model=model, config=cfg,
+                           model_parameters=model_parameters,
+                           mesh_topology=mesh_topology,
+                           init_cache_fn=init_cache_fn)
 
 
 def add_config_arguments(parser):

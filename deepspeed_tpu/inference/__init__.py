@@ -1,0 +1,13 @@
+"""Inference subsystem.
+
+v1 (``engine.py``): TP-sharded jitted forward/generate with a dense KV cache —
+parity with the reference's kernel-injection/AutoTP ``InferenceEngine``
+(``deepspeed/inference/engine.py:39``).
+
+v2 (``ragged/``, ``engine_v2.py``): FastGen-class continuous batching over a
+blocked/paged KV cache with Dynamic-SplitFuse scheduling — parity with
+``deepspeed/inference/v2``.
+"""
+
+from deepspeed_tpu.inference.config import InferenceConfig
+from deepspeed_tpu.inference.engine import InferenceEngine

@@ -1,0 +1,90 @@
+"""Inference engine configuration.
+
+Parity: ``DeepSpeedInferenceConfig`` (reference ``deepspeed/inference/config.py``) —
+the same knob surface (tensor_parallel.tp_size, dtype, max_out_tokens, quant,
+checkpoint, replace_with_kernel_inject) re-based on this repo's dataclass config
+tree. CUDA-graph options are accepted-and-ignored (XLA jit compilation subsumes
+graph capture); kernel injection maps to the Pallas kernel routing that is always
+on for TPU.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.config import ConfigError, ConfigModel
+
+_DTYPES = {"float32": jnp.float32, "fp32": jnp.float32,
+           "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+           "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+           "int8": jnp.int8}
+
+
+@dataclass
+class TPConfig(ConfigModel):
+    """Parity: ``DeepSpeedTPConfig`` (inference/config.py:47)."""
+    enabled: bool = True
+    tp_size: int = 1
+
+
+@dataclass
+class InferenceMoEConfig(ConfigModel):
+    """Parity: ``DeepSpeedMoEConfig`` (inference/config.py:65)."""
+    enabled: bool = True
+    ep_size: int = 1
+    moe_experts: Any = field(default_factory=lambda: [1])
+
+
+@dataclass
+class WeightQuantConfig(ConfigModel):
+    """Parity: ``WeightQuantConfig`` (inference/config.py:100) + ZeRO-inference
+    weight-only quantization (inference/quantization)."""
+    enabled: bool = False
+    bits: int = 8
+    group_size: int = 64
+
+
+@dataclass
+class InferenceCheckpointConfig(ConfigModel):
+    """Parity: checkpoint loading args of ``DeepSpeedInferenceConfig``."""
+    checkpoint_dir: Optional[str] = None
+    tag: Optional[str] = None
+
+
+@dataclass
+class InferenceConfig(ConfigModel):
+    """Parity: ``DeepSpeedInferenceConfig`` (inference/config.py:125+)."""
+    dtype: str = "bfloat16"
+    tensor_parallel: TPConfig = field(default_factory=TPConfig)
+    moe: InferenceMoEConfig = field(default_factory=InferenceMoEConfig)
+    quant: WeightQuantConfig = field(default_factory=WeightQuantConfig)
+    checkpoint: InferenceCheckpointConfig = field(default_factory=InferenceCheckpointConfig)
+    max_out_tokens: int = 1024
+    min_out_tokens: int = 1
+    max_tokens: int = 4096          # prompt + generation KV budget per sequence
+    replace_with_kernel_inject: bool = False   # accepted; Pallas routing is implicit
+    enable_cuda_graph: bool = False            # accepted-and-ignored (XLA jit)
+    model_family: Optional[str] = None         # TP rule table selector
+    seed: int = 0
+
+    @property
+    def compute_dtype(self):
+        if self.dtype not in _DTYPES:
+            raise ConfigError(f"inference dtype {self.dtype!r} not in {sorted(_DTYPES)}")
+        return _DTYPES[self.dtype]
+
+    @classmethod
+    def load(cls, config: Optional[Dict[str, Any]] = None, **kwargs) -> "InferenceConfig":
+        import copy
+        data = copy.deepcopy(dict(config or {}))  # never mutate the caller's dict
+        data.update(kwargs)
+        # legacy flat aliases (reference accepts mp_size at top level)
+        if "mp_size" in data:
+            tp = data.setdefault("tensor_parallel", {})
+            if isinstance(tp, dict):
+                tp.setdefault("tp_size", data.pop("mp_size"))
+            else:
+                data.pop("mp_size")
+        data.pop("replace_method", None)  # deprecated in reference, ignored here
+        return cls.from_dict(data)

@@ -1,0 +1,291 @@
+"""Inference engine v1: TP-sharded jitted forward + KV-cache generation.
+
+Parity: ``InferenceEngine`` (reference ``deepspeed/inference/engine.py:39``) —
+``init_inference(model, config)`` wraps a model for serving: model-parallel group
+creation (``:254``), AutoTP / kernel-injection sharding (``:408``), checkpoint
+loading (``:331``), CUDA-graph capture (``:524``), and a patched ``generate``.
+
+TPU-native re-design:
+  - "MP group creation" = a mesh with a 'tensor' axis sized ``tp_size``.
+  - "AutoTP weight slicing" = PartitionSpec rules (``parallel/tensor_parallel``);
+    XLA's SPMD partitioner derives the column/row-parallel compute and the
+    per-layer all-reduce the reference's ``LinearAllreduce`` modules issue by hand.
+  - "CUDA graph capture" = jit compilation (always on; ``enable_cuda_graph`` is
+    accepted and ignored).
+  - "kernel injection" = the ops layer's Pallas routing (``ops/attention.py``),
+    always active on TPU.
+  - generation: jitted prefill + jitted single-token decode step with a donated
+    dense KV cache (the paged/ragged cache belongs to the v2 engine).
+
+The model must follow the zoo decode protocol (``models/llama.py``):
+``apply(..., method='forward_logits')`` and ``apply(ids, cache, index,
+method='decode')``; cache built by ``models.llama.init_cache``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import (DATA_AXIS, FSDP_AXIS, TENSOR_AXIS,
+                                     MeshTopology, build_topology, get_topology,
+                                     set_topology)
+from deepspeed_tpu.config import MeshConfig
+from deepspeed_tpu.inference.config import InferenceConfig
+from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.tree import tree_cast
+
+
+class InferenceEngine:
+    """See module docstring."""
+
+    def __init__(self,
+                 model: Any,
+                 config: InferenceConfig,
+                 model_parameters: Optional[Any] = None,
+                 mesh_topology: Optional[MeshTopology] = None,
+                 init_cache_fn: Optional[Callable] = None):
+        self.config = config
+        self.module = model
+        self.model_config = getattr(model, "config", None)
+
+        tp = config.tensor_parallel.tp_size if config.tensor_parallel.enabled else 1
+        ep = config.moe.ep_size if config.moe.enabled else 1
+        if mesh_topology is not None:
+            # register so global-topology readers (e.g. MoE sharding constraints)
+            # see the same mesh this engine shards over
+            self.topology = set_topology(mesh_topology)
+        else:
+            n = len(jax.devices())
+            if tp * ep > n:
+                raise ValueError(f"tp_size*ep_size={tp * ep} > {n} devices")
+            self.topology = set_topology(build_topology(
+                MeshConfig(tensor=tp, expert=ep, data=n // (tp * ep), fsdp=1)))
+        self._dtype = config.compute_dtype
+
+        # -- params: load -> cast -> quantize -> shard --------------------- #
+        params = model_parameters
+        if params is None and config.checkpoint.checkpoint_dir:
+            params = self._load_checkpoint_params(config.checkpoint.checkpoint_dir,
+                                                  config.checkpoint.tag)
+        if params is None:
+            raise ValueError("init_inference needs model_parameters or "
+                             "config.checkpoint.checkpoint_dir")
+        params = tree_cast(params, self._dtype)
+        if config.quant.enabled:
+            params = self._quantize_weights(params)
+        self._tp_specs = self._derive_specs(params)
+        self.params = self._shard_params(params)
+
+        self._init_cache_fn = init_cache_fn
+        self._prefill = None
+        self._decode_step = None
+        self._forward = None
+        self._rng = jax.random.PRNGKey(config.seed)
+        log_dist(f"init_inference: tp={tp} ep={ep} dtype={config.dtype} "
+                 f"quant={'on' if config.quant.enabled else 'off'}", ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    # setup helpers
+    # ------------------------------------------------------------------ #
+
+    def _load_checkpoint_params(self, ckpt_dir: str, tag: Optional[str]):
+        """Parity: engine.py:331 _load_checkpoint — reads the training layout's
+        model_states file into a param pytree (keys are '/'-joined paths)."""
+        import os
+        from deepspeed_tpu.checkpoint.state import (MODEL_FILE, read_latest_tag)
+        tag = tag or read_latest_tag(ckpt_dir) or ""
+        path = os.path.join(ckpt_dir, tag, MODEL_FILE)
+        data = np.load(path)
+        tree: Dict[str, Any] = {}
+        for key in data.files:
+            parts = key.split("/")
+            node = tree
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = data[key]
+        return tree
+
+    def _quantize_weights(self, params):
+        """ZeRO-inference-style weight-only group quantization (parity:
+        inference/quantization/quantization.py): group-wise symmetric int
+        quant+dequant of matmul weights; memory savings come from the int8
+        representation in the v2 engine — here we keep numerics parity."""
+        from deepspeed_tpu.ops.quantizer import quantize_dequantize
+        bits = self.config.quant.bits
+        group = self.config.quant.group_size
+
+        def maybe_q(path, leaf):
+            name = "/".join(str(getattr(p, "key", p)) for p in path)
+            if leaf.ndim < 2 or "embed" in name or "norm" in name.lower():
+                return leaf
+            if leaf.size % group != 0:
+                return leaf
+            return quantize_dequantize(jnp.asarray(leaf), num_bits=bits,
+                                       group_size=group)
+
+        return jax.tree_util.tree_map_with_path(maybe_q, params)
+
+    def _derive_specs(self, params):
+        topo = self.topology
+        specs = None
+        if topo.tp_world_size > 1:
+            from deepspeed_tpu.parallel.tensor_parallel import (derive_tp_specs,
+                                                                tp_rules_for)
+            family = self.config.model_family or _guess_family(self.module)
+            specs = derive_tp_specs(params, tp_rules_for(family), topo.tp_world_size)
+        if topo.ep_world_size > 1:
+            from deepspeed_tpu.parallel.moe import derive_ep_specs
+            ep = derive_ep_specs(params, topo.ep_world_size)
+            if specs is None:
+                specs = ep
+            else:
+                specs = jax.tree_util.tree_map(
+                    lambda t, e: e if tuple(e) != () else t, specs, ep,
+                    is_leaf=lambda s: isinstance(s, P))
+        return specs
+
+    def _shard_params(self, params):
+        topo = self.topology
+        if self._tp_specs is None:
+            sh = jax.tree_util.tree_map(lambda _: topo.replicated(), params)
+        else:
+            sh = jax.tree_util.tree_map(
+                lambda s: NamedSharding(topo.mesh, s), self._tp_specs,
+                is_leaf=lambda s: isinstance(s, P))
+        return jax.device_put(params, sh)
+
+    def _cache_sharding(self, cache):
+        """KV cache [L, B, S, H_kv, D]: batch over 'data', heads over 'tensor'
+        when divisible (the reference slices the KV heads across TP ranks in its
+        injected attention modules)."""
+        topo = self.topology
+        tp = topo.tp_world_size
+
+        def sh(x):
+            spec = [None] * x.ndim
+            if x.ndim >= 5:
+                if x.shape[1] % max(topo.sizes[DATA_AXIS], 1) == 0:
+                    spec[1] = DATA_AXIS
+                if tp > 1 and x.shape[3] % tp == 0:
+                    spec[3] = TENSOR_AXIS
+            return NamedSharding(topo.mesh, P(*spec))
+
+        return jax.tree_util.tree_map(sh, cache)
+
+    def _make_cache(self, batch_size: int, max_len: int):
+        fn = self._init_cache_fn
+        if fn is None:
+            from deepspeed_tpu.models.llama import init_cache
+            fn = init_cache
+        cache = fn(self.model_config, batch_size, max_len, dtype=self._dtype)
+        return jax.device_put(cache, self._cache_sharding(cache))
+
+    # ------------------------------------------------------------------ #
+    # forward / generate
+    # ------------------------------------------------------------------ #
+
+    def forward(self, input_ids) -> jax.Array:
+        """Full-sequence logits (parity: InferenceEngine.forward engine.py:584)."""
+        if self._forward is None:
+            mod = self.module
+
+            def fwd(params, ids):
+                return mod.apply({"params": params}, ids,
+                                 method=type(mod).forward_logits)
+
+            self._forward = jax.jit(fwd)
+        return self._forward(self.params, jnp.asarray(input_ids))
+
+    __call__ = forward
+
+    def _build_gen_steps(self):
+        mod = self.module
+        method = type(mod).decode
+
+        def prefill(params, ids, cache):
+            logits, cache = mod.apply({"params": params}, ids, cache,
+                                      jnp.int32(0), method=method)
+            return logits[:, -1, :], cache
+
+        def step(params, tok, cache, index):
+            logits, cache = mod.apply({"params": params}, tok, cache, index,
+                                      method=method)
+            return logits[:, -1, :], cache
+
+        self._prefill = jax.jit(prefill, donate_argnums=(2,))
+        self._decode_step = jax.jit(step, donate_argnums=(2,))
+
+    def _sample(self, logits: jax.Array, do_sample: bool, temperature: float,
+                top_k: int) -> jax.Array:
+        if not do_sample:
+            return jnp.argmax(logits, axis=-1)
+        self._rng, key = jax.random.split(self._rng)
+        logits = logits / jnp.maximum(temperature, 1e-6)
+        if top_k > 0:
+            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            logits = jnp.where(logits < kth, jnp.finfo(logits.dtype).min, logits)
+        return jax.random.categorical(key, logits, axis=-1)
+
+    def generate(self,
+                 input_ids,
+                 max_new_tokens: int = 32,
+                 do_sample: bool = False,
+                 temperature: float = 1.0,
+                 top_k: int = 0,
+                 eos_token_id: Optional[int] = None) -> np.ndarray:
+        """Autoregressive generation (parity: the reference patches
+        ``model.generate`` through its injected modules; here an explicit jitted
+        prefill + decode loop). Returns [B, T + max_new_tokens] token ids."""
+        ids = jnp.asarray(input_ids)
+        B, T = ids.shape
+        if max_new_tokens > self.config.max_out_tokens:
+            raise ValueError(f"max_new_tokens {max_new_tokens} exceeds "
+                             f"config.max_out_tokens {self.config.max_out_tokens}")
+        max_len = T + max_new_tokens
+        if max_len > self.config.max_tokens:
+            raise ValueError(f"prompt+generation {max_len} exceeds "
+                             f"config.max_tokens {self.config.max_tokens}")
+        if self._prefill is None:
+            self._build_gen_steps()
+        cache = self._make_cache(B, max_len)
+        logits, cache = self._prefill(self.params, ids, cache)
+
+        out = [np.asarray(ids)]
+        tok = self._sample(logits, do_sample, temperature, top_k)
+        finished = np.zeros((B,), bool)
+        for i in range(max_new_tokens):
+            tok_np = np.asarray(tok)
+            if eos_token_id is not None and i + 1 >= self.config.min_out_tokens:
+                tok_np = np.where(finished, eos_token_id, tok_np)
+                finished |= tok_np == eos_token_id
+            out.append(tok_np[:, None])
+            if eos_token_id is not None and finished.all():
+                break
+            if i + 1 == max_new_tokens:
+                break
+            logits, cache = self._decode_step(self.params, jnp.asarray(tok_np)[:, None],
+                                              cache, jnp.int32(T + i))
+            tok = self._sample(logits, do_sample, temperature, top_k)
+        return np.concatenate(out, axis=1)
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def mp_world_size(self) -> int:
+        return self.topology.tp_world_size
+
+    def module_state_dict(self):
+        return jax.device_get(self.params)
+
+
+def _guess_family(model) -> Optional[str]:
+    name = type(model).__name__.lower()
+    for fam in ("mixtral", "llama", "gpt2", "bert", "neox", "mistral"):
+        if fam in name:
+            return "llama" if fam == "mistral" else fam
+    return None

@@ -145,6 +145,43 @@ def _window_bias(q_positions: jax.Array, k_positions: jax.Array,
     return jnp.where(ok, 0.0, jnp.finfo(jnp.float32).min)[:, None]
 
 
+def sliding_window_attention(q, k, v, positions, window: int) -> jax.Array:
+    """O(T·w) local attention: queries in block i attend keys in blocks i-1 and i
+    (block size = window, so [q-w+1, q] is always covered). Parity role: the
+    reference's long-sequence lever is block-sparse Triton attention
+    (ops/sparse_attention, 'bslongformer' pattern); this is the same banded
+    structure expressed as a blocked einsum XLA tiles onto the MXU — no [T, T]
+    score materialisation."""
+    B, T, H, D = q.shape
+    w = window
+    nb = -(-T // w)
+    pad = nb * w - T
+    if pad:
+        padw = ((0, 0), (0, pad), (0, 0), (0, 0))
+        q, k, v = (jnp.pad(t, padw) for t in (q, k, v))
+        # padded queries mask themselves out via positions = -inf sentinel
+        positions = jnp.pad(positions, ((0, 0), (0, pad)), constant_values=-(10 ** 9))
+    blk = lambda t: t.reshape(B, nb, w, H, D)
+    qb, kb, vb = blk(q), blk(k), blk(v)
+    def shift(t, fill=0):
+        pad_cfg = ((0, 0), (1, 0)) + ((0, 0),) * (t.ndim - 2)
+        return jnp.pad(t, pad_cfg, constant_values=fill)[:, :-1]
+
+    k2 = jnp.concatenate([shift(kb), kb], axis=2)          # [B, nb, 2w, H, D]
+    v2 = jnp.concatenate([shift(vb), vb], axis=2)
+    pb = positions.reshape(B, nb, w)
+    # phantom block before block 0 carries +inf-like positions => delta < 0 => masked
+    pk2 = jnp.concatenate([shift(pb, fill=2 ** 30), pb], axis=2)  # [B, nb, 2w]
+    delta = pb[..., :, None] - pk2[..., None, :]            # [B, nb, w, 2w]
+    ok = (delta >= 0) & (delta < w)
+    bias = jnp.where(ok, 0.0, jnp.finfo(jnp.float32).min)[:, :, None]  # [B,nb,1,w,2w]
+    scale = 1.0 / (D ** 0.5)
+    scores = jnp.einsum("bnqhd,bnkhd->bnhqk", qb, k2).astype(jnp.float32) * scale
+    probs = jax.nn.softmax(scores + bias, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bnhqk,bnkhd->bnqhd", probs, v2).reshape(B, nb * w, H, D)
+    return out[:, :T]
+
+
 class LlamaAttention(nn.Module):
     config: LlamaConfig
 
@@ -174,8 +211,7 @@ class LlamaAttention(nn.Module):
         n_rep = cfg.num_attention_heads // cfg.num_key_value_heads
         k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
         if cfg.sliding_window is not None and T > cfg.sliding_window:
-            bias = _window_bias(positions, positions, cfg.sliding_window)
-            out = reference_attention(q, k, v, bias=bias)
+            out = sliding_window_attention(q, k, v, positions, cfg.sliding_window)
         else:
             out = dot_product_attention(q, k, v, causal=True)
         return self.o_proj(out.reshape(B, T, cfg.num_attention_heads * cfg.head_dim))
@@ -239,6 +275,31 @@ class LlamaBlock(nn.Module):
         return x + self.mlp(self.post_attention_layernorm(x)), new_cache
 
 
+def causal_lm_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean next-token NLL with shift-by-one (shared by the CausalLM heads)."""
+    logp = jax.nn.log_softmax(logits[:, :-1, :], axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, 1:][..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def decode_layers(model, input_ids, cache, cache_index, positions):
+    """Shared incremental-decode trunk for the CausalLM heads (duck-typed over
+    ``embed_tokens``/``layers``/``norm``/``lm_head``). Returns (logits, cache)."""
+    B, T = input_ids.shape
+    if positions is None:
+        positions = cache_index + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    x = model.embed_tokens(input_ids)
+    new_k, new_v = [], []
+    for i, layer in enumerate(model.layers):
+        layer_cache = {"k": cache["k"][i], "v": cache["v"][i]}
+        x, nc = layer.decode(x, positions, layer_cache, cache_index)
+        new_k.append(nc["k"])
+        new_v.append(nc["v"])
+    x = model.norm(x)
+    logits = model.lm_head(x).astype(jnp.float32)
+    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+
 class LlamaForCausalLM(nn.Module):
     """Training: ``__call__(batch)`` -> loss (engine contract). Inference:
     ``apply(..., method='forward_logits'/'decode')``."""
@@ -275,12 +336,7 @@ class LlamaForCausalLM(nn.Module):
             labels = batch.get("labels", input_ids)
         else:
             input_ids, labels = batch, batch
-        logits = self.forward_logits(input_ids)
-        logits_s = logits[:, :-1, :]
-        labels_s = labels[:, 1:]
-        logp = jax.nn.log_softmax(logits_s, axis=-1)
-        nll = -jnp.take_along_axis(logp, labels_s[..., None], axis=-1)[..., 0]
-        return jnp.mean(nll)
+        return causal_lm_loss(self.forward_logits(input_ids), labels)
 
     def decode(self, input_ids, cache, cache_index, positions=None):
         """One incremental step (prefill or single-token decode).
@@ -288,19 +344,7 @@ class LlamaForCausalLM(nn.Module):
         input_ids: [B, T]; cache: pytree from ``init_cache`` — {"k","v"}:
         [L, B, S_max, H_kv, D]; cache_index: int32 write offset.
         Returns (logits [B, T, V] fp32, new_cache)."""
-        B, T = input_ids.shape
-        if positions is None:
-            positions = cache_index + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
-        x = self.embed_tokens(input_ids)
-        new_k, new_v = [], []
-        for i, layer in enumerate(self.layers):
-            layer_cache = {"k": cache["k"][i], "v": cache["v"][i]}
-            x, nc = layer.decode(x, positions, layer_cache, cache_index)
-            new_k.append(nc["k"])
-            new_v.append(nc["v"])
-        x = self.norm(x)
-        logits = self.lm_head(x).astype(jnp.float32)
-        return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+        return decode_layers(self, input_ids, cache, cache_index, positions)
 
 
 def init_cache(config: LlamaConfig, batch_size: int, max_len: int,

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.models.llama import (LlamaAttention, LlamaConfig, RMSNorm,
-                                        init_cache)
+                                        causal_lm_loss, decode_layers, init_cache)
 from deepspeed_tpu.parallel.moe import _capacity, _constrain_expert, topk_gating
 
 
@@ -151,26 +151,12 @@ class MixtralForCausalLM(nn.Module):
         else:
             input_ids, labels = batch, batch
         logits, aux_total = self._forward(input_ids)
-        logp = jax.nn.log_softmax(logits[:, :-1, :], axis=-1)
-        nll = -jnp.take_along_axis(logp, labels[:, 1:][..., None], axis=-1)[..., 0]
-        loss = jnp.mean(nll)
+        loss = causal_lm_loss(logits, labels)
         cfg = self.config
         return loss + cfg.router_aux_loss_coef * aux_total / cfg.num_hidden_layers
 
     def decode(self, input_ids, cache, cache_index, positions=None):
-        B, T = input_ids.shape
-        if positions is None:
-            positions = cache_index + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
-        x = self.embed_tokens(input_ids)
-        new_k, new_v = [], []
-        for i, layer in enumerate(self.layers):
-            layer_cache = {"k": cache["k"][i], "v": cache["v"][i]}
-            x, nc = layer.decode(x, positions, layer_cache, cache_index)
-            new_k.append(nc["k"])
-            new_v.append(nc["v"])
-        x = self.norm(x)
-        return self.lm_head(x).astype(jnp.float32), {"k": jnp.stack(new_k),
-                                                     "v": jnp.stack(new_v)}
+        return decode_layers(self, input_ids, cache, cache_index, positions)
 
 
 __all__ = ["MixtralConfig", "MixtralForCausalLM", "init_cache"]

@@ -85,6 +85,23 @@ class TestLlama:
         assert np.abs(np.asarray(lg1)[0, 1] - np.asarray(lg2)[0, 1]).max() > 1e-6
 
 
+class TestSlidingWindowAttention:
+    def test_matches_dense_masked(self):
+        """Blocked O(T·w) local attention == dense attention with window bias."""
+        from deepspeed_tpu.models.llama import (_window_bias,
+                                                sliding_window_attention)
+        from deepspeed_tpu.ops.attention import reference_attention
+        B, T, H, D, w = 2, 23, 2, 8, 5  # T deliberately not a multiple of w
+        key = jax.random.PRNGKey(0)
+        q, k, v = (jax.random.normal(kk, (B, T, H, D))
+                   for kk in jax.random.split(key, 3))
+        pos = jnp.broadcast_to(jnp.arange(T)[None], (B, T))
+        dense = reference_attention(q, k, v, bias=_window_bias(pos, pos, w))
+        blocked = sliding_window_attention(q, k, v, pos, w)
+        np.testing.assert_allclose(np.asarray(dense), np.asarray(blocked),
+                                   rtol=1e-5, atol=1e-5)
+
+
 class TestRoPEUtils:
     def test_rope_rotation_norm_preserving(self):
         x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 4, 16))
