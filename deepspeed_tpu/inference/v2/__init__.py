@@ -1,0 +1,9 @@
+"""Inference v2 — the FastGen-class ragged/continuous-batching engine.
+
+Parity: reference ``deepspeed/inference/v2`` (``engine_v2.py:30 InferenceEngineV2``,
+the ``ragged/`` KV subsystem, and the Dynamic SplitFuse scheduling described in
+``blogs/deepspeed-fastgen``). TPU-native design notes live in ``engine_v2.py``.
+"""
+
+from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2

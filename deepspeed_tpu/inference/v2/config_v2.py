@@ -1,0 +1,60 @@
+"""Inference v2 engine configuration.
+
+Parity: ``RaggedInferenceEngineConfig`` (reference ``inference/v2/config_v2.py``)
+with its ``DSStateManagerConfig`` (``ragged/manager_configs.py``): tracked-sequence
+capacity, ragged-batch token budget, and KV memory sizing — plus the TPU additions
+(mesh/tp size, page block size).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+
+@dataclass
+class DSStateManagerConfig:
+    """Parity: ``DSStateManagerConfig`` (manager_configs.py)."""
+    max_tracked_sequences: int = 64          # sequences with live KV state
+    max_ragged_sequence_count: int = 32      # decode rows per pass
+    max_ragged_batch_size: int = 768         # token budget per pass (chunk + decode)
+    max_context: int = 8192                  # per-sequence KV capacity
+
+    @property
+    def chunk_budget(self) -> int:
+        return self.max_ragged_batch_size - self.max_ragged_sequence_count
+
+
+@dataclass
+class KVCacheSizingConfig:
+    block_size: int = 128
+    num_blocks: Optional[int] = None         # explicit pool size
+    memory_fraction: float = 0.8             # else: fraction of free HBM
+
+
+@dataclass
+class RaggedInferenceEngineConfig:
+    state_manager: DSStateManagerConfig = field(default_factory=DSStateManagerConfig)
+    kv_cache: KVCacheSizingConfig = field(default_factory=KVCacheSizingConfig)
+    tensor_parallel: int = 1
+    dtype: Any = jnp.bfloat16
+    seed: int = 0
+
+    @classmethod
+    def load(cls, config=None, **overrides) -> "RaggedInferenceEngineConfig":
+        if isinstance(config, cls):
+            cfg = config
+        else:
+            d = dict(config or {})
+            d.update(overrides)
+            sm = DSStateManagerConfig(**d.pop("state_manager", {})) \
+                if not isinstance(d.get("state_manager"), DSStateManagerConfig) \
+                else d.pop("state_manager")
+            kv = d.pop("kv_cache", {})
+            kv = KVCacheSizingConfig(**kv) if isinstance(kv, dict) else kv
+            cfg = cls(state_manager=sm, kv_cache=kv, **d)
+        if cfg.state_manager.chunk_budget <= 0:
+            raise ValueError("max_ragged_batch_size must exceed max_ragged_sequence_count")
+        return cfg

@@ -1,0 +1,6 @@
+"""Ragged batching primitives (parity: reference ``inference/v2/ragged/``)."""
+
+from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache, KVCacheConfig
+from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import DSSequenceDescriptor
+from deepspeed_tpu.inference.v2.ragged.ragged_batch import RaggedBatch

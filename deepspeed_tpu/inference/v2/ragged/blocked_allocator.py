@@ -1,0 +1,51 @@
+"""KV-block allocator.
+
+Parity: ``BlockedAllocator`` (reference ``inference/v2/ragged/blocked_allocator.py``)
+— a host-side free list over the fixed pool of KV-cache pages. The reference keeps
+an int32 next-pointer linked list in a torch tensor; here a plain python deque (the
+pool is host metadata, never shipped to device — only block *tables* are).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Iterable, List
+
+import numpy as np
+
+
+class BlockedAllocator:
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"need at least 1 block, got {num_blocks}")
+        self._num_blocks = num_blocks
+        self._free = deque(range(num_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def total_blocks(self) -> int:
+        return self._num_blocks
+
+    def allocate(self, num_blocks: int) -> np.ndarray:
+        """Pop ``num_blocks`` page ids; raises if the pool is exhausted (the
+        scheduler checks ``free_blocks`` first — parity: engine_v2 can_schedule)."""
+        if num_blocks > len(self._free):
+            raise RuntimeError(
+                f"cannot allocate {num_blocks} blocks, only {len(self._free)} free")
+        return np.array([self._free.popleft() for _ in range(num_blocks)],
+                        dtype=np.int32)
+
+    def free(self, blocks: Iterable[int]) -> None:
+        blocks = list(int(b) for b in blocks)
+        for b in blocks:
+            if not (0 <= b < self._num_blocks):
+                raise ValueError(f"block id {b} out of range")
+        in_free = set(self._free)
+        for b in blocks:
+            if b in in_free:
+                raise ValueError(f"double free of block {b}")
+        self._free.extend(blocks)

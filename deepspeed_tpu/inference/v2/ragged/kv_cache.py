@@ -1,0 +1,99 @@
+"""Blocked (paged) KV cache.
+
+Parity: ``KVCacheManager`` / blocked KV configs (reference
+``inference/v2/ragged/kv_cache.py`` + ``inference/v2/ragged/manager_configs.py``).
+Pages are device arrays ``[L, num_blocks, block_size, H_kv, D]`` — layout chosen so
+
+  - the per-token cache write is one flat scatter (`block * block_size + slot`)
+    over the fused (num_blocks, block_size) dim, and
+  - the paged decode kernel (``ops/pallas/paged_attention.py``) pulls one page per
+    grid step via scalar-prefetched block tables.
+
+Sharding: KV heads ride the 'tensor' mesh axis when divisible (the reference slices
+KV heads across TP ranks in its sharded model implementations); layers/pages are
+never sharded — a page must live whole on the chip that attends with it.
+
+The cache arrays are *functional*: each engine pass takes them as donated jit
+arguments and returns the updated pages, so XLA aliases them in place in HBM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import TENSOR_AXIS, MeshTopology
+
+
+@dataclass
+class KVCacheConfig:
+    num_layers: int
+    num_kv_heads: int
+    head_dim: int
+    block_size: int = 128
+    num_blocks: int = 256
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def max_tokens(self) -> int:
+        return self.num_blocks * self.block_size
+
+    def bytes_per_block(self) -> int:
+        itemsize = jnp.dtype(self.dtype).itemsize
+        return 2 * self.num_layers * self.block_size * self.num_kv_heads * \
+            self.head_dim * itemsize
+
+    @classmethod
+    def from_memory_budget(cls, num_layers: int, num_kv_heads: int, head_dim: int,
+                           budget_bytes: int, block_size: int = 128,
+                           dtype: Any = jnp.bfloat16) -> "KVCacheConfig":
+        """Size the pool from an HBM budget (parity: the reference sizes its pool
+        from free GPU memory after model load, ``engine_v2.py`` memory config)."""
+        probe = cls(num_layers, num_kv_heads, head_dim, block_size, 1, dtype)
+        nb = max(1, budget_bytes // probe.bytes_per_block())
+        return cls(num_layers, num_kv_heads, head_dim, block_size, int(nb), dtype)
+
+
+class BlockedKVCache:
+    """Owns the page arrays and their sharding."""
+
+    def __init__(self, config: KVCacheConfig, topology: Optional[MeshTopology] = None):
+        self.config = config
+        self.topology = topology
+        shape = (config.num_layers, config.num_blocks, config.block_size,
+                 config.num_kv_heads, config.head_dim)
+        sharding = None
+        if topology is not None:
+            tp = topology.tp_world_size
+            spec = [None] * 5
+            if tp > 1 and config.num_kv_heads % tp == 0:
+                spec[3] = TENSOR_AXIS
+            sharding = NamedSharding(topology.mesh, P(*spec))
+        self.k = _zeros(shape, config.dtype, sharding)
+        self.v = _zeros(shape, config.dtype, sharding)
+        self.sharding = sharding
+
+    def update(self, k: jax.Array, v: jax.Array) -> None:
+        """Adopt the pages returned by a jitted pass (donated in, aliased out)."""
+        self.k, self.v = k, v
+
+    def flat_write_index(self, block_id: np.ndarray, slot: np.ndarray) -> np.ndarray:
+        """Host-side: flat scatter destination over the fused page dim; padding
+        rows use an out-of-bounds sentinel so the scatter drops them."""
+        return (np.asarray(block_id, np.int64) * self.config.block_size
+                + np.asarray(slot, np.int64)).astype(np.int32)
+
+    @property
+    def oob_sentinel(self) -> int:
+        return self.config.num_blocks * self.config.block_size
+
+
+def _zeros(shape: Tuple[int, ...], dtype, sharding):
+    if sharding is None:
+        return jnp.zeros(shape, dtype)
+    return jax.jit(lambda: jnp.zeros(shape, dtype), out_shardings=sharding)()

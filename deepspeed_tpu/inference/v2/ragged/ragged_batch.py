@@ -1,0 +1,93 @@
+"""Host-side pass descriptor arrays.
+
+Parity: ``RaggedBatchWrapper`` (reference ``inference/v2/ragged/ragged_wrapper.py``)
+— the per-forward metadata buffers (token ids, inflight descriptors, KV block
+tables) assembled on host and shipped to device once per pass. The reference uses
+pinned host buffers (``ragged/csrc/fast_host_buffer.cu``); here plain numpy arrays
+feed ``jax.device_put`` / jit donation.
+
+Pass layout (static shapes; see ``ragged_model.py`` for how each section is used):
+
+  - **chunk section** (``chunk_budget`` rows): one sequence's prompt chunk —
+    Dynamic SplitFuse processes at most one prompt chunk per pass alongside all
+    ready decode tokens, so prefill never stalls token generation.
+  - **decode section** (``max_sequences`` rows): one query token per sequence,
+    served by the paged flash-decode kernel.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class RaggedBatch:
+    # static capacities
+    chunk_budget: int
+    max_sequences: int
+    max_blocks: int
+
+    # chunk section (one prompt chunk)
+    chunk_uid: Optional[int] = None
+    chunk_tokens: np.ndarray = None           # [C] int32
+    chunk_positions: np.ndarray = None        # [C] int32
+    chunk_num_tokens: int = 0
+    chunk_block_table: np.ndarray = None      # [MB] int32
+    chunk_ctx_len: int = 0                    # kv visible after this chunk
+    chunk_is_final: bool = False              # last chunk of prompt -> logits used
+
+    # decode section
+    decode_uids: List[int] = field(default_factory=list)
+    decode_tokens: np.ndarray = None          # [S] int32
+    decode_positions: np.ndarray = None       # [S] int32
+    decode_block_tables: np.ndarray = None    # [S, MB] int32
+    decode_ctx_lens: np.ndarray = None        # [S] int32 (0 => inactive row)
+
+    # flat KV scatter destinations for every new token, chunk rows then decode
+    # rows; padding rows hold the cache's OOB sentinel so the write drops them
+    kv_dest: np.ndarray = None                # [C + S] int32
+
+    def __post_init__(self):
+        C, S, MB = self.chunk_budget, self.max_sequences, self.max_blocks
+        if self.chunk_tokens is None:
+            self.chunk_tokens = np.zeros((C,), np.int32)
+        if self.chunk_positions is None:
+            self.chunk_positions = np.zeros((C,), np.int32)
+        if self.chunk_block_table is None:
+            self.chunk_block_table = np.zeros((MB,), np.int32)
+        if self.decode_tokens is None:
+            self.decode_tokens = np.zeros((S,), np.int32)
+        if self.decode_positions is None:
+            self.decode_positions = np.zeros((S,), np.int32)
+        if self.decode_block_tables is None:
+            self.decode_block_tables = np.zeros((S, MB), np.int32)
+        if self.decode_ctx_lens is None:
+            self.decode_ctx_lens = np.zeros((S,), np.int32)
+        if self.kv_dest is None:
+            self.kv_dest = np.zeros((C + S,), np.int32)
+
+    @property
+    def current_tokens(self) -> int:
+        return self.chunk_num_tokens + len(self.decode_uids)
+
+    @property
+    def current_sequences(self) -> int:
+        return (1 if self.chunk_uid is not None else 0) + len(self.decode_uids)
+
+    def device_arrays(self) -> Dict[str, Any]:
+        """The dict handed to the jitted pass (shapes static across passes)."""
+        return {
+            "chunk_tokens": self.chunk_tokens,
+            "chunk_positions": self.chunk_positions,
+            "chunk_num_tokens": np.int32(self.chunk_num_tokens),
+            "chunk_block_table": self.chunk_block_table,
+            "chunk_ctx_len": np.int32(self.chunk_ctx_len),
+            "decode_tokens": self.decode_tokens,
+            "decode_positions": self.decode_positions,
+            "decode_block_tables": self.decode_block_tables,
+            "decode_ctx_lens": self.decode_ctx_lens,
+            "kv_dest": self.kv_dest,
+        }

@@ -1,0 +1,166 @@
+"""Dynamic SplitFuse pass scheduler.
+
+Parity: the FastGen scheduling policy (reference ``blogs/deepspeed-fastgen`` §
+"Dynamic SplitFuse", and the ``can_schedule``/``query`` accounting in
+``inference/v2/engine_v2.py:153-227``): long prompts are decomposed into chunks
+processed across passes; short work is composed so every pass runs near the token
+budget. Each pass here = all ready decode tokens (one per active sequence, up to
+``max_ragged_sequence_count``) + at most one prompt chunk (up to ``chunk_budget``
+tokens) — the chunk's matmuls amortise the decode tokens' bandwidth, which is the
+SplitFuse win; attention splits per section (dense flash for the chunk, paged
+flash-decode for the rest) in ``ragged_model.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache
+from deepspeed_tpu.inference.v2.ragged.ragged_batch import RaggedBatch
+from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import DSSequenceDescriptor
+
+
+class DynamicSplitFuseScheduler:
+
+    def __init__(self, config: DSStateManagerConfig, cache: BlockedKVCache,
+                 allocator: BlockedAllocator):
+        self.config = config
+        self.cache = cache
+        self.allocator = allocator
+        self.seqs: Dict[int, DSSequenceDescriptor] = {}
+        bs = cache.config.block_size
+        self.max_blocks = -(-config.max_context // bs)
+
+    # ------------------------------------------------------------------ #
+    # sequence admission (parity: engine_v2.put token intake)
+    # ------------------------------------------------------------------ #
+
+    def add_tokens(self, uid: int, tokens: np.ndarray) -> None:
+        if uid not in self.seqs:
+            if len(self.seqs) >= self.config.max_tracked_sequences:
+                raise RuntimeError(
+                    f"max_tracked_sequences={self.config.max_tracked_sequences} exceeded")
+            self.seqs[uid] = DSSequenceDescriptor(uid=uid)
+        seq = self.seqs[uid]
+        seq.extend_pending(tokens)
+        total = seq.seen_tokens + len(seq.pending)
+        if total > self.config.max_context:
+            raise ValueError(f"sequence {uid}: {total} tokens > max_context "
+                             f"{self.config.max_context}")
+
+    def flush(self, uid: int) -> None:
+        """Release a sequence's KV blocks (parity: ``engine_v2.flush``)."""
+        seq = self.seqs.pop(uid, None)
+        if seq is not None and seq.blocks:
+            self.allocator.free(seq.blocks)
+
+    # ------------------------------------------------------------------ #
+    # capacity queries (parity: engine_v2.query/can_schedule :153-227)
+    # ------------------------------------------------------------------ #
+
+    def query(self, uid: int, max_request_tokens: int) -> Tuple[int, int]:
+        """(max new tokens fundable by free blocks, free blocks)."""
+        seq = self.seqs.get(uid, DSSequenceDescriptor(uid=uid))
+        bs = self.cache.config.block_size
+        slack = len(seq.blocks) * bs - seq.seen_tokens
+        fundable = slack + self.allocator.free_blocks * bs
+        return min(max_request_tokens, fundable), self.allocator.free_blocks
+
+    def can_schedule(self, uids: List[int], lengths: List[int]) -> bool:
+        bs = self.cache.config.block_size
+        needed = 0
+        for uid, n in zip(uids, lengths):
+            seq = self.seqs.get(uid, DSSequenceDescriptor(uid=uid))
+            needed += seq.kv_blocks_needed(len(seq.pending) + n, bs)
+        if needed > self.allocator.free_blocks:
+            return False
+        new = sum(1 for u in uids if u not in self.seqs)
+        return len(self.seqs) + new <= self.config.max_tracked_sequences
+
+    def has_pending(self) -> bool:
+        return any(len(s.pending) > 0 for s in self.seqs.values())
+
+    # ------------------------------------------------------------------ #
+    # pass construction
+    # ------------------------------------------------------------------ #
+
+    def _ensure_blocks(self, seq: DSSequenceDescriptor, new_tokens: int) -> None:
+        need = seq.kv_blocks_needed(new_tokens, self.cache.config.block_size)
+        if need:
+            seq.blocks.extend(int(b) for b in self.allocator.allocate(need))
+
+    def schedule_pass(self) -> Optional[RaggedBatch]:
+        """Build the next pass, or None when no pending work exists."""
+        cfg = self.config
+        C, S, MB = cfg.chunk_budget, cfg.max_ragged_sequence_count, self.max_blocks
+        bs = self.cache.config.block_size
+        batch = RaggedBatch(chunk_budget=C, max_sequences=S, max_blocks=MB)
+        kv_dest = np.full((C + S,), self.cache.oob_sentinel, np.int32)
+
+        # decode rows: sequences holding exactly one pending token
+        decode = [s for s in self.seqs.values()
+                  if len(s.pending) == 1 and s.seen_tokens > 0]
+        decode = decode[:S]
+        for row, seq in enumerate(decode):
+            self._ensure_blocks(seq, 1)
+            pos = seq.seen_tokens
+            batch.decode_uids.append(seq.uid)
+            batch.decode_tokens[row] = seq.pending[0]
+            batch.decode_positions[row] = pos
+            batch.decode_block_tables[row] = seq.block_table(MB)
+            batch.decode_ctx_lens[row] = pos + 1
+            kv_dest[C + row] = self.cache.flat_write_index(
+                seq.blocks[pos // bs], pos % bs)
+            seq.in_flight_tokens = 1
+
+        # one prompt chunk: longest pending first (prefer finishing prefills)
+        prompts = sorted((s for s in self.seqs.values()
+                          if len(s.pending) > 1 or
+                          (len(s.pending) == 1 and s.seen_tokens == 0
+                           and s.uid not in batch.decode_uids)),
+                         key=lambda s: -len(s.pending))
+        if prompts:
+            seq = prompts[0]
+            n = min(C, len(seq.pending))
+            self._ensure_blocks(seq, n)
+            positions = seq.seen_tokens + np.arange(n, dtype=np.int32)
+            batch.chunk_uid = seq.uid
+            batch.chunk_tokens[:n] = seq.pending[:n]
+            batch.chunk_positions[:n] = positions
+            batch.chunk_num_tokens = n
+            batch.chunk_block_table = seq.block_table(MB)
+            batch.chunk_ctx_len = seq.seen_tokens + n
+            batch.chunk_is_final = (n == len(seq.pending))
+            blocks = np.asarray(seq.blocks, np.int32)
+            kv_dest[:n] = self.cache.flat_write_index(
+                blocks[positions // bs], positions % bs)
+            seq.in_flight_tokens = n
+
+        batch.kv_dest = kv_dest
+        if batch.current_sequences == 0:
+            return None
+        return batch
+
+    def complete_pass(self, batch: RaggedBatch) -> List[int]:
+        """Advance descriptors after the pass ran; returns uids whose *next-token
+        logits* this pass produced (final prompt chunks + all decode rows)."""
+        finished: List[int] = []
+        if batch.chunk_uid is not None:
+            seq = self.seqs[batch.chunk_uid]
+            n = seq.in_flight_tokens
+            seq.seen_tokens += n
+            seq.pending = seq.pending[n:]
+            seq.in_flight_tokens = 0
+            if batch.chunk_is_final:
+                finished.append(seq.uid)
+        for uid in batch.decode_uids:
+            seq = self.seqs[uid]
+            seq.seen_tokens += 1
+            seq.pending = seq.pending[1:]
+            seq.in_flight_tokens = 0
+            finished.append(uid)
+        return finished

@@ -1,0 +1,314 @@
+"""Paged (blocked-KV) decode attention for TPU (Pallas).
+
+Parity role: the reference's ragged inference kernels — blocked flash decode over a
+paged KV cache (``inference/v2/kernels/ragged_ops/blocked_flash``, the CUDA
+flash-attn wrapper reading ``linear_blocked_kv_rotary``-filled KV pages). SURVEY §7
+ranks this the hardest kernel in the project; this is the TPU-native take:
+
+  - The KV cache lives in HBM as pages ``[num_blocks, block_size, H_kv, D]``
+    (``inference/ragged/kv_cache.py``); sequences own arbitrary page lists
+    (block tables), so there is no per-sequence contiguous KV to flash over.
+  - One grid step = (one sequence, one page). The page's physical index comes from
+    the block table via **scalar prefetch** (`PrefetchScalarGridSpec`): Pallas reads
+    ``block_tables[s, i]`` *before* issuing the HBM->VMEM copy for the page, so the
+    gather is free — no materialised per-sequence KV copy (the XLA fallback below
+    pays that copy; the kernel does not).
+  - Online softmax (flash) across a sequence's pages with running (m, l, acc) in
+    VMEM scratch, exactly like the training flash kernel
+    (``ops/pallas/flash_attention.py``).
+  - GQA: the q head block is reshaped to [H_kv, G, D] and both dots batch over
+    H_kv, so K/V pages are read once per sequence regardless of the group size.
+
+Decode-only by design (one query token per sequence): SplitFuse prompt chunks take
+the dense-flash path over a gathered context instead (``inference/v2/ragged_model``)
+— chunk attention is compute-bound where paging buys little, while decode attention
+is bandwidth-bound and must not copy the KV.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_sc, m_sc, l_sc, *, scale, block_size, max_blocks,
+                   h_kv, groups):
+    s, i = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    ctx = cl_ref[s]
+
+    @pl.when(i * block_size < ctx)
+    def _():
+        H = h_kv * groups
+        q = q_ref[0].astype(jnp.float32)                       # [H, D]
+        k = k_ref[0]                                           # [bs, H_kv, D]
+        v = v_ref[0]
+        # GQA: per kv head, the group's G query rows share one K/V page slice.
+        # Mosaic wants plain 2D dots (batched dot_general with differing batch-dim
+        # positions is unsupported), and h_kv is tiny, so unroll over kv heads.
+        scs = []
+        for h in range(h_kv):
+            qh = q[h * groups:(h + 1) * groups, :]             # [G, D]
+            kh = k[:, h, :].astype(jnp.float32)                # [bs, D]
+            scs.append(jax.lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
+                                           preferred_element_type=jnp.float32))
+        sc = jnp.concatenate(scs, axis=0) * scale              # [H, bs]
+        tok = i * block_size + jax.lax.broadcasted_iota(jnp.int32, (H, block_size), 1)
+        sc = jnp.where(tok < ctx, sc, NEG_INF)
+
+        m_prev = m_sc[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
+        p = jnp.exp(sc - m_new)                                # [H, bs]
+        alpha = jnp.exp(m_prev - m_new)
+        l_sc[:, 0:1] = l_sc[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_sc[:, 0:1] = m_new
+        pvs = []
+        for h in range(h_kv):
+            ph = p[h * groups:(h + 1) * groups, :]             # [G, bs]
+            vh = v[:, h, :].astype(jnp.float32)                # [bs, D]
+            pvs.append(jax.lax.dot_general(ph, vh, (((1,), (0,)), ((), ())),
+                                           preferred_element_type=jnp.float32))
+        pv = jnp.concatenate(pvs, axis=0)                      # [H, D]
+        acc_sc[:] = acc_sc[:] * alpha + pv
+
+    @pl.when(i == max_blocks - 1)
+    def _():
+        l = l_sc[:, 0:1]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0] = (acc_sc[:] / safe_l).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jax.Array,
+                           k_pages: jax.Array,
+                           v_pages: jax.Array,
+                           block_tables: jax.Array,
+                           ctx_lens: jax.Array,
+                           softmax_scale: Optional[float] = None) -> jax.Array:
+    """Single-token-per-sequence attention over a paged KV cache.
+
+    q:            [S, H, D]        one query token per sequence
+    k_pages:      [NB, bs, H_kv, D]
+    v_pages:      [NB, bs, H_kv, D]
+    block_tables: [S, MB] int32    physical page ids per sequence (0-padded)
+    ctx_lens:     [S] int32        tokens visible per sequence (incl. current)
+
+    Returns [S, H, D]. Rows whose ctx_len is 0 return zeros.
+    """
+    S, H, D = q.shape
+    NB, bs, Hkv, Dk = k_pages.shape
+    assert Dk == D, (Dk, D)
+    assert H % Hkv == 0, f"GQA: {H} q heads not divisible by {Hkv} kv heads"
+    G = H // Hkv
+    MB = block_tables.shape[1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+
+    kernel = functools.partial(_decode_kernel, scale=scale, block_size=bs,
+                               max_blocks=MB, h_kv=Hkv, groups=G)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, MB),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda s, i, bt, cl: (s, 0, 0)),
+            pl.BlockSpec((1, bs, Hkv, D), lambda s, i, bt, cl: (bt[s, i], 0, 0, 0)),
+            pl.BlockSpec((1, bs, Hkv, D), lambda s, i, bt, cl: (bt[s, i], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda s, i, bt, cl: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, D), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32), q, k_pages, v_pages)
+
+
+def _chunk_kernel(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_sc, m_sc, l_sc, *, scale, block_size, block_q,
+                  max_blocks, h_kv, groups):
+    iq, i = pl.program_id(0), pl.program_id(1)
+    q0 = meta_ref[0]
+    ctx = meta_ref[1]
+
+    @pl.when(i == 0)
+    def _():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    # causal skip: page starts past this q block's last visible position
+    run = (i * block_size <= q0 + iq * block_q + block_q - 1) & (i * block_size < ctx)
+
+    @pl.when(run)
+    def _():
+        bq, G, bs = block_q, groups, block_size
+        q = q_ref[:].astype(jnp.float32)                       # [bq, H, D]
+        q_pos = q0 + iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bs), 0)
+        k_pos = i * bs + jax.lax.broadcasted_iota(jnp.int32, (bq, bs), 1)
+        mask = (k_pos <= q_pos) & (k_pos < ctx)                # [bq, bs]
+        mask = jnp.broadcast_to(mask[:, None, :], (bq, G, bs)).reshape(bq * G, bs)
+
+        # per kv head: the group's bq*G query rows share one page slice
+        for h in range(h_kv):
+            qh = q[:, h * G:(h + 1) * G, :].reshape(bq * G, -1)
+            kh = k_ref[0, :, h, :].astype(jnp.float32)         # [bs, D]
+            vh = v_ref[0, :, h, :].astype(jnp.float32)
+            sc = jax.lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32) * scale
+            sc = jnp.where(mask, sc, NEG_INF)
+            rows = slice(h * bq * G, (h + 1) * bq * G)
+            m_prev = m_sc[rows, 0:1]
+            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
+            p = jnp.exp(sc - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_sc[rows, 0:1] = l_sc[rows, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+            m_sc[rows, 0:1] = m_new
+            acc_sc[rows, :] = acc_sc[rows, :] * alpha + jax.lax.dot_general(
+                p, vh, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(i == max_blocks - 1)
+    def _():
+        bq, G = block_q, groups
+        l = l_sc[:, 0:1]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o = acc_sc[:] / safe_l                                  # [Hkv*bq*G, D]
+        o = o.reshape(h_kv, bq, G, -1)
+        o_ref[:] = jnp.moveaxis(o, 0, 1).reshape(bq, h_kv * G, -1).astype(o_ref.dtype)
+
+
+def paged_chunk_attention(q: jax.Array,
+                          k_pages: jax.Array,
+                          v_pages: jax.Array,
+                          block_table: jax.Array,
+                          q_start,
+                          ctx_len,
+                          softmax_scale: Optional[float] = None,
+                          block_q: int = 128) -> jax.Array:
+    """Prompt-chunk (prefill) flash attention over one sequence's paged KV.
+
+    The SplitFuse chunk side: ``q`` holds a contiguous chunk of one sequence's
+    prompt occupying absolute positions ``[q_start, q_start + C)``; its KV (and all
+    earlier context) is already written to the pages. Reads pages directly via the
+    scalar-prefetched block table — like the decode kernel, no per-sequence KV
+    gather copy — with flash online softmax across pages and causal masking by
+    absolute position.
+
+    q:           [C, H, D]
+    k/v_pages:   [NB, bs, H_kv, D]
+    block_table: [MB] int32
+    q_start:     int32 — absolute position of q row 0
+    ctx_len:     int32 — KV tokens visible in total (= q_start + C for prefill)
+
+    Rows past the real chunk length are computed but meaningless (the caller
+    ignores them); with ctx_len == 0 the output is zeros.
+    """
+    C, H, D = q.shape
+    NB, bs, Hkv, _ = k_pages.shape
+    assert H % Hkv == 0
+    G = H // Hkv
+    MB = block_table.shape[0]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+    bq = block_q
+    while C % bq != 0:
+        bq //= 2
+    bq = max(bq, 1)
+    nq = C // bq
+
+    meta = jnp.stack([jnp.asarray(q_start, jnp.int32),
+                      jnp.asarray(ctx_len, jnp.int32)])
+    kernel = functools.partial(_chunk_kernel, scale=scale, block_size=bs,
+                               block_q=bq, max_blocks=MB, h_kv=Hkv, groups=G)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nq, MB),
+        in_specs=[
+            pl.BlockSpec((bq, H, D), lambda iq, i, bt, m: (iq, 0, 0)),
+            pl.BlockSpec((1, bs, Hkv, D), lambda iq, i, bt, m: (bt[i], 0, 0, 0)),
+            pl.BlockSpec((1, bs, Hkv, D), lambda iq, i, bt, m: (bt[i], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bq, H, D), lambda iq, i, bt, m: (iq, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv * bq * G, D), jnp.float32),
+            pltpu.VMEM((Hkv * bq * G, 128), jnp.float32),
+            pltpu.VMEM((Hkv * bq * G, 128), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((C, H, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(block_table.astype(jnp.int32), meta, q, k_pages, v_pages)
+
+
+def paged_chunk_attention_reference(q, k_pages, v_pages, block_table, q_start,
+                                    ctx_len, softmax_scale: Optional[float] = None):
+    """jnp reference for the chunk kernel (materialises the [C, MB*bs] scores)."""
+    C, H, D = q.shape
+    NB, bs, Hkv, _ = k_pages.shape
+    G = H // Hkv
+    MB = block_table.shape[0]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+    k_seq = k_pages[block_table].reshape(MB * bs, Hkv, D)
+    v_seq = v_pages[block_table].reshape(MB * bs, Hkv, D)
+    k_seq = jnp.repeat(k_seq, G, axis=1)
+    v_seq = jnp.repeat(v_seq, G, axis=1)
+    sc = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                    k_seq.astype(jnp.float32)) * scale
+    q_pos = q_start + jnp.arange(C)
+    k_pos = jnp.arange(MB * bs)
+    mask = (k_pos[None, :] <= q_pos[:, None]) & (k_pos[None, :] < ctx_len)
+    sc = jnp.where(mask[None], sc, NEG_INF)
+    p = jax.nn.softmax(sc, axis=-1)
+    p = jnp.where(jnp.any(mask, axis=-1)[None, :, None], p, 0.0)
+    out = jnp.einsum("hqk,khd->qhd", p, v_seq.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def paged_decode_attention_reference(q, k_pages, v_pages, block_tables, ctx_lens,
+                                     softmax_scale: Optional[float] = None):
+    """jnp reference (gathers each sequence's pages — the copy the kernel avoids)."""
+    S, H, D = q.shape
+    NB, bs, Hkv, _ = k_pages.shape
+    G = H // Hkv
+    MB = block_tables.shape[1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+
+    k_seq = k_pages[block_tables].reshape(S, MB * bs, Hkv, D)
+    v_seq = v_pages[block_tables].reshape(S, MB * bs, Hkv, D)
+    k_seq = jnp.repeat(k_seq, G, axis=2)
+    v_seq = jnp.repeat(v_seq, G, axis=2)
+    sc = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),
+                    k_seq.astype(jnp.float32)) * scale
+    mask = jnp.arange(MB * bs)[None, None, :] < ctx_lens[:, None, None]
+    sc = jnp.where(mask, sc, NEG_INF)
+    p = jax.nn.softmax(sc, axis=-1)
+    p = jnp.where(ctx_lens[:, None, None] > 0, p, 0.0)
+    out = jnp.einsum("sht,sthd->shd", p, v_seq.astype(jnp.float32))
+    return out.astype(q.dtype)
