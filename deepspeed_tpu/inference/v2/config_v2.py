@@ -45,6 +45,9 @@ class RaggedInferenceEngineConfig:
     @classmethod
     def load(cls, config=None, **overrides) -> "RaggedInferenceEngineConfig":
         if isinstance(config, cls):
+            if overrides:
+                raise ValueError("pass overrides via a dict config, not on top of "
+                                 "an already-built RaggedInferenceEngineConfig")
             cfg = config
         else:
             d = dict(config or {})

@@ -1,0 +1,375 @@
+"""Ragged model implementations for the v2 engine.
+
+Parity: reference ``inference/v2/model_implementations/`` (llama_v2, mistral,
+mixtral, opt, falcon, phi — each a hand-assembled stack of DSModule kernels over a
+ragged batch) and the module registry in ``inference/v2/modules``. TPU-native
+re-design: ONE generic ragged forward — a ``lax.scan`` over layer-stacked weights —
+specialised per family by a :class:`RaggedModelSpec` (norm type, activation,
+rope/learned positions, parallel residual, MoE) and a weight *adapter* that
+re-keys the zoo model's param tree into the canonical stacked layout.
+
+Pass structure (see ``ragged/ragged_batch.py``): tokens = [prompt chunk | decode
+rows]. Each layer writes the pass's K/V into the paged cache (one flat scatter),
+then attends:
+
+  - chunk rows  -> ``paged_chunk_attention`` (flash over pages, causal by position)
+  - decode rows -> ``paged_decode_attention`` (one token per sequence)
+
+MoE layers use sort-based grouped GEMM (``jax.lax.ragged_dot`` when available) —
+the TPU analog of the reference's CUTLASS ``moe_gemm`` + moe_scatter/gather
+(``inference/v2/kernels/cutlass_ops``, ``ragged_ops/moe_{scatter,gather}``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.pallas.paged_attention import (paged_chunk_attention,
+                                                      paged_decode_attention)
+
+
+@dataclass
+class RaggedModelSpec:
+    family: str
+    num_layers: int
+    hidden_size: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    vocab_size: int
+    norm: str = "rms"              # "rms" | "ln"
+    activation: str = "swiglu"     # "swiglu" | "gelu" | "relu"
+    rope_theta: Optional[float] = 10000.0   # None -> no rotary
+    rotary_dim: Optional[int] = None        # partial rotary (phi); None = full head
+    learned_pos: bool = False      # gpt2/opt learned position embeddings
+    pos_offset: int = 0            # opt: positions are offset by 2 in the table
+    parallel_block: bool = False   # falcon/phi: attn + mlp both from the same norm
+    tied_lm_head: bool = False     # gpt2: logits = x @ embed.T
+    eps: float = 1e-5
+    moe: Optional[Dict[str, int]] = None    # {"num_experts": E, "top_k": k}
+    dtype: Any = jnp.bfloat16
+
+
+# --------------------------------------------------------------------------- #
+# adapters: zoo param tree -> canonical stacked weights
+# --------------------------------------------------------------------------- #
+
+def _stack(trees: List[Any]) -> Any:
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def adapt_llama(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
+    """models/llama.py param tree (LlamaForCausalLM / MixtralForCausalLM).
+
+    Parity anchors: reference ``inference/v2/model_implementations/llama_v2`` /
+    ``mistral`` / ``mixtral``."""
+    moe = None
+    if hasattr(config, "num_local_experts"):
+        moe = {"num_experts": config.num_local_experts,
+               "top_k": config.num_experts_per_tok}
+    spec = RaggedModelSpec(
+        family="mixtral" if moe else "llama",
+        num_layers=config.num_hidden_layers,
+        hidden_size=config.hidden_size,
+        num_heads=config.num_attention_heads,
+        num_kv_heads=config.num_key_value_heads,
+        head_dim=config.head_dim,
+        vocab_size=config.vocab_size,
+        norm="rms", activation="swiglu", rope_theta=config.rope_theta,
+        eps=config.rms_norm_eps, moe=moe, dtype=config.dtype)
+
+    layers = []
+    for i in range(config.num_hidden_layers):
+        lp = params[f"layers_{i}"]
+        attn = lp["self_attn"]
+        layer = {
+            "ln1": {"scale": lp["input_layernorm"]["weight"]},
+            "ln2": {"scale": lp["post_attention_layernorm"]["weight"]},
+            "wq": attn["q_proj"]["kernel"],
+            "wk": attn["k_proj"]["kernel"],
+            "wv": attn["v_proj"]["kernel"],
+            "wo": attn["o_proj"]["kernel"],
+        }
+        if moe:
+            mb = lp["block_sparse_moe"]
+            layer["moe"] = {
+                "router": mb["gate"]["kernel"],
+                "w_gate": mb["w_gate"], "w_up": mb["w_up"], "w_down": mb["w_down"],
+            }
+        else:
+            layer["mlp"] = {
+                "w_gate": lp["mlp"]["gate_proj"]["kernel"],
+                "w_up": lp["mlp"]["up_proj"]["kernel"],
+                "w_down": lp["mlp"]["down_proj"]["kernel"],
+            }
+        layers.append(layer)
+
+    weights = {
+        "embed": params["embed_tokens"]["embedding"],
+        "layers": _stack(layers),
+        "final_norm": {"scale": params["norm"]["weight"]},
+        "lm_head": params["lm_head"]["kernel"],
+    }
+    return spec, weights
+
+
+def adapt_gpt2(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
+    """models/gpt2.py param tree (GPT2LMHead): fused c_attn qkv, tied head."""
+    spec = RaggedModelSpec(
+        family="gpt2",
+        num_layers=config.n_layer,
+        hidden_size=config.n_embd,
+        num_heads=config.n_head,
+        num_kv_heads=config.n_head,
+        head_dim=config.n_embd // config.n_head,
+        vocab_size=config.vocab_size,
+        norm="ln", activation="gelu", rope_theta=None, learned_pos=True,
+        tied_lm_head=True, eps=1e-5, dtype=config.dtype)
+
+    E = config.n_embd
+    layers = []
+    for i in range(config.n_layer):
+        lp = params[f"h_{i}"]
+        wqkv = lp["attn"]["c_attn"]["kernel"]     # [E, 3E]
+        bqkv = lp["attn"]["c_attn"]["bias"]
+        layers.append({
+            "ln1": {"scale": lp["ln_1"]["scale"], "bias": lp["ln_1"]["bias"]},
+            "ln2": {"scale": lp["ln_2"]["scale"], "bias": lp["ln_2"]["bias"]},
+            "wq": wqkv[:, :E], "wk": wqkv[:, E:2 * E], "wv": wqkv[:, 2 * E:],
+            "bq": bqkv[:E], "bk": bqkv[E:2 * E], "bv": bqkv[2 * E:],
+            "wo": lp["attn"]["c_proj"]["kernel"],
+            "bo": lp["attn"]["c_proj"]["bias"],
+            "mlp": {
+                "w_up": lp["mlp"]["c_fc"]["kernel"],
+                "b_up": lp["mlp"]["c_fc"]["bias"],
+                "w_down": lp["mlp"]["c_proj"]["kernel"],
+                "b_down": lp["mlp"]["c_proj"]["bias"],
+            },
+        })
+
+    weights = {
+        "embed": params["wte"]["embedding"],
+        "pos_embed": params["wpe"]["embedding"],
+        "layers": _stack(layers),
+        "final_norm": {"scale": params["ln_f"]["scale"],
+                       "bias": params["ln_f"]["bias"]},
+    }
+    return spec, weights
+
+
+ADAPTERS: Dict[str, Callable] = {
+    "llama": adapt_llama,
+    "mistral": adapt_llama,
+    "mixtral": adapt_llama,
+    "gpt2": adapt_gpt2,
+}
+
+
+def adapt_model(family: str, params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
+    if family not in ADAPTERS:
+        raise ValueError(f"no ragged adapter for family '{family}' "
+                         f"(have {sorted(ADAPTERS)})")
+    return ADAPTERS[family](params, config)
+
+
+# --------------------------------------------------------------------------- #
+# generic ragged forward
+# --------------------------------------------------------------------------- #
+
+def _norm(x, w, kind: str, eps: float, dtype):
+    xf = x.astype(jnp.float32)
+    if kind == "rms":
+        var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+        y = xf * jax.lax.rsqrt(var + eps) * w["scale"]
+    else:
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + eps) * w["scale"] + w["bias"]
+    return y.astype(dtype)
+
+
+def _rope_flat(x: jax.Array, positions: jax.Array, theta: float,
+               rotary_dim: Optional[int]) -> jax.Array:
+    """Rotary embedding on [T, H, D] with per-token positions [T]; optionally only
+    the first ``rotary_dim`` features rotate (phi)."""
+    from deepspeed_tpu.models.llama import rope_frequencies
+    D = x.shape[-1]
+    rd = rotary_dim or D
+    xr, xp = x[..., :rd], x[..., rd:]
+    freqs = rope_frequencies(rd, theta)
+    angles = positions[:, None].astype(jnp.float32) * freqs        # [T, rd/2]
+    cos = jnp.cos(angles)[:, None, :]
+    sin = jnp.sin(angles)[:, None, :]
+    x1 = xr[..., 0::2].astype(jnp.float32)
+    x2 = xr[..., 1::2].astype(jnp.float32)
+    r1 = x1 * cos - x2 * sin
+    r2 = x2 * cos + x1 * sin
+    rot = jnp.stack([r1, r2], axis=-1).reshape(xr.shape).astype(x.dtype)
+    return jnp.concatenate([rot, xp], axis=-1) if rd < D else rot
+
+
+def _moe_ffn(x: jax.Array, w: Dict, top_k: int, dtype) -> jax.Array:
+    """Sort-based token dispatch + grouped GEMM (parity: reference moe_scatter ->
+    CUTLASS moe_gemm -> moe_gather, inference/v2/kernels). x: [T, hid]."""
+    T, hid = x.shape
+    E = w["router"].shape[-1]
+    logits = x.astype(jnp.float32) @ w["router"].astype(jnp.float32)   # [T, E]
+    gates, ids = jax.lax.top_k(logits, top_k)                          # [T, K]
+    gates = jax.nn.softmax(gates, axis=-1)
+
+    tok_idx = jnp.repeat(jnp.arange(T), top_k)                         # [T*K]
+    expert_ids = ids.reshape(-1)
+    order = jnp.argsort(expert_ids)
+    src = tok_idx[order]
+    xs = x[src]                                                        # [T*K, hid]
+    group_sizes = jnp.bincount(expert_ids, length=E).astype(jnp.int32)
+
+    def gg(lhs, rhs):
+        return jax.lax.ragged_dot(lhs, rhs.astype(lhs.dtype), group_sizes)
+
+    if "w_gate" in w:
+        h = jax.nn.silu(gg(xs, w["w_gate"])) * gg(xs, w["w_up"])
+    else:
+        h = jax.nn.gelu(gg(xs, w["w_up"]))
+    ys = gg(h, w["w_down"])                                            # [T*K, hid]
+    scale = gates.reshape(-1)[order].astype(ys.dtype)
+    out = jnp.zeros((T, hid), ys.dtype).at[src].add(ys * scale[:, None])
+    return out.astype(dtype)
+
+
+def build_ragged_forward(spec: RaggedModelSpec,
+                         mesh=None,
+                         tp: int = 1) -> Callable:
+    """Returns ``fwd(weights, k_pages, v_pages, batch) ->
+    (chunk_logits [V], decode_logits [S, V], new_k, new_v)``.
+
+    k/v_pages: [L, NB, bs, Hkv, D]. ``batch`` is RaggedBatch.device_arrays().
+    When ``tp > 1`` the paged attention kernels run under shard_map on the
+    'tensor' axis (heads sharded); everything else partitions via XLA SPMD.
+    """
+    H, Hkv, D = spec.num_heads, spec.num_kv_heads, spec.head_dim
+    hid = spec.hidden_size
+    dtype = spec.dtype
+
+    def _decode_attn(q, k_l, v_l, bts, cls_):
+        if tp > 1:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+            from deepspeed_tpu.comm.mesh import TENSOR_AXIS
+            fn = shard_map(
+                paged_decode_attention, mesh=mesh,
+                in_specs=(P(None, TENSOR_AXIS, None),
+                          P(None, None, TENSOR_AXIS, None),
+                          P(None, None, TENSOR_AXIS, None), P(None, None), P(None)),
+                out_specs=P(None, TENSOR_AXIS, None), check_vma=False)
+            return fn(q, k_l, v_l, bts, cls_)
+        return paged_decode_attention(q, k_l, v_l, bts, cls_)
+
+    def _chunk_attn(q, k_l, v_l, bt, q0, ctx):
+        if tp > 1:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+            from deepspeed_tpu.comm.mesh import TENSOR_AXIS
+            fn = shard_map(
+                paged_chunk_attention, mesh=mesh,
+                in_specs=(P(None, TENSOR_AXIS, None),
+                          P(None, None, TENSOR_AXIS, None),
+                          P(None, None, TENSOR_AXIS, None), P(None), P(), P()),
+                out_specs=P(None, TENSOR_AXIS, None), check_vma=False)
+            return fn(q, k_l, v_l, bt, q0, ctx)
+        return paged_chunk_attention(q, k_l, v_l, bt, q0, ctx)
+
+    def fwd(weights, k_pages, v_pages, b):
+        C = b["chunk_tokens"].shape[0]
+        S = b["decode_tokens"].shape[0]
+        tokens = jnp.concatenate([b["chunk_tokens"], b["decode_tokens"]])
+        positions = jnp.concatenate([b["chunk_positions"], b["decode_positions"]])
+
+        x = weights["embed"][tokens]
+        if spec.learned_pos:
+            x = x + weights["pos_embed"][positions + spec.pos_offset]
+        x = x.astype(dtype)
+
+        def layer_fn(x, scanned):
+            w, k_l, v_l = scanned
+            h1 = _norm(x, w["ln1"], spec.norm, spec.eps, dtype)
+            q = (h1 @ w["wq"]).reshape(-1, H, D)
+            k = (h1 @ w["wk"]).reshape(-1, Hkv, D)
+            v = (h1 @ w["wv"]).reshape(-1, Hkv, D)
+            if "bq" in w:
+                q = q + w["bq"].reshape(H, D)
+                k = k + w["bk"].reshape(Hkv, D)
+                v = v + w["bv"].reshape(Hkv, D)
+            if spec.rope_theta is not None:
+                q = _rope_flat(q, positions, spec.rope_theta, spec.rotary_dim)
+                k = _rope_flat(k, positions, spec.rope_theta, spec.rotary_dim)
+
+            # KV write: one flat scatter over the fused (page, slot) dim; padding
+            # rows carry an out-of-bounds sentinel and are dropped
+            NB, bs = k_l.shape[0], k_l.shape[1]
+            kf = k_l.reshape(NB * bs, Hkv, D)
+            vf = v_l.reshape(NB * bs, Hkv, D)
+            kf = kf.at[b["kv_dest"]].set(k.astype(kf.dtype), mode="drop")
+            vf = vf.at[b["kv_dest"]].set(v.astype(vf.dtype), mode="drop")
+            k_l = kf.reshape(NB, bs, Hkv, D)
+            v_l = vf.reshape(NB, bs, Hkv, D)
+
+            q0 = b["chunk_positions"][0]
+            out_c = _chunk_attn(q[:C], k_l, v_l, b["chunk_block_table"],
+                                q0, b["chunk_ctx_len"])
+            out_d = _decode_attn(q[C:], k_l, v_l, b["decode_block_tables"],
+                                 b["decode_ctx_lens"])
+            out = jnp.concatenate([out_c, out_d], axis=0).reshape(-1, H * D)
+            attn_out = out @ w["wo"]
+            if "bo" in w:
+                attn_out = attn_out + w["bo"]
+
+            if spec.parallel_block:
+                mlp_in = h1
+            else:
+                x = x + attn_out
+                mlp_in = _norm(x, w["ln2"], spec.norm, spec.eps, dtype)
+
+            if spec.moe is not None:
+                mlp_out = _moe_ffn(mlp_in, w["moe"], spec.moe["top_k"], dtype)
+            else:
+                m = w["mlp"]
+                if spec.activation == "swiglu":
+                    hmid = jax.nn.silu(mlp_in @ m["w_gate"]) * (mlp_in @ m["w_up"])
+                else:
+                    act = jax.nn.gelu if spec.activation == "gelu" else jax.nn.relu
+                    hmid = mlp_in @ m["w_up"]
+                    if "b_up" in m:
+                        hmid = hmid + m["b_up"]
+                    hmid = act(hmid)
+                mlp_out = hmid @ m["w_down"]
+                if "b_down" in m:
+                    mlp_out = mlp_out + m["b_down"]
+
+            if spec.parallel_block:
+                x = x + attn_out + mlp_out
+            else:
+                x = x + mlp_out
+            return x.astype(dtype), (k_l, v_l)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            layer_fn, x, (weights["layers"], k_pages, v_pages))
+
+        x = _norm(x, weights["final_norm"], spec.norm, spec.eps, dtype)
+        # only 1 + S rows are ever read (parity: ragged_ops/logits_gather — the
+        # reference also gathers the needed rows before the unembed GEMM)
+        last = jnp.maximum(b["chunk_num_tokens"] - 1, 0)
+        chunk_row = jax.lax.dynamic_index_in_dim(x[:C], last, keepdims=True)
+        xs = jnp.concatenate([chunk_row, x[C:]], axis=0)       # [1 + S, hid]
+        if spec.tied_lm_head:
+            logits = xs.astype(jnp.float32) @ weights["embed"].astype(jnp.float32).T
+        else:
+            logits = (xs @ weights["lm_head"]).astype(jnp.float32)
+        return logits[0], logits[1:], new_k, new_v
+
+    return fwd

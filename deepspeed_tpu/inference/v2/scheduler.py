@@ -40,17 +40,19 @@ class DynamicSplitFuseScheduler:
     # ------------------------------------------------------------------ #
 
     def add_tokens(self, uid: int, tokens: np.ndarray) -> None:
-        if uid not in self.seqs:
-            if len(self.seqs) >= self.config.max_tracked_sequences:
-                raise RuntimeError(
-                    f"max_tracked_sequences={self.config.max_tracked_sequences} exceeded")
-            self.seqs[uid] = DSSequenceDescriptor(uid=uid)
-        seq = self.seqs[uid]
-        seq.extend_pending(tokens)
-        total = seq.seen_tokens + len(seq.pending)
+        tokens = np.asarray(tokens, np.int32)
+        seq = self.seqs.get(uid)
+        known = 0 if seq is None else seq.seen_tokens + len(seq.pending)
+        total = known + len(tokens)
         if total > self.config.max_context:
             raise ValueError(f"sequence {uid}: {total} tokens > max_context "
                              f"{self.config.max_context}")
+        if seq is None:
+            if len(self.seqs) >= self.config.max_tracked_sequences:
+                raise RuntimeError(
+                    f"max_tracked_sequences={self.config.max_tracked_sequences} exceeded")
+            seq = self.seqs[uid] = DSSequenceDescriptor(uid=uid)
+        seq.extend_pending(tokens)
 
     def flush(self, uid: int) -> None:
         """Release a sequence's KV blocks (parity: ``engine_v2.flush``)."""
@@ -63,11 +65,12 @@ class DynamicSplitFuseScheduler:
     # ------------------------------------------------------------------ #
 
     def query(self, uid: int, max_request_tokens: int) -> Tuple[int, int]:
-        """(max new tokens fundable by free blocks, free blocks)."""
+        """(max new tokens fundable by free blocks, free blocks). Accounts for
+        queued-but-unprocessed pending tokens, which will consume the same pool."""
         seq = self.seqs.get(uid, DSSequenceDescriptor(uid=uid))
         bs = self.cache.config.block_size
-        slack = len(seq.blocks) * bs - seq.seen_tokens
-        fundable = slack + self.allocator.free_blocks * bs
+        slack = len(seq.blocks) * bs - seq.seen_tokens - len(seq.pending)
+        fundable = max(0, slack + self.allocator.free_blocks * bs)
         return min(max_request_tokens, fundable), self.allocator.free_blocks
 
     def can_schedule(self, uids: List[int], lengths: List[int]) -> bool:
