@@ -284,6 +284,9 @@ class InferenceEngine:
 
 
 def _guess_family(model) -> Optional[str]:
+    fam = getattr(getattr(model, "config", None), "family", None)
+    if fam:
+        return fam
     name = type(model).__name__.lower()
     for fam in ("mixtral", "llama", "gpt2", "bert", "neox", "mistral"):
         if fam in name:

@@ -101,38 +101,20 @@ class InferenceEngineV2:
     # ------------------------------------------------------------------ #
 
     def _shard_weights(self, weights):
+        """TP sharding of the canonical stacked weights via the shared AutoTP
+        rule walker (``parallel/tensor_parallel.py``) — one source of truth for
+        column/row assignments; non-divisible dims warn and replicate."""
         topo = self.topology
         tp = topo.tp_world_size
         if tp <= 1:
             return jax.device_put(weights, topo.replicated())
-
-        def spec_for(path, leaf):
-            keys = [str(getattr(p, "key", getattr(p, "idx", p))) for p in path]
-            name = keys[-1]
-            none = (None,) * (leaf.ndim - 1)
-            if name in ("wq", "wk", "wv") or name in ("w_gate", "w_up"):
-                return P(*(None,) * (leaf.ndim - 1), TENSOR_AXIS)
-            if name in ("bq", "bk", "bv", "b_up"):
-                return P(*(None,) * (leaf.ndim - 1), TENSOR_AXIS)
-            if name in ("wo", "w_down"):
-                return P(*(None,) * (leaf.ndim - 2), TENSOR_AXIS, None)
-            if name == "lm_head" or keys == ["lm_head"]:
-                return P(None, TENSOR_AXIS)
-            return P(*([None] * leaf.ndim)) if leaf.ndim else P()
-
-        def ok(spec, leaf):
-            for dim, ax in zip(leaf.shape, spec):
-                if ax is not None and dim % tp != 0:
-                    return False
-            return True
-
-        def place(path, leaf):
-            sp = spec_for(path, leaf)
-            if not ok(sp, leaf):
-                sp = P(*([None] * leaf.ndim))
-            return jax.device_put(leaf, NamedSharding(topo.mesh, sp))
-
-        return jax.tree_util.tree_map_with_path(place, weights)
+        from deepspeed_tpu.parallel.tensor_parallel import (
+            RAGGED_STACKED_TP_RULES, derive_tp_specs)
+        specs = derive_tp_specs(weights, RAGGED_STACKED_TP_RULES, tp)
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(topo.mesh, s), specs,
+            is_leaf=lambda s: isinstance(s, P))
+        return jax.device_put(weights, shardings)
 
     # ------------------------------------------------------------------ #
     # public API (parity: engine_v2.py put/query/can_schedule/flush)
@@ -218,7 +200,14 @@ class InferenceEngineV2:
         """Generate continuations for a batch of prompts with continuous
         batching: all sequences advance together; finished ones are flushed and
         their blocks recycled. Returns full token lists (prompt + generation)."""
-        uids = list(range(len(prompts)))
+        # fresh uid namespace: never collide with caller-owned put() sequences
+        uids: List[int] = []
+        nxt = 0
+        while len(uids) < len(prompts):
+            if nxt not in self.scheduler.seqs:
+                uids.append(nxt)
+            nxt += 1
+        idx_of = {u: i for i, u in enumerate(uids)}
         outs: List[List[int]] = [list(map(int, p)) for p in prompts]
         arr = self.put(uids, [np.asarray(p, np.int32) for p in prompts])
         logits_map = {u: arr[i] for i, u in enumerate(uids)}
@@ -227,7 +216,7 @@ class InferenceEngineV2:
             next_toks: Dict[int, int] = {}
             for u in sorted(live):
                 t = self._sample(logits_map[u], do_sample, temperature, top_k)
-                outs[u].append(t)
+                outs[idx_of[u]].append(t)
                 if eos_token_id is not None and t == eos_token_id:
                     live.discard(u)
                     self.flush([u])   # recycle KV blocks immediately
@@ -244,6 +233,9 @@ class InferenceEngineV2:
 
 
 def _guess_family(model) -> str:
+    fam = getattr(getattr(model, "config", None), "family", None)
+    if fam:
+        return fam
     name = type(model).__name__.lower()
     for fam in ("mixtral", "mistral", "llama", "gpt2", "opt", "falcon", "phi"):
         if fam in name:

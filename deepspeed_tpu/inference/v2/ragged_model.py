@@ -49,6 +49,7 @@ class RaggedModelSpec:
     learned_pos: bool = False      # gpt2/opt learned position embeddings
     pos_offset: int = 0            # opt: positions are offset by 2 in the table
     parallel_block: bool = False   # falcon/phi: attn + mlp both from the same norm
+    parallel_dual_norm: bool = False  # gpt_neox: parallel, but MLP from ln2(x)
     tied_lm_head: bool = False     # gpt2: logits = x @ embed.T
     eps: float = 1e-5
     moe: Optional[Dict[str, int]] = None    # {"num_experts": E, "top_k": k}
@@ -162,11 +163,47 @@ def adapt_gpt2(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
     return spec, weights
 
 
+def adapt_decoder(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
+    """models/decoder.py (DecoderLM — opt/falcon/phi/gpt_neox): canonical names,
+    so adaptation is re-rooting + stacking. Parity anchors: reference
+    ``inference/v2/model_implementations/{opt,falcon,phi}``."""
+    spec = RaggedModelSpec(
+        family=config.family,
+        num_layers=config.num_hidden_layers,
+        hidden_size=config.hidden_size,
+        num_heads=config.num_attention_heads,
+        num_kv_heads=config.kv_heads,
+        head_dim=config.head_dim,
+        vocab_size=config.vocab_size,
+        norm=config.norm, activation=config.activation,
+        rope_theta=config.rope_theta, rotary_dim=config.rotary_dim,
+        learned_pos=config.learned_pos, pos_offset=config.pos_offset,
+        parallel_block=config.parallel_block,
+        parallel_dual_norm=config.parallel_dual_norm,
+        tied_lm_head=config.tied_lm_head, eps=config.eps, dtype=config.dtype)
+
+    layers = [params[f"layers_{i}"] for i in range(config.num_hidden_layers)]
+    weights = {
+        "embed": params["embed"]["embedding"],
+        "layers": _stack(layers),
+        "final_norm": params["final_norm"],
+    }
+    if config.learned_pos:
+        weights["pos_embed"] = params["pos_embed"]["embedding"]
+    if not config.tied_lm_head:
+        weights["lm_head"] = params["lm_head"]
+    return spec, weights
+
+
 ADAPTERS: Dict[str, Callable] = {
     "llama": adapt_llama,
     "mistral": adapt_llama,
     "mixtral": adapt_llama,
     "gpt2": adapt_gpt2,
+    "opt": adapt_decoder,
+    "falcon": adapt_decoder,
+    "phi": adapt_decoder,
+    "gpt_neox": adapt_decoder,
 }
 
 
@@ -195,22 +232,11 @@ def _norm(x, w, kind: str, eps: float, dtype):
 
 def _rope_flat(x: jax.Array, positions: jax.Array, theta: float,
                rotary_dim: Optional[int]) -> jax.Array:
-    """Rotary embedding on [T, H, D] with per-token positions [T]; optionally only
-    the first ``rotary_dim`` features rotate (phi)."""
-    from deepspeed_tpu.models.llama import rope_frequencies
-    D = x.shape[-1]
-    rd = rotary_dim or D
-    xr, xp = x[..., :rd], x[..., rd:]
-    freqs = rope_frequencies(rd, theta)
-    angles = positions[:, None].astype(jnp.float32) * freqs        # [T, rd/2]
-    cos = jnp.cos(angles)[:, None, :]
-    sin = jnp.sin(angles)[:, None, :]
-    x1 = xr[..., 0::2].astype(jnp.float32)
-    x2 = xr[..., 1::2].astype(jnp.float32)
-    r1 = x1 * cos - x2 * sin
-    r2 = x2 * cos + x1 * sin
-    rot = jnp.stack([r1, r2], axis=-1).reshape(xr.shape).astype(x.dtype)
-    return jnp.concatenate([rot, xp], axis=-1) if rd < D else rot
+    """Rotary embedding on [T, H, D] with per-token positions [T] — delegates to
+    the zoo's single implementation (models/decoder._partial_rope) via a unit
+    batch dim so v1 dense and v2 ragged paths share the exact rotation math."""
+    from deepspeed_tpu.models.decoder import _partial_rope
+    return _partial_rope(x[None], positions[None], theta, rotary_dim)[0]
 
 
 def _moe_ffn(x: jax.Array, w: Dict, top_k: int, dtype) -> jax.Array:
@@ -330,7 +356,8 @@ def build_ragged_forward(spec: RaggedModelSpec,
                 attn_out = attn_out + w["bo"]
 
             if spec.parallel_block:
-                mlp_in = h1
+                mlp_in = (_norm(x, w["ln2"], spec.norm, spec.eps, dtype)
+                          if spec.parallel_dual_norm else h1)
             else:
                 x = x + attn_out
                 mlp_in = _norm(x, w["ln2"], spec.norm, spec.eps, dtype)

@@ -1,5 +1,11 @@
-"""Model zoo for the BASELINE config ladder (gpt2, llama/mistral, mixtral)."""
+"""Model zoo: the BASELINE config ladder families (gpt2, llama/mistral, mixtral,
+gpt-neox) plus the inference-container families (opt, falcon, phi, bert) —
+matching the reference's model coverage (module_inject/containers,
+inference/v2/model_implementations)."""
 
+from deepspeed_tpu.models.bert import BertConfig, BertForMaskedLM
+from deepspeed_tpu.models.decoder import (DecoderConfig, DecoderLM,
+                                          init_decoder_cache)
 from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
 from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM, init_cache
 from deepspeed_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM

@@ -1,0 +1,148 @@
+"""BERT encoder in flax.linen (BASELINE ladder config #2: BERT-large ZeRO-2).
+
+Parity role: the reference accelerates BERT through the fused
+``DeepSpeedTransformerLayer`` training kernels (``csrc/transformer``,
+``ops/transformer/transformer.py:296``) and serves it via the bert inference
+container (``module_inject/containers/bert.py``). On TPU the fused-kernel value is
+captured by XLA fusion over this plain pre/post-LN encoder; param naming follows
+HF conventions so ``BERT_TP_RULES`` (``parallel/tensor_parallel.py``) shard it.
+
+Batch contract: ``{"input_ids", "attention_mask"?, "token_type_ids"?, "labels"?}``
+— with labels (-100 = ignore) returns the masked-LM mean cross-entropy (the
+pre-training objective), else the MLM logits.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.attention import reference_attention
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.float32
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @classmethod
+    def base(cls, **kw):
+        return cls(**kw)
+
+    @classmethod
+    def large(cls, **kw):
+        d = dict(hidden_size=1024, num_hidden_layers=24, num_attention_heads=16,
+                 intermediate_size=4096)
+        d.update(kw); return cls(**d)
+
+    @classmethod
+    def tiny(cls, **kw):
+        d = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                 num_attention_heads=4, intermediate_size=128,
+                 max_position_embeddings=128)
+        d.update(kw); return cls(**d)
+
+
+class BertSelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, bias):
+        cfg = self.config
+        B, T, _ = x.shape
+        H, D = cfg.num_attention_heads, cfg.head_dim
+        dense = lambda name: nn.Dense(H * D, dtype=cfg.dtype, name=name)
+        q = dense("query")(x).reshape(B, T, H, D)
+        k = dense("key")(x).reshape(B, T, H, D)
+        v = dense("value")(x).reshape(B, T, H, D)
+        return reference_attention(q, k, v, bias=bias).reshape(B, T, H * D)
+
+
+class BertLayer(nn.Module):
+    """Post-LN encoder block (original BERT ordering)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, bias):
+        cfg = self.config
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                                       name=name)
+        attn = BertSelfAttention(cfg, name="attention")(x, bias)
+        attn = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                        name="attention_output")(attn)
+        x = ln("attention_layernorm")(x + attn)
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                     name="intermediate")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="output")(h)
+        return ln("output_layernorm")(x + h)
+
+
+class BertForMaskedLM(nn.Module):
+    """Returns MLM loss when batch has labels (-100 ignored), else logits."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, batch, deterministic: bool = True):
+        cfg = self.config
+        input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
+        B, T = input_ids.shape
+        mask = batch.get("attention_mask") if isinstance(batch, dict) else None
+        types = batch.get("token_type_ids") if isinstance(batch, dict) else None
+
+        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       name="word_embeddings")
+        x = wte(input_ids)
+        x = x + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                         dtype=cfg.dtype,
+                         name="position_embeddings")(jnp.arange(T)[None, :])
+        if types is None:
+            types = jnp.zeros_like(input_ids)
+        x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                         name="token_type_embeddings")(types)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="embeddings_layernorm")(x)
+
+        # bidirectional: only padding is masked
+        bias = None
+        if mask is not None:
+            bias = jnp.where(mask[:, None, None, :] > 0, 0.0,
+                             jnp.finfo(jnp.float32).min)
+        layer_cls = nn.remat(BertLayer) if cfg.remat else BertLayer
+        for i in range(cfg.num_hidden_layers):
+            x = layer_cls(cfg, name=f"layer_{i}")(x, bias)
+
+        # MLM head: transform + tied decoder (HF cls.predictions shape)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_transform")(x)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="mlm_layernorm")(h)
+        logits = wte.attend(h.astype(jnp.float32))
+
+        labels = batch.get("labels") if isinstance(batch, dict) else None
+        if labels is None:
+            return logits
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        valid = labels >= 0
+        safe = jnp.where(valid, labels, 0)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        denom = jnp.maximum(jnp.sum(valid), 1)
+        return jnp.sum(jnp.where(valid, nll, 0.0)) / denom

@@ -1,0 +1,366 @@
+"""Configurable decoder-only LM covering the OPT / Falcon / Phi / GPT-NeoX families.
+
+Parity role: the reference serves these families through per-model containers and
+implementations (``module_inject/containers/{opt,gptneox}.py``,
+``inference/v2/model_implementations/{opt,falcon,phi}``). TPU-native re-design:
+the families differ only in a handful of structural flags (norm type, activation,
+rotary fraction vs learned positions, parallel residual, biases), so the zoo
+carries ONE flax module — :class:`DecoderLM` — specialised by
+:class:`DecoderConfig` classmethods, with canonical parameter names (``wq``,
+``mlp/w_up``...) shared with the v2 ragged adapter (``inference/v2/ragged_model``).
+
+Family structural facts encoded here:
+  - **OPT**: pre-LN, learned positions offset by 2, ReLU MLP, biases everywhere,
+    LM head tied to the embedding.
+  - **Falcon (7B lineage)**: parallel attention+MLP off one layernorm, rotary,
+    GELU, bias-free projections, (multi-query via num_key_value_heads).
+  - **Phi (phi-2 lineage)**: parallel block off one layernorm, *partial* rotary
+    (rotary_pct < 1), GELU, biases on projections.
+  - **GPT-NeoX**: parallel residual with TWO norms (attn from ln1(x), MLP from
+    ln2(x)), partial rotary, GELU, biases.
+
+Call paths match the llama zoo protocol: ``__call__(batch) -> loss``,
+``forward_logits``, ``decode(ids, cache, index)`` with the dense KV cache from
+``init_decoder_cache`` (inference v1), plus the v2 ragged adapter below.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.llama import (causal_lm_loss, repeat_kv,
+                                        rope_frequencies, _window_bias)
+from deepspeed_tpu.ops.attention import dot_product_attention, reference_attention
+
+
+@dataclass
+class DecoderConfig:
+    family: str = "opt"
+    vocab_size: int = 50272
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    num_key_value_heads: Optional[int] = None   # None -> MHA
+    max_position_embeddings: int = 2048
+    norm: str = "ln"                 # "ln" | "rms"
+    activation: str = "relu"         # "relu" | "gelu" | "swiglu"
+    rope_theta: Optional[float] = None          # None -> no rotary
+    rotary_pct: float = 1.0                     # fraction of head_dim that rotates
+    learned_pos: bool = False
+    pos_offset: int = 0              # OPT: positions offset by 2 in the table
+    parallel_block: bool = False     # attn + mlp in one residual add
+    parallel_dual_norm: bool = False # neox: MLP from ln2(x) instead of ln1(x)
+    qkv_bias: bool = True
+    out_bias: bool = True
+    mlp_bias: bool = True
+    tied_lm_head: bool = False
+    eps: float = 1e-5
+    dtype: Any = jnp.float32
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_key_value_heads or self.num_attention_heads
+
+    @property
+    def rotary_dim(self) -> Optional[int]:
+        if self.rope_theta is None:
+            return None
+        rd = int(self.head_dim * self.rotary_pct)
+        return rd - rd % 2
+
+    # ---- family presets (sizes per public model cards) -------------------- #
+
+    @classmethod
+    def opt_125m(cls, **kw):
+        d = dict(family="opt", vocab_size=50272, hidden_size=768,
+                 intermediate_size=3072, num_hidden_layers=12,
+                 num_attention_heads=12, learned_pos=True, pos_offset=2,
+                 activation="relu", tied_lm_head=True)
+        d.update(kw); return cls(**d)
+
+    @classmethod
+    def opt_1b3(cls, **kw):
+        d = dict(family="opt", vocab_size=50272, hidden_size=2048,
+                 intermediate_size=8192, num_hidden_layers=24,
+                 num_attention_heads=32, learned_pos=True, pos_offset=2,
+                 activation="relu", tied_lm_head=True)
+        d.update(kw); return cls(**d)
+
+    @classmethod
+    def falcon_7b(cls, **kw):
+        d = dict(family="falcon", vocab_size=65024, hidden_size=4544,
+                 intermediate_size=4 * 4544, num_hidden_layers=32,
+                 num_attention_heads=71, num_key_value_heads=1,
+                 rope_theta=10000.0, activation="gelu", parallel_block=True,
+                 qkv_bias=False, out_bias=False, mlp_bias=False)
+        d.update(kw); return cls(**d)
+
+    @classmethod
+    def phi_2(cls, **kw):
+        d = dict(family="phi", vocab_size=51200, hidden_size=2560,
+                 intermediate_size=10240, num_hidden_layers=32,
+                 num_attention_heads=32, rope_theta=10000.0, rotary_pct=0.4,
+                 activation="gelu", parallel_block=True)
+        d.update(kw); return cls(**d)
+
+    @classmethod
+    def gpt_neox_20b(cls, **kw):
+        d = dict(family="gpt_neox", vocab_size=50432, hidden_size=6144,
+                 intermediate_size=24576, num_hidden_layers=44,
+                 num_attention_heads=64, rope_theta=10000.0, rotary_pct=0.25,
+                 activation="gelu", parallel_block=True, parallel_dual_norm=True)
+        d.update(kw); return cls(**d)
+
+    @classmethod
+    def tiny(cls, family: str = "opt", **kw):
+        base = {
+            "opt": dict(learned_pos=True, pos_offset=2, activation="relu",
+                        tied_lm_head=True),
+            "falcon": dict(rope_theta=10000.0, activation="gelu",
+                           parallel_block=True, qkv_bias=False, out_bias=False,
+                           mlp_bias=False, num_key_value_heads=1),
+            "phi": dict(rope_theta=10000.0, rotary_pct=0.5, activation="gelu",
+                        parallel_block=True),
+            "gpt_neox": dict(rope_theta=10000.0, rotary_pct=0.5, activation="gelu",
+                             parallel_block=True, parallel_dual_norm=True),
+        }[family]
+        d = dict(family=family, vocab_size=256, hidden_size=64,
+                 intermediate_size=128, num_hidden_layers=2,
+                 num_attention_heads=4, max_position_embeddings=128)
+        d.update(base); d.update(kw)
+        return cls(**d)
+
+
+class _Norm(nn.Module):
+    kind: str
+    eps: float
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        xf = x.astype(jnp.float32)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        if self.kind == "rms":
+            var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+            y = xf * jax.lax.rsqrt(var + self.eps) * scale
+        else:
+            bias = self.param("bias", nn.initializers.zeros, (x.shape[-1],))
+            mean = jnp.mean(xf, axis=-1, keepdims=True)
+            var = jnp.var(xf, axis=-1, keepdims=True)
+            y = (xf - mean) * jax.lax.rsqrt(var + self.eps) * scale + bias
+        return y.astype(self.dtype)
+
+
+def _partial_rope(x, positions, theta: float, rotary_dim: Optional[int]):
+    """[B, T, H, D] with per-row positions [B, T]; rotates the first rotary_dim."""
+    D = x.shape[-1]
+    rd = rotary_dim or D
+    xr, xp = x[..., :rd], x[..., rd:]
+    freqs = rope_frequencies(rd, theta)
+    angles = positions[..., None].astype(jnp.float32) * freqs
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1 = xr[..., 0::2].astype(jnp.float32)
+    x2 = xr[..., 1::2].astype(jnp.float32)
+    rot = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                    axis=-1).reshape(xr.shape).astype(x.dtype)
+    return jnp.concatenate([rot, xp], axis=-1) if rd < D else rot
+
+
+class _Mlp(nn.Module):
+    config: DecoderConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        init = nn.initializers.normal(0.02)
+        ff, hid = cfg.intermediate_size, cfg.hidden_size
+        if cfg.activation == "swiglu":
+            w_gate = self.param("w_gate", init, (hid, ff), jnp.float32)
+            w_up = self.param("w_up", init, (hid, ff), jnp.float32)
+            h = nn.silu(x @ w_gate.astype(cfg.dtype)) * (x @ w_up.astype(cfg.dtype))
+        else:
+            w_up = self.param("w_up", init, (hid, ff), jnp.float32)
+            h = x @ w_up.astype(cfg.dtype)
+            if cfg.mlp_bias:
+                h = h + self.param("b_up", nn.initializers.zeros, (ff,), jnp.float32) \
+                    .astype(cfg.dtype)
+            h = nn.gelu(h) if cfg.activation == "gelu" else nn.relu(h)
+        w_down = self.param("w_down", init, (ff, hid), jnp.float32)
+        out = h @ w_down.astype(cfg.dtype)
+        if cfg.mlp_bias and cfg.activation != "swiglu":
+            out = out + self.param("b_down", nn.initializers.zeros, (hid,),
+                                   jnp.float32).astype(cfg.dtype)
+        return out
+
+
+class DecoderBlock(nn.Module):
+    config: DecoderConfig
+
+    def setup(self):
+        cfg = self.config
+        init = nn.initializers.normal(0.02)
+        H, Hkv, D, hid = (cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim,
+                          cfg.hidden_size)
+        self.ln1 = _Norm(cfg.norm, cfg.eps, cfg.dtype, name="ln1")
+        if not cfg.parallel_block or cfg.parallel_dual_norm:
+            self.ln2 = _Norm(cfg.norm, cfg.eps, cfg.dtype, name="ln2")
+        self.wq = self.param("wq", init, (hid, H * D), jnp.float32)
+        self.wk = self.param("wk", init, (hid, Hkv * D), jnp.float32)
+        self.wv = self.param("wv", init, (hid, Hkv * D), jnp.float32)
+        self.wo = self.param("wo", init, (H * D, hid), jnp.float32)
+        if cfg.qkv_bias:
+            self.bq = self.param("bq", nn.initializers.zeros, (H * D,), jnp.float32)
+            self.bk = self.param("bk", nn.initializers.zeros, (Hkv * D,), jnp.float32)
+            self.bv = self.param("bv", nn.initializers.zeros, (Hkv * D,), jnp.float32)
+        if cfg.out_bias:
+            self.bo = self.param("bo", nn.initializers.zeros, (hid,), jnp.float32)
+        self.mlp = _Mlp(cfg, name="mlp")
+
+    def _qkv(self, h, positions):
+        cfg = self.config
+        B, T, _ = h.shape
+        H, Hkv, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+        dt = cfg.dtype
+        q = h @ self.wq.astype(dt)
+        k = h @ self.wk.astype(dt)
+        v = h @ self.wv.astype(dt)
+        if cfg.qkv_bias:
+            q = q + self.bq.astype(dt)
+            k = k + self.bk.astype(dt)
+            v = v + self.bv.astype(dt)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, Hkv, D)
+        v = v.reshape(B, T, Hkv, D)
+        if cfg.rope_theta is not None:
+            q = _partial_rope(q, positions, cfg.rope_theta, cfg.rotary_dim)
+            k = _partial_rope(k, positions, cfg.rope_theta, cfg.rotary_dim)
+        return q, k, v
+
+    def _proj_out(self, out, B, T):
+        cfg = self.config
+        y = out.reshape(B, T, -1) @ self.wo.astype(cfg.dtype)
+        if cfg.out_bias:
+            y = y + self.bo.astype(cfg.dtype)
+        return y
+
+    def _combine(self, x, h1, attn_out):
+        cfg = self.config
+        if cfg.parallel_block:
+            mlp_in = self.ln2(x) if cfg.parallel_dual_norm else h1
+            return x + attn_out + self.mlp(mlp_in)
+        x = x + attn_out
+        return x + self.mlp(self.ln2(x))
+
+    def __call__(self, x, positions):
+        cfg = self.config
+        B, T, _ = x.shape
+        h1 = self.ln1(x)
+        q, k, v = self._qkv(h1, positions)
+        rep = cfg.num_attention_heads // cfg.kv_heads
+        out = dot_product_attention(q, repeat_kv(k, rep), repeat_kv(v, rep),
+                                    causal=True)
+        return self._combine(x, h1, self._proj_out(out, B, T))
+
+    def decode(self, x, positions, layer_cache, cache_index):
+        """Dense-cache incremental step (v1 engine protocol, cf. llama.py)."""
+        cfg = self.config
+        B, T, _ = x.shape
+        h1 = self.ln1(x)
+        q, k, v = self._qkv(h1, positions)
+        ck = jax.lax.dynamic_update_slice(
+            layer_cache["k"], k.astype(layer_cache["k"].dtype), (0, cache_index, 0, 0))
+        cv = jax.lax.dynamic_update_slice(
+            layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, cache_index, 0, 0))
+        S = ck.shape[1]
+        rep = cfg.num_attention_heads // cfg.kv_heads
+        k_pos = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+        bias = _window_bias(positions, k_pos, None)
+        out = reference_attention(q, repeat_kv(ck, rep), repeat_kv(cv, rep), bias=bias)
+        return self._combine(x, h1, self._proj_out(out, B, T)), {"k": ck, "v": cv}
+
+
+class DecoderLM(nn.Module):
+    """See module docstring. Engine contract: ``__call__(batch) -> loss``."""
+
+    config: DecoderConfig
+
+    def setup(self):
+        cfg = self.config
+        self.embed = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                              name="embed")
+        if cfg.learned_pos:
+            self.pos_embed = nn.Embed(cfg.max_position_embeddings + cfg.pos_offset,
+                                      cfg.hidden_size, dtype=cfg.dtype,
+                                      name="pos_embed")
+        block = nn.remat(DecoderBlock) if cfg.remat else DecoderBlock
+        self.layers = [block(cfg, name=f"layers_{i}")
+                       for i in range(cfg.num_hidden_layers)]
+        self.final_norm = _Norm(cfg.norm, cfg.eps, cfg.dtype, name="final_norm")
+        if not cfg.tied_lm_head:
+            self.lm_head = self.param("lm_head", nn.initializers.normal(0.02),
+                                      (cfg.hidden_size, cfg.vocab_size), jnp.float32)
+
+    def _embed_in(self, input_ids, positions):
+        cfg = self.config
+        x = self.embed(input_ids)
+        if cfg.learned_pos:
+            x = x + self.pos_embed(positions + cfg.pos_offset)
+        return x.astype(cfg.dtype)
+
+    def _logits(self, x):
+        cfg = self.config
+        x = self.final_norm(x)
+        if cfg.tied_lm_head:
+            return self.embed.attend(x.astype(jnp.float32))
+        return (x @ self.lm_head.astype(cfg.dtype)).astype(jnp.float32)
+
+    def forward_logits(self, input_ids, positions=None):
+        B, T = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        x = self._embed_in(input_ids, positions)
+        for layer in self.layers:
+            x = layer(x, positions)
+        return self._logits(x)
+
+    def __call__(self, batch, deterministic: bool = True):
+        if isinstance(batch, dict):
+            input_ids = batch["input_ids"]
+            labels = batch.get("labels", input_ids)
+        else:
+            input_ids, labels = batch, batch
+        return causal_lm_loss(self.forward_logits(input_ids), labels)
+
+    def decode(self, input_ids, cache, cache_index, positions=None):
+        B, T = input_ids.shape
+        if positions is None:
+            positions = cache_index + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        x = self._embed_in(input_ids, positions)
+        new_k, new_v = [], []
+        for i, layer in enumerate(self.layers):
+            x, nc = layer.decode(x, positions, {"k": cache["k"][i], "v": cache["v"][i]},
+                                 cache_index)
+            new_k.append(nc["k"])
+            new_v.append(nc["v"])
+        return self._logits(x), {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+
+
+def init_decoder_cache(config: DecoderConfig, batch_size: int, max_len: int,
+                       dtype: Any = None) -> Dict[str, jax.Array]:
+    """Dense KV cache for the v1 engine (analog of models/llama.py init_cache)."""
+    dtype = dtype or config.dtype
+    shape = (config.num_hidden_layers, batch_size, max_len, config.kv_heads,
+             config.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}

@@ -54,17 +54,43 @@ LLAMA_TP_RULES: List[Tuple[str, str]] = [
 
 BERT_TP_RULES: List[Tuple[str, str]] = [
     (r".*(query|key|value)/kernel", COLUMN),
-    (r".*attention/output/dense/kernel", ROW),
-    (r".*intermediate/dense/kernel", COLUMN),
-    (r".*\d+/output/dense/kernel", ROW),
+    (r".*attention_output/kernel", ROW),
+    (r".*intermediate/kernel", COLUMN),
+    (r".*layer_\d+/output/kernel", ROW),
+]
+
+# models/decoder.py DecoderLM canonical names (opt / falcon / phi / gpt_neox)
+DECODER_TP_RULES: List[Tuple[str, str]] = [
+    (r".*/(wq|wk|wv|bq|bk|bv)", COLUMN),
+    (r".*/wo", ROW),
+    (r".*mlp/(w_gate|w_up|b_up)", COLUMN),
+    (r".*mlp/w_down", ROW),
+    (r"embed/embedding", VOCAB),
+    (r"lm_head", COLUMN),
+]
+
+# canonical *stacked* ragged-model weights (inference/v2/ragged_model.py): layer
+# kernels carry a leading [L] (and MoE an [E]) dim, which COLUMN (last dim) / ROW
+# (second-to-last) already handle; embeddings/norms/router replicate (no rule)
+RAGGED_STACKED_TP_RULES: List[Tuple[str, str]] = [
+    (r".*/(wq|wk|wv|bq|bk|bv)", COLUMN),
+    (r".*/wo", ROW),
+    (r".*/(w_gate|w_up|b_up)", COLUMN),
+    (r".*/w_down", ROW),
+    (r"lm_head", COLUMN),
 ]
 
 MODEL_TP_RULES: Dict[str, List[Tuple[str, str]]] = {
     "gpt2": GPT2_TP_RULES,
     "llama": LLAMA_TP_RULES,
+    "mistral": LLAMA_TP_RULES,
     "mixtral": LLAMA_TP_RULES,
     "neox": LLAMA_TP_RULES,
     "bert": BERT_TP_RULES,
+    "opt": DECODER_TP_RULES,
+    "falcon": DECODER_TP_RULES,
+    "phi": DECODER_TP_RULES,
+    "gpt_neox": DECODER_TP_RULES,
 }
 
 # generic fallback patterns for unknown HF-style models (parity: AutoTP's
