@@ -1,0 +1,15 @@
+"""Data pipeline (parity: ``deepspeed/runtime/data_pipeline/``)."""
+
+from deepspeed_tpu.data.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.data.data_sampler import DeepSpeedDataSampler
+from deepspeed_tpu.data.indexed_dataset import (MMapIndexedDataset,
+                                                MMapIndexedDatasetBuilder,
+                                                make_builder, make_dataset)
+from deepspeed_tpu.data.random_ltd import (RandomLTDScheduler, gather_tokens,
+                                           random_ltd_indices, scatter_tokens,
+                                           slice_attention_mask)
+
+__all__ = ["CurriculumScheduler", "DeepSpeedDataSampler", "MMapIndexedDataset",
+           "MMapIndexedDatasetBuilder", "make_builder", "make_dataset",
+           "RandomLTDScheduler", "random_ltd_indices", "gather_tokens",
+           "scatter_tokens", "slice_attention_mask"]

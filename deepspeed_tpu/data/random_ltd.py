@@ -1,0 +1,86 @@
+"""Random layerwise token dropping (random-LTD).
+
+Parity: ``deepspeed/runtime/data_pipeline/data_routing/basic_layer.py`` (the
+``RandomLayerTokenDrop`` wrapper) + the token-sort CUDA kernels
+(``csrc/random_ltd/``). TPU-native: the random subset selection is a
+``jax.random.permutation`` + static-size ``take`` (XLA gathers tile fine on
+TPU — SURVEY §2.2 marks the CUDA sort kernels as "jnp sort/gather" here), and
+the kept-token count follows a linear schedule so shapes change only at bucket
+boundaries.
+
+Usage: wrap a layer's input/output inside the model::
+
+    idx = random_ltd_indices(rng, seq_len, keep)          # static keep
+    x_small = gather_tokens(x, idx)                       # [B, keep, H]
+    y_small = layer(x_small)
+    y = scatter_tokens(y_small, idx, seq_len)             # zeros elsewhere
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def random_ltd_indices(rng: jax.Array, seq_len: int, keep: int) -> jax.Array:
+    """Sorted random subset of ``keep`` token positions (sorted to preserve
+    order, matching the reference's token_sort kernel semantics)."""
+    perm = jax.random.permutation(rng, seq_len)
+    return jnp.sort(perm[:keep])
+
+
+def gather_tokens(x: jax.Array, idx: jax.Array, axis: int = 1) -> jax.Array:
+    """Parity: ``gather_scatter.cu`` gather — select kept tokens."""
+    return jnp.take(x, idx, axis=axis)
+
+
+def scatter_tokens(y_small: jax.Array, idx: jax.Array, seq_len: int,
+                   axis: int = 1) -> jax.Array:
+    """Scatter processed tokens back to the full sequence (zeros for dropped
+    positions — the reference path adds these to the residual stream)."""
+    shape = list(y_small.shape)
+    shape[axis] = seq_len
+    full = jnp.zeros(shape, y_small.dtype)
+    return full.at[(slice(None),) * axis + (idx,)].set(y_small)
+
+
+def slice_attention_mask(mask: jax.Array, idx: jax.Array) -> jax.Array:
+    """Parity: ``slice_attn_masks.cu`` — restrict an additive [..., S, S] mask
+    to the kept token rows and columns."""
+    m = jnp.take(mask, idx, axis=-2)
+    return jnp.take(m, idx, axis=-1)
+
+
+class RandomLTDScheduler:
+    """Kept-token schedule (parity: ``random_ltd scheduler`` in
+    ``data_routing/scheduler.py``): linear increase from ``start`` to
+    ``seq_len`` over ``total_steps``, stepped to ``step_size`` buckets so XLA
+    recompiles once per bucket."""
+
+    def __init__(self, seq_len: int, start: int, total_steps: int,
+                 step_size: int = 16):
+        if not (0 < start <= seq_len):
+            raise ValueError("need 0 < start <= seq_len")
+        self.seq_len = seq_len
+        self.start = start
+        self.total_steps = max(1, total_steps)
+        self.step_size = max(1, step_size)
+        self.current_keep = start
+
+    def get_keep(self, global_step: int) -> int:
+        frac = min(1.0, global_step / self.total_steps)
+        raw = self.start + frac * (self.seq_len - self.start)
+        keep = int(self.step_size * round(raw / self.step_size))
+        return max(self.start, min(self.seq_len, keep))
+
+    def update(self, global_step: int) -> int:
+        self.current_keep = self.get_keep(global_step)
+        return self.current_keep
+
+    def state_dict(self) -> Dict:
+        return {"current_keep": self.current_keep}
+
+    def load_state_dict(self, state: Dict):
+        self.current_keep = state["current_keep"]

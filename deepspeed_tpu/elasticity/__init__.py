@@ -1,0 +1,7 @@
+"""Elastic training (parity: ``deepspeed/elasticity/``)."""
+
+from deepspeed_tpu.elasticity.elasticity import (ElasticityError,
+                                                 compute_elastic_config,
+                                                 validate_elastic_nodes)
+
+__all__ = ["ElasticityError", "compute_elastic_config", "validate_elastic_nodes"]

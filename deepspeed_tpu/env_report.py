@@ -1,0 +1,78 @@
+"""Environment/compatibility report.
+
+Parity: ``deepspeed/env_report.py`` (the ``ds_report`` CLI) — prints framework
+versions, device inventory, and the kernel-registry availability table (the
+analog of the reference's op-compatibility matrix over ``op_builder`` classes).
+Run as ``python -m deepspeed_tpu.env_report``.
+"""
+
+from __future__ import annotations
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def kernel_availability():
+    """Pallas/XLA kernel registry availability checks (analog of
+    ``op_builder.*.is_compatible``)."""
+    checks = {}
+
+    def probe(name, fn):
+        try:
+            fn()
+            checks[name] = True
+        except Exception:
+            checks[name] = False
+
+    probe("pallas.flash_attention",
+          lambda: __import__("deepspeed_tpu.ops.pallas.flash_attention",
+                             fromlist=["flash_attention"]))
+    probe("pallas.paged_attention",
+          lambda: __import__("deepspeed_tpu.ops.pallas.paged_attention",
+                             fromlist=["paged_attention_decode"]))
+    probe("quantizer",
+          lambda: __import__("deepspeed_tpu.ops.quantizer", fromlist=["quantize"]))
+    probe("fused_adam",
+          lambda: __import__("deepspeed_tpu.ops.adam", fromlist=["FusedAdam"]))
+    probe("aio", lambda: __import__("deepspeed_tpu.ops.aio", fromlist=["AsyncIOHandle"]))
+    return checks
+
+
+def get_report_lines():
+    import jax
+
+    import deepspeed_tpu
+
+    lines = []
+    lines.append("-" * 60)
+    lines.append("DeepSpeed-TPU environment report (parity: ds_report)")
+    lines.append("-" * 60)
+    lines.append(f"deepspeed_tpu version .... {deepspeed_tpu.__version__}")
+    lines.append(f"jax version .............. {jax.__version__}")
+    try:
+        import jaxlib
+        lines.append(f"jaxlib version ........... {jaxlib.__version__}")
+    except Exception:
+        pass
+    try:
+        import flax
+        lines.append(f"flax version ............. {flax.__version__}")
+    except Exception:
+        pass
+    lines.append(f"default backend .......... {jax.default_backend()}")
+    devs = jax.devices()
+    lines.append(f"devices .................. {len(devs)} x {devs[0].device_kind}")
+    lines.append("-" * 60)
+    lines.append("kernel registry:")
+    for name, ok in kernel_availability().items():
+        lines.append(f"  {name:<28} {GREEN_OK if ok else RED_NO}")
+    lines.append("-" * 60)
+    return lines
+
+
+def main():
+    print("\n".join(get_report_lines()))
+
+
+if __name__ == "__main__":
+    main()

@@ -180,8 +180,10 @@ class InferenceEngine:
     def _make_cache(self, batch_size: int, max_len: int):
         fn = self._init_cache_fn
         if fn is None:
+            from deepspeed_tpu.models.decoder import DecoderLM, init_decoder_cache
             from deepspeed_tpu.models.llama import init_cache
-            fn = init_cache
+            fn = (init_decoder_cache if isinstance(self.module, DecoderLM)
+                  else init_cache)
         cache = fn(self.model_config, batch_size, max_len, dtype=self._dtype)
         return jax.device_put(cache, self._cache_sharding(cache))
 

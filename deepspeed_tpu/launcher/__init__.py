@@ -1,0 +1,1 @@
+"""Launcher / CLI (parity: ``deepspeed/launcher/``)."""

@@ -1,0 +1,96 @@
+"""Per-node process spawner.
+
+Parity: ``deepspeed/launcher/launch.py`` — decodes ``--world_info`` (base64
+host→slots map), computes this node's ranks, sets rendezvous env, forks the
+worker processes, and relays signals.
+
+TPU difference: JAX is single-controller-per-host — ONE process drives all local
+chips — so the per-node fanout is normally one worker (the reference forks one
+per GPU). Multiple slots per host are still honored (e.g. CPU simulation or
+subslice-per-process setups), each slot becoming one process with its own RANK.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+from typing import Dict, List
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--world_info", type=str, required=True)
+    parser.add_argument("--master_addr", type=str, required=True)
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--node_rank", type=int, default=-1)
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def decode_world_info(world_info_b64: str) -> Dict[str, List[int]]:
+    return json.loads(base64.urlsafe_b64decode(world_info_b64).decode())
+
+
+def main(args=None):
+    args = parse_args(args)
+    world_info = decode_world_info(args.world_info)
+    hosts = list(world_info.keys())
+    hostname = socket.gethostname()
+    if args.node_rank >= 0:
+        node_rank = args.node_rank
+    else:
+        matches = [i for i, h in enumerate(hosts)
+                   if h == hostname or hostname.startswith(h)]
+        node_rank = matches[0] if matches else 0
+    world_size = sum(len(s) for s in world_info.values())
+    first_rank = sum(len(world_info[h]) for h in hosts[:node_rank])
+    my_slots = world_info[hosts[node_rank]]
+
+    base_env = os.environ.copy()
+    base_env["COORDINATOR_ADDRESS"] = f"{args.master_addr}:{args.master_port}"
+    base_env["MASTER_ADDR"] = args.master_addr
+    base_env["MASTER_PORT"] = str(args.master_port)
+    base_env["WORLD_SIZE"] = str(world_size)
+
+    procs = []
+    for local_rank, _slot in enumerate(my_slots):
+        env = dict(base_env)
+        env["RANK"] = str(first_rank + local_rank)
+        env["LOCAL_RANK"] = str(local_rank)
+        cmd = [sys.executable, "-u", args.user_script] + list(args.user_args)
+        logger.info(f"launch node_rank={node_rank} rank={env['RANK']}: "
+                    f"{' '.join(cmd)}")
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    def sig_handler(signum, frame):  # relay to children (parity: launch.py)
+        for p in procs:
+            try:
+                p.send_signal(signum)
+            except ProcessLookupError:
+                pass
+
+    signal.signal(signal.SIGINT, sig_handler)
+    signal.signal(signal.SIGTERM, sig_handler)
+
+    rc = 0
+    for p in procs:
+        p.wait()
+        if p.returncode != 0:
+            rc = p.returncode
+            for q in procs:  # fail fast: kill siblings (parity: launch.py monitor)
+                if q.poll() is None:
+                    q.terminate()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

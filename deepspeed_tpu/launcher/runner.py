@@ -1,0 +1,296 @@
+"""`dstpu` CLI: multi-host launcher.
+
+Parity: ``deepspeed/launcher/runner.py:388 main`` — hostfile discovery
+(``fetch_hostfile`` runner.py:200), ``--include/--exclude`` filters (:255),
+multinode runner selection, env propagation — re-targeted at TPU pod slices:
+
+  - On Cloud TPU the topology comes from the TPU metadata/JAX runtime, so the
+    default path is **one process per host** with ``jax.distributed.initialize``
+    autodetection and no hostfile at all.
+  - The hostfile/ssh path is kept for GKE-less clusters: ``hostname slots=N``
+    lines, pdsh/ssh fan-out, each host running ``launcher.launch`` with
+    rendezvous env (COORDINATOR_ADDRESS / RANK / WORLD_SIZE) instead of the
+    reference's MASTER_ADDR+CUDA_VISIBLE_DEVICES.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import collections
+import json
+import os
+import shlex
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ["PYTHONPATH", "PATH", "LD_LIBRARY_PATH", "JAX_PLATFORMS",
+               "XLA_FLAGS", "LIBTPU_INIT_ARGS", "TPU_NAME"]
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="dstpu launcher (parity: `deepspeed` CLI, launcher/runner.py)")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="hostfile of `hostname slots=N` lines")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help='e.g. "worker-0@worker-1:0,2" (parity runner.py:255)')
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help='e.g. "worker-1:0" (parity runner.py:255)')
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_hosts", type=int, default=-1,
+                        help="alias for --num_nodes (TPU: one process per host)")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--launcher", type=str, default="pdsh",
+                        choices=["pdsh", "ssh", "openmpi", "local"])
+    parser.add_argument("--launcher_args", type=str, default="")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--elastic_training", action="store_true")
+    parser.add_argument("--min_elastic_nodes", type=int, default=-1)
+    parser.add_argument("--max_elastic_nodes", type=int, default=-1)
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path: str) -> Optional[Dict[str, int]]:
+    """Parse `hostname slots=N` lines (parity: ``fetch_hostfile`` runner.py:200).
+
+    Returns an ordered {hostname: slot_count} dict, or None if no hostfile."""
+    if not os.path.isfile(hostfile_path):
+        return None
+    resource_pool = collections.OrderedDict()
+    with open(hostfile_path, "r") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                _, slot_count = slots.split("=")
+                slot_count = int(slot_count)
+            except ValueError:
+                raise ValueError(f"hostfile line malformed: {line!r} "
+                                 "(expected `hostname slots=N`)")
+            if hostname in resource_pool:
+                raise ValueError(f"hostfile contains duplicate host {hostname}")
+            resource_pool[hostname] = slot_count
+    return resource_pool
+
+
+def _parse_filter(s: str) -> Dict[str, Optional[List[int]]]:
+    """'host1@host2:0,2' -> {host1: None, host2: [0, 2]}."""
+    out: Dict[str, Optional[List[int]]] = {}
+    if not s:
+        return out
+    for part in s.split("@"):
+        if ":" in part:
+            host, slots = part.split(":")
+            out[host] = [int(x) for x in slots.split(",")]
+        else:
+            out[part] = None
+    return out
+
+
+def parse_inclusion_exclusion(resource_pool: Dict[str, int], inclusion: str,
+                              exclusion: str) -> Dict[str, List[int]]:
+    """Apply --include/--exclude to the resource pool (parity: runner.py:255
+    ``parse_resource_filter``). Slots are per-host process indices."""
+    active = collections.OrderedDict(
+        (host, list(range(n))) for host, n in resource_pool.items())
+    inc = _parse_filter(inclusion)
+    exc = _parse_filter(exclusion)
+    if inc and exc:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    if inc:
+        filtered = collections.OrderedDict()
+        for host, slots in inc.items():
+            if host not in active:
+                raise ValueError(f"included host {host} not in hostfile")
+            keep = slots if slots is not None else active[host]
+            bad = set(keep) - set(active[host])
+            if bad:
+                raise ValueError(f"included slots {sorted(bad)} not on {host}")
+            filtered[host] = sorted(keep)
+        return filtered
+    for host, slots in exc.items():
+        if host not in active:
+            raise ValueError(f"excluded host {host} not in hostfile")
+        if slots is None:
+            del active[host]
+        else:
+            bad = set(slots) - set(active[host])
+            if bad:
+                raise ValueError(f"excluded slots {sorted(bad)} not on {host}")
+            active[host] = [s for s in active[host] if s not in slots]
+            if not active[host]:
+                del active[host]
+    return active
+
+
+def encode_world_info(active_resources: Dict[str, List[int]]) -> str:
+    """base64 host->slots map handed to each node (parity: runner.py world_info)."""
+    return base64.urlsafe_b64encode(
+        json.dumps(active_resources).encode()).decode()
+
+
+def build_launch_cmd(args, active_resources: Dict[str, List[int]],
+                     master_addr: str) -> List[str]:
+    """The per-node command every host runs (parity: launch.py invocation)."""
+    world_info = encode_world_info(active_resources)
+    cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+           f"--world_info={world_info}",
+           f"--master_addr={master_addr}",
+           f"--master_port={args.master_port}",
+           args.user_script] + list(args.user_args)
+    return cmd
+
+
+class MultiNodeRunner:
+    """Parity: ``launcher/multinode_runner.py:51``."""
+
+    def __init__(self, args, world_info_b64: str):
+        self.args = args
+        self.world_info_b64 = world_info_b64
+        self.exports: Dict[str, str] = {}
+
+    def add_export(self, key: str, value: str):
+        self.exports[key] = value
+
+    def get_cmd(self, environment, active_resources) -> List[str]:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+
+class PDSHRunner(MultiNodeRunner):
+    """pdsh fan-out (parity: multinode_runner.py:51 PDSHRunner)."""
+
+    def backend_exists(self) -> bool:
+        import shutil
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, environment, active_resources) -> List[str]:
+        environment["PDSH_RCMD_TYPE"] = "ssh"
+        hosts = ",".join(active_resources.keys())
+        exports = "".join(f"export {k}={shlex.quote(v)}; "
+                          for k, v in self.exports.items())
+        node_cmd = exports + " ".join(
+            shlex.quote(c) for c in build_launch_cmd(
+                self.args, active_resources, self.args.master_addr))
+        return ["pdsh", "-S", "-f", "1024", "-w", hosts] + \
+            shlex.split(self.args.launcher_args) + [node_cmd]
+
+
+class SSHRunner(MultiNodeRunner):
+    """Plain ssh loop fallback."""
+
+    def backend_exists(self) -> bool:
+        import shutil
+        return shutil.which("ssh") is not None
+
+    def get_cmd_for_host(self, host: str, active_resources) -> List[str]:
+        exports = "".join(f"export {k}={shlex.quote(v)}; "
+                          for k, v in self.exports.items())
+        node_cmd = exports + " ".join(
+            shlex.quote(c) for c in build_launch_cmd(
+                self.args, active_resources, self.args.master_addr))
+        return ["ssh", host] + shlex.split(self.args.launcher_args) + [node_cmd]
+
+    def get_cmd(self, environment, active_resources) -> List[str]:
+        # first host's command; main() loops hosts for ssh
+        host = next(iter(active_resources))
+        return self.get_cmd_for_host(host, active_resources)
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    """mpirun fan-out (parity: multinode_runner.py:117 OpenMPIRunner)."""
+
+    def backend_exists(self) -> bool:
+        import shutil
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, environment, active_resources) -> List[str]:
+        n_hosts = len(active_resources)
+        hosts = ",".join(f"{h}:1" for h in active_resources)
+        export_flags: List[str] = []
+        for k, v in self.exports.items():
+            export_flags += ["-x", f"{k}={v}"]
+        return (["mpirun", "-n", str(n_hosts), "--host", hosts]
+                + export_flags + shlex.split(self.args.launcher_args)
+                + [sys.executable, "-u", args_script(self.args)]
+                + list(self.args.user_args))
+
+
+def args_script(args) -> str:
+    return args.user_script
+
+
+def main(args=None):
+    args = parse_args(args)
+    if args.num_hosts > 0 and args.num_nodes < 0:
+        args.num_nodes = args.num_hosts
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    if not resource_pool and not args.force_multi:
+        # single-host (or Cloud TPU with runtime autodetection): exec in place
+        env = os.environ.copy()
+        cmd = [sys.executable, "-u", args.user_script] + list(args.user_args)
+        logger.info(f"dstpu single-host launch: {' '.join(cmd)}")
+        result = subprocess.Popen(cmd, env=env)
+        result.wait()
+        sys.exit(result.returncode)
+
+    if not resource_pool:
+        raise RuntimeError("--force_multi requires a hostfile")
+    active = parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
+    if args.num_nodes > 0:
+        active = collections.OrderedDict(list(active.items())[:args.num_nodes])
+    if args.elastic_training:
+        from deepspeed_tpu.elasticity import validate_elastic_nodes
+        validate_elastic_nodes(len(active), args.min_elastic_nodes,
+                               args.max_elastic_nodes)
+    if not args.master_addr:
+        args.master_addr = next(iter(active))
+
+    env = os.environ.copy()
+    runner_cls = {"pdsh": PDSHRunner, "ssh": SSHRunner,
+                  "openmpi": OpenMPIRunner, "local": None}[args.launcher]
+    if runner_cls is None:
+        cmd = build_launch_cmd(args, active, args.master_addr)
+        logger.info(f"dstpu local multi-launch: {' '.join(cmd)}")
+        proc = subprocess.Popen(cmd, env=env)
+        proc.wait()
+        sys.exit(proc.returncode)
+
+    runner = runner_cls(args, encode_world_info(active))
+    if not runner.backend_exists():
+        raise RuntimeError(f"launcher backend for {runner.name} not found in PATH")
+    for var in EXPORT_ENVS:
+        if var in env:
+            runner.add_export(var, env[var])
+
+    if isinstance(runner, SSHRunner):
+        procs = [subprocess.Popen(runner.get_cmd_for_host(h, active), env=env)
+                 for h in active]
+        rc = 0
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+        sys.exit(rc)
+    cmd = runner.get_cmd(env, active)
+    logger.info(f"dstpu {runner.name} launch: {' '.join(cmd)}")
+    proc = subprocess.Popen(cmd, env=env)
+    proc.wait()
+    sys.exit(proc.returncode)
+
+
+if __name__ == "__main__":
+    main()

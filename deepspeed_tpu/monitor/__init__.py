@@ -1,0 +1,7 @@
+"""Monitoring backends (parity: ``deepspeed/monitor/``)."""
+
+from deepspeed_tpu.monitor.monitor import (CsvMonitor, Monitor, MonitorMaster,
+                                           TensorBoardMonitor, WandbMonitor)
+
+__all__ = ["Monitor", "MonitorMaster", "TensorBoardMonitor", "WandbMonitor",
+           "CsvMonitor"]

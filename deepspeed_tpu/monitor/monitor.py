@@ -1,0 +1,145 @@
+"""Event monitoring: fan-out of ``(name, value, step)`` tuples to backends.
+
+Parity: ``deepspeed/monitor/monitor.py:29 MonitorMaster`` — a single object the
+engine writes event lists to, which forwards them to every enabled backend
+(TensorBoard / Weights & Biases / CSV). Backends are constructed from the config
+tree (``tensorboard`` / ``wandb`` / ``csv_monitor`` sections) and only rank 0 of
+the process (host) writes, matching the reference's rank-0 gating.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    """Abstract backend. Parity: ``deepspeed/monitor/monitor.py:16 Monitor``."""
+
+    def __init__(self, config):
+        self.enabled = bool(getattr(config, "enabled", False))
+
+    def write_events(self, event_list: Iterable[Event]) -> None:
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    """Parity: ``deepspeed/monitor/tensorboard.py``. Uses
+    ``torch.utils.tensorboard`` when importable; degrades to disabled otherwise
+    (this image has torch but may lack the tensorboard wheel)."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.summary_writer = None
+        if not self.enabled:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+        except Exception as e:  # pragma: no cover - env without tensorboard
+            logger.warning(f"tensorboard unavailable ({e}); TensorBoardMonitor disabled")
+            self.enabled = False
+            return
+        import os
+        log_dir = os.path.join(config.output_path or ".", config.job_name)
+        self.summary_writer = SummaryWriter(log_dir=log_dir)
+
+    def write_events(self, event_list: Iterable[Event]) -> None:
+        if not self.enabled or self.summary_writer is None:
+            return
+        for name, value, step in event_list:
+            self.summary_writer.add_scalar(name, float(value), int(step))
+        self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    """Parity: ``deepspeed/monitor/wandb.py``. Gated on the wandb package."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._wandb = None
+        if not self.enabled:
+            return
+        try:
+            import wandb
+        except Exception as e:  # pragma: no cover - env without wandb
+            logger.warning(f"wandb unavailable ({e}); WandbMonitor disabled")
+            self.enabled = False
+            return
+        self._wandb = wandb
+        wandb.init(project=config.project, group=config.group, entity=config.team)
+
+    def write_events(self, event_list: Iterable[Event]) -> None:
+        if not self.enabled or self._wandb is None:
+            return
+        for name, value, step in event_list:
+            self._wandb.log({name: float(value)}, step=int(step))
+
+
+class CsvMonitor(Monitor):
+    """Parity: ``deepspeed/monitor/csv_monitor.py`` — one CSV file per event
+    name under ``output_path/job_name/``."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self._files = {}
+        self.log_dir = None
+        if not self.enabled:
+            return
+        import os
+        self.log_dir = os.path.join(config.output_path or ".", config.job_name)
+        os.makedirs(self.log_dir, exist_ok=True)
+
+    def _file_for(self, name: str):
+        import os
+        if name not in self._files:
+            # event names like Train/Samples/lr -> Train_Samples_lr.csv
+            fname = name.replace("/", "_") + ".csv"
+            path = os.path.join(self.log_dir, fname)
+            new = not os.path.exists(path)
+            f = open(path, "a")
+            if new:
+                f.write("step,value\n")
+            self._files[name] = f
+        return self._files[name]
+
+    def write_events(self, event_list: Iterable[Event]) -> None:
+        if not self.enabled:
+            return
+        for name, value, step in event_list:
+            f = self._file_for(name)
+            f.write(f"{int(step)},{float(value)}\n")
+            f.flush()
+
+    def close(self):
+        for f in self._files.values():
+            f.close()
+        self._files = {}
+
+
+class MonitorMaster(Monitor):
+    """Fan-out master. Parity: ``deepspeed/monitor/monitor.py:29``.
+
+    Only the process-rank-0 host writes (in single-controller JAX there is one
+    Python process per host; events are identical across hosts since metrics are
+    fully reduced on device)."""
+
+    def __init__(self, config):
+        # config here is the full DeepSpeedTPUConfig
+        self.tb_monitor = TensorBoardMonitor(config.tensorboard)
+        self.wandb_monitor = WandbMonitor(config.wandb)
+        self.csv_monitor = CsvMonitor(config.csv_monitor)
+        self.enabled = (self.tb_monitor.enabled or self.wandb_monitor.enabled
+                        or self.csv_monitor.enabled)
+        import deepspeed_tpu.comm as dist
+        self._is_rank0 = dist.get_rank() == 0
+
+    def write_events(self, event_list: Iterable[Event]) -> None:
+        if not self.enabled or not self._is_rank0:
+            return
+        event_list = list(event_list)
+        self.tb_monitor.write_events(event_list)
+        self.wandb_monitor.write_events(event_list)
+        self.csv_monitor.write_events(event_list)

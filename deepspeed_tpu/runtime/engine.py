@@ -132,6 +132,17 @@ class DeepSpeedTPUEngine:
         self.skipped_steps = 0
         self._last_metrics: Dict[str, Any] = {}
 
+        # -- monitor (parity: MonitorMaster wiring, engine.py:249) ---------
+        from deepspeed_tpu.monitor import MonitorMaster
+        self.monitor = MonitorMaster(self.config)
+
+        # -- curriculum learning (parity: data-pipeline hook engine.py:1823)
+        self.curriculum_scheduler = None
+        if self.config.curriculum_learning.enabled:
+            from deepspeed_tpu.data.curriculum_scheduler import CurriculumScheduler
+            self.curriculum_scheduler = CurriculumScheduler(
+                self.config.curriculum_learning)
+
         # -- timers --------------------------------------------------------
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
@@ -383,6 +394,18 @@ class DeepSpeedTPUEngine:
         self._ensure_state(batch)
         if self._fused_step is None:
             self._fused_step = jax.jit(self._build_fused_step(), donate_argnums=(0,))
+        fp_cfg = self.config.flops_profiler
+        if fp_cfg.enabled and self.global_steps + 1 == fp_cfg.profile_step:
+            self._run_flops_profile(batch)
+        if (self.curriculum_scheduler is not None
+                and self.config.curriculum_learning.curriculum_type == "seqlen"):
+            # truncate to the scheduled seqlen; bucketed by difficulty_step so
+            # XLA recompiles once per bucket (parity: curriculum seqlen hook)
+            seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps)
+            batch = jax.tree_util.tree_map(
+                lambda x: np.asarray(x)[:, :seqlen]
+                if getattr(np.asarray(x), "ndim", 0) >= 2 else np.asarray(x),
+                batch)
         self.tput_timer.start()
         self.timers(STEP_GLOBAL_TIMER).start()
         sharded = self._shard_global_batch(batch)
@@ -392,6 +415,28 @@ class DeepSpeedTPUEngine:
         self._after_step(metrics)
         return metrics["loss"]
 
+    def _run_flops_profile(self, batch):
+        """Profile the model forward at ``profile_step`` (parity: flops-profiler
+        engine hooks, reference engine.py:1808-1850, 2188-2200)."""
+        from deepspeed_tpu.profiling import FlopsProfiler
+        fp_cfg = self.config.flops_profiler
+        prof = FlopsProfiler(fp_cfg)
+        micro = jax.tree_util.tree_map(
+            lambda x: np.asarray(x)[:max(1, self.micro_batch_size_)], batch)
+        params = self._current_params(self.state)
+        if hasattr(self.module, "apply"):
+            prof.start_profile(self.module, {"params": params}, micro)
+        else:
+            prof.start_profile()
+        prof.measure(lambda p, b: self._loss_of(p, b), params, micro)
+        prof.print_model_profile(profile_step=fp_cfg.profile_step,
+                                 module_depth=fp_cfg.module_depth,
+                                 top_modules=fp_cfg.top_modules,
+                                 detailed=fp_cfg.detailed,
+                                 output_file=fp_cfg.output_file)
+        prof.end_profile()
+        self.flops_profiler = prof
+
     def _after_step(self, metrics, count_micro_steps: bool = True):
         self.global_steps += 1
         self.global_samples += self.train_batch_size_
@@ -399,6 +444,20 @@ class DeepSpeedTPUEngine:
             # facade path counts micro steps in backward(); fused path counts here
             self.micro_steps += self.gas_
         self._last_metrics = metrics
+        if self.monitor.enabled:
+            # parity: _write_monitor (engine.py:2259) + loss/lr/scale events
+            # (engine.py:1943-1951, 2164-2185); the facade path's step metrics
+            # carry no loss
+            events = [("Train/Samples/lr", float(metrics["lr"]), self.global_samples),
+                      ("Train/Samples/grad_norm", float(metrics["grad_norm"]),
+                       self.global_samples)]
+            if "loss" in metrics:
+                events.insert(0, ("Train/Samples/train_loss",
+                                  float(metrics["loss"]), self.global_samples))
+            if self.config.fp16.enabled:
+                events.append(("Train/Samples/loss_scale",
+                               float(metrics["loss_scale"]), self.global_samples))
+            self.monitor.write_events(events)
         every = self.config.steps_per_print
         if every and self.global_steps % every == 0:
             loss = float(metrics["loss"]) if "loss" in metrics else float("nan")

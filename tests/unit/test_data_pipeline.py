@@ -1,0 +1,190 @@
+"""Data pipeline tests (parity: ``tests/unit/runtime/test_data_efficiency.py``
+and indexed-dataset tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.data import (CurriculumScheduler, DeepSpeedDataSampler,
+                                MMapIndexedDataset, make_builder, make_dataset,
+                                RandomLTDScheduler, gather_tokens,
+                                random_ltd_indices, scatter_tokens,
+                                slice_attention_mask)
+
+
+# ---------------------------- curriculum ---------------------------------- #
+
+def _sched(**over):
+    cfg = {"min_difficulty": 8, "max_difficulty": 64,
+           "schedule_type": "fixed_linear",
+           "schedule_config": {"total_curriculum_step": 100,
+                               "difficulty_step": 8}}
+    cfg.update(over)
+    return CurriculumScheduler(cfg)
+
+
+def test_fixed_linear_schedule():
+    s = _sched()
+    assert s.get_difficulty(0) == 8
+    assert s.get_difficulty(100) == 64
+    assert s.get_difficulty(1000) == 64
+    mid = s.get_difficulty(50)
+    assert 8 <= mid <= 64 and mid % 8 == 0
+    # monotone non-decreasing
+    vals = [s.get_difficulty(t) for t in range(0, 101, 10)]
+    assert vals == sorted(vals)
+
+
+def test_fixed_root_schedule():
+    s = _sched(schedule_type="fixed_root",
+               schedule_config={"total_curriculum_step": 100,
+                                "difficulty_step": 8, "root_degree": 2})
+    # sqrt schedule ramps faster early than linear
+    assert s.get_difficulty(25) >= _sched().get_difficulty(25)
+    assert s.get_difficulty(100) == 64
+
+
+def test_fixed_discrete_schedule():
+    s = _sched(schedule_type="fixed_discrete",
+               schedule_config={"difficulty": [8, 16, 64],
+                                "max_step": [10, 20]})
+    assert s.get_difficulty(5) == 8
+    assert s.get_difficulty(15) == 16
+    assert s.get_difficulty(25) == 64
+
+
+def test_curriculum_state_roundtrip():
+    s = _sched()
+    s.update_difficulty(50)
+    st = s.get_state()
+    s2 = _sched()
+    s2.set_state(st)
+    assert s2.current_difficulty == s.current_difficulty
+
+
+# ---------------------------- indexed dataset ----------------------------- #
+
+def test_indexed_dataset_roundtrip(tmp_path):
+    prefix = str(tmp_path / "corpus")
+    b = make_builder(prefix, dtype=np.int32)
+    seqs = [np.arange(5), np.arange(100, 103), np.arange(7)]
+    for s in seqs:
+        b.add_item(s)
+    b.end_document()
+    b.finalize()
+    ds = make_dataset(prefix)
+    assert len(ds) == 3
+    for i, s in enumerate(seqs):
+        np.testing.assert_array_equal(ds[i], s.astype(np.int32))
+    np.testing.assert_array_equal(ds.get(1, offset=1, length=2), [101, 102])
+    with pytest.raises(IndexError):
+        ds.get(0, offset=3, length=5)
+
+
+def test_indexed_dataset_bad_magic(tmp_path):
+    prefix = str(tmp_path / "bad")
+    with open(prefix + ".idx", "wb") as f:
+        f.write(b"WRONGMAG" + b"\0" * 32)
+    with open(prefix + ".bin", "wb") as f:
+        f.write(b"")
+    with pytest.raises(ValueError, match="magic"):
+        MMapIndexedDataset(prefix)
+
+
+# ---------------------------- data sampler -------------------------------- #
+
+def test_sampler_partitions_ranks():
+    n, mbs, dp = 64, 4, 2
+    samplers = [DeepSpeedDataSampler(n, mbs, data_parallel_rank=r,
+                                     data_parallel_size=dp, seed=7)
+                for r in range(dp)]
+    seen = [set(), set()]
+    for r, s in enumerate(samplers):
+        for mb in s:
+            assert len(mb) == mbs
+            seen[r].update(mb)
+    assert not (seen[0] & seen[1])  # disjoint across ranks
+    assert len(seen[0] | seen[1]) == n
+
+
+def test_sampler_resume():
+    s = DeepSpeedDataSampler(32, 2, gradient_accumulation_steps=2, seed=3)
+    it = iter(s)
+    first = [next(it), next(it)]  # one global batch consumed
+    state = s.state_dict()
+    s2 = DeepSpeedDataSampler(32, 2, gradient_accumulation_steps=2, seed=3)
+    s2.load_state_dict(state)
+    resumed = list(s2)
+    full = list(DeepSpeedDataSampler(32, 2, gradient_accumulation_steps=2, seed=3))
+    assert resumed == full[2:]
+
+
+def test_sampler_curriculum_defers_hard_samples():
+    n = 32
+    difficulties = np.arange(n)  # sample i has difficulty i
+    cur = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 32,
+                               "schedule_type": "fixed_linear",
+                               "schedule_config": {"total_curriculum_step": 100,
+                                                   "difficulty_step": 8}})
+    s = DeepSpeedDataSampler(n, 4, difficulties=difficulties, curriculum=cur,
+                             seed=0)
+    first_batch = next(iter(s))
+    assert all(difficulties[i] <= 8 for i in first_batch)
+
+
+# ---------------------------- random-LTD ---------------------------------- #
+
+def test_random_ltd_gather_scatter():
+    rng = jax.random.PRNGKey(0)
+    x = jnp.arange(2 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 4)
+    idx = random_ltd_indices(rng, 8, 3)
+    assert idx.shape == (3,)
+    assert bool(jnp.all(idx[:-1] < idx[1:]))  # sorted
+    small = gather_tokens(x, idx)
+    assert small.shape == (2, 3, 4)
+    full = scatter_tokens(small, idx, 8)
+    assert full.shape == x.shape
+    np.testing.assert_allclose(gather_tokens(full, idx), small)
+    kept = np.zeros(8, bool)
+    kept[np.asarray(idx)] = True
+    assert bool(jnp.all(full[:, ~kept] == 0))
+
+
+def test_random_ltd_mask_slice():
+    mask = jnp.arange(36, dtype=jnp.float32).reshape(6, 6)
+    idx = jnp.array([1, 4])
+    m = slice_attention_mask(mask, idx)
+    np.testing.assert_array_equal(np.asarray(m),
+                                  [[mask[1, 1], mask[1, 4]],
+                                   [mask[4, 1], mask[4, 4]]])
+
+
+def test_random_ltd_scheduler():
+    s = RandomLTDScheduler(seq_len=128, start=32, total_steps=100, step_size=16)
+    assert s.get_keep(0) == 32
+    assert s.get_keep(100) == 128
+    assert s.get_keep(50) % 16 == 0
+    vals = [s.get_keep(t) for t in range(0, 101, 10)]
+    assert vals == sorted(vals)
+
+
+def test_engine_curriculum_seqlen(tmp_path):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+    model = GPT2LMHead(GPT2Config.tiny())
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+           "curriculum_learning": {"enabled": True, "min_difficulty": 8,
+                                   "max_difficulty": 16,
+                                   "schedule_type": "fixed_linear",
+                                   "schedule_config": {"total_curriculum_step": 4,
+                                                       "difficulty_step": 8}}}
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+    batch = {"input_ids": np.zeros((8, 16), np.int32)}
+    engine.train_batch(batch)  # step 0: seqlen 8
+    assert engine.curriculum_scheduler.current_difficulty == 8
+    for _ in range(4):
+        engine.train_batch(batch)
+    assert engine.curriculum_scheduler.current_difficulty == 16

@@ -1,0 +1,85 @@
+"""Elasticity tests (parity: ``tests/unit/elasticity/test_elastic.py``)."""
+
+import pytest
+
+from deepspeed_tpu.elasticity import ElasticityError, compute_elastic_config
+from deepspeed_tpu.elasticity.elasticity import (_get_compatible_gpus_v01,
+                                                 _get_compatible_gpus_v02,
+                                                 validate_elastic_nodes)
+
+
+def base_config(**over):
+    e = {"enabled": True, "max_train_batch_size": 10000,
+         "micro_batch_sizes": [8, 12, 16, 17], "min_gpus": 32,
+         "max_gpus": 1500, "prefer_larger_batch": True, "version": 0.2}
+    e.update(over)
+    return {"elasticity": e}
+
+
+def test_basic_v01():
+    final_batch, valid = _get_compatible_gpus_v01(
+        micro_batches=[8, 12, 16], max_acceptable_batch_size=10000,
+        min_gpus=32, max_gpus=1500)
+    assert final_batch <= 10000
+    for w in valid:
+        assert 32 <= w <= 1500
+        # batch must decompose as mb * gas * w for some preferred micro batch
+        assert any(final_batch % (mb * w) == 0 for mb in (8, 12, 16))
+    assert len(valid) > 10
+
+
+def test_v02_granularity():
+    final_batch, valid, chosen = _get_compatible_gpus_v02(
+        micro_batches=[2, 4], max_acceptable_batch_size=2048,
+        current_num_gpus=16, min_gpus=4, max_gpus=256,
+        num_gpus_per_node=8)
+    for w in valid:
+        assert w % 8 == 0  # host granularity
+    assert chosen == 16
+
+
+def test_v02_model_parallel():
+    final_batch, valid, chosen = _get_compatible_gpus_v02(
+        micro_batches=[2], max_acceptable_batch_size=512,
+        current_num_gpus=16, min_gpus=4, max_gpus=64,
+        num_gpus_per_node=4, model_parallel_size=8)
+    for w in valid:
+        assert w % 8 == 0  # dp degree steps in mp-compatible groups
+
+
+def test_compute_elastic_config():
+    final_batch, valid = compute_elastic_config(base_config())
+    assert final_batch <= 10000
+    assert valid
+    # with a concrete world size: micro batch returned and divisibility holds
+    w = valid[0]
+    fb, vg, micro = compute_elastic_config(base_config(), world_size=w,
+                                           return_microbatch=True)
+    assert fb % (micro * w) == 0
+
+
+def test_invalid_world_size_rejected():
+    cfg = base_config()
+    _, valid = compute_elastic_config(cfg)
+    bad = max(valid) + 1
+    if bad not in valid:
+        with pytest.raises(ElasticityError):
+            compute_elastic_config(cfg, world_size=bad)
+
+
+def test_disabled_raises():
+    with pytest.raises(ElasticityError):
+        compute_elastic_config({"elasticity": {"enabled": False}})
+
+
+def test_negative_micro_batch_rejected():
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(base_config(micro_batch_sizes=[-1, 4]))
+
+
+def test_validate_elastic_nodes():
+    validate_elastic_nodes(4, 2, 8)
+    with pytest.raises(ElasticityError):
+        validate_elastic_nodes(1, 2, 8)
+    with pytest.raises(ElasticityError):
+        validate_elastic_nodes(9, 2, 8)

@@ -1,0 +1,77 @@
+"""Flops profiler tests (parity: ``tests/unit/profiling/flops_profiler``)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.profiling import FlopsProfiler, get_model_profile
+
+
+class TwoLayer(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(32, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.LayerNorm(name="ln")(x)
+        return nn.Dense(8, name="fc2")(x)
+
+
+def test_dense_macs_counted():
+    model = TwoLayer()
+    x = jnp.zeros((4, 16))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    prof = FlopsProfiler()
+    prof.start_profile(model, variables, x)
+    # fc1: 4*32*16 macs; fc2: 4*8*32 macs
+    expected = 4 * 32 * 16 + 4 * 8 * 32
+    assert prof.get_total_macs() == expected
+    # layernorm flops counted on top
+    assert prof.total_flops_analytic == 2 * expected + 5 * 4 * 32
+    # params: fc1 16*32+32, ln 2*32, fc2 32*8+8
+    assert prof.get_total_params() == 16 * 32 + 32 + 64 + 32 * 8 + 8
+    assert "fc1" in str(sorted(prof.modules))
+
+
+def test_measure_and_report(tmp_path):
+    model = TwoLayer()
+    x = jnp.zeros((4, 16))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    prof = FlopsProfiler()
+    prof.start_profile(model, variables, x)
+    prof.measure(lambda v, b: model.apply(v, b), variables, x)
+    assert prof.latency_s is not None and prof.latency_s > 0
+    out = str(tmp_path / "profile.txt")
+    report = prof.print_model_profile(output_file=out)
+    assert "Flops Profiler" in report
+    with open(out) as f:
+        assert "params" in f.read()
+
+
+def test_get_model_profile():
+    model = TwoLayer()
+    x = jnp.zeros((2, 16))
+    flops, macs, params = get_model_profile(model, x)
+    assert macs == 2 * 32 * 16 + 2 * 8 * 32
+    assert flops >= 2 * macs
+    assert params > 0
+
+
+def test_engine_flops_profiler_hook(tmp_path):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+    out = str(tmp_path / "prof.txt")
+    model = GPT2LMHead(GPT2Config.tiny())
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+           "flops_profiler": {"enabled": True, "profile_step": 1,
+                              "output_file": out}}
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+    batch = {"input_ids": np.zeros((8, 16), np.int32)}
+    engine.train_batch(batch)
+    assert engine.flops_profiler is not None
+    with open(out) as f:
+        txt = f.read()
+    assert "MACs" in txt

@@ -1,0 +1,97 @@
+"""Launcher tests (parity: ``tests/unit/launcher/`` — hostfile parsing etc.,
+pure single-process unit tests)."""
+
+import base64
+import json
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher.launch import decode_world_info
+from deepspeed_tpu.launcher.runner import (build_launch_cmd, encode_world_info,
+                                           fetch_hostfile,
+                                           parse_inclusion_exclusion,
+                                           parse_args)
+
+
+def _write_hostfile(tmp_path, text):
+    p = tmp_path / "hostfile"
+    p.write_text(text)
+    return str(p)
+
+
+def test_fetch_hostfile(tmp_path):
+    path = _write_hostfile(tmp_path, """
+# comment
+worker-0 slots=4
+worker-1 slots=8
+""")
+    pool = fetch_hostfile(path)
+    assert pool == {"worker-0": 4, "worker-1": 8}
+    assert list(pool) == ["worker-0", "worker-1"]
+
+
+def test_fetch_hostfile_missing(tmp_path):
+    assert fetch_hostfile(str(tmp_path / "nope")) is None
+
+
+def test_fetch_hostfile_malformed(tmp_path):
+    path = _write_hostfile(tmp_path, "worker-0 4\n")
+    with pytest.raises(ValueError, match="malformed"):
+        fetch_hostfile(path)
+
+
+def test_fetch_hostfile_duplicate(tmp_path):
+    path = _write_hostfile(tmp_path, "w0 slots=2\nw0 slots=2\n")
+    with pytest.raises(ValueError, match="duplicate"):
+        fetch_hostfile(path)
+
+
+def test_include_filter():
+    pool = {"w0": 4, "w1": 4}
+    active = parse_inclusion_exclusion(pool, "w1:0,2", "")
+    assert active == {"w1": [0, 2]}
+    active = parse_inclusion_exclusion(pool, "w0@w1:1", "")
+    assert active == {"w0": [0, 1, 2, 3], "w1": [1]}
+
+
+def test_exclude_filter():
+    pool = {"w0": 2, "w1": 2}
+    active = parse_inclusion_exclusion(pool, "", "w0")
+    assert active == {"w1": [0, 1]}
+    active = parse_inclusion_exclusion(pool, "", "w1:1")
+    assert active == {"w0": [0, 1], "w1": [0]}
+
+
+def test_include_exclude_mutually_exclusive():
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion({"w0": 1}, "w0", "w0")
+
+
+def test_unknown_host_rejected():
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion({"w0": 1}, "w9", "")
+
+
+def test_world_info_roundtrip():
+    active = {"w0": [0, 1], "w1": [0]}
+    assert decode_world_info(encode_world_info(active)) == active
+
+
+def test_build_launch_cmd():
+    args = parse_args(["--master_port", "12345", "train.py", "--foo", "1"])
+    args.master_addr = "w0"
+    cmd = build_launch_cmd(args, {"w0": [0]}, "w0")
+    assert cmd[0] == sys.executable
+    assert "deepspeed_tpu.launcher.launch" in cmd
+    assert "train.py" in cmd and "--foo" in cmd
+    assert any(c.startswith("--world_info=") for c in cmd)
+
+
+def test_env_report_runs():
+    from deepspeed_tpu.env_report import get_report_lines
+    lines = get_report_lines()
+    text = "\n".join(lines)
+    assert "jax version" in text
+    assert "kernel registry" in text
