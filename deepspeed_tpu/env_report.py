@@ -34,7 +34,7 @@ def kernel_availability():
           lambda: __import__("deepspeed_tpu.ops.quantizer", fromlist=["quantize"]))
     probe("fused_adam",
           lambda: __import__("deepspeed_tpu.ops.adam", fromlist=["FusedAdam"]))
-    probe("aio", lambda: __import__("deepspeed_tpu.ops.aio", fromlist=["AsyncIOHandle"]))
+    probe("aio", lambda: __import__("deepspeed_tpu.ops.native", fromlist=["AsyncIOHandle"]))
     return checks
 
 

@@ -1,0 +1,22 @@
+"""Native host runtime tier: C++ async file I/O + host optimizer kernels.
+
+The TPU-framework analog of the reference's ``csrc/`` + ``op_builder/`` native
+layer for everything that is genuinely *host-side* work (NVMe tensor spill,
+ZeRO-Offload optimizer steps, dtype conversion for copy-back). Device compute
+stays in XLA/Pallas; this tier exists because disk I/O and host DRAM math
+cannot ride the MXU.
+"""
+
+from deepspeed_tpu.ops.native.builder import load_native, native_available
+from deepspeed_tpu.ops.native.aio import (AsyncIOHandle, aligned_empty,
+                                          swap_in_tensors, swap_out_tensors,
+                                          AIO_DEFAULT_DICT)
+from deepspeed_tpu.ops.native.cpu_optimizer import (HostAdam, HostAdagrad,
+                                                    HostLion, f32_to_bf16,
+                                                    bf16_to_f32)
+
+__all__ = [
+    "load_native", "native_available", "AsyncIOHandle", "aligned_empty",
+    "swap_in_tensors", "swap_out_tensors", "AIO_DEFAULT_DICT", "HostAdam",
+    "HostAdagrad", "HostLion", "f32_to_bf16", "bf16_to_f32",
+]

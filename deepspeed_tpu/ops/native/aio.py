@@ -1,0 +1,221 @@
+"""Async tensor file I/O handle.
+
+Behavioral parity with the reference ``aio_handle``
+(``csrc/aio/py_lib/deepspeed_py_aio_handle.cpp``, bound in ``py_ds_aio.cpp``:
+``sync_pread/sync_pwrite/async_pread/async_pwrite/wait`` + block_size /
+queue_depth / thread_count accessors), re-designed for the TPU host: requests
+operate on numpy arrays (the host staging buffers that JAX device transfers
+read from / write to), the native engine is a C++ thread pool issuing chunked
+pread/pwrite (O_DIRECT when aligned), and a pure-Python ``ThreadPoolExecutor``
+fallback keeps every feature working without a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.native.builder import load_native
+
+AIO_DEFAULT_DICT = {
+    "block_size": 1 << 20,
+    "queue_depth": 32,
+    "thread_count": 8,
+    "single_submit": False,
+    "overlap_events": True,
+    "use_o_direct": False,
+}
+
+
+def _as_byte_view(arr: np.ndarray) -> np.ndarray:
+    if not arr.flags["C_CONTIGUOUS"]:
+        raise ValueError("AIO requires C-contiguous arrays")
+    return arr.view(np.uint8).reshape(-1)
+
+
+def aligned_empty(shape, dtype=np.float32) -> np.ndarray:
+    """Page-aligned uninitialized array: the pinned-buffer analog
+    (reference ``deepspeed_pin_tensor.cpp``). Buffers from here satisfy the
+    O_DIRECT alignment contract, so the native engine bypasses the page cache;
+    falls back to a plain numpy allocation without the native lib."""
+    import weakref
+    lib = load_native()
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    dtype = np.dtype(dtype)
+    nbytes = int(np.prod(shape)) * dtype.itemsize
+    if lib is None:
+        return np.empty(shape, dtype)
+    ptr = lib.ds_alloc_aligned(max(nbytes, 1))
+    if not ptr:
+        return np.empty(shape, dtype)
+    buf = (ctypes.c_uint8 * max(nbytes, 1)).from_address(ptr)
+    arr = np.frombuffer(buf, np.uint8, count=nbytes).view(dtype).reshape(shape)
+    weakref.finalize(buf, lib.ds_free_aligned, ptr)
+    return arr
+
+
+class AsyncIOHandle:
+    """Submit/wait file I/O over numpy buffers.
+
+    ``async_pread(buffer, path)`` / ``async_pwrite(buffer, path)`` enqueue a
+    request; ``wait()`` blocks until all inflight requests retire and returns
+    the completed count (reference contract: callers assert
+    ``n == handle.wait()``, e.g. ``runtime/swap_tensor/utils.py:21``).
+    """
+
+    def __init__(self, block_size: int = AIO_DEFAULT_DICT["block_size"],
+                 queue_depth: int = AIO_DEFAULT_DICT["queue_depth"],
+                 single_submit: bool = False, overlap_events: bool = True,
+                 thread_count: int = AIO_DEFAULT_DICT["thread_count"],
+                 use_o_direct: bool = False):
+        self._block_size = int(block_size)
+        self._queue_depth = int(queue_depth)
+        self._single_submit = bool(single_submit)
+        self._overlap_events = bool(overlap_events)
+        self._thread_count = int(thread_count)
+        self._lib = load_native()
+        self._handle = None
+        self._futures: List[Future] = []
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._keepalive: List[np.ndarray] = []
+        if self._lib is not None:
+            self._handle = self._lib.ds_aio_create(
+                self._block_size, self._queue_depth, self._thread_count,
+                1 if use_o_direct else 0)
+        else:
+            self._pool = ThreadPoolExecutor(max_workers=self._thread_count)
+
+    # -- accessors (reference py_ds_aio.cpp binding surface) -------------- #
+    def get_block_size(self) -> int:
+        return self._block_size
+
+    def get_queue_depth(self) -> int:
+        return self._queue_depth
+
+    def get_single_submit(self) -> bool:
+        return self._single_submit
+
+    def get_overlap_events(self) -> bool:
+        return self._overlap_events
+
+    def get_thread_count(self) -> int:
+        return self._thread_count
+
+    # -- submit/wait ------------------------------------------------------ #
+    def async_pread(self, buffer: np.ndarray, path: str, file_offset: int = 0) -> int:
+        return self._submit(buffer, path, file_offset, is_read=True)
+
+    def async_pwrite(self, buffer: np.ndarray, path: str, file_offset: int = 0) -> int:
+        return self._submit(buffer, path, file_offset, is_read=False)
+
+    def sync_pread(self, buffer: np.ndarray, path: str, file_offset: int = 0) -> int:
+        rc = self.async_pread(buffer, path, file_offset)
+        if rc != 0:
+            return rc
+        n = self.wait()
+        return 0 if n >= 0 else n
+
+    def sync_pwrite(self, buffer: np.ndarray, path: str, file_offset: int = 0) -> int:
+        rc = self.async_pwrite(buffer, path, file_offset)
+        if rc != 0:
+            return rc
+        n = self.wait()
+        return 0 if n >= 0 else n
+
+    # reference aliases (read/write are whole-file sync ops)
+    read = sync_pread
+    write = sync_pwrite
+
+    def wait(self) -> int:
+        if self._handle is not None:
+            # Buffers must stay pinned until the C++ pool retires every chunk.
+            rc = self._lib.ds_aio_wait(self._handle)
+            self._keepalive.clear()
+            return rc
+        completed = 0
+        err = 0
+        for fut in self._futures:
+            try:
+                fut.result()
+                completed += 1
+            except OSError as e:
+                err = e.errno or 1
+        self._futures.clear()
+        self._keepalive.clear()
+        return -err if err else completed
+
+    def inflight(self) -> int:
+        return len(self._keepalive)
+
+    def close(self):
+        if self._handle is not None:
+            self._lib.ds_aio_destroy(self._handle)
+            self._handle = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- internals --------------------------------------------------------- #
+    def _submit(self, buffer: np.ndarray, path: str, file_offset: int,
+                is_read: bool) -> int:
+        view = _as_byte_view(buffer)
+        if self._handle is not None:
+            ptr = view.ctypes.data_as(ctypes.c_void_p)
+            rc = int(self._lib.ds_aio_submit(
+                self._handle, ptr, view.nbytes, path.encode(), file_offset,
+                1 if is_read else 0))
+            if rc == 0:
+                self._keepalive.append(view)  # pin until wait()
+            return rc
+        self._keepalive.append(view)
+        self._futures.append(
+            self._pool.submit(self._py_io, view, path, file_offset, is_read))
+        return 0
+
+    @staticmethod
+    def _py_io(view: np.ndarray, path: str, file_offset: int, is_read: bool):
+        mv = memoryview(view)
+        if is_read:
+            with open(path, "rb", buffering=0) as f:
+                f.seek(file_offset)
+                got = 0
+                while got < view.nbytes:
+                    n = f.readinto(mv[got:])
+                    if not n:
+                        raise OSError(5, f"short read from {path}")
+                    got += n
+        else:
+            # O_CREAT without O_TRUNC: concurrent offset-writes to one file
+            # (partitioned swap-out) must not clobber each other.
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT, 0o644)
+            try:
+                written = 0
+                while written < view.nbytes:
+                    written += os.pwrite(fd, mv[written:], file_offset + written)
+            finally:
+                os.close(fd)
+
+
+def swap_out_tensors(handle: AsyncIOHandle, arrays, paths) -> None:
+    """Enqueue writes for a list of arrays (reference swap_tensor/utils.py)."""
+    for arr, path in zip(arrays, paths, strict=True):
+        rc = handle.async_pwrite(arr, path)
+        if rc != 0:
+            raise OSError(-rc, f"async_pwrite submit failed for {path}")
+
+
+def swap_in_tensors(handle: AsyncIOHandle, arrays, paths) -> None:
+    for arr, path in zip(arrays, paths, strict=True):
+        rc = handle.async_pread(arr, path)
+        if rc != 0:
+            raise OSError(-rc, f"async_pread submit failed for {path}")
