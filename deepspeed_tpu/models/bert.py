@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.ops.attention import reference_attention
+from deepspeed_tpu.runtime.activation_checkpointing import remat_block
 
 
 @dataclass
@@ -36,6 +37,7 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     dtype: Any = jnp.float32
     remat: bool = False
+    remat_policy: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -126,8 +128,9 @@ class BertForMaskedLM(nn.Module):
         if mask is not None:
             bias = jnp.where(mask[:, None, None, :] > 0, 0.0,
                              jnp.finfo(jnp.float32).min)
-        layer_cls = nn.remat(BertLayer) if cfg.remat else BertLayer
         for i in range(cfg.num_hidden_layers):
+            layer_cls = remat_block(BertLayer, i, cfg.num_hidden_layers,
+                                    cfg.remat, policy=cfg.remat_policy)
             x = layer_cls(cfg, name=f"layer_{i}")(x, bias)
 
         # MLM head: transform + tied decoder (HF cls.predictions shape)

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from deepspeed_tpu.models.llama import (causal_lm_loss, repeat_kv,
                                         rope_frequencies, _window_bias)
 from deepspeed_tpu.ops.attention import dot_product_attention, reference_attention
+from deepspeed_tpu.runtime.activation_checkpointing import remat_block
 
 
 @dataclass
@@ -63,6 +64,7 @@ class DecoderConfig:
     eps: float = 1e-5
     dtype: Any = jnp.float32
     remat: bool = False
+    remat_policy: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -304,9 +306,10 @@ class DecoderLM(nn.Module):
             self.pos_embed = nn.Embed(cfg.max_position_embeddings + cfg.pos_offset,
                                       cfg.hidden_size, dtype=cfg.dtype,
                                       name="pos_embed")
-        block = nn.remat(DecoderBlock) if cfg.remat else DecoderBlock
-        self.layers = [block(cfg, name=f"layers_{i}")
-                       for i in range(cfg.num_hidden_layers)]
+        self.layers = [
+            remat_block(DecoderBlock, i, cfg.num_hidden_layers, cfg.remat,
+                        policy=cfg.remat_policy)(cfg, name=f"layers_{i}")
+            for i in range(cfg.num_hidden_layers)]
         self.final_norm = _Norm(cfg.norm, cfg.eps, cfg.dtype, name="final_norm")
         if not cfg.tied_lm_head:
             self.lm_head = self.param("lm_head", nn.initializers.normal(0.02),

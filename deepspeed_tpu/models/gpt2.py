@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.ops.attention import dot_product_attention
+from deepspeed_tpu.runtime.activation_checkpointing import remat_block
 
 
 @dataclass
@@ -37,6 +38,7 @@ class GPT2Config:
     # runtime/activation_checkpointing/checkpointing.py; on TPU = jax.checkpoint
     # around each block, letting XLA re-materialise instead of storing activations)
     remat: bool = False
+    remat_policy: Optional[str] = None
 
     @classmethod
     def small(cls, **kw):
@@ -105,8 +107,9 @@ class GPT2LMHead(nn.Module):
         wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype, name="wte")
         wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype, name="wpe")
         x = wte(input_ids) + wpe(jnp.arange(T)[None, :])
-        block_cls = nn.remat(Block, static_argnums=(2,)) if cfg.remat else Block
         for i in range(cfg.n_layer):
+            block_cls = remat_block(Block, i, cfg.n_layer, cfg.remat,
+                                    policy=cfg.remat_policy, static_argnums=(2,))
             x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         logits = wte.attend(x.astype(jnp.float32))  # tied LM head, fp32 logits

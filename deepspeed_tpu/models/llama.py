@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.ops.attention import dot_product_attention, reference_attention
+from deepspeed_tpu.runtime.activation_checkpointing import remat_block
 
 
 @dataclass
@@ -46,6 +47,7 @@ class LlamaConfig:
     sliding_window: Optional[int] = None  # Mistral: 4096
     dtype: Any = jnp.float32
     remat: bool = False
+    remat_policy: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
@@ -310,9 +312,10 @@ class LlamaForCausalLM(nn.Module):
         cfg = self.config
         self.embed_tokens = nn.Embed(cfg.vocab_size, cfg.hidden_size,
                                      dtype=cfg.dtype, name="embed_tokens")
-        block = nn.remat(LlamaBlock) if cfg.remat else LlamaBlock
-        self.layers = [block(cfg, name=f"layers_{i}")
-                       for i in range(cfg.num_hidden_layers)]
+        self.layers = [
+            remat_block(LlamaBlock, i, cfg.num_hidden_layers, cfg.remat,
+                        policy=cfg.remat_policy)(cfg, name=f"layers_{i}")
+            for i in range(cfg.num_hidden_layers)]
         self.norm = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")
         self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                                 name="lm_head")

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from deepspeed_tpu.models.llama import (LlamaAttention, LlamaConfig, RMSNorm,
                                         causal_lm_loss, decode_layers, init_cache)
 from deepspeed_tpu.parallel.moe import _capacity, _constrain_expert, topk_gating
+from deepspeed_tpu.runtime.activation_checkpointing import remat_block
 
 
 @dataclass
@@ -121,9 +122,10 @@ class MixtralForCausalLM(nn.Module):
         cfg = self.config
         self.embed_tokens = nn.Embed(cfg.vocab_size, cfg.hidden_size,
                                      dtype=cfg.dtype, name="embed_tokens")
-        block = nn.remat(MixtralBlock) if cfg.remat else MixtralBlock
-        self.layers = [block(cfg, name=f"layers_{i}")
-                       for i in range(cfg.num_hidden_layers)]
+        self.layers = [
+            remat_block(MixtralBlock, i, cfg.num_hidden_layers, cfg.remat,
+                        policy=cfg.remat_policy)(cfg, name=f"layers_{i}")
+            for i in range(cfg.num_hidden_layers)]
         self.norm = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")
         self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                                 name="lm_head")

@@ -31,9 +31,11 @@ AIO_DEFAULT_DICT = {
 }
 
 
-def _as_byte_view(arr: np.ndarray) -> np.ndarray:
+def _as_byte_view(arr: np.ndarray, for_read: bool = False) -> np.ndarray:
     if not arr.flags["C_CONTIGUOUS"]:
         raise ValueError("AIO requires C-contiguous arrays")
+    if for_read and not arr.flags.writeable:
+        raise ValueError("AIO pread target buffer must be writable")
     return arr.view(np.uint8).reshape(-1)
 
 
@@ -113,18 +115,22 @@ class AsyncIOHandle:
         return self._submit(buffer, path, file_offset, is_read=False)
 
     def sync_pread(self, buffer: np.ndarray, path: str, file_offset: int = 0) -> int:
-        rc = self.async_pread(buffer, path, file_offset)
-        if rc != 0:
-            return rc
-        n = self.wait()
-        return 0 if n >= 0 else n
+        """Blocking read in the caller's thread. Deliberately does NOT touch the
+        async queue: pending async requests stay pending and their completions
+        are still counted by the next ``wait()`` (reference contract)."""
+        return self._sync_io(buffer, path, file_offset, is_read=True)
 
     def sync_pwrite(self, buffer: np.ndarray, path: str, file_offset: int = 0) -> int:
-        rc = self.async_pwrite(buffer, path, file_offset)
-        if rc != 0:
-            return rc
-        n = self.wait()
-        return 0 if n >= 0 else n
+        return self._sync_io(buffer, path, file_offset, is_read=False)
+
+    def _sync_io(self, buffer: np.ndarray, path: str, file_offset: int,
+                 is_read: bool) -> int:
+        view = _as_byte_view(buffer, for_read=is_read)
+        try:
+            self._py_io(view, path, file_offset, is_read)
+        except OSError as e:
+            return -(e.errno or 1)
+        return 0
 
     # reference aliases (read/write are whole-file sync ops)
     read = sync_pread
@@ -142,8 +148,8 @@ class AsyncIOHandle:
             try:
                 fut.result()
                 completed += 1
-            except OSError as e:
-                err = e.errno or 1
+            except Exception as e:
+                err = getattr(e, "errno", None) or 1
         self._futures.clear()
         self._keepalive.clear()
         return -err if err else completed
@@ -168,7 +174,7 @@ class AsyncIOHandle:
     # -- internals --------------------------------------------------------- #
     def _submit(self, buffer: np.ndarray, path: str, file_offset: int,
                 is_read: bool) -> int:
-        view = _as_byte_view(buffer)
+        view = _as_byte_view(buffer, for_read=is_read)
         if self._handle is not None:
             ptr = view.ctypes.data_as(ctypes.c_void_p)
             rc = int(self._lib.ds_aio_submit(

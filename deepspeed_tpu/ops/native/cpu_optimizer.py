@@ -29,6 +29,10 @@ def _check(name: str, *arrays: np.ndarray) -> None:
     for a in arrays:
         if a.dtype != np.float32 or not a.flags["C_CONTIGUOUS"]:
             raise ValueError(f"{name}: buffers must be contiguous float32")
+        if a.size != arrays[0].size:
+            raise ValueError(
+                f"{name}: buffer size mismatch ({a.size} vs {arrays[0].size}); "
+                "params/grads/states must be the same flat length")
 
 
 class HostAdam:

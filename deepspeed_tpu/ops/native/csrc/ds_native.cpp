@@ -102,23 +102,22 @@ public:
         req->file_offset = file_offset;
         req->is_read = is_read;
 
+        // Enqueue every chunk without blocking: submit must return immediately
+        // so compute/swap overlap works (reference async_pread/pwrite contract).
+        // Concurrency is bounded by the worker pool; queue_depth is a tuning
+        // accessor mirrored from the reference's io_submit depth.
         size_t n_chunks = nbytes == 0 ? 1 : (nbytes + block_size_ - 1) / block_size_;
         {
-            std::unique_lock<std::mutex> lk(mu_);
+            std::lock_guard<std::mutex> lk(mu_);
             pending_.push_back(req);
             inflight_chunks_ += n_chunks;
             for (size_t i = 0; i < n_chunks; ++i) {
-                // queue_depth bounds queued-but-unclaimed chunks, mirroring the
-                // reference's io_submit queue-depth throttle.
-                space_cv_.wait(lk, [this] {
-                    return queue_.size() < static_cast<size_t>(queue_depth_);
-                });
                 size_t off = i * block_size_;
                 size_t len = nbytes == 0 ? 0 : std::min(block_size_, nbytes - off);
                 queue_.push_back(Chunk{req, off, len});
-                cv_.notify_one();
             }
         }
+        cv_.notify_all();
         return 0;
     }
 
@@ -163,7 +162,6 @@ private:
                 if (stop_ && queue_.empty()) return;
                 c = queue_.front();
                 queue_.pop_front();
-                space_cv_.notify_one();
             }
             run_chunk(c);
             {
@@ -203,7 +201,7 @@ private:
     std::deque<Chunk> queue_;
     std::vector<AioRequest*> pending_;
     std::mutex mu_;
-    std::condition_variable cv_, done_cv_, space_cv_;
+    std::condition_variable cv_, done_cv_;
     size_t inflight_chunks_ = 0;
     bool stop_ = false;
 };

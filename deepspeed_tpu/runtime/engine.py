@@ -85,6 +85,10 @@ class DeepSpeedTPUEngine:
         self.train_batch_size_, self.micro_batch_size_, self.gas_ = \
             self.config.resolve_batch(self.topology.dp_world_size)
         dist.configure(self.config)
+        # Remat policy for every model family built under this engine
+        # (parity: _configure_checkpointing engine.py:912 + checkpointing.configure)
+        from deepspeed_tpu.runtime import activation_checkpointing
+        activation_checkpointing.configure(self.config)
 
         self.module = model
         self._apply_fn = _extract_apply_fn(model)

@@ -1,0 +1,135 @@
+"""Activation checkpointing (remat) subsystem tests.
+
+Parity model: reference ``tests/unit/runtime/activation_checkpointing`` — the
+checkpointed forward/backward must produce bit-identical losses and grads vs the
+un-checkpointed run (the reference compares against non-checkpointed autograd);
+plus configure()/is_configured() API shape and policy selection.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.config import DeepSpeedTPUConfig
+from deepspeed_tpu.runtime import activation_checkpointing as ac
+
+
+@pytest.fixture(autouse=True)
+def _reset_ac():
+    yield
+    ac.reset()
+
+
+def _mlp_loss(params, x):
+    h = x
+    for w in params:
+        h = jnp.tanh(h @ w)
+    return jnp.sum(h ** 2)
+
+
+def _params(key, n=3, d=16):
+    keys = jax.random.split(key, n)
+    return [jax.random.normal(k, (d, d)) / np.sqrt(d) for k in keys]
+
+
+def test_checkpoint_matches_plain_grads():
+    params = _params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+
+    plain = jax.grad(_mlp_loss)(params, x)
+    ckpt = jax.grad(lambda p, x: ac.checkpoint(_mlp_loss, p, x))(params, x)
+    for a, b in zip(plain, ckpt):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_checkpoint_with_selective_policy():
+    ac.configure(partition_activations=True)
+    assert ac.is_configured()
+    params = _params(jax.random.PRNGKey(2))
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, 16))
+    plain = jax.grad(_mlp_loss)(params, x)
+    ckpt = jax.jit(jax.grad(lambda p, x: ac.checkpoint(_mlp_loss, p, x)))(params, x)
+    for a, b in zip(plain, ckpt):
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_configure_from_config_dict():
+    cfg = DeepSpeedTPUConfig.load({
+        "train_batch_size": 8,
+        "activation_checkpointing": {
+            "partition_activations": True,
+            "cpu_checkpointing": False,
+            "number_checkpoints": 2,
+        },
+    })
+    ac.configure(cfg)
+    assert ac.is_configured()
+    assert ac.current_policy() is not None
+    pred = ac.layer_remat_predicate(8)
+    # number_checkpoints=2 over 8 layers -> every 4th layer remats
+    assert [i for i in range(8) if pred(i)] == [0, 4]
+
+
+def test_policy_registry_and_errors():
+    assert ac.resolve_policy(None) is None
+    assert ac.resolve_policy("dots_saveable") is not None
+    with pytest.raises(ValueError):
+        ac.resolve_policy("not-a-policy")
+
+
+def test_apply_remat_flax_module_grads_match():
+    import flax.linen as nn
+
+    class Block(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return jnp.tanh(nn.Dense(16)(x))
+
+    class Net(nn.Module):
+        remat: bool
+
+        @nn.compact
+        def __call__(self, x):
+            cls = ac.apply_remat(Block, self.remat)
+            for i in range(3):
+                x = cls(name=f"b{i}")(x)
+            return jnp.sum(x ** 2)
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16))
+    plain_net, remat_net = Net(remat=False), Net(remat=True)
+    params = plain_net.init(jax.random.PRNGKey(1), x)
+    g1 = jax.grad(lambda p: plain_net.apply(p, x))(params)
+    g2 = jax.grad(lambda p: remat_net.apply(p, x))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6), g1, g2)
+
+
+def test_rng_tracker_fork_deterministic():
+    tr = ac.RNGStatesTracker()
+    tr.add("model-parallel-rng", 1234)
+    with tr.fork() as k1:
+        a = jax.random.normal(k1, (4,))
+    with tr.fork() as k2:
+        b = jax.random.normal(k2, (4,))
+    assert not np.allclose(a, b)  # key advances
+    tr2 = ac.RNGStatesTracker()
+    tr2.add("model-parallel-rng", 1234)
+    with tr2.fork() as k3:
+        c = jax.random.normal(k3, (4,))
+    np.testing.assert_allclose(a, c)  # same seed -> same stream
+    with pytest.raises(ValueError):
+        tr.add("model-parallel-rng", 0)
+
+
+def test_model_parallel_seed_decorrelates_ranks():
+    k0 = ac.model_parallel_seed(7, tp_rank=0)
+    k1 = ac.model_parallel_seed(7, tp_rank=1)
+    assert not np.array_equal(np.asarray(k0), np.asarray(k1))
+
+
+def test_cpu_checkpointing_policy_selected():
+    ac.configure(checkpoint_in_cpu=True)
+    # offload policy object exists; on the CPU test platform we only check wiring,
+    # execution of pinned_host offload is exercised on real TPU.
+    assert ac.current_policy() is not None
