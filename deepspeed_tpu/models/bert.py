@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.ops.attention import reference_attention
-from deepspeed_tpu.runtime.activation_checkpointing import remat_block
+from deepspeed_tpu.runtime.activation_checkpointing import apply_checkpointed_layers
 
 
 @dataclass
@@ -102,7 +102,23 @@ class BertForMaskedLM(nn.Module):
 
     config: BertConfig
 
-    @nn.compact
+    def setup(self):
+        cfg = self.config
+        self.wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                            name="word_embeddings")
+        self.pos_emb = nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
+                                dtype=cfg.dtype, name="position_embeddings")
+        self.type_emb = nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                                 dtype=cfg.dtype, name="token_type_embeddings")
+        self.emb_ln = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                                   name="embeddings_layernorm")
+        self.layers = [BertLayer(cfg, name=f"layer_{i}")
+                       for i in range(cfg.num_hidden_layers)]
+        self.mlm_transform = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                                      name="mlm_transform")
+        self.mlm_ln = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                                   name="mlm_layernorm")
+
     def __call__(self, batch, deterministic: bool = True):
         cfg = self.config
         input_ids = batch["input_ids"] if isinstance(batch, dict) else batch
@@ -110,35 +126,27 @@ class BertForMaskedLM(nn.Module):
         mask = batch.get("attention_mask") if isinstance(batch, dict) else None
         types = batch.get("token_type_ids") if isinstance(batch, dict) else None
 
-        wte = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
-                       name="word_embeddings")
-        x = wte(input_ids)
-        x = x + nn.Embed(cfg.max_position_embeddings, cfg.hidden_size,
-                         dtype=cfg.dtype,
-                         name="position_embeddings")(jnp.arange(T)[None, :])
+        x = self.wte(input_ids)
+        x = x + self.pos_emb(jnp.arange(T)[None, :])
         if types is None:
             types = jnp.zeros_like(input_ids)
-        x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
-                         name="token_type_embeddings")(types)
-        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
-                         name="embeddings_layernorm")(x)
+        x = x + self.type_emb(types)
+        x = self.emb_ln(x)
 
         # bidirectional: only padding is masked
         bias = None
         if mask is not None:
             bias = jnp.where(mask[:, None, None, :] > 0, 0.0,
                              jnp.finfo(jnp.float32).min)
-        for i in range(cfg.num_hidden_layers):
-            layer_cls = remat_block(BertLayer, i, cfg.num_hidden_layers,
-                                    cfg.remat, policy=cfg.remat_policy)
-            x = layer_cls(cfg, name=f"layer_{i}")(x, bias)
+        x = apply_checkpointed_layers(
+            self, x, lambda mdl, h, i: mdl.layers[i](h, bias),
+            cfg.num_hidden_layers, cfg.remat, cfg.remat_policy)
 
         # MLM head: transform + tied decoder (HF cls.predictions shape)
-        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="mlm_transform")(x)
+        h = self.mlm_transform(x)
         h = nn.gelu(h)
-        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
-                         name="mlm_layernorm")(h)
-        logits = wte.attend(h.astype(jnp.float32))
+        h = self.mlm_ln(h)
+        logits = self.wte.attend(h.astype(jnp.float32))
 
         labels = batch.get("labels") if isinstance(batch, dict) else None
         if labels is None:

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from deepspeed_tpu.models.llama import (causal_lm_loss, repeat_kv,
                                         rope_frequencies, _window_bias)
 from deepspeed_tpu.ops.attention import dot_product_attention, reference_attention
-from deepspeed_tpu.runtime.activation_checkpointing import remat_block
+from deepspeed_tpu.runtime.activation_checkpointing import apply_checkpointed_layers
 
 
 @dataclass
@@ -306,10 +306,8 @@ class DecoderLM(nn.Module):
             self.pos_embed = nn.Embed(cfg.max_position_embeddings + cfg.pos_offset,
                                       cfg.hidden_size, dtype=cfg.dtype,
                                       name="pos_embed")
-        self.layers = [
-            remat_block(DecoderBlock, i, cfg.num_hidden_layers, cfg.remat,
-                        policy=cfg.remat_policy)(cfg, name=f"layers_{i}")
-            for i in range(cfg.num_hidden_layers)]
+        self.layers = [DecoderBlock(cfg, name=f"layers_{i}")
+                       for i in range(cfg.num_hidden_layers)]
         self.final_norm = _Norm(cfg.norm, cfg.eps, cfg.dtype, name="final_norm")
         if not cfg.tied_lm_head:
             self.lm_head = self.param("lm_head", nn.initializers.normal(0.02),
@@ -330,12 +328,14 @@ class DecoderLM(nn.Module):
         return (x @ self.lm_head.astype(cfg.dtype)).astype(jnp.float32)
 
     def forward_logits(self, input_ids, positions=None):
+        cfg = self.config
         B, T = input_ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
         x = self._embed_in(input_ids, positions)
-        for layer in self.layers:
-            x = layer(x, positions)
+        x = apply_checkpointed_layers(
+            self, x, lambda mdl, h, i: mdl.layers[i](h, positions),
+            cfg.num_hidden_layers, cfg.remat, cfg.remat_policy)
         return self._logits(x)
 
     def __call__(self, batch, deterministic: bool = True):

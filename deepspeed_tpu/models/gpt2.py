@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.ops.attention import dot_product_attention
-from deepspeed_tpu.runtime.activation_checkpointing import remat_block
+from deepspeed_tpu.runtime.activation_checkpointing import apply_checkpointed_layers
 
 
 @dataclass
@@ -95,7 +95,13 @@ class GPT2LMHead(nn.Module):
 
     config: GPT2Config
 
-    @nn.compact
+    def setup(self):
+        cfg = self.config
+        self.wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype, name="wte")
+        self.wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype, name="wpe")
+        self.blocks = [Block(cfg, name=f"h_{i}") for i in range(cfg.n_layer)]
+        self.ln_f = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")
+
     def __call__(self, batch, deterministic: bool = True):
         cfg = self.config
         if isinstance(batch, dict):
@@ -104,15 +110,12 @@ class GPT2LMHead(nn.Module):
         else:
             input_ids, labels = batch, None
         B, T = input_ids.shape
-        wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype, name="wte")
-        wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype, name="wpe")
-        x = wte(input_ids) + wpe(jnp.arange(T)[None, :])
-        for i in range(cfg.n_layer):
-            block_cls = remat_block(Block, i, cfg.n_layer, cfg.remat,
-                                    policy=cfg.remat_policy, static_argnums=(2,))
-            x = block_cls(cfg, name=f"h_{i}")(x, deterministic)
-        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
-        logits = wte.attend(x.astype(jnp.float32))  # tied LM head, fp32 logits
+        x = self.wte(input_ids) + self.wpe(jnp.arange(T)[None, :])
+        x = apply_checkpointed_layers(
+            self, x, lambda mdl, h, i: mdl.blocks[i](h, deterministic),
+            cfg.n_layer, cfg.remat, cfg.remat_policy)
+        x = self.ln_f(x)
+        logits = self.wte.attend(x.astype(jnp.float32))  # tied LM head, fp32 logits
 
         if labels is None and isinstance(batch, dict) and "input_ids" in batch:
             labels = input_ids  # LM objective: predict next token of the same ids

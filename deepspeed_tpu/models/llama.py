@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.ops.attention import dot_product_attention, reference_attention
-from deepspeed_tpu.runtime.activation_checkpointing import remat_block
+from deepspeed_tpu.runtime.activation_checkpointing import apply_checkpointed_layers
 
 
 @dataclass
@@ -312,18 +312,18 @@ class LlamaForCausalLM(nn.Module):
         cfg = self.config
         self.embed_tokens = nn.Embed(cfg.vocab_size, cfg.hidden_size,
                                      dtype=cfg.dtype, name="embed_tokens")
-        self.layers = [
-            remat_block(LlamaBlock, i, cfg.num_hidden_layers, cfg.remat,
-                        policy=cfg.remat_policy)(cfg, name=f"layers_{i}")
-            for i in range(cfg.num_hidden_layers)]
+        self.layers = [LlamaBlock(cfg, name=f"layers_{i}")
+                       for i in range(cfg.num_hidden_layers)]
         self.norm = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")
         self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                                 name="lm_head")
 
     def _trunk(self, input_ids, positions):
+        cfg = self.config
         x = self.embed_tokens(input_ids)
-        for layer in self.layers:
-            x = layer(x, positions)
+        x = apply_checkpointed_layers(
+            self, x, lambda mdl, h, i: mdl.layers[i](h, positions),
+            cfg.num_hidden_layers, cfg.remat, cfg.remat_policy)
         return self.norm(x)
 
     def forward_logits(self, input_ids, positions=None):

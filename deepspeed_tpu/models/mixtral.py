@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from deepspeed_tpu.models.llama import (LlamaAttention, LlamaConfig, RMSNorm,
                                         causal_lm_loss, decode_layers, init_cache)
 from deepspeed_tpu.parallel.moe import _capacity, _constrain_expert, topk_gating
-from deepspeed_tpu.runtime.activation_checkpointing import remat_block
+from deepspeed_tpu.runtime.activation_checkpointing import apply_checkpointed_layers
 
 
 @dataclass
@@ -122,10 +122,8 @@ class MixtralForCausalLM(nn.Module):
         cfg = self.config
         self.embed_tokens = nn.Embed(cfg.vocab_size, cfg.hidden_size,
                                      dtype=cfg.dtype, name="embed_tokens")
-        self.layers = [
-            remat_block(MixtralBlock, i, cfg.num_hidden_layers, cfg.remat,
-                        policy=cfg.remat_policy)(cfg, name=f"layers_{i}")
-            for i in range(cfg.num_hidden_layers)]
+        self.layers = [MixtralBlock(cfg, name=f"layers_{i}")
+                       for i in range(cfg.num_hidden_layers)]
         self.norm = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")
         self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                                 name="lm_head")
@@ -139,10 +137,16 @@ class MixtralForCausalLM(nn.Module):
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
         x = self.embed_tokens(input_ids)
-        aux_total = jnp.float32(0.0)
-        for layer in self.layers:
-            x, l_aux = layer(x, positions)
-            aux_total = aux_total + l_aux
+
+        def call_layer(mdl, carry, i):
+            h, aux = carry
+            h, l_aux = mdl.layers[i](h, positions)
+            return h, aux + l_aux
+
+        cfg = self.config
+        x, aux_total = apply_checkpointed_layers(
+            self, (x, jnp.float32(0.0)), call_layer,
+            cfg.num_hidden_layers, cfg.remat, cfg.remat_policy)
         x = self.norm(x)
         return self.lm_head(x).astype(jnp.float32), aux_total
 

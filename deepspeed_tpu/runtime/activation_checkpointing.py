@@ -191,10 +191,9 @@ def checkpoint_wrapper(function: Callable, policy=None, static_argnums=()):
 def apply_remat(block_cls, remat: bool = True, policy=None, static_argnums=()):
     """Wrap a flax module class in ``nn.remat`` with the configured policy.
 
-    Model builders call this so that the config block
-    (``activation_checkpointing`` in the DeepSpeed-style dict) uniformly drives
-    every model family. ``number_checkpoints`` is honoured by
-    :func:`layer_remat_predicate` at the call site for every-Nth-layer remat.
+    For whole-class wrapping; model layer stacks use
+    :func:`apply_checkpointed_layers`, which additionally honours
+    ``number_checkpoints`` chunking.
     """
     if not remat:
         return block_cls
@@ -203,28 +202,47 @@ def apply_remat(block_cls, remat: bool = True, policy=None, static_argnums=()):
     return nn.remat(block_cls, policy=pol, static_argnums=static_argnums)
 
 
-def remat_block(block_cls, layer_idx: int, n_layers: int, remat: bool = True,
-                policy=None, static_argnums=()):
-    """Per-layer remat wrapper used by model builders: honours
-    ``number_checkpoints`` by only rematerialising the evenly spaced subset of
-    layers chosen by :func:`layer_remat_predicate`."""
-    if not remat or not layer_remat_predicate(n_layers)(layer_idx):
-        return block_cls
-    return apply_remat(block_cls, True, policy=policy, static_argnums=static_argnums)
+def layer_chunks(n_layers: int) -> list:
+    """Chunk boundaries [(start, end), ...] for checkpointed layer application.
 
-
-def layer_remat_predicate(n_layers: int) -> Callable[[int], bool]:
-    """Which layer indices to remat when ``number_checkpoints`` caps the count.
-
-    Parity: the reference checkpoints ``num_layers/num_checkpoints``-sized chunks
-    (checkpointing.py ``num_layers`` partitioning); here we remat an evenly spaced
-    subset of layers when ``number_checkpoints < n_layers``.
+    Parity: ``num_checkpoints`` is "the number of activation checkpoints stored
+    during the forward" (checkpointing.py:1097) — layers are partitioned into
+    that many chunks and only chunk-boundary activations survive; everything
+    inside a chunk recomputes in backward. Fewer checkpoints => less memory,
+    more recompute. Default (unset): one chunk per layer.
     """
-    k = _STATE.number_checkpoints if _STATE.configured else None
-    if not k or k >= n_layers:
-        return lambda i: True
-    stride = max(1, round(n_layers / k))
-    return lambda i: (i % stride) == 0
+    k = _STATE.number_checkpoints if _STATE.configured and _STATE.number_checkpoints \
+        else n_layers
+    k = max(1, min(int(k), n_layers))
+    per = -(-n_layers // k)  # ceil
+    return [(s, min(s + per, n_layers)) for s in range(0, n_layers, per)]
+
+
+def apply_checkpointed_layers(module, carry, call_layer, n_layers: int,
+                              remat: bool = True, policy=None):
+    """Apply ``n_layers`` layers with chunked rematerialisation.
+
+    ``call_layer(module, carry, i) -> carry`` applies layer ``i``; layers must be
+    reachable through ``module`` (setup-defined submodule lists), the flax lifted
+    -transform contract. Model builders use this so the
+    ``activation_checkpointing`` config block uniformly drives every family.
+    """
+    if not remat:
+        for i in range(n_layers):
+            carry = call_layer(module, carry, i)
+        return carry
+    import flax.linen as nn
+    pol = resolve_policy(policy) if policy is not None else current_policy()
+
+    def chunk(mdl, carry, s, e):
+        for i in range(s, e):
+            carry = call_layer(mdl, carry, i)
+        return carry
+
+    rchunk = nn.remat(chunk, policy=pol, static_argnums=(2, 3))
+    for s, e in layer_chunks(n_layers):
+        carry = rchunk(module, carry, s, e)
+    return carry
 
 
 # --------------------------------------------------------------------------- #

@@ -44,6 +44,11 @@ from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIM
 from deepspeed_tpu.utils.tree import global_norm, tree_cast
 
 
+def _last_key(path) -> str:
+    from deepspeed_tpu.checkpoint.state import _path_str
+    return _path_str(path[-1])
+
+
 def _extract_apply_fn(model: Any) -> Callable:
     """Accept a flax module (uses ``.apply``), or a callable ``f(params, batch)``.
 
@@ -104,6 +109,17 @@ class DeepSpeedTPUEngine:
         self.partitioner = ZeroPartitioner(
             self.zero_stage, self.topology,
             persistence_threshold=self.config.zero_optimization.stage3_param_persistence_threshold)
+
+        # -- ZeRO-Offload/Infinity: host/NVMe optimizer step (parity:
+        # cpu_offload stage_1_and_2.py:140, stage3 swap_tensor wiring) -----
+        off = self.config.zero_optimization.offload_optimizer
+        self._offload_cfg = None
+        self._offload = None  # HostOffloadOptimizer, built in _init_state
+        if off is not None and getattr(off.device, "value", off.device) != "none":
+            self._offload_cfg = off
+            if self.zero_stage == 0:
+                logger.warning("offload_optimizer with zero stage 0: optimizer "
+                               "states go to host but grads stay replicated")
 
         # -- optimizer (parity: _configure_optimizer engine.py:1210) -----
         self.client_optimizer = optimizer
@@ -207,6 +223,8 @@ class DeepSpeedTPUEngine:
             self._tp_specs = specs
         master_sh = self.partitioner.master_sharding(model_parameters, self._tp_specs)
         param_sh = self.partitioner.param_sharding(model_parameters, self._tp_specs)
+        if self._offload_cfg is not None:
+            return self._init_state_offload(model_parameters, master_sh, param_sh)
         opt_template = jax.eval_shape(self.optimizer.init,
                                       jax.eval_shape(lambda t: tree_cast(t, jnp.float32),
                                                      model_parameters))
@@ -248,11 +266,230 @@ class DeepSpeedTPUEngine:
         self._scaler_dynamic = bool(dynamic and fp16.loss_scale == 0)
 
     # ------------------------------------------------------------------ #
+    # ZeRO-Offload state + step (host/NVMe optimizer; parity: cpu_offload +
+    # swap_tensor pipelined optimizer swapper)
+    # ------------------------------------------------------------------ #
+
+    def _init_state_offload(self, model_parameters, master_sh, param_sh):
+        """State layout in offload mode: ``params`` is the full device tree
+        (compute dtype, sharded); ``master``/``opt`` are FLAT dicts keyed by
+        '/'-joined paths holding only the *device-flow* leaves (twin-flow
+        ``ratio`` knob); host-flow leaves live in ``self._offload`` (RAM or
+        NVMe via the pipelined swapper). The flat-key scheme matches the
+        checkpoint layer, so offload and non-offload checkpoints are
+        interchangeable."""
+        from deepspeed_tpu.checkpoint.state import flatten_tree
+        from deepspeed_tpu.runtime.zero.offload import (HostOffloadOptimizer,
+                                                        partition_leaves)
+        topo = self.topology
+        flat = flatten_tree(model_parameters)
+        host_names, dev_names = partition_leaves(flat, self._offload_cfg.ratio)
+        self._offload_host_names = host_names
+        self._offload_dev_names = dev_names
+        self._param_template = jax.eval_shape(lambda t: t, model_parameters)
+        flat_master_sh = flatten_tree(master_sh)
+
+        host_master = {k: np.asarray(jax.device_get(flat[k]), np.float32)
+                       for k in host_names}
+        self._offload = HostOffloadOptimizer(self.optimizer, host_master,
+                                             self._offload_cfg)
+
+        dev_template = {k: jax.ShapeDtypeStruct(np.shape(flat[k]), jnp.float32)
+                        for k in dev_names}
+        opt_template = jax.eval_shape(self.optimizer.init, dev_template)
+        repl = NamedSharding(topo.mesh, P())
+
+        def opt_leaf_sharding(path, leaf):
+            if not np.shape(leaf):
+                return repl
+            return flat_master_sh.get(_last_key(path), repl)
+
+        opt_sh = jax.tree_util.tree_map_with_path(opt_leaf_sharding, opt_template)
+        shardings = {
+            "params": param_sh,
+            "master": {k: flat_master_sh[k] for k in dev_names},
+            "opt": opt_sh,
+            "step": repl,
+            "scaler": {k: repl for k in ("scale", "growth_tracker", "hysteresis")},
+            "skipped": repl,
+        }
+        fp16 = self.config.fp16
+
+        def build(params_in):
+            flat_in = flatten_tree(params_in)
+            master_dev = {k: flat_in[k].astype(jnp.float32) for k in dev_names}
+            scaler = make_loss_scale_state(fp16.enabled, fp16.loss_scale,
+                                           fp16.initial_scale_power, fp16.hysteresis)
+            return {"params": tree_cast(params_in, self.compute_dtype),
+                    "master": master_dev,
+                    "opt": self.optimizer.init(master_dev),
+                    "step": jnp.zeros((), jnp.int32),
+                    "scaler": {k: scaler[k] for k in ("scale", "growth_tracker",
+                                                      "hysteresis")},
+                    "skipped": jnp.zeros((), jnp.int32)}
+
+        with topo.mesh:
+            self.state = jax.jit(build, out_shardings=shardings)(model_parameters)
+        self._state_shardings = shardings
+        self._scaler_dynamic = bool(fp16.enabled and fp16.loss_scale == 0)
+        self._offload_merge = None
+        log_dist(f"offload_optimizer[{self._offload_cfg.device}]: "
+                 f"{len(host_names)} host leaves, {len(dev_names)} device leaves",
+                 ranks=[0])
+
+    def _build_offload_grad_step(self):
+        """Jitted: scan microbatches -> mean grads; update device-flow leaves;
+        emit clipped fp32 host-flow grads for the host optimizer."""
+        from deepspeed_tpu.checkpoint.state import flatten_tree
+        fp16 = self.config.fp16
+        clip = self.config.gradient_clipping
+        dev_names, host_names = self._offload_dev_names, self._offload_host_names
+
+        def step_fn(state, batch):
+            params = state["params"]
+            scale = state["scaler"]["scale"] if fp16.enabled else jnp.float32(1.0)
+            grads, losses = self._accumulate_grads(params, scale, batch)
+            flat_g = flatten_tree(grads)
+            gnorm = global_norm(flat_g)
+            overflow = has_overflow(flat_g) if fp16.enabled else jnp.bool_(False)
+            cscale = jnp.minimum(1.0, clip / (gnorm + 1e-6)) if clip > 0 \
+                else jnp.float32(1.0)
+            lr = self._lr_fn(state["step"])
+
+            dev_g = {k: flat_g[k] * cscale for k in dev_names}
+            host_g = {k: flat_g[k] * cscale for k in host_names}
+
+            def do_update(operand):
+                master, opt = operand
+                return self.optimizer.update(dev_g, opt, master, lr=lr)
+
+            new_master, new_opt = jax.lax.cond(
+                overflow, lambda o: o, do_update, (state["master"], state["opt"]))
+            scaler_full = dict(state["scaler"], dynamic=self._scaler_dynamic)
+            new_scaler = update_loss_scale(
+                scaler_full, overflow, loss_scale_window=fp16.loss_scale_window,
+                hysteresis=fp16.hysteresis, min_loss_scale=fp16.min_loss_scale)
+            new_state = {
+                "params": params,  # merged after the host step
+                "master": new_master,
+                "opt": new_opt,
+                "step": state["step"] + jnp.where(overflow, 0, 1).astype(jnp.int32),
+                "scaler": {k: new_scaler[k] for k in ("scale", "growth_tracker",
+                                                      "hysteresis")},
+                "skipped": state["skipped"] + overflow.astype(jnp.int32),
+            }
+            metrics = {"loss": jnp.mean(losses), "grad_norm": gnorm, "lr": lr,
+                       "overflow": overflow, "loss_scale": new_scaler["scale"]}
+            return new_state, host_g, metrics
+
+        return step_fn
+
+    def _offload_train_step(self, sharded_batch):
+        if self._fused_step is None:
+            # params pass through to the output state, so donation aliases the
+            # old buffers instead of double-allocating device state
+            self._fused_step = jax.jit(self._build_offload_grad_step(),
+                                       donate_argnums=(0,))
+        if self._offload_merge is None:
+            self._offload_train_merge_warmup()
+        self.state, host_g, metrics = self._fused_step(self.state, sharded_batch)
+        overflow = bool(metrics["overflow"]) if self.config.fp16.enabled else False
+        if not overflow:
+            host_np = {k: np.asarray(jax.device_get(v)) for k, v in host_g.items()}
+            updated = self._offload.step(host_np, float(metrics["lr"]))
+            self.state["params"] = self._offload_merge(self.state["master"], updated)
+        return metrics
+
+    def _offload_ckpt_state(self):
+        """Synthetic full-state view for checkpoint save: device-flow leaves
+        fetched from device, host-flow leaves read from RAM/NVMe; flat keys make
+        the layout identical to non-offload checkpoints."""
+        dev_master = {k: np.asarray(jax.device_get(v))
+                      for k, v in self.state["master"].items()}
+        host_master, moments = self._offload.state_leaves()
+        full_master = {**dev_master, **host_master}
+        dev_opt = jax.device_get(self.state["opt"])
+        full_opt = {}
+        for key, val in dev_opt.items():
+            if isinstance(val, dict):
+                full_opt[key] = {**val, **moments.get(key, {})}
+            else:
+                full_opt[key] = val
+        return {"master": full_master, "opt": full_opt, "step": self.state["step"],
+                "scaler": self.state["scaler"], "skipped": self.state["skipped"]}
+
+    def _load_checkpoint_offload(self, load_dir, tag, load_optimizer_states=True,
+                                 load_module_only=False):
+        from deepspeed_tpu.checkpoint import state as ck
+        import json
+        tag = tag or ck.read_latest_tag(load_dir)
+        if tag is None:
+            raise FileNotFoundError(f"no 'latest' file in {load_dir}")
+        ckpt_dir = os.path.join(load_dir, tag)
+        model_flat = dict(np.load(os.path.join(ckpt_dir, ck.MODEL_FILE)))
+        dev_names, host_names = self._offload_dev_names, self._offload_host_names
+        master_sh = self._state_shardings["master"]
+        self.state["master"] = {
+            k: jax.device_put(model_flat[k], master_sh[k]) for k in dev_names}
+        self._offload.load_master_leaves({k: model_flat[k] for k in host_names})
+        if load_optimizer_states and not load_module_only:
+            optim_flat = dict(np.load(os.path.join(ckpt_dir, ck.OPTIM_FILE)))
+            dev_opt = jax.device_get(self.state["opt"])
+            new_opt, host_moments = {}, {}
+            for key, val in dev_opt.items():
+                if isinstance(val, dict):
+                    new_opt[key] = {
+                        k: jax.device_put(optim_flat[f"opt/{key}/{k}"],
+                                          self._state_shardings["opt"][key][k])
+                        for k in dev_names}
+                    host_moments[key] = {k: optim_flat[f"opt/{key}/{k}"]
+                                         for k in host_names}
+                else:
+                    new_opt[key] = jax.device_put(optim_flat[f"opt/{key}"],
+                                                  self._state_shardings["opt"][key])
+            self.state["opt"] = new_opt
+            step_num = int(optim_flat.get("opt/step", optim_flat.get("step", 0)))
+            self._offload.load_moment_leaves(host_moments, step_num=step_num)
+            for k in ("step", "skipped"):
+                self.state[k] = jax.device_put(optim_flat[k].astype(np.int32),
+                                               self._state_shardings[k])
+            self.state["scaler"] = {
+                k: jax.device_put(optim_flat[f"scaler/{k}"],
+                                  self._state_shardings["scaler"][k])
+                for k in ("scale", "growth_tracker", "hysteresis")}
+        # rebuild device params from masters
+        if self._offload_merge is None:
+            self._offload_train_merge_warmup()
+        self.state["params"] = self._offload_merge(self.state["master"],
+                                                   self._offload.master_leaves())
+        client_path = os.path.join(ckpt_dir, ck.CLIENT_FILE)
+        client_state = {}
+        if os.path.exists(client_path):
+            with open(client_path) as f:
+                client_state = json.load(f)
+        return load_dir, client_state
+
+    def _offload_train_merge_warmup(self):
+        from deepspeed_tpu.checkpoint.state import unflatten_into
+        param_sh = self._state_shardings["params"]
+        template = self._param_template
+        dtype = self.compute_dtype
+
+        def merge(master_dev, host_master):
+            flat = {k: v.astype(dtype) for k, v in master_dev.items()}
+            flat.update({k: v.astype(dtype) for k, v in host_master.items()})
+            return unflatten_into(template, flat)
+
+        self._offload_merge = jax.jit(merge, out_shardings=param_sh)
+
+    # ------------------------------------------------------------------ #
     # loss / grads
     # ------------------------------------------------------------------ #
 
     def _current_params(self, state):
-        return state["params"] if self.mixed_precision else state["master"]
+        if "params" in state:
+            return state["params"]
+        return state["master"]
 
     def _loss_of(self, params, batch, rngs=None):
         out = self._apply_fn(params, batch, rngs)
@@ -279,28 +516,34 @@ class DeepSpeedTPUEngine:
     # fused train step (scan over microbatches)
     # ------------------------------------------------------------------ #
 
-    def _build_fused_step(self):
-        gas = self.gas_
-        fp16 = self.config.fp16
+    def _accumulate_grads(self, params, scale, batch):
+        """Scan microbatches; return (mean fp32 grads, per-microbatch losses).
+        Shared by the fused and offload step builders (parity: the GAS loop,
+        engine.py:1920-2061)."""
         accum_dtype = self.config.grad_accum_dtype
+
+        def body(acc, mb):
+            loss, grads = self._grad_fn(params, mb, scale)
+            grads = tree_cast(grads, accum_dtype)
+            grads = self._constrain_grads(grads)
+            acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+            return acc, loss
+
+        acc0 = jax.tree_util.tree_map(
+            lambda x: jnp.zeros(x.shape, accum_dtype), params)
+        acc0 = self._constrain_grads(acc0)
+        grads, losses = jax.lax.scan(body, acc0, batch)
+        inv = 1.0 / (self.gas_ * scale)
+        grads = jax.tree_util.tree_map(lambda g: (g * inv).astype(jnp.float32), grads)
+        return grads, losses
+
+    def _build_fused_step(self):
+        fp16 = self.config.fp16
 
         def step_fn(state, batch):
             params = self._current_params(state)
             scale = state["scaler"]["scale"] if fp16.enabled else jnp.float32(1.0)
-
-            def body(acc, mb):
-                loss, grads = self._grad_fn(params, mb, scale)
-                grads = tree_cast(grads, accum_dtype)
-                grads = self._constrain_grads(grads)
-                acc = jax.tree_util.tree_map(jnp.add, acc, grads)
-                return acc, loss
-
-            acc0 = jax.tree_util.tree_map(
-                lambda x: jnp.zeros(x.shape, accum_dtype), params)
-            acc0 = self._constrain_grads(acc0)
-            grads, losses = jax.lax.scan(body, acc0, batch)
-            inv = 1.0 / (gas * scale)
-            grads = jax.tree_util.tree_map(lambda g: (g * inv).astype(jnp.float32), grads)
+            grads, losses = self._accumulate_grads(params, scale, batch)
             new_state, metrics = self._apply_grads(state, grads)
             metrics["loss"] = jnp.mean(losses)
             return new_state, metrics
@@ -396,7 +639,7 @@ class DeepSpeedTPUEngine:
                 data_iter = self._data_iterator
             batch = next(data_iter)
         self._ensure_state(batch)
-        if self._fused_step is None:
+        if self._fused_step is None and self._offload is None:
             self._fused_step = jax.jit(self._build_fused_step(), donate_argnums=(0,))
         fp_cfg = self.config.flops_profiler
         if fp_cfg.enabled and self.global_steps + 1 == fp_cfg.profile_step:
@@ -413,7 +656,10 @@ class DeepSpeedTPUEngine:
         self.tput_timer.start()
         self.timers(STEP_GLOBAL_TIMER).start()
         sharded = self._shard_global_batch(batch)
-        self.state, metrics = self._fused_step(self.state, sharded)
+        if self._offload is not None:
+            metrics = self._offload_train_step(sharded)
+        else:
+            self.state, metrics = self._fused_step(self.state, sharded)
         self.timers(STEP_GLOBAL_TIMER).stop(sync_obj=metrics["loss"])
         self.tput_timer.stop(sync_obj=metrics["loss"])
         self._after_step(metrics)
@@ -579,7 +825,8 @@ class DeepSpeedTPUEngine:
             "micro_steps": self.micro_steps,
             "skipped_steps": self.get_skipped_steps(),
         })
-        save_engine_checkpoint(save_dir, tag, self.state, client_state,
+        state = self._offload_ckpt_state() if self._offload is not None else self.state
+        save_engine_checkpoint(save_dir, tag, state, client_state,
                                save_latest=save_latest)
         return True
 
@@ -591,6 +838,15 @@ class DeepSpeedTPUEngine:
         if self.state is None:
             raise RuntimeError("engine state not initialised; pass model_parameters "
                                "or run a batch before load_checkpoint")
+        if self._offload is not None:
+            load_dir_, client_state = self._load_checkpoint_offload(
+                load_dir, tag, load_optimizer_states=load_optimizer_states,
+                load_module_only=load_module_only)
+            self.global_steps = int(client_state.get("global_steps", 0))
+            self.global_samples = int(client_state.get("global_samples", 0))
+            self.micro_steps = int(client_state.get("micro_steps", 0))
+            self.skipped_steps = int(client_state.get("skipped_steps", 0))
+            return load_dir_, client_state
         state, client_state = load_engine_checkpoint(
             load_dir, tag, self.state, self._state_shardings,
             load_optimizer_states=load_optimizer_states,
@@ -601,6 +857,15 @@ class DeepSpeedTPUEngine:
         self.micro_steps = int(client_state.get("micro_steps", 0))
         self.skipped_steps = int(client_state.get("skipped_steps", 0))
         return load_dir, client_state
+
+    def destroy(self):
+        """Release host-side resources (parity: ``DeepSpeedEngine.destroy``):
+        the offload optimizer's AIO pools/swap files and monitor writers."""
+        if self._offload is not None:
+            self._offload.close()
+        close = getattr(self.monitor, "close", None)
+        if close is not None:
+            close()
 
     # ------------------------------------------------------------------ #
     # property surface (parity: engine.py:469-870 accessors)

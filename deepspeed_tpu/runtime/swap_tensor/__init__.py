@@ -1,0 +1,13 @@
+"""ZeRO-Infinity style tensor swapping (host DRAM <-> NVMe).
+
+Parity: reference ``deepspeed/runtime/swap_tensor/`` — ``partitioned_param_swapper``,
+``optimizer_utils``, ``partitioned_optimizer_swapper``, ``pipelined_optimizer_swapper``,
+``async_swapper`` — over the native AIO engine (``deepspeed_tpu/ops/native/aio.py``).
+"""
+
+from deepspeed_tpu.runtime.swap_tensor.buffer_pool import SwapBufferPool
+from deepspeed_tpu.runtime.swap_tensor.optimizer_swapper import (
+    OptimizerStateSwapper, PipelinedOptimizerSwapper, SwappedTensorMeta)
+
+__all__ = ["SwapBufferPool", "OptimizerStateSwapper", "PipelinedOptimizerSwapper",
+           "SwappedTensorMeta"]

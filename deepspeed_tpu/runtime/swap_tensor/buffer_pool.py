@@ -1,0 +1,56 @@
+"""Reusable page-aligned host bounce buffers for NVMe swapping.
+
+Parity: reference ``runtime/swap_tensor/utils.py SwapBufferPool`` /
+``SwapBufferManager`` — fixed pool of pinned buffers that swap reads land in and
+swap writes stage from, so steady-state swapping does zero allocations. Buffers
+come from ``aligned_empty`` (page-aligned -> O_DIRECT engages in the native
+engine; the pinned-tensor analog of ``deepspeed_pin_tensor.cpp``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from deepspeed_tpu.ops.native.aio import aligned_empty
+
+_ALIGN = 4096
+
+
+def _round_up(n: int) -> int:
+    return max(_ALIGN, (n + _ALIGN - 1) // _ALIGN * _ALIGN)
+
+
+class SwapBufferPool:
+    """Size-bucketed free lists of aligned uint8 buffers."""
+
+    def __init__(self, max_buffers: int = 16):
+        self.max_buffers = max_buffers
+        self._free: Dict[int, List[np.ndarray]] = {}
+        self._outstanding = 0
+
+    def get(self, nbytes: int) -> np.ndarray:
+        """A page-aligned uint8 buffer of at least ``nbytes`` (rounded-up size)."""
+        size = _round_up(nbytes)
+        bucket = self._free.get(size)
+        self._outstanding += 1
+        if bucket:
+            return bucket.pop()
+        return aligned_empty(size, np.uint8)
+
+    def put(self, buf: np.ndarray) -> None:
+        self._outstanding -= 1
+        bucket = self._free.setdefault(buf.nbytes, [])
+        if sum(len(b) for b in self._free.values()) < self.max_buffers:
+            bucket.append(buf)
+
+    def view(self, buf: np.ndarray, shape, dtype) -> np.ndarray:
+        """Typed window into a pooled buffer (no copy)."""
+        dtype = np.dtype(dtype)
+        count = int(np.prod(shape)) if shape else 1
+        return buf[:count * dtype.itemsize].view(dtype).reshape(shape)
+
+    @property
+    def outstanding(self) -> int:
+        return self._outstanding

@@ -1,0 +1,233 @@
+"""Optimizer-state swappers: NVMe-resident tensors swapped in/out around the step.
+
+Parity (re-designed): reference ``runtime/swap_tensor/partitioned_optimizer_swapper.py``
+(synchronous swapper), ``pipelined_optimizer_swapper.py`` (double-buffered: reads
+for sub-group i+1 and writes for sub-group i-1 overlap the step of sub-group i),
+and ``async_swapper.py AsyncTensorSwapper``. Tensors are flat fp32 numpy views;
+each registered tensor owns one file under the swap directory, written/read whole
+through the native AIO engine (O_DIRECT when aligned).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.ops.native.aio import AsyncIOHandle
+from deepspeed_tpu.runtime.swap_tensor.buffer_pool import SwapBufferPool
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclass
+class SwappedTensorMeta:
+    name: str
+    shape: Tuple[int, ...]
+    dtype: np.dtype
+    path: str
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.prod(self.shape)) * np.dtype(self.dtype).itemsize if self.shape \
+            else np.dtype(self.dtype).itemsize
+
+
+class OptimizerStateSwapper:
+    """Synchronous swap-in/step/swap-out of named tensors.
+
+    ``register(name, array)`` writes the initial value to its file and drops the
+    host copy; ``swap_in(names)`` returns name -> writable array views backed by
+    pooled buffers; ``swap_out(views)`` persists them and releases the buffers.
+    """
+
+    def __init__(self, swap_dir: str, aio_config: Optional[dict] = None,
+                 max_pooled_buffers: int = 16):
+        os.makedirs(swap_dir, exist_ok=True)
+        self.swap_dir = swap_dir
+        aio = dict(aio_config or {})
+        self.handle = AsyncIOHandle(
+            block_size=aio.get("block_size", 1 << 20),
+            queue_depth=aio.get("queue_depth", 32),
+            thread_count=aio.get("thread_count", 4),
+            single_submit=aio.get("single_submit", False),
+            overlap_events=aio.get("overlap_events", True),
+            use_o_direct=aio.get("use_o_direct", False))
+        self.pool = SwapBufferPool(max_buffers=max_pooled_buffers)
+        self.meta: Dict[str, SwappedTensorMeta] = {}
+        self._views: Dict[str, np.ndarray] = {}   # name -> typed view
+        self._buffers: Dict[str, np.ndarray] = {}  # name -> raw pooled buffer
+
+    # -- registration ----------------------------------------------------- #
+    def register(self, name: str, array: np.ndarray) -> SwappedTensorMeta:
+        safe = name.replace("/", "__")
+        meta = SwappedTensorMeta(name=name, shape=tuple(array.shape),
+                                 dtype=np.dtype(array.dtype),
+                                 path=os.path.join(self.swap_dir, f"{safe}.swp"))
+        arr = np.ascontiguousarray(array)
+        rc = self.handle.sync_pwrite(arr, meta.path)
+        if rc != 0:
+            raise OSError(-rc, f"swap register write failed for {meta.path}")
+        self.meta[name] = meta
+        return meta
+
+    def element_count(self) -> int:
+        return sum(int(np.prod(m.shape)) for m in self.meta.values())
+
+    # -- sync swap --------------------------------------------------------- #
+    def swap_in(self, names: Sequence[str]) -> Dict[str, np.ndarray]:
+        self._submit_reads(names)
+        n = self.handle.wait()
+        if n < 0:
+            raise OSError(-n, "swap-in read failed")
+        return {name: self._views[name] for name in names}
+
+    def swap_out(self, names: Optional[Sequence[str]] = None) -> None:
+        names = list(self._views) if names is None else list(names)
+        self._submit_writes(names)
+        n = self.handle.wait()
+        if n < 0:
+            raise OSError(-n, "swap-out write failed")
+        self._release(names)
+
+    # -- internals shared with the pipelined swapper ----------------------- #
+    def _submit_reads(self, names: Sequence[str], handle=None) -> None:
+        handle = handle or self.handle
+        for name in names:
+            meta = self.meta[name]
+            buf = self.pool.get(meta.nbytes)
+            view = self.pool.view(buf, meta.shape, meta.dtype)
+            self._buffers[name] = buf
+            self._views[name] = view
+            rc = handle.async_pread(view, meta.path)
+            if rc != 0:
+                self._release([name])
+                raise OSError(-rc, f"swap-in submit failed for {meta.path}")
+
+    def _submit_writes(self, names: Sequence[str]) -> None:
+        for name in names:
+            meta = self.meta[name]
+            rc = self.handle.async_pwrite(self._views[name], meta.path)
+            if rc != 0:
+                raise OSError(-rc, f"swap-out submit failed for {meta.path}")
+
+    def _release(self, names: Iterable[str]) -> None:
+        for name in names:
+            self._views.pop(name, None)
+            buf = self._buffers.pop(name, None)
+            if buf is not None:
+                self.pool.put(buf)
+
+    # -- whole-state materialisation (checkpoint save) --------------------- #
+    def read_all(self) -> Dict[str, np.ndarray]:
+        out = {}
+        for name, meta in self.meta.items():
+            if name in self._views:
+                out[name] = np.array(self._views[name])
+                continue
+            arr = np.empty(meta.shape, meta.dtype)
+            rc = self.handle.sync_pread(arr, meta.path)
+            if rc != 0:
+                raise OSError(-rc, f"swap read_all failed for {meta.path}")
+            out[name] = arr
+        return out
+
+    def write(self, name: str, array: np.ndarray) -> None:
+        """Overwrite a registered tensor's file (checkpoint load)."""
+        meta = self.meta[name]
+        if tuple(array.shape) != meta.shape:
+            raise ValueError(f"swap write shape mismatch for {name}")
+        rc = self.handle.sync_pwrite(np.ascontiguousarray(array, meta.dtype), meta.path)
+        if rc != 0:
+            raise OSError(-rc, f"swap write failed for {meta.path}")
+
+    def close(self):
+        self.handle.close()
+
+
+class PipelinedOptimizerSwapper(OptimizerStateSwapper):
+    """Double-buffered group pipeline over sub-groups of tensors.
+
+    ``run(groups, step_fn)`` iterates groups of names; while ``step_fn`` runs on
+    group i's views, group i+1's reads are already in flight on a second AIO
+    handle and group i-1's writes drain on a third (parity:
+    pipelined_optimizer_swapper.py ``pipeline_read``/``pipeline_write``).
+    """
+
+    def __init__(self, swap_dir: str, aio_config: Optional[dict] = None,
+                 max_pooled_buffers: int = 16, pipeline_read: bool = True,
+                 pipeline_write: bool = True):
+        super().__init__(swap_dir, aio_config, max_pooled_buffers)
+        self.pipeline_read = pipeline_read
+        self.pipeline_write = pipeline_write
+        aio = dict(aio_config or {})
+        kw = dict(block_size=aio.get("block_size", 1 << 20),
+                  thread_count=aio.get("thread_count", 4))
+        self._read_handle = AsyncIOHandle(**kw) if pipeline_read else self.handle
+        self._write_handle = AsyncIOHandle(**kw) if pipeline_write else self.handle
+
+    def run(self, groups: Sequence[Sequence[str]], step_fn) -> None:
+        """``step_fn(group_views: Dict[str, np.ndarray])`` mutates views in place."""
+        groups = [list(g) for g in groups if g]
+        if not groups:
+            return
+        inflight_writes: List[str] = []
+        for i, group in enumerate(groups):
+            if any(name not in self._views for name in group):
+                self._read_group(group)  # not prefetched (first group / no pipeline)
+            if self.pipeline_read and i + 1 < len(groups):
+                self._prefetch_group(groups[i + 1])
+            step_fn({name: self._views[name] for name in group})
+            if inflight_writes:
+                n = self._write_handle.wait()
+                if n < 0:
+                    raise OSError(-n, "pipelined swap-out failed")
+                self._release(inflight_writes)
+                inflight_writes = []
+            if self.pipeline_write:
+                for name in group:
+                    meta = self.meta[name]
+                    rc = self._write_handle.async_pwrite(self._views[name], meta.path)
+                    if rc != 0:
+                        raise OSError(-rc, f"swap-out submit failed for {meta.path}")
+                inflight_writes = list(group)
+            else:
+                self._write_group_sync(group)
+            if self.pipeline_read and i + 1 < len(groups):
+                n = self._read_handle.wait()
+                if n < 0:
+                    raise OSError(-n, "pipelined swap-in failed")
+        if inflight_writes:
+            n = self._write_handle.wait()
+            if n < 0:
+                raise OSError(-n, "pipelined swap-out failed")
+            self._release(inflight_writes)
+
+    # -- helpers ----------------------------------------------------------- #
+    def _read_group(self, names: Sequence[str]) -> None:
+        self._submit_reads(names, handle=self._read_handle)
+        n = self._read_handle.wait()
+        if n < 0:
+            raise OSError(-n, "swap-in read failed")
+
+    def _prefetch_group(self, names: Sequence[str]) -> None:
+        self._submit_reads(names, handle=self._read_handle)
+
+    def _write_group_sync(self, names: Sequence[str]) -> None:
+        for name in names:
+            meta = self.meta[name]
+            rc = self._write_handle.async_pwrite(self._views[name], meta.path)
+            if rc != 0:
+                raise OSError(-rc, f"swap-out submit failed for {meta.path}")
+        n = self._write_handle.wait()
+        if n < 0:
+            raise OSError(-n, "swap-out write failed")
+        self._release(names)
+
+    def close(self):
+        if self._read_handle is not self.handle:
+            self._read_handle.close()
+        if self._write_handle is not self.handle:
+            self._write_handle.close()
+        super().close()

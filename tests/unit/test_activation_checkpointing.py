@@ -66,9 +66,46 @@ def test_configure_from_config_dict():
     ac.configure(cfg)
     assert ac.is_configured()
     assert ac.current_policy() is not None
-    pred = ac.layer_remat_predicate(8)
-    # number_checkpoints=2 over 8 layers -> every 4th layer remats
-    assert [i for i in range(8) if pred(i)] == [0, 4]
+    # number_checkpoints=2 -> 8 layers partition into 2 chunks: only 2 boundary
+    # activations stored (reference: num_checkpoints = activations stored)
+    assert ac.layer_chunks(8) == [(0, 4), (4, 8)]
+
+
+def test_layer_chunks_default_and_clamping():
+    ac.configure()  # no number_checkpoints -> per-layer chunks
+    assert ac.layer_chunks(3) == [(0, 1), (1, 2), (2, 3)]
+    ac.configure(num_checkpoints=1)
+    assert ac.layer_chunks(5) == [(0, 5)]  # whole net one recompute chunk
+    ac.configure(num_checkpoints=99)
+    assert ac.layer_chunks(4) == [(0, 1), (1, 2), (2, 3), (3, 4)]
+
+
+def test_chunked_layers_grads_match_and_fewer_saved():
+    import flax.linen as nn
+
+    class Layer(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return jnp.tanh(nn.Dense(16)(x))
+
+    class Net(nn.Module):
+        remat: bool = True
+
+        def setup(self):
+            self.layers = [Layer(name=f"l{i}") for i in range(4)]
+
+        def __call__(self, x):
+            x = ac.apply_checkpointed_layers(
+                self, x, lambda m, h, i: m.layers[i](h), 4, self.remat)
+            return jnp.sum(x ** 2)
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16))
+    params = Net(remat=False).init(jax.random.PRNGKey(1), x)
+    g_plain = jax.grad(lambda p: Net(remat=False).apply(p, x))(params)
+    ac.configure(num_checkpoints=2)
+    g_chunk = jax.grad(lambda p: Net(remat=True).apply(p, x))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6), g_plain, g_chunk)
 
 
 def test_policy_registry_and_errors():
