@@ -111,7 +111,8 @@ def read_latest_tag(load_dir: str) -> Optional[str]:
 def load_engine_checkpoint(load_dir: str, tag: Optional[str], state: Dict[str, Any],
                            shardings: Dict[str, Any],
                            load_optimizer_states: bool = True,
-                           load_module_only: bool = False
+                           load_module_only: bool = False,
+                           params_builder=None
                            ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     tag = tag or read_latest_tag(load_dir)
     if tag is None:
@@ -134,12 +135,13 @@ def load_engine_checkpoint(load_dir: str, tag: Optional[str], state: Dict[str, A
         new_state["skipped"] = jax.device_put(optim["skipped"], shardings["skipped"])
 
     if "params" in state:
-        # recompute compute-dtype params from the loaded master
-        dtype = jax.tree_util.tree_leaves(state["params"])[0].dtype
-        from deepspeed_tpu.utils.tree import tree_cast
+        # recompute compute-dtype (or quantized, qwZ) params from the loaded master
+        if params_builder is None:
+            from deepspeed_tpu.utils.tree import tree_cast
+            dtype = jax.tree_util.tree_leaves(state["params"])[0].dtype
+            params_builder = lambda m: tree_cast(m, dtype)
         new_state["params"] = jax.jit(
-            lambda m: tree_cast(m, dtype),
-            out_shardings=shardings["params"])(new_state["master"])
+            params_builder, out_shardings=shardings["params"])(new_state["master"])
 
     client_path = os.path.join(ckpt_dir, CLIENT_FILE)
     client_state = {}

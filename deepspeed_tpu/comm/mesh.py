@@ -38,15 +38,21 @@ from deepspeed_tpu.utils.logging import logger
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
+FSDP_SUB_AXIS = "fsdp_sub"  # ZeRO++ hpZ secondary partition / MiCS sub-group axis
 EXPERT_AXIS = "expert"
 SEQ_AXIS = "seq"
 TENSOR_AXIS = "tensor"
 
-ALL_AXES: Tuple[str, ...] = (PIPE_AXIS, DATA_AXIS, FSDP_AXIS, EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS)
+ALL_AXES: Tuple[str, ...] = (PIPE_AXIS, DATA_AXIS, FSDP_AXIS, FSDP_SUB_AXIS,
+                             EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS)
 
 # Composite "batch" axes: a global batch is sharded across everything that consumes
 # distinct data (data-parallel replicas and fsdp shards).
-BATCH_AXES: Tuple[str, ...] = (DATA_AXIS, FSDP_AXIS)
+BATCH_AXES: Tuple[str, ...] = (DATA_AXIS, FSDP_AXIS, FSDP_SUB_AXIS)
+
+# Full ZeRO state-sharding axes: hpZ/MiCS factorize fsdp into (inter, intra);
+# with fsdp_sub == 1 (default) this collapses to plain fsdp sharding.
+FSDP_AXES: Tuple[str, ...] = (FSDP_AXIS, FSDP_SUB_AXIS)
 
 
 @dataclass(frozen=True)
@@ -71,7 +77,7 @@ class MeshTopology:
     @property
     def dp_world_size(self) -> int:
         """Number of distinct data shards = data * fsdp (ZeRO shards see distinct data)."""
-        return self.sizes[DATA_AXIS] * self.sizes[FSDP_AXIS]
+        return self.sizes[DATA_AXIS] * self.fsdp_world_size
 
     @property
     def replica_world_size(self) -> int:
@@ -79,7 +85,12 @@ class MeshTopology:
 
     @property
     def fsdp_world_size(self) -> int:
-        return self.sizes[FSDP_AXIS]
+        return self.sizes[FSDP_AXIS] * self.sizes.get(FSDP_SUB_AXIS, 1)
+
+    @property
+    def fsdp_sub_size(self) -> int:
+        """hpZ secondary-partition / MiCS sub-group size (1 = not factorized)."""
+        return self.sizes.get(FSDP_SUB_AXIS, 1)
 
     @property
     def tp_world_size(self) -> int:
@@ -125,6 +136,10 @@ def build_topology(config: Optional[MeshConfig] = None,
     devices = devices if devices is not None else jax.devices()
     sizes = config.resolve(len(devices))
     order = tuple(config.axis_order)
+    if FSDP_SUB_AXIS not in order and FSDP_AXIS in order:
+        # accept pre-hpZ six-axis orders
+        i = order.index(FSDP_AXIS)
+        order = order[:i + 1] + (FSDP_SUB_AXIS,) + order[i + 1:]
     if set(order) != set(ALL_AXES):
         raise ValueError(f"mesh.axis_order must be a permutation of {ALL_AXES}, got {order}")
     shape = tuple(sizes[a] for a in order)

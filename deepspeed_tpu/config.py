@@ -487,15 +487,18 @@ class MeshConfig(ConfigModel):
     pipe: int = 1
     data: int = -1
     fsdp: int = 1
+    fsdp_sub: int = 1  # hpZ secondary partition / MiCS sub-group (inner fsdp axis)
     expert: int = 1
     seq: int = 1
     tensor: int = 1
     # device order: "default" follows jax.devices(); on real slices XLA device order
     # is already ICI-contiguous in the trailing axes.
-    axis_order: Tuple[str, ...] = ("pipe", "data", "fsdp", "expert", "seq", "tensor")
+    axis_order: Tuple[str, ...] = ("pipe", "data", "fsdp", "fsdp_sub", "expert",
+                                   "seq", "tensor")
 
     def resolve(self, n_devices: int) -> Dict[str, int]:
-        sizes = {a: getattr(self, a) for a in ("pipe", "data", "fsdp", "expert", "seq", "tensor")}
+        sizes = {a: getattr(self, a) for a in ("pipe", "data", "fsdp", "fsdp_sub",
+                                               "expert", "seq", "tensor")}
         wild = [a for a, s in sizes.items() if s == -1]
         if len(wild) > 1:
             raise ConfigError(f"mesh: only one axis may be -1, got {wild}")

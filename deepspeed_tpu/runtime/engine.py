@@ -86,6 +86,20 @@ class DeepSpeedTPUEngine:
                  tp_rules=None,
                  model_family: Optional[str] = None):
         self.config = config if isinstance(config, DeepSpeedTPUConfig) else DeepSpeedTPUConfig.load(config)
+        # ZeRO++ hpZ / MiCS factorize the fsdp axis into (inter, intra) so
+        # secondary-partition gathers ride the intra-node axis
+        zc0 = self.config.zero_optimization
+        sub = max(zc0.zero_hpz_partition_size,
+                  zc0.mics_shard_size if zc0.mics_shard_size > 0 else 1)
+        if sub > 1 and self.config.mesh.fsdp_sub == 1 and mesh_topology is None:
+            if self.config.mesh.fsdp > 0 and self.config.mesh.fsdp % sub != 0:
+                from deepspeed_tpu.config import ConfigError
+                raise ConfigError(
+                    f"mesh.fsdp={self.config.mesh.fsdp} not divisible by "
+                    f"hpz/mics sub-group size {sub}")
+            self.config.mesh.fsdp_sub = sub
+            if self.config.mesh.fsdp > 0:
+                self.config.mesh.fsdp //= sub
         self.topology = mesh_topology or set_topology(build_topology(self.config.mesh))
         self.train_batch_size_, self.micro_batch_size_, self.gas_ = \
             self.config.resolve_batch(self.topology.dp_world_size)
@@ -106,9 +120,18 @@ class DeepSpeedTPUEngine:
         self._tp_rules = tp_rules
         self._model_family = model_family
         self._tp_specs = None
+        if sub > 1 and self.topology.fsdp_sub_size == 1:
+            from deepspeed_tpu.config import ConfigError
+            raise ConfigError(
+                f"hpz/mics sub-group size {sub} configured but the provided mesh "
+                "topology has no fsdp_sub axis; factorize fsdp (mesh.fsdp_sub) "
+                "or drop mesh_topology so the engine can")
         self.partitioner = ZeroPartitioner(
             self.zero_stage, self.topology,
-            persistence_threshold=self.config.zero_optimization.stage3_param_persistence_threshold)
+            persistence_threshold=self.config.zero_optimization.stage3_param_persistence_threshold,
+            hpz=self.config.zero_optimization.zero_hpz_partition_size > 1,
+            mics=self.config.zero_optimization.mics_shard_size > 0)
+        self.quantized_weights = self.config.zero_optimization.zero_quantized_weights
 
         # -- ZeRO-Offload/Infinity: host/NVMe optimizer step (parity:
         # cpu_offload stage_1_and_2.py:140, stage3 swap_tensor wiring) -----
@@ -120,6 +143,10 @@ class DeepSpeedTPUEngine:
             if self.zero_stage == 0:
                 logger.warning("offload_optimizer with zero stage 0: optimizer "
                                "states go to host but grads stay replicated")
+            if self.config.zero_optimization.zero_quantized_weights:
+                from deepspeed_tpu.config import ConfigError
+                raise ConfigError("zero_quantized_weights is not supported "
+                                  "together with offload_optimizer")
 
         # -- optimizer (parity: _configure_optimizer engine.py:1210) -----
         self.client_optimizer = optimizer
@@ -242,7 +269,11 @@ class DeepSpeedTPUEngine:
             "scaler": {k: repl for k in ("scale", "growth_tracker", "hysteresis")},
             "skipped": repl,
         }
-        if self.mixed_precision:
+        if self.quantized_weights:
+            from deepspeed_tpu.runtime.zero.zeropp import quantized_param_shardings
+            shardings["params"] = quantized_param_shardings(
+                param_sh, model_parameters, topo.mesh)
+        elif self.mixed_precision:
             shardings["params"] = param_sh
 
         fp16 = self.config.fp16
@@ -256,7 +287,10 @@ class DeepSpeedTPUEngine:
             st = {"master": master, "opt": opt, "step": jnp.zeros((), jnp.int32),
                   "scaler": {k: scaler[k] for k in ("scale", "growth_tracker", "hysteresis")},
                   "skipped": jnp.zeros((), jnp.int32)}
-            if self.mixed_precision:
+            if self.quantized_weights:
+                from deepspeed_tpu.runtime.zero.zeropp import quantize_param_tree
+                st["params"] = quantize_param_tree(master, self.compute_dtype)
+            elif self.mixed_precision:
                 st["params"] = tree_cast(master, self.compute_dtype)
             return st
 
@@ -488,6 +522,9 @@ class DeepSpeedTPUEngine:
 
     def _current_params(self, state):
         if "params" in state:
+            if self.quantized_weights:
+                from deepspeed_tpu.runtime.zero.zeropp import dequantize_param_tree
+                return dequantize_param_tree(state["params"], self.compute_dtype)
             return state["params"]
         return state["master"]
 
@@ -585,7 +622,12 @@ class DeepSpeedTPUEngine:
             "scaler": {k: new_scaler[k] for k in ("scale", "growth_tracker", "hysteresis")},
             "skipped": state["skipped"] + overflow.astype(jnp.int32),
         }
-        if self.mixed_precision:
+        if self.quantized_weights:
+            from deepspeed_tpu.runtime.zero.zeropp import quantize_param_tree
+            new_state["params"] = jax.lax.with_sharding_constraint(
+                quantize_param_tree(new_master, self.compute_dtype),
+                self._state_shardings["params"])
+        elif self.mixed_precision:
             param_sh = self._state_shardings["params"]
             new_params = jax.lax.with_sharding_constraint(
                 tree_cast(new_master, self.compute_dtype), param_sh)
@@ -847,10 +889,14 @@ class DeepSpeedTPUEngine:
             self.micro_steps = int(client_state.get("micro_steps", 0))
             self.skipped_steps = int(client_state.get("skipped_steps", 0))
             return load_dir_, client_state
+        params_builder = None
+        if self.quantized_weights:
+            from deepspeed_tpu.runtime.zero.zeropp import quantize_param_tree
+            params_builder = lambda m: quantize_param_tree(m, self.compute_dtype)
         state, client_state = load_engine_checkpoint(
             load_dir, tag, self.state, self._state_shardings,
             load_optimizer_states=load_optimizer_states,
-            load_module_only=load_module_only)
+            load_module_only=load_module_only, params_builder=params_builder)
         self.state = state
         self.global_steps = int(client_state.get("global_steps", 0))
         self.global_samples = int(client_state.get("global_samples", 0))

@@ -105,10 +105,11 @@ class OptimizerStateSwapper:
                 self._release([name])
                 raise OSError(-rc, f"swap-in submit failed for {meta.path}")
 
-    def _submit_writes(self, names: Sequence[str]) -> None:
+    def _submit_writes(self, names: Sequence[str], handle=None) -> None:
+        handle = handle or self.handle
         for name in names:
             meta = self.meta[name]
-            rc = self.handle.async_pwrite(self._views[name], meta.path)
+            rc = handle.async_pwrite(self._views[name], meta.path)
             if rc != 0:
                 raise OSError(-rc, f"swap-out submit failed for {meta.path}")
 
@@ -163,7 +164,11 @@ class PipelinedOptimizerSwapper(OptimizerStateSwapper):
         self.pipeline_write = pipeline_write
         aio = dict(aio_config or {})
         kw = dict(block_size=aio.get("block_size", 1 << 20),
-                  thread_count=aio.get("thread_count", 4))
+                  queue_depth=aio.get("queue_depth", 32),
+                  thread_count=aio.get("thread_count", 4),
+                  single_submit=aio.get("single_submit", False),
+                  overlap_events=aio.get("overlap_events", True),
+                  use_o_direct=aio.get("use_o_direct", False))
         self._read_handle = AsyncIOHandle(**kw) if pipeline_read else self.handle
         self._write_handle = AsyncIOHandle(**kw) if pipeline_write else self.handle
 
@@ -186,11 +191,7 @@ class PipelinedOptimizerSwapper(OptimizerStateSwapper):
                 self._release(inflight_writes)
                 inflight_writes = []
             if self.pipeline_write:
-                for name in group:
-                    meta = self.meta[name]
-                    rc = self._write_handle.async_pwrite(self._views[name], meta.path)
-                    if rc != 0:
-                        raise OSError(-rc, f"swap-out submit failed for {meta.path}")
+                self._submit_writes(group, handle=self._write_handle)
                 inflight_writes = list(group)
             else:
                 self._write_group_sync(group)
@@ -215,11 +216,7 @@ class PipelinedOptimizerSwapper(OptimizerStateSwapper):
         self._submit_reads(names, handle=self._read_handle)
 
     def _write_group_sync(self, names: Sequence[str]) -> None:
-        for name in names:
-            meta = self.meta[name]
-            rc = self._write_handle.async_pwrite(self._views[name], meta.path)
-            if rc != 0:
-                raise OSError(-rc, f"swap-out submit failed for {meta.path}")
+        self._submit_writes(names, handle=self._write_handle)
         n = self._write_handle.wait()
         if n < 0:
             raise OSError(-n, "swap-out write failed")

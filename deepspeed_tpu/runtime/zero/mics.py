@@ -1,0 +1,41 @@
+"""MiCS — Minimal-interference Communication Sharding (sub-group ZeRO).
+
+Parity: reference ``runtime/zero/mics.py`` (``MiCS_Init``, ``MiCS_Optimizer``,
+``mics_shard_size``, hierarchical all-gather in ``mics_utils.py``). MiCS shards
+ZeRO state inside *sub-groups* of ``mics_shard_size`` ranks and replicates it
+across groups, so the frequent param gathers stay inside a group (one node /
+one ICI domain) and only gradient averaging crosses groups.
+
+TPU-native reduction: MiCS is entirely a sharding policy —
+
+- the engine factorizes the fsdp mesh axis into (``fsdp``, ``fsdp_sub``) with
+  ``fsdp_sub == mics_shard_size``;
+- ``ZeroPartitioner(mics=True)`` shards master/opt/params over ``fsdp_sub``
+  only, leaving the outer ``fsdp`` axis as pure data parallelism;
+- XLA then emits all-gathers/reduce-scatters over the inner (intra-node) axis
+  and cross-group all-reduces for gradients — exactly the reference's
+  hierarchical communication schedule (``mics_utils.py``), chosen by the
+  compiler instead of hand-written ProcessGroups.
+
+This module holds the user-facing helpers; the policy itself lives in
+``runtime/zero/partition.py`` and the axis factorization in the engine.
+"""
+
+from __future__ import annotations
+
+from deepspeed_tpu.config import ConfigError, DeepSpeedTPUConfig
+
+
+def validate_mics_config(config: DeepSpeedTPUConfig, n_devices: int) -> int:
+    """Check ``mics_shard_size`` divides the fsdp extent; return the size."""
+    zc = config.zero_optimization
+    size = zc.mics_shard_size
+    if size <= 0:
+        raise ConfigError("MiCS requires zero_optimization.mics_shard_size > 0")
+    if zc.stage < 3:
+        raise ConfigError("MiCS requires ZeRO stage 3 (param sharding)")
+    return size
+
+
+def mics_sub_group_size(config: DeepSpeedTPUConfig) -> int:
+    return max(0, config.zero_optimization.mics_shard_size)

@@ -34,7 +34,8 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from deepspeed_tpu.comm.mesh import FSDP_AXIS, MeshTopology
+from deepspeed_tpu.comm.mesh import (FSDP_AXES, FSDP_AXIS, FSDP_SUB_AXIS,
+                                     MeshTopology)
 from deepspeed_tpu.utils.logging import warning_once
 
 
@@ -51,7 +52,8 @@ def shard_dim_for(shape: Sequence[int], n_shards: int,
     return best
 
 
-def _param_spec(x, n_shards: int, threshold: int, existing: Optional[P] = None) -> P:
+def _param_spec(x, n_shards: int, threshold: int, existing: Optional[P] = None,
+                axes=FSDP_AXES) -> P:
     shape = np.shape(x)
     size = int(np.prod(shape)) if shape else 1
     base = list(existing) if existing is not None else [None] * len(shape)
@@ -64,7 +66,7 @@ def _param_spec(x, n_shards: int, threshold: int, existing: Optional[P] = None) 
     if dim is None:
         warning_once(f"param of shape {tuple(shape)} not divisible by fsdp={n_shards}; replicated")
         return P(*base)
-    base[dim] = FSDP_AXIS
+    base[dim] = tuple(axes) if len(axes) > 1 else axes[0]
     return P(*base)
 
 
@@ -72,10 +74,28 @@ class ZeroPartitioner:
     """Produces sharding trees for params / master / grads / optimizer state."""
 
     def __init__(self, stage: int, topology: MeshTopology,
-                 persistence_threshold: int = 100_000):
+                 persistence_threshold: int = 100_000,
+                 hpz: bool = False, mics: bool = False):
+        """``hpz``: ZeRO++ secondary partition — compute params shard only over
+        the intra-node ``fsdp_sub`` axis so forward/backward all-gathers ride
+        ICI, while master/opt stay sharded over the full fsdp extent (parity:
+        ``zero_hpz_partition_size`` / ``_partition_param_sec``,
+        partition_parameters.py:1551). ``mics``: MiCS sub-group sharding — ALL
+        zero state shards only within ``fsdp_sub`` sub-groups; the outer fsdp
+        axis acts as pure DP with hierarchical gathers (parity:
+        ``runtime/zero/mics.py``)."""
         self.stage = stage
         self.topo = topology
-        self.n = topology.fsdp_world_size
+        self.hpz = hpz
+        self.mics = mics
+        sub = topology.fsdp_sub_size
+        full = topology.fsdp_world_size
+        # state (master/opt/grad) sharding axes vs compute-param sharding axes
+        self.state_axes = (FSDP_SUB_AXIS,) if mics else FSDP_AXES
+        self.param_axes = (FSDP_SUB_AXIS,) if (hpz or mics) else self.state_axes
+        self.n_state = sub if mics else full
+        self.n_param = sub if (hpz or mics) else self.n_state
+        self.n = self.n_state
         # Reference semantics: threshold only gates stage-3 param sharding
         # (stage3_param_persistence_threshold, runtime/zero/config.py).
         self.persistence_threshold = persistence_threshold
@@ -86,7 +106,8 @@ class ZeroPartitioner:
         """Compute-dtype param sharding. Stage 3 shards; else TP spec or replicated."""
         def one(x, tp=None):
             if self.stage >= 3:
-                return _param_spec(x, self.n, self.persistence_threshold, existing=tp)
+                return _param_spec(x, self.n_param, self.persistence_threshold,
+                                   existing=tp, axes=self.param_axes)
             return tp if tp is not None else P()
         if tp_specs is not None:
             return jax.tree_util.tree_map(one, params, tp_specs,
@@ -99,7 +120,8 @@ class ZeroPartitioner:
         the master never round-trips during forward)."""
         def one(x, tp=None):
             if self.stage >= 1:
-                return _param_spec(x, self.n, 0, existing=tp)
+                return _param_spec(x, self.n_state, 0, existing=tp,
+                                   axes=self.state_axes)
             return tp if tp is not None else P()
         if tp_specs is not None:
             return jax.tree_util.tree_map(one, params, tp_specs,

@@ -1,0 +1,126 @@
+"""ZeRO++ — quantized weights (qwZ), quantized gradients (qgZ), secondary
+partition (hpZ).
+
+Parity (re-designed for XLA SPMD):
+
+- **hpZ** (``zero_hpz_partition_size``; reference ``_partition_param_sec``,
+  partition_parameters.py:1551): a sharding policy, not code here — the engine
+  factorizes the fsdp mesh axis into (``fsdp``, ``fsdp_sub``) and
+  ``ZeroPartitioner`` shards compute params over ``fsdp_sub`` only, so
+  forward/backward all-gathers ride intra-node ICI while master/optimizer state
+  stays sharded over the full extent.
+
+- **qwZ** (``zero_quantized_weights``; reference ``quantized_weights`` +
+  swizzled_quantize.cu): compute params are *stored* as row-wise int8 + fp32
+  scales. Use sites need the full tensor, so XLA's all-gather moves the int8
+  payload (plus small scales) instead of bf16 — halving weight-gather traffic —
+  and dequantization happens locally after the gather (XLA sinks the gather
+  past the elementwise dequant). This module owns the quantize/dequantize tree
+  transforms and their sharding trees.
+
+- **qgZ** (``zero_quantized_gradients``; reference ``all_to_all_quant_reduce``,
+  runtime/comm/coalesced_collectives.py): hierarchical int8 gradient reduction.
+  Under SPMD jit the compiler inserts gradient reductions, so the explicit
+  2-hop quantized reduce lives here as a shard_map collective
+  (:func:`hierarchical_quantized_grad_reduce`) for the manual-collective
+  engines (pipeline, ring, custom shard_map steps); the SPMD engine maps the
+  flag to bf16 reduction dtype (the compiler-visible compression).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+#: leaves smaller than this stay unquantized (gather latency beats volume;
+#: parity: qwZ quantizes weights, not biases/norms)
+QWZ_MIN_SIZE = 2048
+
+
+def _is_qleaf(x) -> bool:
+    return isinstance(x, dict) and set(x) == {"q", "s"}
+
+
+def _should_quantize(x) -> bool:
+    shape = np.shape(x)
+    return len(shape) >= 2 and int(np.prod(shape)) >= QWZ_MIN_SIZE
+
+
+def quantize_leaf(x: jax.Array) -> dict:
+    """Symmetric row-wise int8: scale over the last dim (one fp32 per row)."""
+    x32 = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(x32), axis=-1, keepdims=True) / 127.0
+    scale = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(x32 / scale), -128, 127).astype(jnp.int8)
+    return {"q": q, "s": scale}
+
+
+def dequantize_leaf(d: dict, dtype) -> jax.Array:
+    return (d["q"].astype(jnp.float32) * d["s"]).astype(dtype)
+
+
+def quantize_param_tree(master: Any, dtype) -> Any:
+    """Master fp32 tree -> compute tree with large >=2-d leaves as int8+scale."""
+    return jax.tree_util.tree_map(
+        lambda x: quantize_leaf(x) if _should_quantize(x) else x.astype(dtype),
+        master)
+
+
+def dequantize_param_tree(tree: Any, dtype) -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: dequantize_leaf(x, dtype) if _is_qleaf(x) else x,
+        tree, is_leaf=_is_qleaf)
+
+
+def quantized_param_shardings(param_sh: Any, params_template: Any, mesh) -> Any:
+    """Sharding tree congruent with :func:`quantize_param_tree` output.
+
+    ``q`` keeps the leaf's param sharding (same shape, int8); ``s`` drops the
+    last (reduced) dim's axis so each shard holds the scales for its rows."""
+    def one(sh, x):
+        if not _should_quantize(x):
+            return sh
+        spec = list(sh.spec) if sh.spec else []
+        while len(spec) < len(np.shape(x)):
+            spec.append(None)
+        s_spec = P(*(spec[:-1] + [None]))
+        return {"q": sh, "s": NamedSharding(mesh, s_spec)}
+    return jax.tree_util.tree_map(one, param_sh, params_template)
+
+
+# --------------------------------------------------------------------------- #
+# qgZ: hierarchical quantized gradient reduction (shard_map collective)
+# --------------------------------------------------------------------------- #
+
+def hierarchical_quantized_grad_reduce(grads: jax.Array, intra_axis: str,
+                                       inter_axis: Optional[str] = None,
+                                       num_bits: int = 8) -> jax.Array:
+    """2-hop qgZ reduction inside ``shard_map``: quantize -> all-to-all over the
+    intra-node axis -> local reduce -> (re)quantize -> all-to-all over the
+    inter-node axis -> reduce -> mean. Returns this rank's reduced grad shard
+    of shape ``grads.shape[0] // (intra * inter)`` along dim 0.
+
+    Parity: ``all_to_all_quant_reduce`` (coalesced_collectives.py) — one int8
+    hop rides ICI, the second crosses nodes at 1/4 the fp32 volume, and
+    double-quantization error stays bounded by re-quantizing the *reduced*
+    tensor (same trick as the reference's fused dequant+reduce kernel).
+
+    The input is pre-swizzled (transposing the (inter, intra) chunk grid) so
+    the two-hop scatter lands each rank's chunk in canonical reduce-scatter
+    order — the role of the reference's ``swizzled_quantize.cu`` layout.
+    """
+    from deepspeed_tpu.ops.quantizer import quantized_all_to_all_reduce
+    intra = jax.lax.psum(1, intra_axis)
+    inter = jax.lax.psum(1, inter_axis) if inter_axis is not None else 1
+    if inter <= 1:
+        return quantized_all_to_all_reduce(grads, intra_axis, num_bits=num_bits)
+    flat = grads.reshape(-1)
+    # canonical chunk c = i*intra + j must end at device (i, j); hop1 scatters
+    # position-chunk j, hop2 sub-scatters i -> place chunk c at p = j*inter + i
+    swz = flat.reshape(inter, intra, -1).transpose(1, 0, 2).reshape(-1)
+    out = quantized_all_to_all_reduce(swz, intra_axis, num_bits=num_bits)
+    return quantized_all_to_all_reduce(out, inter_axis, num_bits=num_bits)

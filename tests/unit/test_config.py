@@ -106,7 +106,8 @@ def test_mesh_resolution():
     cfg = DeepSpeedTPUConfig.load({"train_batch_size": 8,
                                    "mesh": {"fsdp": 4, "tensor": 2}})
     sizes = cfg.mesh.resolve(8)
-    assert sizes == {"pipe": 1, "data": 1, "fsdp": 4, "expert": 1, "seq": 1, "tensor": 2}
+    assert sizes == {"pipe": 1, "data": 1, "fsdp": 4, "fsdp_sub": 1, "expert": 1,
+                     "seq": 1, "tensor": 2}
 
 
 def test_mesh_bad_product():
