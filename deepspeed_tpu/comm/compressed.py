@@ -1,0 +1,101 @@
+"""1-bit compressed allreduce with error feedback.
+
+Parity (re-designed): reference ``runtime/comm/nccl.py:51
+NcclBackend.compressed_allreduce`` (also ``mpi.py``/``hccl.py`` and the cupy
+compression backend ``runtime/compression/cupy.py``) — the communication core
+of the 1-bit optimizers: each worker sends only the *sign bits* of its tensor
+plus one fp32 scale per chunk, with both worker-side and server-side error
+feedback so the quantization error is re-injected on the next step and the
+iterates converge as if uncompressed (arXiv:2102.02888).
+
+TPU-native: a ``shard_map`` collective over a mesh axis. Transport is real
+1-bit — signs packed 8-per-byte via ``packbits`` — so on-wire volume is
+1/32 of fp32 (+1 scale per worker chunk), matching the reference's NCCL
+gather of bit tensors. Two phases, like the reference:
+
+  1. scatter-reduce: sign-compress (with worker error), all-to-all so worker k
+     holds every worker's k-th chunk, decompress + sum;
+  2. allgather: sign-compress the local reduced chunk (with server error),
+     all-gather compressed, decompress -> every worker holds the full result.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _compress(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Sign-bits (packed uint8) + L1-mean scale. x must be 1-d, len % 8 == 0."""
+    scale = jnp.mean(jnp.abs(x))
+    bits = (x >= 0).astype(jnp.uint8)
+    return jnp.packbits(bits), scale
+
+
+def _decompress(packed: jax.Array, scale: jax.Array, n: int) -> jax.Array:
+    bits = jnp.unpackbits(packed)[:n].astype(jnp.float32)
+    return (bits * 2.0 - 1.0) * scale
+
+
+def compressed_allreduce(x: jax.Array, error_worker: jax.Array,
+                         error_server: jax.Array, axis_name: str
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Mean of ``x`` across ``axis_name`` via 1-bit compression.
+
+    Must run inside ``shard_map``. ``error_worker``/``error_server`` are this
+    rank's persistent error-feedback buffers (same shape as ``x`` and
+    ``x.size/n`` respectively). Returns ``(avg, new_error_worker,
+    new_error_server)``.
+    """
+    n = jax.lax.psum(1, axis_name)
+    orig_shape = x.shape
+    flat = x.reshape(-1).astype(jnp.float32)
+    if flat.shape[0] % (n * 8) != 0:
+        raise ValueError(f"compressed_allreduce needs size divisible by "
+                         f"{n * 8}, got {flat.shape[0]} (pad the buffer)")
+    corrected = flat / n + error_worker.reshape(-1)
+
+    # phase 1: compress chunks, a2a so rank k receives everyone's chunk k
+    chunks = corrected.reshape(n, -1)
+    chunk_len = chunks.shape[1]
+    packed, scales = jax.vmap(_compress)(chunks)
+    local_deq = jax.vmap(lambda p, s: _decompress(p, s, chunk_len))(packed, scales)
+    new_error_worker = (corrected - local_deq.reshape(-1)).reshape(-1)
+
+    recv_packed = jax.lax.all_to_all(packed, axis_name, 0, 0).reshape(n, -1)
+    recv_scales = jax.lax.all_to_all(scales[:, None], axis_name, 0, 0).reshape(n)
+    server_sum = jnp.sum(
+        jax.vmap(lambda p, s: _decompress(p, s, chunk_len))(recv_packed, recv_scales),
+        axis=0)
+
+    # phase 2: compress the reduced chunk with server error, allgather
+    server_corrected = server_sum + error_server.reshape(-1)
+    s_packed, s_scale = _compress(server_corrected)
+    s_deq = _decompress(s_packed, s_scale, chunk_len)
+    new_error_server = server_corrected - s_deq
+
+    all_packed = jax.lax.all_gather(s_packed, axis_name)
+    all_scales = jax.lax.all_gather(s_scale, axis_name)
+    result = jax.vmap(lambda p, s: _decompress(p, s, chunk_len))(
+        all_packed, all_scales).reshape(-1)
+    return (result.reshape(orig_shape), new_error_worker.reshape(orig_shape),
+            new_error_server.reshape(error_server.shape))
+
+
+def compressed_allreduce_emulated(x: jax.Array, error: jax.Array
+                                  ) -> Tuple[jax.Array, jax.Array]:
+    """Single-worker sign compression with error feedback.
+
+    The 1-bit optimizers in the SPMD engine receive *already-reduced* grads
+    (XLA inserts the DP reduction), so the communication-compression effect is
+    applied to the reduced tensor: sign(x + error) * L1-mean, error carried to
+    the next step. This is exactly ``compressed_allreduce`` at world size 1;
+    the multi-worker shard_map form above serves manual-collective engines.
+    """
+    corrected = x.astype(jnp.float32) + error
+    scale = jnp.mean(jnp.abs(corrected))
+    out = jnp.sign(corrected) * scale
+    out = jnp.where(corrected == 0.0, scale, out)  # sign(0) -> +1 like packbits
+    return out, corrected - out

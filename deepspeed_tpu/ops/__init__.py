@@ -13,6 +13,7 @@ from deepspeed_tpu.ops.adam import FusedAdam, DeepSpeedCPUAdam
 from deepspeed_tpu.ops.lamb import FusedLamb
 from deepspeed_tpu.ops.lion import FusedLion, DeepSpeedCPULion
 from deepspeed_tpu.ops.adagrad import DeepSpeedCPUAdagrad, Adagrad
+from deepspeed_tpu.ops.onebit import OnebitAdam, OnebitLamb, ZeroOneAdam
 from deepspeed_tpu.ops.sgd import SGD
 
 # Names accepted in config optimizer.type, matching the reference's
@@ -32,6 +33,9 @@ OPTIMIZER_REGISTRY: Dict[str, Type[TPUOptimizer]] = {
     "adagrad": Adagrad,
     "cpuadagrad": DeepSpeedCPUAdagrad,
     "sgd": SGD,
+    "onebitadam": OnebitAdam,
+    "onebitlamb": OnebitLamb,
+    "zerooneadam": ZeroOneAdam,
 }
 
 ADAM_OPTIMIZER = "adam"

@@ -34,11 +34,16 @@ class TPUOptimizer:
     # -- helpers -------------------------------------------------------- #
 
     @staticmethod
-    def _split3(mapped_tree: Any) -> Tuple[Any, Any, Any]:
+    def _split(mapped_tree: Any, n: int) -> Tuple[Any, ...]:
+        """Unzip a tree of n-tuples (tree_map outputs) into n trees."""
         is_tup = lambda t: isinstance(t, tuple)
         return tuple(
             jax.tree_util.tree_map(lambda t, i=i: t[i], mapped_tree, is_leaf=is_tup)
-            for i in range(3))
+            for i in range(n))
+
+    @staticmethod
+    def _split3(mapped_tree: Any) -> Tuple[Any, Any, Any]:
+        return TPUOptimizer._split(mapped_tree, 3)
 
 
 class OptaxWrapper(TPUOptimizer):
