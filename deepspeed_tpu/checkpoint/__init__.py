@@ -7,3 +7,15 @@ from deepspeed_tpu.checkpoint.state import (
     flatten_tree,
     unflatten_into,
 )
+from deepspeed_tpu.checkpoint.engine import (
+    CheckpointEngine,
+    NativeCheckpointEngine,
+    AsyncCheckpointEngine,
+    build_checkpoint_engine,
+)
+from deepspeed_tpu.checkpoint.sharded import save_sharded, load_sharded
+from deepspeed_tpu.checkpoint.universal import (
+    ds_to_universal,
+    load_universal,
+    load_universal_into_engine,
+)

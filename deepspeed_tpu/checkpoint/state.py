@@ -77,23 +77,36 @@ def unflatten_into(template: Any, flat: Dict[str, np.ndarray], prefix: str = "")
 
 
 def save_engine_checkpoint(save_dir: str, tag: str, state: Dict[str, Any],
-                           client_state: Dict[str, Any], save_latest: bool = True):
+                           client_state: Dict[str, Any], save_latest: bool = True,
+                           ckpt_engine=None):
+    """``ckpt_engine``: a ``checkpoint.engine.CheckpointEngine``; the async
+    engine queues the writes and makes them durable at ``commit`` — the
+    ``latest`` tag only flips after commit succeeds."""
+    if ckpt_engine is None:
+        from deepspeed_tpu.checkpoint.engine import NativeCheckpointEngine
+        ckpt_engine = NativeCheckpointEngine()
     ckpt_dir = os.path.join(save_dir, tag)
-    os.makedirs(ckpt_dir, exist_ok=True)
+    ckpt_engine.create(tag)
+    ckpt_engine.makedirs(ckpt_dir, exist_ok=True)
 
+    # freshly materialised host copies: ownership passes to the engine
+    # (snapshot=False avoids a second full copy in the async path)
     model_flat = {k: np.asarray(jax.device_get(v))
                   for k, v in flatten_tree(state["master"]).items()}
-    np.savez(os.path.join(ckpt_dir, MODEL_FILE), **model_flat)
+    ckpt_engine.save(model_flat, os.path.join(ckpt_dir, MODEL_FILE),
+                     snapshot=False)
 
     optim_state = {"opt": state["opt"], "step": state["step"],
                    "scaler": state["scaler"], "skipped": state["skipped"]}
     optim_flat = {k: np.asarray(jax.device_get(v))
                   for k, v in flatten_tree(optim_state).items()}
-    np.savez(os.path.join(ckpt_dir, OPTIM_FILE), **optim_flat)
+    ckpt_engine.save(optim_flat, os.path.join(ckpt_dir, OPTIM_FILE),
+                     snapshot=False)
 
     with open(os.path.join(ckpt_dir, CLIENT_FILE), "w") as f:
         json.dump(client_state, f, indent=2, default=str)
 
+    ckpt_engine.commit(tag)
     if save_latest:
         with open(os.path.join(save_dir, LATEST), "w") as f:
             f.write(tag)
@@ -112,20 +125,23 @@ def load_engine_checkpoint(load_dir: str, tag: Optional[str], state: Dict[str, A
                            shardings: Dict[str, Any],
                            load_optimizer_states: bool = True,
                            load_module_only: bool = False,
-                           params_builder=None
+                           params_builder=None, ckpt_engine=None
                            ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    if ckpt_engine is None:
+        from deepspeed_tpu.checkpoint.engine import NativeCheckpointEngine
+        ckpt_engine = NativeCheckpointEngine()
     tag = tag or read_latest_tag(load_dir)
     if tag is None:
         raise FileNotFoundError(f"no 'latest' file in {load_dir}; pass an explicit tag")
     ckpt_dir = os.path.join(load_dir, tag)
 
-    model_flat = dict(np.load(os.path.join(ckpt_dir, MODEL_FILE)))
+    model_flat = ckpt_engine.load(os.path.join(ckpt_dir, MODEL_FILE))
     master = unflatten_into(state["master"], model_flat)
     new_state = dict(state)
     new_state["master"] = jax.device_put(master, shardings["master"])
 
     if load_optimizer_states and not load_module_only:
-        optim_flat = dict(np.load(os.path.join(ckpt_dir, OPTIM_FILE)))
+        optim_flat = ckpt_engine.load(os.path.join(ckpt_dir, OPTIM_FILE))
         optim_template = {"opt": state["opt"], "step": state["step"],
                           "scaler": state["scaler"], "skipped": state["skipped"]}
         optim = unflatten_into(optim_template, optim_flat)

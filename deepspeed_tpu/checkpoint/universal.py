@@ -1,0 +1,182 @@
+"""Universal checkpoints: per-parameter fragments loadable at any parallelism.
+
+Parity: reference ``deepspeed/checkpoint/ds_to_universal.py`` (``extract_zero_
+shards`` :87, ``merge_tp_slices`` :156) + ``universal_checkpoint.py:12
+load_hp_checkpoint_state``. The reference must first *undo* its (tp, pp, dp)
+sharded file layout — merging flat-buffer fragments and re-splicing qkv/row/col
+TP slices — because each rank saved only its partition. Our engine checkpoints
+already store full logical tensors per parameter, so conversion is a re-keying
+into the universal on-disk layout, and loading at a different (tp, pp, dp/fsdp,
+ep) is free: the engine re-shards whole tensors at load time.
+
+Universal layout (matching the reference's shape)::
+
+    <out_dir>/
+      zero/
+        <param_key>/fp32.npy
+        <param_key>/exp_avg.npy          (per optimizer-state key)
+        ...
+      universal_meta.json                {step, scaler, skipped, keys}
+
+The layout is also the interchange point for checkpoints produced by *other*
+systems: anything that can emit one .npy per parameter fragment can be loaded
+into this engine.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.checkpoint.state import (CLIENT_FILE, MODEL_FILE, OPTIM_FILE,
+                                            read_latest_tag)
+from deepspeed_tpu.utils.logging import log_dist
+
+META_FILE = "universal_meta.json"
+ZERO_DIR = "zero"
+
+_SCALARS = ("step", "skipped", "scaler/scale", "scaler/growth_tracker",
+            "scaler/hysteresis", "opt/step")
+
+
+def _param_dir(out_dir: str, key: str) -> str:
+    return os.path.join(out_dir, ZERO_DIR, key)
+
+
+def ds_to_universal(ckpt_dir: str, out_dir: str, tag: Optional[str] = None) -> str:
+    """Convert an engine checkpoint into universal per-parameter fragments.
+
+    Parity: ``ds_to_universal.py main()`` — but single-pass, since shards are
+    already merged in our layout.
+    """
+    tag = tag or read_latest_tag(ckpt_dir)
+    if tag is None:
+        raise FileNotFoundError(f"no 'latest' in {ckpt_dir}; pass tag")
+    src = os.path.join(ckpt_dir, tag)
+    model = dict(np.load(os.path.join(src, MODEL_FILE)))
+    optim = dict(np.load(os.path.join(src, OPTIM_FILE)))
+
+    os.makedirs(os.path.join(out_dir, ZERO_DIR), exist_ok=True)
+    keys = sorted(model)
+    for key, val in model.items():
+        pdir = _param_dir(out_dir, key)
+        os.makedirs(pdir, exist_ok=True)
+        np.save(os.path.join(pdir, "fp32.npy"), np.asarray(val, np.float32))
+    # optimizer state fragments: optim keys look like "opt/<state_key>/<param_key>"
+    for okey, val in optim.items():
+        if not okey.startswith("opt/") or okey in _SCALARS:
+            continue
+        rest = okey[len("opt/"):]
+        state_key, _, param_key = rest.partition("/")
+        if not param_key:  # scalar like opt/step
+            continue
+        pdir = _param_dir(out_dir, param_key)
+        os.makedirs(pdir, exist_ok=True)
+        np.save(os.path.join(pdir, f"{state_key}.npy"), np.asarray(val))
+
+    meta = {"keys": keys,
+            "scalars": {k: np.asarray(optim[k]).item()
+                        for k in optim if k in _SCALARS},
+            "source_tag": tag}
+    client = os.path.join(src, CLIENT_FILE)
+    if os.path.exists(client):
+        with open(client) as f:
+            meta["client_state"] = json.load(f)
+    with open(os.path.join(out_dir, META_FILE), "w") as f:
+        json.dump(meta, f, indent=2)
+    log_dist(f"universal checkpoint written to {out_dir}", ranks=[0])
+    return out_dir
+
+
+def load_universal(out_dir: str) -> Tuple[Dict[str, np.ndarray],
+                                          Dict[str, np.ndarray], dict]:
+    """Read fragments back: (master_flat, optim_flat, meta).
+
+    Parity: ``universal_checkpoint.py load_hp_checkpoint_state`` — each
+    parameter's fp32 value + optimizer states, addressed by name, shardable to
+    ANY target topology by the caller.
+    """
+    with open(os.path.join(out_dir, META_FILE)) as f:
+        meta = json.load(f)
+    master: Dict[str, np.ndarray] = {}
+    optim: Dict[str, np.ndarray] = {}
+    zero_root = os.path.join(out_dir, ZERO_DIR)
+    for key in meta["keys"]:
+        pdir = os.path.join(zero_root, key)
+        master[key] = np.load(os.path.join(pdir, "fp32.npy"))
+        for fname in os.listdir(pdir):
+            if fname == "fp32.npy" or not fname.endswith(".npy"):
+                continue
+            state_key = fname[:-len(".npy")]
+            optim[f"opt/{state_key}/{key}"] = np.load(os.path.join(pdir, fname))
+    for k, v in meta.get("scalars", {}).items():
+        optim[k] = np.asarray(v)
+    return master, optim, meta
+
+
+def load_universal_into_engine(engine, out_dir: str,
+                               load_optimizer_states: bool = True,
+                               load_module_only: bool = False) -> dict:
+    """Load a universal checkpoint into a live engine at ITS topology
+    (the different-(tp,pp,dp) resume path; engine re-shards whole tensors)."""
+    import jax
+    from deepspeed_tpu.checkpoint.state import flatten_tree, unflatten_into
+    if getattr(engine, "_offload", None) is not None:
+        raise NotImplementedError(
+            "universal-checkpoint load into an offload_optimizer engine is not "
+            "supported; load the universal checkpoint into a non-offload engine "
+            "or convert to a regular checkpoint first")
+    master_flat, optim_flat, meta = load_universal(out_dir)
+    state = engine.state
+    sh = engine._state_shardings
+    new_master = unflatten_into(state["master"], master_flat)
+    state["master"] = jax.device_put(new_master, sh["master"])
+    scalars = meta.get("scalars", {})
+    if load_optimizer_states and not load_module_only:
+        opt_template_flat = flatten_tree(state["opt"], prefix="opt/")
+        opt_sh_flat = flatten_tree(sh["opt"], prefix="opt/")
+        rebuilt = {}
+        for key, leaf in opt_template_flat.items():
+            if key in optim_flat:
+                val = np.asarray(optim_flat[key]).astype(
+                    np.dtype(leaf.dtype)).reshape(np.shape(leaf))
+                rebuilt[key] = jax.device_put(val, opt_sh_flat[key])
+            else:
+                rebuilt[key] = leaf
+        state["opt"] = unflatten_into(state["opt"], {k[len("opt/"):]: v
+                                                     for k, v in rebuilt.items()})
+        if "step" in scalars:
+            state["step"] = jax.device_put(np.int32(scalars["step"]), sh["step"])
+        if "skipped" in scalars:
+            state["skipped"] = jax.device_put(np.int32(scalars["skipped"]),
+                                              sh["skipped"])
+        for name, full in (("scale", "scaler/scale"),
+                           ("growth_tracker", "scaler/growth_tracker"),
+                           ("hysteresis", "scaler/hysteresis")):
+            if full in scalars:
+                cur = state["scaler"][name]
+                state["scaler"][name] = jax.device_put(
+                    np.asarray(scalars[full], np.dtype(cur.dtype)),
+                    sh["scaler"][name])
+    if "params" in state:
+        if getattr(engine, "quantized_weights", False):
+            from deepspeed_tpu.runtime.zero.zeropp import quantize_param_tree
+            params_builder = lambda m: quantize_param_tree(m, engine.compute_dtype)
+        else:
+            from deepspeed_tpu.utils.tree import tree_cast
+            dtype = engine.compute_dtype
+            params_builder = lambda m: tree_cast(m, dtype)
+        state["params"] = jax.jit(params_builder,
+                                  out_shardings=sh["params"])(state["master"])
+    engine.state = state
+    client = meta.get("client_state", {})
+    if not load_module_only:
+        engine.global_steps = int(client.get("global_steps", scalars.get("step", 0)))
+        engine.global_samples = int(client.get("global_samples", 0))
+        engine.micro_steps = int(client.get("micro_steps", 0))
+        engine.skipped_steps = int(client.get("skipped_steps",
+                                              scalars.get("skipped", 0)))
+    return client

@@ -460,14 +460,15 @@ class DeepSpeedTPUEngine:
         if tag is None:
             raise FileNotFoundError(f"no 'latest' file in {load_dir}")
         ckpt_dir = os.path.join(load_dir, tag)
-        model_flat = dict(np.load(os.path.join(ckpt_dir, ck.MODEL_FILE)))
+        cke = self._checkpoint_engine()
+        model_flat = cke.load(os.path.join(ckpt_dir, ck.MODEL_FILE))
         dev_names, host_names = self._offload_dev_names, self._offload_host_names
         master_sh = self._state_shardings["master"]
         self.state["master"] = {
             k: jax.device_put(model_flat[k], master_sh[k]) for k in dev_names}
         self._offload.load_master_leaves({k: model_flat[k] for k in host_names})
         if load_optimizer_states and not load_module_only:
-            optim_flat = dict(np.load(os.path.join(ckpt_dir, ck.OPTIM_FILE)))
+            optim_flat = cke.load(os.path.join(ckpt_dir, ck.OPTIM_FILE))
             dev_opt = jax.device_get(self.state["opt"])
             new_opt, host_moments = {}, {}
             for key, val in dev_opt.items():
@@ -869,8 +870,18 @@ class DeepSpeedTPUEngine:
         })
         state = self._offload_ckpt_state() if self._offload is not None else self.state
         save_engine_checkpoint(save_dir, tag, state, client_state,
-                               save_latest=save_latest)
+                               save_latest=save_latest,
+                               ckpt_engine=self._checkpoint_engine())
         return True
+
+    def _checkpoint_engine(self):
+        """Configured checkpoint engine, built lazily (parity:
+        _configure_checkpointing engine.py:912 picking Torch vs Nebula)."""
+        if getattr(self, "_ckpt_engine", None) is None:
+            from deepspeed_tpu.checkpoint.engine import build_checkpoint_engine
+            self._ckpt_engine = build_checkpoint_engine(
+                self.config.checkpoint.engine)
+        return self._ckpt_engine
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,
@@ -880,6 +891,15 @@ class DeepSpeedTPUEngine:
         if self.state is None:
             raise RuntimeError("engine state not initialised; pass model_parameters "
                                "or run a batch before load_checkpoint")
+        if self.config.checkpoint.load_universal:
+            from deepspeed_tpu.checkpoint.universal import load_universal_into_engine
+            if tag is not None:
+                logger.warning("load_universal: universal checkpoints are "
+                               f"untagged directories; ignoring tag={tag!r}")
+            client_state = load_universal_into_engine(
+                self, load_dir, load_optimizer_states=load_optimizer_states,
+                load_module_only=load_module_only)
+            return load_dir, client_state
         if self._offload is not None:
             load_dir_, client_state = self._load_checkpoint_offload(
                 load_dir, tag, load_optimizer_states=load_optimizer_states,
@@ -896,7 +916,8 @@ class DeepSpeedTPUEngine:
         state, client_state = load_engine_checkpoint(
             load_dir, tag, self.state, self._state_shardings,
             load_optimizer_states=load_optimizer_states,
-            load_module_only=load_module_only, params_builder=params_builder)
+            load_module_only=load_module_only, params_builder=params_builder,
+            ckpt_engine=self._checkpoint_engine())
         self.state = state
         self.global_steps = int(client_state.get("global_steps", 0))
         self.global_samples = int(client_state.get("global_samples", 0))
@@ -909,6 +930,10 @@ class DeepSpeedTPUEngine:
         the offload optimizer's AIO pools/swap files and monitor writers."""
         if self._offload is not None:
             self._offload.close()
+        if getattr(self, "_ckpt_engine", None) is not None:
+            close = getattr(self._ckpt_engine, "close", None)
+            if close is not None:
+                close()
         close = getattr(self.monitor, "close", None)
         if close is not None:
             close()

@@ -1,0 +1,71 @@
+"""Offline consolidation of engine checkpoints into a plain fp32 state dict.
+
+Parity: reference ``deepspeed/utils/zero_to_fp32.py`` (592 LoC of shard-merge
+logic: ``get_fp32_state_dict_from_zero_checkpoint``,
+``convert_zero_checkpoint_to_fp32_state_dict``) — the script users run to turn
+a ZeRO checkpoint into something ``model.load_state_dict`` accepts, with no
+accelerator required. Our checkpoints already hold full logical tensors, so
+"consolidation" is reading the model file and re-keying; the API shape (and the
+CLI: ``python -m deepspeed_tpu.utils.zero_to_fp32 <ckpt_dir> <output>``)
+matches the reference so existing workflows port unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from deepspeed_tpu.checkpoint.state import MODEL_FILE, read_latest_tag
+from deepspeed_tpu.utils.logging import logger
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str,
+                                             tag: Optional[str] = None
+                                             ) -> Dict[str, np.ndarray]:
+    """Full fp32 param dict, keys '/'-joined (reference: same name, zero_to_fp32.py)."""
+    tag = tag or read_latest_tag(checkpoint_dir)
+    if tag is None:
+        raise FileNotFoundError(
+            f"no 'latest' file in {checkpoint_dir}; pass an explicit tag")
+    path = os.path.join(checkpoint_dir, tag, MODEL_FILE)
+    return {k: np.asarray(v, np.float32) for k, v in np.load(path).items()}
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str,
+                                               output_file: str,
+                                               tag: Optional[str] = None) -> str:
+    """Write the consolidated state dict to ``output_file``.
+
+    ``.pt`` -> torch.save of a torch state dict (dots for key separators, the
+    HF/torch convention); anything else -> npz.
+    """
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    if output_file.endswith(".pt") or output_file.endswith(".bin"):
+        import torch
+        torch_sd = {k.replace("/", "."): torch.from_numpy(np.array(v))
+                    for k, v in sd.items()}
+        torch.save(torch_sd, output_file)
+    else:
+        np.savez(output_file if output_file.endswith(".npz")
+                 else output_file + ".npz", **sd)
+    logger.info(f"consolidated fp32 state dict ({len(sd)} tensors) -> {output_file}")
+    return output_file
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="Consolidate a deepspeed_tpu checkpoint into an fp32 state dict")
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file",
+                   help=".pt/.bin -> torch state dict; otherwise .npz")
+    p.add_argument("-t", "--tag", default=None)
+    args = p.parse_args()
+    convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir,
+                                               args.output_file, tag=args.tag)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,180 @@
+"""Checkpoint tier tests: engines, sharded writes, universal format, zero_to_fp32.
+
+Parity model: reference ``tests/unit/checkpoint`` (11 files) — save/load across
+zero stages, universal checkpoint reshape (DistributedFixture: save at one
+world size, load at another), consolidation without accelerators.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint import (AsyncCheckpointEngine, NativeCheckpointEngine,
+                                      build_checkpoint_engine, ds_to_universal,
+                                      load_sharded, load_universal, save_sharded)
+
+
+def _model_and_batches(seed=0, steps=4):
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    model = GPT2LMHead(GPT2Config(vocab_size=64, n_positions=16, n_embd=32,
+                                  n_layer=2, n_head=2))
+    rng = np.random.default_rng(seed)
+    batches = [{"input_ids": rng.integers(0, 64, (8, 16)).astype(np.int32)}
+               for _ in range(steps)]
+    return model, batches
+
+
+def _engine(model, cfg_extra=None, mesh=None):
+    cfg = {
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "zero_optimization": {"stage": 2},
+        "mesh": mesh or {"data": -1},
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+    }
+    if cfg_extra:
+        cfg.update(cfg_extra)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    return engine
+
+
+# --------------------------------------------------------------------------- #
+# checkpoint engines
+# --------------------------------------------------------------------------- #
+
+def test_engine_registry():
+    assert isinstance(build_checkpoint_engine("native"), NativeCheckpointEngine)
+    assert isinstance(build_checkpoint_engine("nebula"), AsyncCheckpointEngine)
+    with pytest.raises(ValueError):
+        build_checkpoint_engine("bogus")
+
+
+def test_async_engine_commit_barrier(tmp_path):
+    eng = AsyncCheckpointEngine()
+    data = {f"k{i}": np.random.rand(100).astype(np.float32) for i in range(4)}
+    paths = [str(tmp_path / f"f{i}.npz") for i in range(4)]
+    for p in paths:
+        eng.save(data, p)
+    assert eng.commit("tag")
+    for p in paths:
+        got = dict(np.load(p))
+        for k in data:
+            np.testing.assert_array_equal(got[k], data[k])
+    eng.close()
+
+
+def test_async_engine_snapshot_isolation(tmp_path):
+    """Mutating the source after save() must not corrupt the written file."""
+    eng = AsyncCheckpointEngine(max_workers=1)
+    arr = np.zeros(1000, np.float32)
+    eng.save({"a": arr}, str(tmp_path / "x.npz"))
+    arr += 999.0  # racer
+    eng.commit("t")
+    np.testing.assert_array_equal(np.load(str(tmp_path / "x.npz"))["a"],
+                                  np.zeros(1000, np.float32))
+    eng.close()
+
+
+def test_async_engine_in_training(tmp_path):
+    model, batches = _model_and_batches()
+    eng = _engine(model, {"checkpoint": {"engine": "async"}})
+    for b in batches[:2]:
+        eng.train_batch(b)
+    eng.save_checkpoint(str(tmp_path), tag="a1")
+    # latest only after commit; file must be complete
+    assert open(str(tmp_path / "latest")).read() == "a1"
+    eng2 = _engine(model)
+    for b in batches[:1]:
+        eng2.train_batch(b)
+    eng2.load_checkpoint(str(tmp_path), tag="a1")
+    assert eng2.global_steps == 2
+    eng.destroy()
+
+
+# --------------------------------------------------------------------------- #
+# sharded per-host checkpoints
+# --------------------------------------------------------------------------- #
+
+def test_sharded_save_load_roundtrip(eight_devices, tmp_path):
+    mesh = Mesh(np.array(eight_devices).reshape(4, 2), ("fsdp", "tensor"))
+    sh_w = NamedSharding(mesh, P("fsdp", "tensor"))
+    sh_b = NamedSharding(mesh, P(None))
+    w = jax.device_put(np.arange(64, dtype=np.float32).reshape(8, 8), sh_w)
+    b = jax.device_put(np.arange(8, dtype=np.float32), sh_b)
+    trees = {"model": {"w": w, "b": b}}
+    save_sharded(str(tmp_path / "sc"), trees)
+    assert os.path.exists(tmp_path / "sc" / "index.json")
+    assert os.path.exists(tmp_path / "sc" / "shards_h0.npz")
+
+    # reload onto a DIFFERENT mesh layout (resize story)
+    mesh2 = Mesh(np.array(eight_devices), ("fsdp",))
+    sh2 = {"model": {"w": NamedSharding(mesh2, P("fsdp")),
+                     "b": NamedSharding(mesh2, P())}}
+    out = load_sharded(str(tmp_path / "sc"),
+                       {"model": {"w": jax.ShapeDtypeStruct((8, 8), np.float32),
+                                  "b": jax.ShapeDtypeStruct((8,), np.float32)}},
+                       sh2)
+    np.testing.assert_array_equal(np.asarray(out["model"]["w"]),
+                                  np.arange(64, dtype=np.float32).reshape(8, 8))
+    np.testing.assert_array_equal(np.asarray(out["model"]["b"]),
+                                  np.arange(8, dtype=np.float32))
+
+
+# --------------------------------------------------------------------------- #
+# universal checkpoint + zero_to_fp32
+# --------------------------------------------------------------------------- #
+
+def test_universal_roundtrip_and_topology_change(eight_devices, tmp_path):
+    """Save at fsdp=8, convert to universal, resume at data=8 (different
+    parallelism — the reference's ds_to_universal + load_universal flow)."""
+    model, batches = _model_and_batches()
+    eng = _engine(model, mesh={"data": 1, "fsdp": 8})
+    for b in batches[:2]:
+        eng.train_batch(b)
+    eng.save_checkpoint(str(tmp_path / "ck"), tag="u1")
+    ds_to_universal(str(tmp_path / "ck"), str(tmp_path / "uni"), tag="u1")
+
+    master, optim, meta = load_universal(str(tmp_path / "uni"))
+    assert meta["source_tag"] == "u1" and master and optim
+    assert any(k.startswith("opt/exp_avg/") for k in optim)
+
+    # resume at a different topology through config.checkpoint.load_universal
+    eng2 = _engine(model, {"checkpoint": {"load_universal": True}},
+                   mesh={"data": 8, "fsdp": 1})
+    for b in batches[:1]:
+        eng2.train_batch(b)
+    eng2.load_checkpoint(str(tmp_path / "uni"))
+    assert eng2.global_steps == 2
+    # both continue identically
+    l1 = [float(eng.train_batch(b)) for b in batches[2:]]
+    l2 = [float(eng2.train_batch(b)) for b in batches[2:]]
+    np.testing.assert_allclose(l1, l2, rtol=2e-3, atol=2e-3)
+
+
+def test_zero_to_fp32(tmp_path):
+    model, batches = _model_and_batches()
+    eng = _engine(model)
+    eng.train_batch(batches[0])
+    eng.save_checkpoint(str(tmp_path), tag="z")
+    from deepspeed_tpu.utils.zero_to_fp32 import (
+        convert_zero_checkpoint_to_fp32_state_dict,
+        get_fp32_state_dict_from_zero_checkpoint)
+    sd = get_fp32_state_dict_from_zero_checkpoint(str(tmp_path))
+    assert all(v.dtype == np.float32 for v in sd.values())
+    # matches live engine master
+    from deepspeed_tpu.checkpoint.state import flatten_tree
+    live = {k: np.asarray(jax.device_get(v))
+            for k, v in flatten_tree(eng.state["master"]).items()}
+    for k in live:
+        np.testing.assert_allclose(sd[k], live[k], rtol=1e-6)
+    # torch export
+    out = convert_zero_checkpoint_to_fp32_state_dict(
+        str(tmp_path), str(tmp_path / "consolidated.pt"))
+    import torch
+    tsd = torch.load(out, map_location="cpu")
+    assert any("." in k for k in tsd)  # torch key convention
