@@ -1,0 +1,20 @@
+"""Compression: QAT, pruning (sparse/row/head/channel), layer reduction.
+
+Parity: reference ``deepspeed/compression/`` (``compress.py init_compression/
+redundancy_clean``, ``basic_layer.py`` compressed layer zoo, ``scheduler.py``,
+``config.py``). TPU re-design: instead of swapping ``nn.Module`` subclasses
+into the model, compression is a **pure transform over the param tree**
+applied inside the jitted step — STE fake-quant and magnitude masks are
+elementwise chains XLA fuses into the forward for free.
+"""
+
+from deepspeed_tpu.compression.config import CompressionConfig, TechniqueGroup
+from deepspeed_tpu.compression.compress import (CompressionPlan, apply_compression,
+                                                compile_compression_plan,
+                                                init_compression,
+                                                redundancy_clean)
+from deepspeed_tpu.compression.scheduler import CompressionScheduler
+
+__all__ = ["CompressionConfig", "TechniqueGroup", "CompressionPlan",
+           "compile_compression_plan", "apply_compression", "init_compression",
+           "redundancy_clean", "CompressionScheduler"]

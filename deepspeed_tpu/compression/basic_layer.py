@@ -1,0 +1,91 @@
+"""Compression primitives: STE quantization + structured pruning masks.
+
+Parity: reference ``compression/basic_layer.py`` (840 LoC of compressed
+``LinearLayer_Compress``/``Conv2dLayer_Compress``/``Embedding_Compress``
+forward hooks) + ``compression/utils.py`` (TopKBinarizer). Here every
+primitive is a pure array function: the compressed "layer" is composition of
+these over the param leaf inside jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quantizer import quantize_dequantize
+
+
+def ste(x_q: jax.Array, x: jax.Array) -> jax.Array:
+    """Straight-through estimator: forward x_q, gradient of identity on x."""
+    return x + jax.lax.stop_gradient(x_q - x)
+
+
+def quantize_weight(w: jax.Array, bits: int, groups: int = 1,
+                    symmetric: bool = True) -> jax.Array:
+    """QAT weight fake-quant (parity: LinearLayer_Compress weight quantize;
+    fake_quantizer.cu). Group count follows the reference's quantize_groups
+    (row-block groups over the flattened weight)."""
+    n = w.size
+    group_size = max(1, n // max(1, groups))
+    # group_size must divide n; fall back to per-tensor
+    if n % group_size != 0:
+        group_size = n
+    q = quantize_dequantize(w.astype(jnp.float32), num_bits=bits,
+                            group_size=group_size, symmetric=symmetric)
+    return ste(q.astype(w.dtype), w)
+
+
+def quantize_activation(x: jax.Array, bits: int = 8) -> jax.Array:
+    """Activation fake-quant (parity: activation_quantization): dynamic
+    per-tensor symmetric range, STE."""
+    scale = jnp.max(jnp.abs(x)) / (2.0 ** (bits - 1) - 1)
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.round(x.astype(jnp.float32) / scale) * scale
+    return ste(q.astype(x.dtype), x)
+
+
+def _topk_mask(scores: jax.Array, keep_ratio: float) -> jax.Array:
+    """1.0 for the top ``keep_ratio`` fraction by score (TopKBinarizer)."""
+    k = jnp.maximum(1, jnp.int32(round(scores.size * keep_ratio)))
+    flat = scores.reshape(-1)
+    thresh = jnp.sort(flat)[flat.size - k]
+    return (flat >= thresh).astype(jnp.float32).reshape(scores.shape)
+
+
+def sparse_prune(w: jax.Array, dense_ratio: float, method: str = "l1") -> jax.Array:
+    """Unstructured magnitude pruning (parity: sparse_pruning, method l1/topk)."""
+    scores = jnp.abs(w.astype(jnp.float32))
+    mask = _topk_mask(scores, dense_ratio)
+    return ste(w * mask.astype(w.dtype), w)
+
+
+def row_prune(w: jax.Array, dense_ratio: float) -> jax.Array:
+    """Structured row pruning: zero whole output rows by L1 norm (parity:
+    row_pruning — rows of the 2-d weight)."""
+    w2 = w.reshape(w.shape[0], -1) if w.ndim > 1 else w.reshape(1, -1)
+    scores = jnp.sum(jnp.abs(w2.astype(jnp.float32)), axis=1)
+    mask = _topk_mask(scores, dense_ratio)
+    shape = (w.shape[0],) + (1,) * (w.ndim - 1) if w.ndim > 1 else (w.size,)
+    return ste(w * mask.reshape(shape).astype(w.dtype), w)
+
+
+def channel_prune(w: jax.Array, dense_ratio: float) -> jax.Array:
+    """Structured input-channel pruning (last dim; parity: channel_pruning)."""
+    w2 = w.reshape(-1, w.shape[-1])
+    scores = jnp.sum(jnp.abs(w2.astype(jnp.float32)), axis=0)
+    mask = _topk_mask(scores, dense_ratio)
+    shape = (1,) * (w.ndim - 1) + (w.shape[-1],)
+    return ste(w * mask.reshape(shape).astype(w.dtype), w)
+
+
+def head_prune(w: jax.Array, dense_ratio: float, num_heads: int) -> jax.Array:
+    """Attention head pruning (parity: head_pruning over qkv/output proj):
+    the leading dim splits into heads; whole heads are zeroed by L1 norm."""
+    d0 = w.shape[0]
+    if d0 % num_heads != 0:
+        return w
+    per = d0 // num_heads
+    wh = w.reshape(num_heads, per, -1)
+    scores = jnp.sum(jnp.abs(wh.astype(jnp.float32)), axis=(1, 2))
+    mask = _topk_mask(scores, dense_ratio)
+    return ste((wh * mask.reshape(num_heads, 1, 1).astype(w.dtype)).reshape(w.shape), w)

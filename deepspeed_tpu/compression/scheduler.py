@@ -1,0 +1,36 @@
+"""Compression scheduler.
+
+Parity: reference ``compression/scheduler.py CompressionScheduler`` — tracks
+training steps and reports which techniques are active (past their
+``schedule_offset``). In the TPU engine the activation gate is evaluated
+*inside* jit from the traced step (``apply_compression``), so this class
+serves the reference's introspection API (``check_compress_methods``) and the
+host-side curriculum for verbose logging.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from deepspeed_tpu.compression.config import CompressionConfig
+
+
+class CompressionScheduler:
+    def __init__(self, config: CompressionConfig):
+        self.config = config
+        self.training_steps = 0
+
+    def step(self, n: int = 1):
+        self.training_steps += n
+
+    def is_active(self, technique: str) -> bool:
+        shared = self.config.shared.get(technique)
+        if shared is None or not shared.enabled:
+            return False
+        if self.training_steps < shared.schedule_offset:
+            return False
+        end = shared.schedule_offset_end
+        return end is None or self.training_steps < int(end)
+
+    def active_techniques(self) -> Dict[str, bool]:
+        return {t: self.is_active(t) for t in self.config.shared}

@@ -581,6 +581,10 @@ class DeepSpeedTPUConfig(ConfigModel):
     # precision of gradient accumulation buffer (parity: data_types.grad_accum_dtype)
     data_types: Dict[str, Any] = field(default_factory=dict)
 
+    # compression (parity: compression_training block, compression/config.py) —
+    # raw dict, parsed by deepspeed_tpu.compression (dict-schema like the reference)
+    compression_training: Optional[Dict[str, Any]] = None
+
     _migrations = {"fp16_enabled": ("fp16", lambda v: {"enabled": bool(v)})}
 
     # ------------------------------------------------------------------ #

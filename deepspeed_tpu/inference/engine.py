@@ -76,10 +76,14 @@ class InferenceEngine:
             raise ValueError("init_inference needs model_parameters or "
                              "config.checkpoint.checkpoint_dir")
         params = tree_cast(params, self._dtype)
-        if config.quant.enabled:
-            params = self._quantize_weights(params)
         self._tp_specs = self._derive_specs(params)
-        self.params = self._shard_params(params)
+        self._weights_quantized = bool(config.quant.enabled)
+        if self._weights_quantized:
+            # true int8 storage (HBM footprint /2 vs bf16): dequant happens at
+            # jit entry in forward/prefill/decode via _live_params
+            self.params = self._shard_params_quantized(params)
+        else:
+            self.params = self._shard_params(params)
 
         self._init_cache_fn = init_cache_fn
         self._prefill = None
@@ -110,25 +114,57 @@ class InferenceEngine:
             node[parts[-1]] = data[key]
         return tree
 
-    def _quantize_weights(self, params):
-        """ZeRO-inference-style weight-only group quantization (parity:
-        inference/quantization/quantization.py): group-wise symmetric int
-        quant+dequant of matmul weights; memory savings come from the int8
-        representation in the v2 engine — here we keep numerics parity."""
-        from deepspeed_tpu.ops.quantizer import quantize_dequantize
-        bits = self.config.quant.bits
-        group = self.config.quant.group_size
+    @staticmethod
+    def _quantizable(path, leaf) -> bool:
+        """Matmul weights only: the reference's post-init quant skips
+        embeddings and norms (inference/quantization/utils.py)."""
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        return (getattr(leaf, "ndim", 0) >= 2 and "embed" not in name
+                and "norm" not in name.lower())
 
-        def maybe_q(path, leaf):
-            name = "/".join(str(getattr(p, "key", p)) for p in path)
-            if leaf.ndim < 2 or "embed" in name or "norm" in name.lower():
-                return leaf
-            if leaf.size % group != 0:
-                return leaf
-            return quantize_dequantize(jnp.asarray(leaf), num_bits=bits,
-                                       group_size=group)
+    def _shard_params_quantized(self, params):
+        """ZeRO-inference weight-only quantization with REAL int8 storage
+        (parity: inference/quantization/quantization.py + layers.py dequant-
+        on-the-fly): each matmul weight becomes {q: int8, s: fp32 row scales}
+        placed with the weight's TP sharding (scales replicate the sharded-out
+        last dim)."""
+        from deepspeed_tpu.runtime.zero.zeropp import quantize_leaf
+        topo = self.topology
 
-        return jax.tree_util.tree_map_with_path(maybe_q, params)
+        def base_sharding(leaf, spec):
+            return NamedSharding(topo.mesh, spec if spec is not None else P())
+
+        spec_tree = self._tp_specs
+        if spec_tree is None:
+            spec_tree = jax.tree_util.tree_map(lambda _: P(), params)
+
+        bits = int(self.config.quant.bits)
+        group = int(self.config.quant.group_size)
+
+        def one(path, leaf, spec):
+            sh = base_sharding(leaf, spec)
+            if not self._quantizable(path, leaf):
+                return jax.device_put(leaf, sh)
+            d = jax.jit(lambda x: quantize_leaf(x, num_bits=bits,
+                                                group_size=group))(jnp.asarray(leaf))
+            s_spec = list(spec) if spec else []
+            while len(s_spec) < leaf.ndim:
+                s_spec.append(None)
+            # scale shape is leaf.shape[:-1] + (n_groups, 1)
+            s_sh = NamedSharding(topo.mesh, P(*(s_spec[:-1] + [None, None])))
+            return {"q": jax.device_put(d["q"], sh),
+                    "s": jax.device_put(d["s"], s_sh)}
+
+        # leaves follow `params`; the spec subtree (a P or None) passes whole
+        return jax.tree_util.tree_map_with_path(one, params, spec_tree)
+
+    def _live_params(self, params):
+        """Dequantize inside jit (XLA fuses the int8*scale expansion into the
+        consuming matmuls; weights stay int8 in HBM)."""
+        if not self._weights_quantized:
+            return params
+        from deepspeed_tpu.runtime.zero.zeropp import dequantize_param_tree
+        return dequantize_param_tree(params, self._dtype)
 
     def _derive_specs(self, params):
         topo = self.topology
@@ -197,6 +233,7 @@ class InferenceEngine:
             mod = self.module
 
             def fwd(params, ids):
+                params = self._live_params(params)
                 return mod.apply({"params": params}, ids,
                                  method=type(mod).forward_logits)
 
@@ -210,11 +247,13 @@ class InferenceEngine:
         method = type(mod).decode
 
         def prefill(params, ids, cache):
+            params = self._live_params(params)
             logits, cache = mod.apply({"params": params}, ids, cache,
                                       jnp.int32(0), method=method)
             return logits[:, -1, :], cache
 
         def step(params, tok, cache, index):
+            params = self._live_params(params)
             logits, cache = mod.apply({"params": params}, tok, cache, index,
                                       method=method)
             return logits[:, -1, :], cache
@@ -282,6 +321,10 @@ class InferenceEngine:
         return self.topology.tp_world_size
 
     def module_state_dict(self):
+        """Plain weight tree (quantized storage is dequantized for export, so
+        the return shape is stable regardless of ``quant.enabled``)."""
+        if self._weights_quantized:
+            return jax.device_get(jax.jit(self._live_params)(self.params))
         return jax.device_get(self.params)
 
 

@@ -120,6 +120,9 @@ class DeepSpeedTPUEngine:
         self._tp_rules = tp_rules
         self._model_family = model_family
         self._tp_specs = None
+        # compression (parity: compression_training / init_compression wiring)
+        self._compression_plan = None
+        self.compression_scheduler = None
         if sub > 1 and self.topology.fsdp_sub_size == 1:
             from deepspeed_tpu.config import ConfigError
             raise ConfigError(
@@ -228,6 +231,22 @@ class DeepSpeedTPUEngine:
         explicit out_shardings so every tensor materialises directly in its
         partitioned layout — no full-model replication transient."""
         topo = self.topology
+        # compression plan over the full param tree (parity: init_compression
+        # walking the model, compression/compress.py); applied in _current_params
+        comp_cfg = getattr(self, "_compression_config", None)
+        if (self.config.compression_training or comp_cfg is not None) \
+                and self._compression_plan is None:
+            from deepspeed_tpu.compression import (CompressionConfig,
+                                                   CompressionScheduler,
+                                                   compile_compression_plan)
+            if comp_cfg is None:
+                comp_cfg = CompressionConfig.from_dict(
+                    self.config.compression_training)
+                self._compression_config = comp_cfg
+            self._compression_plan = compile_compression_plan(model_parameters,
+                                                              comp_cfg)
+            if self.compression_scheduler is None:
+                self.compression_scheduler = CompressionScheduler(comp_cfg)
         if self._tp_specs is None and (topo.tp_world_size > 1 or topo.ep_world_size > 1):
             specs = None
             if topo.tp_world_size > 1:
@@ -525,9 +544,15 @@ class DeepSpeedTPUEngine:
         if "params" in state:
             if self.quantized_weights:
                 from deepspeed_tpu.runtime.zero.zeropp import dequantize_param_tree
-                return dequantize_param_tree(state["params"], self.compute_dtype)
-            return state["params"]
-        return state["master"]
+                params = dequantize_param_tree(state["params"], self.compute_dtype)
+            else:
+                params = state["params"]
+        else:
+            params = state["master"]
+        if self._compression_plan is not None and self._compression_plan.leaves:
+            from deepspeed_tpu.compression import apply_compression
+            params = apply_compression(params, self._compression_plan, state["step"])
+        return params
 
     def _loss_of(self, params, batch, rngs=None):
         out = self._apply_fn(params, batch, rngs)
@@ -732,6 +757,8 @@ class DeepSpeedTPUEngine:
 
     def _after_step(self, metrics, count_micro_steps: bool = True):
         self.global_steps += 1
+        if self.compression_scheduler is not None:
+            self.compression_scheduler.step()
         self.global_samples += self.train_batch_size_
         if count_micro_steps:
             # facade path counts micro steps in backward(); fused path counts here

@@ -50,17 +50,31 @@ def _should_quantize(x) -> bool:
     return len(shape) >= 2 and int(np.prod(shape)) >= QWZ_MIN_SIZE
 
 
-def quantize_leaf(x: jax.Array) -> dict:
-    """Symmetric row-wise int8: scale over the last dim (one fp32 per row)."""
+def quantize_leaf(x: jax.Array, num_bits: int = 8,
+                  group_size: Optional[int] = None) -> dict:
+    """Symmetric group-wise intN in int8 storage.
+
+    Groups tile the last dim (``group_size`` columns per scale; default one
+    group per row). Scale shape is ``x.shape[:-1] + (n_groups, 1)`` so the
+    dequant broadcast needs no metadata beyond the two arrays.
+    """
     x32 = x.astype(jnp.float32)
-    scale = jnp.max(jnp.abs(x32), axis=-1, keepdims=True) / 127.0
+    d = x.shape[-1]
+    if group_size and 0 < group_size < d and d % group_size == 0:
+        g = x32.reshape(x.shape[:-1] + (d // group_size, group_size))
+    else:
+        g = x32.reshape(x.shape[:-1] + (1, d))
+    qmax = float(2 ** (num_bits - 1) - 1)
+    scale = jnp.max(jnp.abs(g), axis=-1, keepdims=True) / qmax
     scale = jnp.where(scale == 0.0, 1.0, scale)
-    q = jnp.clip(jnp.round(x32 / scale), -128, 127).astype(jnp.int8)
-    return {"q": q, "s": scale}
+    q = jnp.clip(jnp.round(g / scale), -qmax - 1, qmax).astype(jnp.int8)
+    return {"q": q.reshape(x.shape), "s": scale}
 
 
 def dequantize_leaf(d: dict, dtype) -> jax.Array:
-    return (d["q"].astype(jnp.float32) * d["s"]).astype(dtype)
+    q, s = d["q"], d["s"]
+    grouped = q.astype(jnp.float32).reshape(s.shape[:-1] + (-1,))
+    return (grouped * s).reshape(q.shape).astype(dtype)
 
 
 def quantize_param_tree(master: Any, dtype) -> Any:
@@ -87,7 +101,8 @@ def quantized_param_shardings(param_sh: Any, params_template: Any, mesh) -> Any:
         spec = list(sh.spec) if sh.spec else []
         while len(spec) < len(np.shape(x)):
             spec.append(None)
-        s_spec = P(*(spec[:-1] + [None]))
+        # scale has an extra (n_groups, 1) tail replacing the last dim
+        s_spec = P(*(spec[:-1] + [None, None]))
         return {"q": sh, "s": NamedSharding(mesh, s_spec)}
     return jax.tree_util.tree_map(one, param_sh, params_template)
 

@@ -1,0 +1,225 @@
+"""Compression tests: QAT, pruning, layer reduction, scheduler, engine wiring.
+
+Parity model: reference ``tests/unit/compression/test_compression.py`` —
+technique layers quantize/prune as configured, scheduler gates by step,
+redundancy_clean makes effects permanent.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.compression import (CompressionConfig, CompressionScheduler,
+                                       apply_compression, compile_compression_plan,
+                                       redundancy_clean)
+from deepspeed_tpu.compression import basic_layer as bl
+from deepspeed_tpu.compression.compress import apply_layer_reduction
+
+
+# --------------------------------------------------------------------------- #
+# primitives
+# --------------------------------------------------------------------------- #
+
+def test_quantize_weight_ste_grad_is_identity():
+    w = jax.random.normal(jax.random.PRNGKey(0), (32, 32))
+    g = jax.grad(lambda w: jnp.sum(bl.quantize_weight(w, 8) ** 2))(w)
+    g_ref = jax.grad(lambda w: jnp.sum(bl.quantize_weight(w, 8) ** 2))(w)
+    # STE: gradient flows as if through identity (not zero like round's grad)
+    assert np.abs(np.asarray(g)).max() > 0
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(g_ref))
+    # 8-bit quantization error is small
+    err = np.abs(np.asarray(bl.quantize_weight(w, 8)) - np.asarray(w)).max()
+    assert err < np.abs(np.asarray(w)).max() / 50
+
+
+def test_sparse_and_structured_pruning():
+    w = jax.random.normal(jax.random.PRNGKey(1), (16, 24))
+    sp = np.asarray(bl.sparse_prune(w, 0.25))
+    assert np.isclose((sp != 0).mean(), 0.25, atol=0.05)
+    rp = np.asarray(bl.row_prune(w, 0.5))
+    zero_rows = np.sum(~rp.any(axis=1))
+    assert zero_rows == 8
+    cp = np.asarray(bl.channel_prune(w, 0.5))
+    assert np.sum(~cp.any(axis=0)) == 12
+    hp = np.asarray(bl.head_prune(w, 0.5, num_heads=4))
+    heads = hp.reshape(4, 4, 24)
+    assert np.sum([not h.any() for h in heads]) == 2
+
+
+def test_activation_quantization():
+    x = jax.random.normal(jax.random.PRNGKey(2), (64,)) * 3
+    xq = np.asarray(bl.quantize_activation(x, bits=8))
+    assert np.abs(xq - np.asarray(x)).max() < np.abs(np.asarray(x)).max() / 60
+
+
+# --------------------------------------------------------------------------- #
+# plan + schedule
+# --------------------------------------------------------------------------- #
+
+_CFG = {
+    "weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 2,
+                              "quantize_groups": 1},
+        "different_groups": {
+            "wq1": {"params": {"start_bits": 8, "target_bits": 8},
+                    "modules": ["attn"]}},
+    },
+    "sparse_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                              "method": "l1"},
+        "different_groups": {
+            "sp1": {"params": {"dense_ratio": 0.5}, "modules": ["mlp"]}},
+    },
+}
+
+
+def _params():
+    k = jax.random.PRNGKey(0)
+    return {"attn": {"kernel": jax.random.normal(k, (16, 16)), "bias": jnp.ones((16,))},
+            "mlp": {"kernel": jax.random.normal(k, (16, 32))}}
+
+
+def test_plan_matches_modules_and_skips_biases():
+    cfg = CompressionConfig.from_dict(_CFG)
+    plan = compile_compression_plan(_params(), cfg)
+    assert "attn/kernel" in plan.leaves and "mlp/kernel" in plan.leaves
+    assert "attn/bias" not in plan.leaves  # 1-d leaves pass through
+
+
+def test_schedule_offset_gates_quantization():
+    cfg = CompressionConfig.from_dict(_CFG)
+    params = _params()
+    plan = compile_compression_plan(params, cfg)
+    at0 = apply_compression(params, plan, jnp.int32(0))
+    at5 = apply_compression(params, plan, jnp.int32(5))
+    # wq has offset 2: identical at step 0, quantized at step 5
+    np.testing.assert_array_equal(np.asarray(at0["attn"]["kernel"]),
+                                  np.asarray(params["attn"]["kernel"]))
+    assert not np.array_equal(np.asarray(at5["attn"]["kernel"]),
+                              np.asarray(params["attn"]["kernel"]))
+    # sparse pruning has offset 0: active at step 0
+    assert (np.asarray(at0["mlp"]["kernel"]) == 0).mean() > 0.4
+
+
+def test_scheduler_active_techniques():
+    cfg = CompressionConfig.from_dict(_CFG)
+    sched = CompressionScheduler(cfg)
+    assert sched.is_active("sparse_pruning") and not sched.is_active("weight_quantization")
+    sched.step(3)
+    assert sched.is_active("weight_quantization")
+
+
+def test_redundancy_clean_and_layer_reduction():
+    cfg = CompressionConfig.from_dict({
+        **_CFG,
+        "layer_reduction": {"enabled": True, "keep_number": 2,
+                            "module_name_prefix": "h",
+                            "teacher_layer": [0, 3]},
+    })
+    params = {f"h_{i}": {"kernel": jnp.full((8, 8), float(i))} for i in range(4)}
+    params["attn"] = {"kernel": jax.random.normal(jax.random.PRNGKey(1), (16, 16))}
+    cleaned = redundancy_clean(params, cfg)
+    assert set(k for k in cleaned if k.startswith("h_")) == {"h_0", "h_1"}
+    np.testing.assert_array_equal(np.asarray(cleaned["h_1"]["kernel"]),
+                                  np.full((8, 8), 3.0))  # teacher layer 3 -> student 1
+
+
+def test_unknown_technique_raises():
+    from deepspeed_tpu.config import ConfigError
+    with pytest.raises(ConfigError, match="unknown compression technique"):
+        CompressionConfig.from_dict({"bogus_pruning": {}})
+
+
+# --------------------------------------------------------------------------- #
+# engine integration
+# --------------------------------------------------------------------------- #
+
+def test_compression_in_engine_training():
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    model = GPT2LMHead(GPT2Config(vocab_size=64, n_positions=16, n_embd=32,
+                                  n_layer=2, n_head=2))
+    cfg = {
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "zero_optimization": {"stage": 1},
+        "mesh": {"data": -1},
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "compression_training": {
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 0},
+                "different_groups": {
+                    "wq1": {"params": {"target_bits": 8}, "modules": ["attn"]}}},
+        },
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    rng = np.random.default_rng(0)
+    losses = [float(engine.train_batch(
+        {"input_ids": rng.integers(0, 64, (8, 16)).astype(np.int32)}))
+        for _ in range(8)]
+    assert engine._compression_plan is not None and engine._compression_plan.leaves
+    assert engine.compression_scheduler.training_steps == 8
+    assert losses[-1] < losses[0]
+
+
+def test_init_compression_entry_point_before_and_after_first_step():
+    from deepspeed_tpu.compression import init_compression
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    model = GPT2LMHead(GPT2Config(vocab_size=64, n_positions=16, n_embd=32,
+                                  n_layer=2, n_head=2))
+    base = {
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "zero_optimization": {"stage": 1}, "mesh": {"data": -1},
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+    }
+    comp = {"sparse_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"sp": {"params": {"dense_ratio": 0.5},
+                                    "modules": ["mlp"]}}}}
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 64, (8, 16)).astype(np.int32)}
+
+    # attach BEFORE state exists: plan compiles lazily at first step
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=dict(base))
+    init_compression(engine, comp)
+    engine.train_batch(batch)
+    assert engine._compression_plan is not None and engine._compression_plan.leaves
+    assert engine.compression_scheduler is not None
+
+    # attach AFTER a jitted step: cached step drops, plan applies on retrace
+    engine2, _, _, _ = deepspeed_tpu.initialize(model=model, config=dict(base))
+    engine2.train_batch(batch)
+    assert engine2._compression_plan is None
+    init_compression(engine2, comp)
+    assert engine2._fused_step is None  # forced retrace
+    engine2.train_batch(batch)
+    assert engine2._compression_plan.leaves
+
+
+# --------------------------------------------------------------------------- #
+# inference weight-only quantization (true int8 storage)
+# --------------------------------------------------------------------------- #
+
+def test_inference_int8_weight_storage():
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM, init_cache
+    cfg = LlamaConfig.tiny(hidden_size=128, intermediate_size=256)
+    model = LlamaForCausalLM(cfg)
+    batch = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": batch})["params"]
+
+    eng_fp = ds.init_inference(model, model_parameters=params,
+                               config={"dtype": "float32"})
+    eng_q = ds.init_inference(model, model_parameters=params,
+                              config={"dtype": "float32",
+                                      "quant": {"enabled": True, "bits": 8}})
+    q_leaves = [l for l in jax.tree_util.tree_leaves(eng_q.params)
+                if getattr(l, "dtype", None) == jnp.int8]
+    assert q_leaves, "no int8 leaves stored"
+    ids = np.array([[3, 5, 7, 9, 11, 2, 4, 6]], np.int32)
+    lf = np.asarray(eng_fp.forward(ids))
+    lq = np.asarray(eng_q.forward(ids))
+    # int8 weights: logits close to fp run, same argmax mostly
+    agree = (lf.argmax(-1) == lq.argmax(-1)).mean()
+    assert agree > 0.7, agree
