@@ -53,7 +53,14 @@ def _topk_mask(scores: jax.Array, keep_ratio: float) -> jax.Array:
 
 
 def sparse_prune(w: jax.Array, dense_ratio: float, method: str = "l1") -> jax.Array:
-    """Unstructured magnitude pruning (parity: sparse_pruning, method l1/topk)."""
+    """Unstructured pruning (parity: sparse_pruning, method l1/topk).
+
+    Both methods rank by weight magnitude here: the reference's ``topk`` ranks
+    a *learned* score parameter (TopKBinarizer), which has no home in this
+    stateless functional design — magnitudes are the score.
+    """
+    if method not in ("l1", "topk"):
+        raise ValueError(f"sparse_pruning method must be l1|topk, got {method!r}")
     scores = jnp.abs(w.astype(jnp.float32))
     mask = _topk_mask(scores, dense_ratio)
     return ste(w * mask.astype(w.dtype), w)

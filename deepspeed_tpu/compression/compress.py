@@ -45,9 +45,18 @@ class CompressionPlan:
 
 
 def _matches(key: str, patterns: List[str]) -> bool:
+    """Substring, glob, or regex module patterns; dots match '/' too (the
+    reference re.search-es torch module paths like ``attention\\.self`` —
+    our keys are '/'-joined, so dotted patterns are tried both ways)."""
     for pat in patterns:
         if pat == "*" or pat in key or fnmatch.fnmatch(key, f"*{pat}*"):
             return True
+        for candidate in (pat, pat.replace("\\.", "/").replace(".", "/")):
+            try:
+                if re.search(candidate, key):
+                    return True
+            except re.error:
+                pass
     return False
 
 
@@ -106,11 +115,14 @@ def _apply_one(w, tech: dict, active) -> Any:
     return jnp.where(active, out, w)
 
 
-def apply_compression(params: Any, plan: CompressionPlan, step) -> Any:
+def apply_compression(params: Any, plan: CompressionPlan, step=None) -> Any:
     """Transform the param tree per plan; jit-safe (step may be traced).
 
     Parity: the compressed layers' forward pass (basic_layer.py) — each
     technique activates once ``step >= schedule_offset`` (scheduler.py).
+    ``step=None`` applies every technique unconditionally (the
+    ``redundancy_clean`` bake, which ignores schedule windows like the
+    reference's clean pass does).
     """
     if not plan.leaves:
         return params
@@ -120,10 +132,13 @@ def apply_compression(params: Any, plan: CompressionPlan, step) -> Any:
         w = flat[key]
         for tech in lp.techniques:
             shared = tech["shared"]
-            active = step >= shared.schedule_offset
-            if shared.schedule_offset_end is not None:
-                active = jnp.logical_and(active,
-                                         step < int(shared.schedule_offset_end))
+            if step is None:
+                active = jnp.bool_(True)
+            else:
+                active = step >= shared.schedule_offset
+                if shared.schedule_offset_end is not None:
+                    active = jnp.logical_and(
+                        active, step < int(shared.schedule_offset_end))
             w = _apply_one(w, tech, active)
         flat[key] = w
     return unflatten_into(params, flat)
@@ -154,7 +169,12 @@ def init_compression(engine, deepspeed_config=None) -> Any:
                 "compression_training config block before initialize() instead")
         engine._compression_plan = compile_compression_plan(
             engine.state["master"], cfg)
-        engine._fused_step = None  # retrace with the plan applied
+        # drop every cached jitted step (fused + micro-step facade) so the
+        # next batch retraces with the plan applied
+        engine._fused_step = None
+        engine._micro_step = None
+        engine._apply_step = None
+        engine._eval_step = None
     return engine
 
 
@@ -163,7 +183,7 @@ def redundancy_clean(params: Any, config: CompressionConfig,
     """Make compression permanent (parity: ``redundancy_clean`` compress.py):
     bake masks/quantization into the weights and apply layer reduction."""
     plan = plan or compile_compression_plan(params, config)
-    baked = apply_compression(params, plan, jnp.int32(2 ** 30))
+    baked = apply_compression(params, plan, step=None)
     if config.layer_reduction.enabled:
         baked = apply_layer_reduction(baked, config.layer_reduction)
     return baked

@@ -399,7 +399,8 @@ class DeepSpeedTPUEngine:
         dev_names, host_names = self._offload_dev_names, self._offload_host_names
 
         def step_fn(state, batch):
-            params = state["params"]
+            # _current_params applies the compression plan when configured
+            params = self._current_params(state)
             scale = state["scaler"]["scale"] if fp16.enabled else jnp.float32(1.0)
             grads, losses = self._accumulate_grads(params, scale, batch)
             flat_g = flatten_tree(grads)

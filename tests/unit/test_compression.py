@@ -26,10 +26,9 @@ from deepspeed_tpu.compression.compress import apply_layer_reduction
 def test_quantize_weight_ste_grad_is_identity():
     w = jax.random.normal(jax.random.PRNGKey(0), (32, 32))
     g = jax.grad(lambda w: jnp.sum(bl.quantize_weight(w, 8) ** 2))(w)
-    g_ref = jax.grad(lambda w: jnp.sum(bl.quantize_weight(w, 8) ** 2))(w)
-    # STE: gradient flows as if through identity (not zero like round's grad)
-    assert np.abs(np.asarray(g)).max() > 0
-    np.testing.assert_array_equal(np.asarray(g), np.asarray(g_ref))
+    # STE treats the quantizer as identity in backward: d/dw sum(q^2) = 2*q
+    expected = 2.0 * np.asarray(bl.quantize_weight(w, 8))
+    np.testing.assert_allclose(np.asarray(g), expected, rtol=1e-5)
     # 8-bit quantization error is small
     err = np.abs(np.asarray(bl.quantize_weight(w, 8)) - np.asarray(w)).max()
     assert err < np.abs(np.asarray(w)).max() / 50

@@ -1,0 +1,200 @@
+"""Block-sparse attention + Evoformer attention tests.
+
+Parity model: reference ``tests/unit/ops/sparse_attention`` (layout shapes,
+pattern membership, softmax equivalence on active blocks) and
+``tests/unit/ops/deepspeed4science`` (evoformer fwd/bwd vs naive attention).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.evoformer import (DS4Sci_EvoformerAttention,
+                                         evoformer_attention,
+                                         msa_row_attention_mask_bias)
+from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                BSLongformerSparsityConfig,
+                                                DenseSparsityConfig,
+                                                FixedSparsityConfig,
+                                                VariableSparsityConfig,
+                                                layout_to_mask,
+                                                sparse_self_attention,
+                                                sparsity_ratio)
+
+
+# --------------------------------------------------------------------------- #
+# layouts
+# --------------------------------------------------------------------------- #
+
+def test_dense_layout_all_active():
+    layout = DenseSparsityConfig(num_heads=4, block=16).make_layout(64)
+    assert layout.shape == (4, 4, 4) and layout.all()
+
+
+def test_fixed_layout_local_and_global():
+    cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2,
+                              num_global_blocks=1)
+    layout = cfg.make_layout(128)  # 8 blocks
+    assert layout.shape == (2, 8, 8)
+    # local window [0,2) fully connected
+    assert layout[0, 0, 1] == 1 and layout[0, 1, 0] == 1
+    # block 4 does not see local block 0...
+    # ...but global columns (last of each window: 1, 3, 5, 7) are visible everywhere
+    assert layout[0, 4, 1] == 1 and layout[0, 2, 7] == 1
+    assert 0 < sparsity_ratio(layout) < 1
+
+
+def test_fixed_unidirectional_is_block_lower_triangular():
+    cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=4,
+                              attention="unidirectional")
+    layout = cfg.make_layout(128)
+    assert np.array_equal(layout, np.tril(layout))
+
+
+def test_bigbird_layout_components():
+    cfg = BigBirdSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                num_sliding_window_blocks=3, num_global_blocks=1)
+    layout = cfg.make_layout(160)  # 10 blocks
+    # sliding window
+    for i in range(10):
+        assert layout[0, i, i] == 1
+        if i > 0:
+            assert layout[0, i, i - 1] == 1
+    # global first block row+column
+    assert layout[0, :, 0].all() and layout[0, 0, :].all()
+
+
+def test_bslongformer_layout():
+    cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[2])
+    layout = cfg.make_layout(128)
+    assert layout[0, :, 2].all() and layout[0, 2, :].all()
+    assert layout[0, 7, 0] == 0  # far off-window, non-global
+
+
+def test_variable_layout_windows_and_random():
+    cfg = VariableSparsityConfig(num_heads=1, block=16, num_random_blocks=1,
+                                 local_window_blocks=[1, 2],
+                                 global_block_indices=[0], seed=3)
+    layout = cfg.make_layout(128)
+    assert layout[0, :, 0].all()          # global col
+    assert layout[0, 1, 2] == 1 and layout[0, 2, 1] == 1  # window [1,3)
+    assert sparsity_ratio(layout) < 1.0
+
+
+def test_different_layout_per_head():
+    cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=2,
+                              num_global_blocks=1,
+                              different_layout_per_head=True,
+                              num_different_global_patterns=2)
+    layout = cfg.make_layout(128)
+    assert not np.array_equal(layout[0], layout[1])
+    same = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=2)
+    layout2 = same.make_layout(128)
+    assert np.array_equal(layout2[0], layout2[3])
+
+
+def test_seq_len_divisibility_check():
+    with pytest.raises(ValueError, match="divisible"):
+        DenseSparsityConfig(num_heads=1, block=16).make_layout(100)
+
+
+# --------------------------------------------------------------------------- #
+# attention numerics
+# --------------------------------------------------------------------------- #
+
+def _qkv(B=2, H=2, S=64, D=16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (B, H, S, D)) for k in ks)
+
+
+def test_dense_config_matches_full_attention():
+    q, k, v = _qkv()
+    out = sparse_self_attention(q, k, v, DenseSparsityConfig(num_heads=2, block=16))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(16)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_sparse_attention_respects_layout():
+    """Perturbing keys in masked-out blocks must not change the output."""
+    q, k, v = _qkv(H=1)
+    cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                     num_sliding_window_blocks=1,
+                                     global_block_indices=[0])
+    out1 = sparse_self_attention(q, k, v, cfg)
+    # block (3) row only sees blocks {0 (global), 3 (diag)} -> perturb block 2
+    k2 = k.at[:, :, 32:48, :].add(100.0)
+    v2 = v.at[:, :, 32:48, :].add(100.0)
+    out2 = sparse_self_attention(q, k2, v2, cfg)
+    np.testing.assert_allclose(np.asarray(out1[:, :, 48:64]),
+                               np.asarray(out2[:, :, 48:64]), atol=1e-5)
+    # but rows in block 2 itself DO change
+    assert not np.allclose(np.asarray(out1[:, :, 32:48]),
+                           np.asarray(out2[:, :, 32:48]), atol=1e-3)
+
+
+def test_unidirectional_token_level_causality():
+    q, k, v = _qkv(H=1, S=32)
+    cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=2,
+                              attention="unidirectional")
+    out1 = sparse_self_attention(q, k, v, cfg)
+    k2 = k.at[:, :, 10:, :].add(50.0)  # future tokens for position 5
+    v2 = v.at[:, :, 10:, :].add(50.0)
+    out2 = sparse_self_attention(q, k2, v2, cfg)
+    np.testing.assert_allclose(np.asarray(out1[:, :, :10]),
+                               np.asarray(out2[:, :, :10]), atol=1e-5)
+
+
+def test_key_padding_mask():
+    q, k, v = _qkv(H=1, S=32)
+    cfg = DenseSparsityConfig(num_heads=1, block=16)
+    pad = jnp.ones((2, 32)).at[:, 24:].set(0)
+    out = sparse_self_attention(q, k, v, cfg, key_padding_mask=pad)
+    v2 = v.at[:, :, 24:, :].add(100.0)
+    out2 = sparse_self_attention(q, k, v2, cfg, key_padding_mask=pad)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=1e-5)
+
+
+# --------------------------------------------------------------------------- #
+# evoformer
+# --------------------------------------------------------------------------- #
+
+def test_evoformer_matches_naive_and_biases_apply():
+    B, N, S, H, D = 2, 3, 16, 4, 8
+    ks = jax.random.split(jax.random.PRNGKey(1), 5)
+    q, k, v = (jax.random.normal(x, (B, N, S, H, D)) for x in ks[:3])
+    bias1 = jax.random.normal(ks[3], (B, N, 1, 1, S))   # per-key bias
+    bias2 = jax.random.normal(ks[4], (B, 1, H, S, S))   # pair bias
+    out = DS4Sci_EvoformerAttention(q, k, v, [bias1, bias2])
+    assert out.shape == (B, N, S, H, D)
+    scores = jnp.einsum("bnqhd,bnkhd->bnhqk", q, k) / np.sqrt(D)
+    scores = scores + bias1 + bias2
+    ref = jnp.einsum("bnhqk,bnkhd->bnqhd", jax.nn.softmax(scores, -1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    with pytest.raises(ValueError):
+        DS4Sci_EvoformerAttention(q, k, v, [bias1, bias2, bias1])
+
+
+def test_evoformer_mask_bias_blocks_padded_keys():
+    B, N, S, H, D = 1, 2, 8, 2, 4
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q, k, v = (jax.random.normal(x, (B, N, S, H, D)) for x in ks)
+    mask = jnp.ones((B, N, S)).at[:, :, 6:].set(0)
+    bias = msa_row_attention_mask_bias(mask)
+    out1 = evoformer_attention(q, k, v, [bias])
+    v2 = v.at[:, :, 6:].add(99.0)
+    out2 = evoformer_attention(q, k, v2, [bias])
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=1e-5)
+
+
+def test_evoformer_grads_flow_to_biases():
+    B, N, S, H, D = 1, 1, 8, 1, 4
+    ks = jax.random.split(jax.random.PRNGKey(3), 4)
+    q, k, v = (jax.random.normal(x, (B, N, S, H, D)) for x in ks[:3])
+    bias2 = jax.random.normal(ks[3], (B, 1, H, S, S))
+    g = jax.grad(lambda b: jnp.sum(evoformer_attention(q, k, v, [b]) ** 2))(bias2)
+    assert np.abs(np.asarray(g)).max() > 0  # reference attention_bwd parity
