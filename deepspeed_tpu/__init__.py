@@ -47,7 +47,18 @@ def initialize(args=None,
 
     config = DeepSpeedTPUConfig.load(config if config is not None else config_params)
     comm.init_distributed()
-    engine = DeepSpeedTPUEngine(
+    engine_cls = DeepSpeedTPUEngine
+    engine_kwargs = {}
+    if config.hybrid_engine.enabled:
+        # parity: deepspeed.initialize returning DeepSpeedHybridEngine
+        # (__init__.py:156-196) when hybrid_engine.enabled
+        from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedTPUHybridEngine
+        engine_cls = DeepSpeedTPUHybridEngine
+        engine_kwargs["inference_config"] = {
+            "tensor_parallel": {"tp_size": config.hybrid_engine.inference_tp_size},
+            "max_out_tokens": config.hybrid_engine.max_out_tokens,
+        }
+    engine = engine_cls(
         args=args,
         model=model,
         optimizer=optimizer,
@@ -60,6 +71,7 @@ def initialize(args=None,
         rngs=rngs,
         tp_rules=tp_rules,
         model_family=model_family,
+        **engine_kwargs,
     )
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 

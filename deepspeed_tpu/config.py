@@ -519,6 +519,33 @@ class MeshConfig(ConfigModel):
 
 
 # --------------------------------------------------------------------------- #
+# Hybrid engine (RLHF) + progressive layer drop
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class HybridEngineConfig(ConfigModel):
+    """Parity: ``hybrid_engine`` block (``runtime/hybrid_engine.py`` /
+    ``runtime/config.py`` hybrid engine section)."""
+
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+
+
+@dataclass
+class ProgressiveLayerDropConfig(ConfigModel):
+    """Parity: ``progressive_layer_drop`` block (engine.py:1812 hook)."""
+
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
+# --------------------------------------------------------------------------- #
 # Checkpoint
 # --------------------------------------------------------------------------- #
 
@@ -576,6 +603,9 @@ class DeepSpeedTPUConfig(ConfigModel):
     data_efficiency: DataEfficiencyConfig = field(default_factory=DataEfficiencyConfig)
     curriculum_learning: CurriculumLearningConfig = field(default_factory=CurriculumLearningConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    hybrid_engine: HybridEngineConfig = field(default_factory=HybridEngineConfig)
+    progressive_layer_drop: ProgressiveLayerDropConfig = field(
+        default_factory=ProgressiveLayerDropConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
 
     # precision of gradient accumulation buffer (parity: data_types.grad_accum_dtype)

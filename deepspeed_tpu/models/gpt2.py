@@ -111,9 +111,24 @@ class GPT2LMHead(nn.Module):
             input_ids, labels = batch, None
         B, T = input_ids.shape
         x = self.wte(input_ids) + self.wpe(jnp.arange(T)[None, :])
-        x = apply_checkpointed_layers(
-            self, x, lambda mdl, h, i: mdl.blocks[i](h, deterministic),
-            cfg.n_layer, cfg.remat, cfg.remat_policy)
+        pld_theta = batch.get("pld_theta") if isinstance(batch, dict) else None
+        if pld_theta is not None:
+            # progressive layer drop (engine-injected; parity: PLD hook
+            # engine.py:1812 + runtime/progressive_layer_drop.py): deeper
+            # layers drop with higher probability, whole-batch Bernoulli
+            from deepspeed_tpu.runtime.progressive_layer_drop import \
+                apply_layer_drop
+            theta0 = pld_theta[0]
+            key0 = batch["pld_rng"][0]
+            for i in range(cfg.n_layer):
+                keep = 1.0 - (i / cfg.n_layer) * (1.0 - theta0)
+                x_new = self.blocks[i](x, deterministic)
+                x = apply_layer_drop(x_new, x, keep,
+                                     jax.random.fold_in(key0, i))
+        else:
+            x = apply_checkpointed_layers(
+                self, x, lambda mdl, h, i: mdl.blocks[i](h, deterministic),
+                cfg.n_layer, cfg.remat, cfg.remat_policy)
         x = self.ln_f(x)
         logits = self.wte.attend(x.astype(jnp.float32))  # tied LM head, fp32 logits
 

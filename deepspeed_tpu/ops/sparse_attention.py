@@ -81,6 +81,10 @@ class FixedSparsityConfig(SparsityConfig):
         if horizontal_global_attention and attention != "bidirectional":
             raise ValueError("horizontal global attention requires bidirectional")
         self.horizontal_global_attention = horizontal_global_attention
+        if num_different_global_patterns > 1 and not different_layout_per_head:
+            raise ValueError(
+                "num_different_global_patterns > 1 requires "
+                "different_layout_per_head (parity: sparsity_config.py)")
         self.num_different_global_patterns = num_different_global_patterns
 
     def make_layout(self, seq_len: int) -> np.ndarray:

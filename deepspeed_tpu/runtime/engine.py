@@ -186,6 +186,15 @@ class DeepSpeedTPUEngine:
         from deepspeed_tpu.monitor import MonitorMaster
         self.monitor = MonitorMaster(self.config)
 
+        # -- progressive layer drop (parity: engine hook :1812) ------------
+        self.progressive_layer_drop = None
+        if self.config.progressive_layer_drop.enabled:
+            from deepspeed_tpu.runtime.progressive_layer_drop import \
+                ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=self.config.progressive_layer_drop.theta,
+                gamma=self.config.progressive_layer_drop.gamma)
+
         # -- curriculum learning (parity: data-pipeline hook engine.py:1823)
         self.curriculum_scheduler = None
         if self.config.curriculum_learning.enabled:
@@ -722,6 +731,18 @@ class DeepSpeedTPUEngine:
                 lambda x: np.asarray(x)[:, :seqlen]
                 if getattr(np.asarray(x), "ndim", 0) >= 2 else np.asarray(x),
                 batch)
+        if self.progressive_layer_drop is not None and isinstance(batch, dict):
+            # thread theta + a per-step key through the batch so the jitted
+            # step sees them as inputs (no retrace per theta change); models
+            # read batch["pld_theta"]/["pld_rng"] (parity: engine.py:1812
+            # passing pld state into module kwargs)
+            batch = dict(batch)
+            theta = self.progressive_layer_drop.get_theta()
+            batch["pld_theta"] = np.full((self.train_batch_size_,), theta,
+                                         np.float32)
+            self._rng, k = jax.random.split(self._rng)
+            batch["pld_rng"] = np.asarray(
+                jax.random.split(k, self.train_batch_size_))
         self.tput_timer.start()
         self.timers(STEP_GLOBAL_TIMER).start()
         sharded = self._shard_global_batch(batch)
@@ -760,6 +781,8 @@ class DeepSpeedTPUEngine:
         self.global_steps += 1
         if self.compression_scheduler is not None:
             self.compression_scheduler.step()
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
         self.global_samples += self.train_batch_size_
         if count_micro_steps:
             # facade path counts micro steps in backward(); fused path counts here

@@ -1,0 +1,49 @@
+"""Progressive layer drop (stochastic depth schedule).
+
+Parity: ``ProgressiveLayerDrop`` (reference ``runtime/progressive_layer_drop.py``,
+40 LoC; engine hook :1812): theta(t) = theta_bar + (1 - theta_bar) *
+exp(-gamma * t), descending from 1 toward theta_bar; layer i of L keeps
+samples with probability 1 - (i / L) * (1 - theta(t)) (PLD paper,
+arXiv:2010.13369). Models draw the Bernoulli with a per-step PRNG key.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        """theta decays 1 -> theta_bar (reference update_state)."""
+        self.current_theta = (1.0 - self.theta) * math.exp(
+            -self.gamma * global_step) + self.theta
+        return self.current_theta
+
+    def keep_prob(self, layer_idx: int, n_layers: int) -> float:
+        """Layer-wise keep probability (deeper layers drop more)."""
+        return 1.0 - (layer_idx / max(1, n_layers)) * (1.0 - self.current_theta)
+
+
+def apply_layer_drop(x_new: jax.Array, x_skip: jax.Array, keep_prob,
+                     rng: jax.Array, deterministic: bool = False) -> jax.Array:
+    """Stochastic-depth residual combine: keep the layer's output with
+    probability ``keep_prob`` (scaled), else pass the skip branch — jit-safe.
+    """
+    if deterministic:
+        return x_new
+    keep = jax.random.bernoulli(rng, keep_prob)
+    return jnp.where(keep, x_skip + (x_new - x_skip) / keep_prob, x_skip)

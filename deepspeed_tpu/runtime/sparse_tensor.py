@@ -1,0 +1,62 @@
+"""Sparse gradient container (embedding-gradient allreduce path).
+
+Parity: ``SparseTensor`` (reference ``runtime/sparse_tensor.py``, 68 LoC) and
+the engine's ``sparse_allreduce`` (engine.py:2438): torch sparse embedding
+grads are exchanged as (indices, values) to avoid densifying huge vocab
+matrices over NCCL. Under XLA, embedding backward is a scatter-add the
+compiler keeps fused and the DP reduction runs on the dense [vocab, d] grad —
+there is no torch-sparse layout to preserve — so this container exists for
+API parity and for host-side sparse exchange (e.g. the data analyzer or
+custom collectives), with exact to_dense/from_dense round-trips.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import numpy as np
+
+
+@dataclass
+class SparseTensor:
+    """COO over the leading dim (row-sparse, like torch embedding grads)."""
+
+    indices: np.ndarray          # [nnz] int32 row ids
+    values: np.ndarray           # [nnz, ...] row payloads
+    dense_size: Tuple[int, ...]  # full shape
+
+    @classmethod
+    def from_dense(cls, dense: np.ndarray) -> "SparseTensor":
+        dense = np.asarray(dense)
+        rows = np.nonzero(np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+        return cls(indices=rows.astype(np.int32), values=dense[rows],
+                   dense_size=tuple(dense.shape))
+
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros(self.dense_size, self.values.dtype)
+        np.add.at(out, self.indices, self.values)
+        return out
+
+    def to_coo_tensor(self):
+        return self.indices, self.values
+
+    @property
+    def nnz_rows(self) -> int:
+        return int(self.indices.shape[0])
+
+    def sparse_size(self) -> Tuple[int, int]:
+        """(stored elements, dense elements) — the reference's size report."""
+        return self.values.size + self.indices.size, int(np.prod(self.dense_size))
+
+    @staticmethod
+    def type() -> str:
+        return "deepspeed.SparseTensor"
+
+    def add(self, other: "SparseTensor") -> "SparseTensor":
+        if self.dense_size != other.dense_size:
+            raise ValueError("sparse add: shape mismatch")
+        return SparseTensor(
+            indices=np.concatenate([self.indices, other.indices]),
+            values=np.concatenate([self.values, other.values]),
+            dense_size=self.dense_size)

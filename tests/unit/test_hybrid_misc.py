@@ -1,0 +1,179 @@
+"""Hybrid engine, eigenvalue, progressive layer drop, sparse tensor tests.
+
+Parity model: reference ``tests/hybrid_engine`` (train + generate on one
+engine), eigenvalue unit behavior, PLD theta schedule, SparseTensor
+round-trips.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.runtime.progressive_layer_drop import (ProgressiveLayerDrop,
+                                                          apply_layer_drop)
+from deepspeed_tpu.runtime.sparse_tensor import SparseTensor
+
+
+# --------------------------------------------------------------------------- #
+# hybrid engine
+# --------------------------------------------------------------------------- #
+
+def test_hybrid_engine_train_and_generate():
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    model = LlamaForCausalLM(LlamaConfig.tiny())
+    cfg = {
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "zero_optimization": {"stage": 3},
+        "mesh": {"data": 1, "fsdp": 8},
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "hybrid_engine": {"enabled": True, "max_out_tokens": 16},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedTPUHybridEngine
+    assert isinstance(engine, DeepSpeedTPUHybridEngine)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, (8, 16)).astype(np.int32)}
+    engine.train_batch(batch)
+
+    prompt = np.array([[5, 9, 2]], np.int32)
+    out1 = np.asarray(engine.generate(prompt, max_new_tokens=4))
+    assert out1.shape == (1, 7)
+    assert engine.generate_count == 1 and engine.generate_time > 0
+
+    # weights change -> generation sees the NEW weights (the RLHF contract)
+    before = jax.device_get(jax.tree_util.tree_leaves(
+        engine._inference_engine().params)[0])
+    for _ in range(4):
+        engine.train_batch({"input_ids": rng.integers(0, 256, (8, 16)).astype(np.int32)})
+    out2 = np.asarray(engine.generate(prompt, max_new_tokens=4))
+    assert out2.shape == (1, 7)
+    after = jax.device_get(jax.tree_util.tree_leaves(
+        engine._inference_engine().params)[0])
+    assert not np.allclose(np.asarray(before, np.float32),
+                           np.asarray(after, np.float32)), \
+        "inference params not refreshed from training weights"
+    assert engine.generate_count == 2
+
+    engine.eval()
+    assert engine._in_eval
+    engine.train()
+    assert not engine._in_eval
+
+
+# --------------------------------------------------------------------------- #
+# eigenvalue
+# --------------------------------------------------------------------------- #
+
+def test_eigenvalue_quadratic_exact():
+    """For loss = 0.5 x^T A x the Hessian is A: power iteration must find the
+    largest |eigenvalue| of each block."""
+    a_diag = jnp.array([3.0, 1.0, 0.5])
+    b_diag = jnp.array([7.0, 2.0])
+    params = {"a": jnp.ones((3,)), "b": jnp.ones((2,))}
+
+    def loss(p):
+        return 0.5 * jnp.sum(a_diag * p["a"] ** 2) + \
+            0.5 * jnp.sum(b_diag * p["b"] ** 2)
+
+    ev = Eigenvalue(max_iter=200, tol=1e-5).compute_eigenvalue(loss, params)
+    assert abs(ev["a"] - 3.0) < 0.05
+    assert abs(ev["b"] - 7.0) < 0.05
+
+
+def test_eigenvalue_post_process_fills_zeros():
+    e = Eigenvalue()
+    out = e.post_process({"x": 0.0, "y": 4.0})
+    assert out == {"x": 4.0, "y": 4.0}
+
+
+def test_eigenvalue_on_model_loss():
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    model = GPT2LMHead(GPT2Config(vocab_size=32, n_positions=8, n_embd=16,
+                                  n_layer=1, n_head=2))
+    batch = {"input_ids": jnp.arange(16, dtype=jnp.int32).reshape(2, 8) % 32}
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    ev = Eigenvalue(max_iter=8, tol=1e-1).compute_eigenvalue(
+        lambda p: model.apply({"params": p}, batch), params)
+    assert set(ev) == set(params)
+    assert all(np.isfinite(v) for v in ev.values())
+
+
+# --------------------------------------------------------------------------- #
+# progressive layer drop
+# --------------------------------------------------------------------------- #
+
+def test_pld_theta_schedule_descends_to_theta_bar():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0
+    thetas = [pld.update_state(t) for t in range(0, 1000, 100)]
+    assert all(thetas[i] >= thetas[i + 1] for i in range(len(thetas) - 1))
+    assert abs(thetas[-1] - 0.5) < 0.01
+    assert pld.get_state()["progressive_layer_drop"]
+    # deeper layers keep less
+    assert pld.keep_prob(0, 12) >= pld.keep_prob(11, 12)
+
+
+def test_pld_apply_layer_drop():
+    x_new = jnp.full((4,), 2.0)
+    x_skip = jnp.zeros((4,))
+    out_det = apply_layer_drop(x_new, x_skip, 0.5, jax.random.PRNGKey(0),
+                               deterministic=True)
+    np.testing.assert_array_equal(np.asarray(out_det), np.asarray(x_new))
+    # stochastic: either skip (0) or scaled-kept ((2-0)/0.5 = 4)
+    outs = {float(apply_layer_drop(x_new, x_skip, 0.5,
+                                   jax.random.PRNGKey(s))[0])
+            for s in range(20)}
+    assert outs <= {0.0, 4.0} and len(outs) == 2
+
+
+def test_pld_engine_wiring_changes_training():
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    model = GPT2LMHead(GPT2Config(vocab_size=64, n_positions=16, n_embd=32,
+                                  n_layer=2, n_head=2))
+    base = {
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "mesh": {"data": -1},
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+    }
+
+    def run(extra):
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                                   config={**base, **extra})
+        rng = np.random.default_rng(0)
+        losses = [float(engine.train_batch(
+            {"input_ids": rng.integers(0, 64, (8, 16)).astype(np.int32)}))
+            for _ in range(4)]
+        return engine, losses
+
+    # aggressive theta so layers actually drop on the tiny net
+    eng, pld_losses = run({"progressive_layer_drop":
+                           {"enabled": True, "theta": 0.3, "gamma": 10.0}})
+    assert eng.progressive_layer_drop is not None
+    assert eng.progressive_layer_drop.get_theta() < 0.5
+    _, plain_losses = run({})
+    # stochastic depth must actually alter the loss trajectory
+    assert not np.allclose(pld_losses[1:], plain_losses[1:], atol=1e-4), \
+        (pld_losses, plain_losses)
+
+
+# --------------------------------------------------------------------------- #
+# sparse tensor
+# --------------------------------------------------------------------------- #
+
+def test_sparse_tensor_roundtrip_and_add():
+    dense = np.zeros((10, 4), np.float32)
+    dense[2] = 1.0
+    dense[7] = 3.0
+    st = SparseTensor.from_dense(dense)
+    assert st.nnz_rows == 2
+    np.testing.assert_array_equal(st.to_dense(), dense)
+    stored, total = st.sparse_size()
+    assert stored < total
+    st2 = st.add(SparseTensor.from_dense(dense))
+    np.testing.assert_array_equal(st2.to_dense(), dense * 2)  # duplicate rows sum
+    with pytest.raises(ValueError):
+        st.add(SparseTensor.from_dense(np.zeros((5, 4), np.float32)))
