@@ -1,0 +1,12 @@
+"""Autotuning: search over ZeRO stage / micro-batch / remat configurations.
+
+Parity: reference ``deepspeed/autotuning/`` (``Autotuner`` autotuner.py:42,
+``ResourceManager`` scheduler.py:33, tuners in ``autotuning/tuner/``).
+"""
+
+from deepspeed_tpu.autotuning.autotuner import Autotuner, Experiment
+from deepspeed_tpu.autotuning.tuner import (GridSearchTuner, ModelBasedTuner,
+                                            RandomTuner, build_tuner)
+
+__all__ = ["Autotuner", "Experiment", "GridSearchTuner", "RandomTuner",
+           "ModelBasedTuner", "build_tuner"]

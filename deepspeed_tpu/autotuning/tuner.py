@@ -1,0 +1,106 @@
+"""Search strategies over config candidates.
+
+Parity: reference ``autotuning/tuner/{base_tuner,index_based_tuner,
+model_based_tuner}.py`` — GridSearchTuner (exhaustive, ordered), RandomTuner
+(shuffled), ModelBasedTuner (fits a surrogate on observed results and explores
+the most promising remaining candidate).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class BaseTuner:
+    def __init__(self, space: List[Dict[str, Any]], seed: int = 0):
+        self.space = list(space)
+        self.results: List[Tuple[Dict[str, Any], Optional[float]]] = []
+        self.seed = seed
+
+    def has_next(self) -> bool:
+        return len(self.results) < len(self.space)
+
+    def next_trial(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def record(self, candidate: Dict[str, Any], score: Optional[float]):
+        """score None => infeasible (OOM/compile failure)."""
+        self.results.append((candidate, score))
+
+    def best(self) -> Tuple[Optional[Dict[str, Any]], Optional[float]]:
+        feasible = [(c, s) for c, s in self.results if s is not None]
+        if not feasible:
+            return None, None
+        return max(feasible, key=lambda t: t[1])
+
+
+class GridSearchTuner(BaseTuner):
+    """Exhaustive in declared order (index_based_tuner.py)."""
+
+    def next_trial(self) -> Dict[str, Any]:
+        return self.space[len(self.results)]
+
+
+class RandomTuner(BaseTuner):
+    """Shuffled exhaustive (index_based_tuner.py RandomTuner)."""
+
+    def __init__(self, space, seed: int = 0):
+        super().__init__(space, seed)
+        order = list(range(len(self.space)))
+        random.Random(seed).shuffle(order)
+        self._order = order
+
+    def next_trial(self) -> Dict[str, Any]:
+        return self.space[self._order[len(self.results)]]
+
+
+class ModelBasedTuner(BaseTuner):
+    """Nearest-neighbour surrogate (model_based_tuner.py, simplified): after
+    each observation, pick the unexplored candidate closest (in normalized
+    knob space) to the current best — exploit-first with grid fallback."""
+
+    def __init__(self, space, seed: int = 0):
+        super().__init__(space, seed)
+        self._tried: set = set()
+
+    def _key(self, c: Dict[str, Any]) -> tuple:
+        return tuple(sorted((k, str(v)) for k, v in c.items()))
+
+    def _distance(self, a: Dict[str, Any], b: Dict[str, Any]) -> float:
+        d = 0.0
+        for k in set(a) | set(b):
+            va, vb = a.get(k), b.get(k)
+            if isinstance(va, (int, float)) and isinstance(vb, (int, float)):
+                denom = max(abs(va), abs(vb), 1e-9)
+                d += abs(va - vb) / denom
+            elif va != vb:
+                d += 1.0
+        return d
+
+    def next_trial(self) -> Dict[str, Any]:
+        remaining = [c for c in self.space if self._key(c) not in self._tried]
+        best, score = self.best()
+        if best is None:
+            cand = remaining[0]
+        else:
+            cand = min(remaining, key=lambda c: self._distance(c, best))
+        self._tried.add(self._key(cand))
+        return cand
+
+    def record(self, candidate, score):
+        self._tried.add(self._key(candidate))
+        super().record(candidate, score)
+
+
+def build_tuner(tuner_type: str, space: List[Dict[str, Any]], seed: int = 0
+                ) -> BaseTuner:
+    key = tuner_type.lower().replace("_", "")
+    if key in ("gridsearch", "grid"):
+        return GridSearchTuner(space, seed)
+    if key == "random":
+        return RandomTuner(space, seed)
+    if key in ("modelbased", "model"):
+        return ModelBasedTuner(space, seed)
+    raise ValueError(f"unknown tuner_type '{tuner_type}' "
+                     "(gridsearch|random|model_based)")

@@ -115,20 +115,23 @@ class GPT2LMHead(nn.Module):
         if pld_theta is not None:
             # progressive layer drop (engine-injected; parity: PLD hook
             # engine.py:1812 + runtime/progressive_layer_drop.py): deeper
-            # layers drop with higher probability, whole-batch Bernoulli
-            from deepspeed_tpu.runtime.progressive_layer_drop import \
-                apply_layer_drop
+            # layers drop with higher probability, whole-batch Bernoulli.
+            # Composed INSIDE the checkpointed layer application so remat
+            # still bounds activation memory.
+            from deepspeed_tpu.runtime.progressive_layer_drop import (
+                apply_layer_drop, pld_keep_prob)
             theta0 = pld_theta[0]
             key0 = batch["pld_rng"][0]
-            for i in range(cfg.n_layer):
-                keep = 1.0 - (i / cfg.n_layer) * (1.0 - theta0)
-                x_new = self.blocks[i](x, deterministic)
-                x = apply_layer_drop(x_new, x, keep,
-                                     jax.random.fold_in(key0, i))
+
+            def call_layer(mdl, h, i):
+                x_new = mdl.blocks[i](h, deterministic)
+                return apply_layer_drop(x_new, h,
+                                        pld_keep_prob(i, cfg.n_layer, theta0),
+                                        jax.random.fold_in(key0, i))
         else:
-            x = apply_checkpointed_layers(
-                self, x, lambda mdl, h, i: mdl.blocks[i](h, deterministic),
-                cfg.n_layer, cfg.remat, cfg.remat_policy)
+            call_layer = lambda mdl, h, i: mdl.blocks[i](h, deterministic)
+        x = apply_checkpointed_layers(self, x, call_layer, cfg.n_layer,
+                                      cfg.remat, cfg.remat_policy)
         x = self.ln_f(x)
         logits = self.wte.attend(x.astype(jnp.float32))  # tied LM head, fp32 logits
 

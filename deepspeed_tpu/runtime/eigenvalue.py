@@ -29,34 +29,45 @@ class Eigenvalue:
         self.gas_boundary_resolution = gas_boundary_resolution
         self.layer_name = layer_name
         self.layer_num = layer_num
-        # jitted power-iteration steps, keyed by (loss_fn id, block): MoQ calls
-        # compute_eigenvalue every GAS boundary — recompiling the HVP graph per
-        # call would dominate the step
+        # jitted power-iteration steps, keyed by (loss_fn id, block). MoQ
+        # calls compute_eigenvalue every GAS boundary — pass the SAME loss_fn
+        # object (taking (params, batch)) so the cache hits; fresh lambdas
+        # recompile. Bounded so closures don't accumulate across loss_fns.
         self._step_cache = {}
+        self._step_cache_max = 16
 
-    def compute_eigenvalue(self, loss_fn: Callable, params: Any, rng=None
-                           ) -> Dict[str, float]:
+    def compute_eigenvalue(self, loss_fn: Callable, params: Any, rng=None,
+                           batch: Any = None) -> Dict[str, float]:
         """Max |eigenvalue| of the Hessian restricted to each top-level param
         subtree (the reference's per-block estimate over module.parameters()).
 
-        ``loss_fn(params) -> scalar``; returns {block_name: eigenvalue}.
+        ``loss_fn(params) -> scalar``, or — for repeated calls across training
+        (the MoQ GAS-boundary hook) — a STABLE ``loss_fn(params, batch)`` plus
+        ``batch``: the batch is then a jit input rather than a baked closure,
+        so the cached compiled step is reused across batches.
         """
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        grad_fn = jax.grad(loss_fn)
+        if batch is not None:
+            grad_fn = jax.grad(lambda p, b: loss_fn(p, b), argnums=0)
+        else:
+            grad_fn = jax.grad(loss_fn)
         out: Dict[str, float] = {}
         blocks = params.items() if isinstance(params, dict) else [("all", params)]
         for i, (name, _) in enumerate(blocks):
             key = jax.random.fold_in(rng, i)
             out[name] = float(self._power_iteration(loss_fn, grad_fn, params,
-                                                    name, key))
+                                                    name, key, batch))
         return out
 
-    def _power_iteration(self, loss_fn, grad_fn, params, block, key):
-        cache_key = (id(loss_fn), block)
+    def _power_iteration(self, loss_fn, grad_fn, params, block, key, batch=None):
+        cache_key = (id(loss_fn), block, batch is not None)
         if cache_key not in self._step_cache:
+            if len(self._step_cache) >= self._step_cache_max:
+                self._step_cache.pop(next(iter(self._step_cache)))
             stability = self.stability
+            with_batch = batch is not None
 
-            def hvp_block(params, v_block):
+            def hvp_block(params, v_block, b):
                 """H_block @ v: jvp of the gradient, perturbing only this block."""
                 tangent = jax.tree_util.tree_map(jnp.zeros_like, params)
                 if isinstance(tangent, dict):
@@ -64,7 +75,11 @@ class Eigenvalue:
                     tangent[block] = v_block
                 else:
                     tangent = v_block
-                _, hv = jax.jvp(grad_fn, (params,), (tangent,))
+                if with_batch:
+                    g = lambda p: grad_fn(p, b)
+                else:
+                    g = grad_fn
+                _, hv = jax.jvp(g, (params,), (tangent,))
                 return hv[block] if isinstance(hv, dict) else hv
 
             def norm(t):
@@ -72,12 +87,12 @@ class Eigenvalue:
                                     for l in jax.tree_util.tree_leaves(t)))
 
             @jax.jit
-            def one_step(params, v):
+            def one_step(params, v, b):
                 n = norm(v) + stability
                 v = jax.tree_util.tree_map(lambda x: x / n, v)
-                hv = hvp_block(params, v)
+                hv = hvp_block(params, v, b)
                 # Rayleigh quotient v^T H v (v normalized)
-                ev = sum(jnp.sum(a * b) for a, b in zip(
+                ev = sum(jnp.sum(a * b2) for a, b2 in zip(
                     jax.tree_util.tree_leaves(v), jax.tree_util.tree_leaves(hv)))
                 return hv, ev
 
@@ -89,7 +104,7 @@ class Eigenvalue:
             lambda x, k=key: jax.random.normal(k, x.shape, jnp.float32), p_block)
         ev_prev = jnp.float32(0.0)
         for it in range(self.max_iter):
-            v, ev = one_step(params, v)
+            v, ev = one_step(params, v, batch)
             if it > 0 and abs(float(ev - ev_prev)) <= self.tol * abs(float(ev) + 1e-12):
                 break
             ev_prev = ev

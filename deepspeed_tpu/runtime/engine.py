@@ -687,6 +687,21 @@ class DeepSpeedTPUEngine:
         params = self.module.init(init_rng, micro)["params"]
         self._init_state(params)
 
+    def _inject_pld(self, batch, leading: int):
+        """Thread theta + a per-step key through the batch so the jitted step
+        sees them as inputs (no retrace per theta change); models read
+        batch["pld_theta"]/["pld_rng"] (parity: engine.py:1812 passing pld
+        state into module kwargs). Used by BOTH train_batch and the
+        forward/backward facade."""
+        if self.progressive_layer_drop is None or not isinstance(batch, dict):
+            return batch
+        batch = dict(batch)
+        theta = self.progressive_layer_drop.get_theta()
+        batch["pld_theta"] = np.full((leading,), theta, np.float32)
+        self._rng, k = jax.random.split(self._rng)
+        batch["pld_rng"] = np.asarray(jax.random.split(k, leading))
+        return batch
+
     def _shard_global_batch(self, batch):
         """Host-side: reshape [tb, ...] -> [gas, mb*dp, ...] and place sharded."""
         mesh = self.topology.mesh
@@ -731,18 +746,7 @@ class DeepSpeedTPUEngine:
                 lambda x: np.asarray(x)[:, :seqlen]
                 if getattr(np.asarray(x), "ndim", 0) >= 2 else np.asarray(x),
                 batch)
-        if self.progressive_layer_drop is not None and isinstance(batch, dict):
-            # thread theta + a per-step key through the batch so the jitted
-            # step sees them as inputs (no retrace per theta change); models
-            # read batch["pld_theta"]/["pld_rng"] (parity: engine.py:1812
-            # passing pld state into module kwargs)
-            batch = dict(batch)
-            theta = self.progressive_layer_drop.get_theta()
-            batch["pld_theta"] = np.full((self.train_batch_size_,), theta,
-                                         np.float32)
-            self._rng, k = jax.random.split(self._rng)
-            batch["pld_rng"] = np.asarray(
-                jax.random.split(k, self.train_batch_size_))
+        batch = self._inject_pld(batch, self.train_batch_size_)
         self.tput_timer.start()
         self.timers(STEP_GLOBAL_TIMER).start()
         sharded = self._shard_global_batch(batch)
@@ -823,6 +827,8 @@ class DeepSpeedTPUEngine:
         self._ensure_state(batch)
         if self._micro_step is None:
             self._build_micro_steps()
+        leading = int(np.shape(jax.tree_util.tree_leaves(batch)[0])[0])
+        batch = self._inject_pld(batch, leading)
         mesh = self.topology.mesh
         sh = NamedSharding(mesh, P(BATCH_AXES))
         mb = jax.tree_util.tree_map(lambda x: jax.device_put(np.asarray(x), sh), batch)

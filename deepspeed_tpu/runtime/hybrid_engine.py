@@ -16,11 +16,9 @@ from __future__ import annotations
 import time
 from typing import Any, Optional
 
-import jax
 import numpy as np
 
 from deepspeed_tpu.runtime.engine import DeepSpeedTPUEngine
-from deepspeed_tpu.utils.logging import log_dist
 
 
 class DeepSpeedTPUHybridEngine(DeepSpeedTPUEngine):
@@ -66,8 +64,7 @@ class DeepSpeedTPUHybridEngine(DeepSpeedTPUEngine):
             cfg = dict(self._inference_config)
             cfg.setdefault("dtype", str(np.dtype("float32"))
                            if not self.mixed_precision else "bfloat16")
-            icfg = cfg if isinstance(cfg, InferenceConfig) else \
-                InferenceConfig.from_dict(cfg)
+            icfg = InferenceConfig.from_dict(cfg)
             tp = icfg.tensor_parallel.tp_size if icfg.tensor_parallel.enabled else 1
             # inference_tp_size > 1 needs a mesh with a tensor axis; reuse the
             # training mesh only when it already provides one (or no TP asked)
@@ -78,10 +75,6 @@ class DeepSpeedTPUHybridEngine(DeepSpeedTPUEngine):
                 self.module, icfg,
                 model_parameters=self._current_params(self.state),
                 mesh_topology=topo)
-            # InferenceEngine registers its mesh globally; training remains
-            # the ambient topology for any later retrace
-            from deepspeed_tpu.comm.mesh import set_topology
-            set_topology(self.topology)
             self._infer_params_fresh = True
         return self._infer
 
@@ -107,7 +100,16 @@ class DeepSpeedTPUHybridEngine(DeepSpeedTPUEngine):
             self._ensure_state({"input_ids": np.asarray(input_ids)})
         t0 = time.time()
         self.refresh_inference_params()
-        out = self._inference_engine().generate(input_ids, **kwargs)
+        eng = self._inference_engine()
+        # lazy prefill/decode traces read the GLOBAL topology (e.g. MoE
+        # sharding constraints): make the inference mesh ambient for the call,
+        # training mesh ambient otherwise
+        from deepspeed_tpu.comm.mesh import set_topology
+        set_topology(eng.topology)
+        try:
+            out = eng.generate(input_ids, **kwargs)
+        finally:
+            set_topology(self.topology)
         self.generate_time = time.time() - t0
         self.generate_count += 1
         return out
@@ -117,4 +119,10 @@ class DeepSpeedTPUHybridEngine(DeepSpeedTPUEngine):
         out = super().train_batch(*args, **kwargs)
         self.train_time = time.time() - t0
         self._infer_params_fresh = False  # weights moved; next generate refreshes
+        return out
+
+    def step(self):
+        # the forward/backward/step facade also moves weights
+        out = super().step()
+        self._infer_params_fresh = False
         return out

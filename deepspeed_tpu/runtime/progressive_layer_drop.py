@@ -35,7 +35,13 @@ class ProgressiveLayerDrop:
 
     def keep_prob(self, layer_idx: int, n_layers: int) -> float:
         """Layer-wise keep probability (deeper layers drop more)."""
-        return 1.0 - (layer_idx / max(1, n_layers)) * (1.0 - self.current_theta)
+        return pld_keep_prob(layer_idx, n_layers, self.current_theta)
+
+
+def pld_keep_prob(layer_idx: int, n_layers: int, theta):
+    """1 - (i/L)(1-theta); jit-safe (theta may be traced). Single source of
+    truth for the schedule — models and the engine share it."""
+    return 1.0 - (layer_idx / max(1, n_layers)) * (1.0 - theta)
 
 
 def apply_layer_drop(x_new: jax.Array, x_skip: jax.Array, keep_prob,
