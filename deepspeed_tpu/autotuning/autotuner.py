@@ -6,10 +6,13 @@ training JOB per candidate through the launcher, reads metrics files back, and
 prunes by profiled model memory (``model_info_profile_run``). On TPU/XLA the
 expensive part collapses: a candidate's memory footprint comes from
 ``jit(...).lower().compile().memory_analysis()`` WITHOUT running a step, so
-infeasible configs are rejected in seconds ("fast" mode), and only surviving
-candidates run measured steps for the throughput metric — in-process, no
-launcher round-trip (the reference's ResourceManager/scheduler.py exists for
-multi-node experiment placement; here experiments are sequential jit sessions).
+infeasible configs are rejected at compile time; surviving candidates are then
+timed by invoking the already-compiled executable (one XLA compile per
+candidate total). ``fast`` shortens the timed run to one step;
+``compile_only=True`` skips timing and ranks by negative memory — in-process,
+no launcher round-trip (the reference's ResourceManager/scheduler.py exists
+for multi-node experiment placement; here experiments are sequential jit
+sessions).
 """
 
 from __future__ import annotations
@@ -21,8 +24,6 @@ import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
-
-import numpy as np
 
 from deepspeed_tpu.autotuning.tuner import build_tuner
 from deepspeed_tpu.config import DeepSpeedTPUConfig
@@ -115,30 +116,39 @@ class Autotuner:
         return {"engine": engine, "compiled": compiled,
                 "sharded_batch": sharded, "memory": mem}
 
-    def _measure(self, engine, batch, steps: int) -> float:
-        for _ in range(2):  # warmup/compile
-            engine.train_batch(batch)
+    def _measure_compiled(self, probe, batch_size: int, steps: int) -> float:
+        """Time the ALREADY-compiled step (no second XLA compile): the probe's
+        Compiled executable is invoked directly."""
+        compiled = probe["compiled"]
+        state, sharded = probe["engine"].state, probe["sharded_batch"]
+        state, m = compiled(state, sharded)  # warmup execution
+        import jax
+        jax.block_until_ready(m["loss"])
         t0 = time.time()
         for _ in range(steps):
-            engine.train_batch(batch)
+            state, m = compiled(state, sharded)
+        jax.block_until_ready(m["loss"])
         dt = (time.time() - t0) / steps
-        return engine.train_batch_size() / dt  # samples/sec
+        return batch_size / dt  # samples/sec
 
     def run_experiment(self, model, overrides: Dict[str, Any], batch,
                        measure_steps: int = 3, compile_only: bool = False
                        ) -> Experiment:
+        """Compile probe always runs (feasibility + memory metrics); the
+        throughput measurement runs on feasible candidates unless
+        ``compile_only`` (dry mode: rank by negative memory)."""
         exp = Experiment(config_overrides=dict(overrides))
         try:
             cfg = self._apply(overrides)
             probe = self._compile_probe(model, cfg, batch)
             exp.metrics.update(probe["memory"])
             if compile_only:
-                # fast mode: negative memory as the score (less is better)
                 temp = probe["memory"].get("temp_size_in_bytes", 0)
                 args = probe["memory"].get("argument_size_in_bytes", 0)
                 exp.score = -float(temp + args)
             else:
-                exp.score = self._measure(probe["engine"], batch, measure_steps)
+                exp.score = self._measure_compiled(
+                    probe, probe["engine"].train_batch_size(), measure_steps)
                 exp.metrics["throughput_samples_per_sec"] = exp.score
         except Exception as e:  # OOM / invalid combination => infeasible
             exp.error = f"{type(e).__name__}: {e}"
@@ -152,7 +162,12 @@ class Autotuner:
         from deepspeed_tpu.comm.mesh import reset_topology
         tuner_type = tuner_type or self.at.tuner_type
         max_trials = max_trials or self.at.tuner_num_trials
-        compile_only = self.at.fast if compile_only is None else compile_only
+        # default: measure throughput on every compile-feasible candidate;
+        # "fast" shortens the measurement, compile_only=True skips it entirely
+        # (memory-only dry ranking)
+        compile_only = False if compile_only is None else compile_only
+        if self.at.fast and not compile_only:
+            measure_steps = min(measure_steps, 1)
         tuner = build_tuner(tuner_type, self.candidates())
         experiments: List[Experiment] = []
         stagnant = 0

@@ -1,0 +1,115 @@
+"""Offline data analysis: per-sample difficulty metrics for curriculum sampling.
+
+Parity: reference ``runtime/data_pipeline/data_sampling/data_analyzer.py``
+(417 LoC) — a map/reduce over the dataset computing metric values per sample
+(``run_map``: workers scan shards and write partial index files; ``run_reduce``
+merges them into ``sample_to_metric`` and ``metric_to_sample`` maps consumed by
+``DeepSpeedDataSampler``). Same two-phase shape here, numpy-backed: worker
+shards write ``<metric>/part_<i>.npy``; reduce concatenates into
+``sample_to_metric.npy`` + a value-bucketed ``metric_to_sample`` index.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+SAMPLE_TO_METRIC = "sample_to_metric.npy"
+METRIC_TO_SAMPLE = "metric_to_sample.json"
+
+
+class DataAnalyzer:
+    """Two-phase analyzer over an indexable dataset.
+
+    ``metric_functions``: {name: fn(sample) -> float}. ``run_map(worker_id,
+    num_workers)`` may run on separate hosts (each writes its own part file);
+    ``run_reduce`` merges. ``metric_values`` / ``load_difficulties`` read the
+    result back for the sampler.
+    """
+
+    def __init__(self, dataset: Sequence[Any],
+                 metric_functions: Dict[str, Callable[[Any], float]],
+                 save_path: str, num_workers: int = 1,
+                 batch_size: int = 1024):
+        self.dataset = dataset
+        self.metric_functions = dict(metric_functions)
+        self.save_path = save_path
+        self.num_workers = max(1, num_workers)
+        self.batch_size = batch_size
+
+    # -- phase 1: map ------------------------------------------------------ #
+    def _shard_range(self, worker_id: int):
+        n = len(self.dataset)
+        per = -(-n // self.num_workers)
+        return range(worker_id * per, min((worker_id + 1) * per, n))
+
+    def run_map(self, worker_id: int = 0) -> Dict[str, str]:
+        """Compute metrics for this worker's shard; returns part-file paths."""
+        idx_range = self._shard_range(worker_id)
+        out: Dict[str, str] = {}
+        values = {name: np.empty(len(idx_range), np.float64)
+                  for name in self.metric_functions}
+        for j, i in enumerate(idx_range):
+            sample = self.dataset[i]
+            for name, fn in self.metric_functions.items():
+                values[name][j] = float(fn(sample))
+        for name, arr in values.items():
+            d = os.path.join(self.save_path, name)
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, f"part_{worker_id}.npy")
+            np.save(path, arr)
+            out[name] = path
+        logger.info(f"data analyzer map: worker {worker_id} "
+                    f"({len(idx_range)} samples, {len(values)} metrics)")
+        return out
+
+    # -- phase 2: reduce --------------------------------------------------- #
+    def run_reduce(self, num_buckets: int = 100) -> Dict[str, str]:
+        """Merge part files: sample_to_metric array + bucketed inverse index
+        (parity: merge_map_results / metric_to_sample index files)."""
+        out: Dict[str, str] = {}
+        for name in self.metric_functions:
+            d = os.path.join(self.save_path, name)
+            parts = sorted((f for f in os.listdir(d) if f.startswith("part_")),
+                           key=lambda f: int(f[len("part_"):-len(".npy")]))
+            merged = np.concatenate([np.load(os.path.join(d, p)) for p in parts])
+            if merged.shape[0] != len(self.dataset):
+                raise ValueError(
+                    f"metric '{name}': merged {merged.shape[0]} values for "
+                    f"{len(self.dataset)} samples — missing map parts?")
+            np.save(os.path.join(d, SAMPLE_TO_METRIC), merged)
+            # inverse index: bucket id -> sample ids, buckets over value range
+            lo, hi = float(merged.min()), float(merged.max())
+            width = (hi - lo) / num_buckets or 1.0
+            bucket = np.clip(((merged - lo) / width).astype(np.int64),
+                             0, num_buckets - 1)
+            inv = {int(b): np.nonzero(bucket == b)[0].tolist()
+                   for b in np.unique(bucket)}
+            with open(os.path.join(d, METRIC_TO_SAMPLE), "w") as f:
+                json.dump({"min": lo, "max": hi, "num_buckets": num_buckets,
+                           "buckets": inv}, f)
+            out[name] = d
+        return out
+
+    def run(self) -> Dict[str, str]:
+        """Single-process convenience: map all shards then reduce."""
+        for w in range(self.num_workers):
+            self.run_map(w)
+        return self.run_reduce()
+
+    # -- consumption ------------------------------------------------------- #
+    @staticmethod
+    def metric_values(save_path: str, metric_name: str) -> np.ndarray:
+        return np.load(os.path.join(save_path, metric_name, SAMPLE_TO_METRIC))
+
+    @staticmethod
+    def load_difficulties(save_path: str, metric_name: str) -> np.ndarray:
+        """Normalized [0, 1] difficulties for ``DeepSpeedDataSampler``."""
+        v = DataAnalyzer.metric_values(save_path, metric_name).astype(np.float64)
+        lo, hi = v.min(), v.max()
+        return ((v - lo) / (hi - lo or 1.0)).astype(np.float32)

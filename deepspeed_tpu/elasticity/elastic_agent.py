@@ -1,0 +1,91 @@
+"""Elastic training agent: supervise, restart, and resize on failure.
+
+Parity: ``DSElasticAgent`` (reference ``elasticity/elastic_agent.py:28``,
+extending torch's ``LocalElasticAgent``): integrates with torchelastic
+rendezvous so that when workers die or nodes join/leave, the job restarts at a
+new world size while ``compute_elastic_config`` keeps the global batch
+invariant. XLA world membership is static per process set, so the TPU-native
+agent is a host-side supervisor: it runs the training callable, and on failure
+recomputes the valid (micro-batch, GAS, world-size) combination for the
+surviving resources and restarts from the latest checkpoint — the
+checkpoint-based recovery story of SURVEY §5.3/§5.4.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclass
+class RunRecord:
+    world_size: int
+    micro_batch: int
+    gas: int
+    error: Optional[str] = None
+    restarts: int = 0
+
+
+class DSElasticAgent:
+    """Supervise ``run_fn(world_size, micro_batch, gas, resume)``.
+
+    ``ds_config`` must contain an ``elasticity`` block (the reference schema:
+    max_train_batch_size, micro_batch_sizes, min/max_gpus...). On each
+    (re)start the agent asks :func:`compute_elastic_config` for the valid
+    batch split at the current world size; ``device_counts`` simulates
+    membership changes (next entry after each failure).
+    """
+
+    def __init__(self, ds_config: Dict[str, Any], run_fn: Callable,
+                 device_counts: List[int], max_restarts: int = 3,
+                 backoff_s: float = 0.0):
+        self.ds_config = ds_config
+        self.run_fn = run_fn
+        self.device_counts = list(device_counts)
+        if not self.device_counts:
+            raise ValueError("device_counts must be non-empty")
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.records: List[RunRecord] = []
+
+    def _resolve(self, world_size: int):
+        final_batch, _valid, micro_batch = compute_elastic_config(
+            self.ds_config, world_size=world_size, return_microbatch=True)
+        gas = final_batch // (micro_batch * world_size)
+        return final_batch, micro_batch, gas
+
+    def run(self) -> RunRecord:
+        """Run until success or restart budget exhausted (parity: the
+        torchelastic restart loop with rendezvous rounds)."""
+        attempt = 0
+        idx = 0
+        while True:
+            world = self.device_counts[min(idx, len(self.device_counts) - 1)]
+            final_batch, micro, gas = self._resolve(world)
+            rec = RunRecord(world_size=world, micro_batch=micro, gas=gas,
+                            restarts=attempt)
+            logger.info(f"elastic agent: starting ws={world} micro={micro} "
+                        f"gas={gas} (global batch {final_batch}), "
+                        f"attempt {attempt}")
+            try:
+                self.run_fn(world_size=world, micro_batch=micro, gas=gas,
+                            resume=attempt > 0)
+                self.records.append(rec)
+                return rec
+            except Exception as e:
+                rec.error = f"{type(e).__name__}: {e}"
+                self.records.append(rec)
+                attempt += 1
+                idx += 1
+                if attempt > self.max_restarts:
+                    logger.error(f"elastic agent: giving up after "
+                                 f"{self.max_restarts} restarts: {rec.error}")
+                    raise
+                logger.warning(f"elastic agent: run failed ({rec.error}); "
+                               f"restarting with next membership")
+                if self.backoff_s:
+                    time.sleep(self.backoff_s)

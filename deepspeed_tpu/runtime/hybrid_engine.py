@@ -75,6 +75,11 @@ class DeepSpeedTPUHybridEngine(DeepSpeedTPUEngine):
                 self.module, icfg,
                 model_parameters=self._current_params(self.state),
                 mesh_topology=topo)
+            # keep the TRAINING mesh ambient outside generate(): construction
+            # (and eval()) must not leave the inference mesh registered for
+            # training-side retraces; generate() re-registers it per call
+            from deepspeed_tpu.comm.mesh import set_topology
+            set_topology(self.topology)
             self._infer_params_fresh = True
         return self._infer
 

@@ -1,0 +1,66 @@
+"""Meta-device model construction (abstract init + sharded materialization).
+
+Parity: ``OnDevice`` (reference ``deepspeed/utils/init_on_device.py``) — the
+``with OnDevice(dtype=..., device="meta"):`` context that constructs models
+without allocating storage, so trillion-parameter models can be described on
+one host and materialised partitioned. The JAX analog is structural:
+``jax.eval_shape`` gives the abstract param tree for free, and materialisation
+is a jitted init with explicit ``out_shardings`` — every tensor is born in its
+partitioned layout, never replicated (the stronger form of the reference's
+zero.Init interception, partition_parameters.py:734).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Optional
+
+import jax
+
+_ON_DEVICE: Optional["OnDevice"] = None
+
+
+class OnDevice(contextlib.AbstractContextManager):
+    """Context parity with the reference; under JAX it marks intent (models
+    need no patching — use :func:`abstract_init` / :func:`materialize_sharded`
+    inside or outside the context)."""
+
+    def __init__(self, dtype=None, device: str = "meta", enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+
+    def __enter__(self):
+        global _ON_DEVICE
+        self._prev = _ON_DEVICE
+        _ON_DEVICE = self if self.enabled else None
+        return self
+
+    def __exit__(self, *exc):
+        global _ON_DEVICE
+        _ON_DEVICE = self._prev
+        return False
+
+
+def current_on_device() -> Optional[OnDevice]:
+    return _ON_DEVICE
+
+
+def abstract_init(model, sample_batch, rng=None) -> Any:
+    """Param tree of ShapeDtypeStructs — no memory allocated (device='meta')."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    shapes = jax.eval_shape(lambda r, b: model.init(r, b), rng, sample_batch)
+    return shapes["params"] if isinstance(shapes, dict) and "params" in shapes \
+        else shapes
+
+
+def materialize_sharded(model, sample_batch, shardings, rng=None) -> Any:
+    """Jitted init with out_shardings: every param materialises directly in its
+    partition (no full-model replication transient — zero.Init's goal)."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def init_fn(r, b):
+        out = model.init(r, b)
+        return out["params"] if isinstance(out, dict) and "params" in out else out
+
+    return jax.jit(init_fn, out_shardings=shardings)(rng, sample_batch)
