@@ -34,13 +34,11 @@ class DataAnalyzer:
 
     def __init__(self, dataset: Sequence[Any],
                  metric_functions: Dict[str, Callable[[Any], float]],
-                 save_path: str, num_workers: int = 1,
-                 batch_size: int = 1024):
+                 save_path: str, num_workers: int = 1):
         self.dataset = dataset
         self.metric_functions = dict(metric_functions)
         self.save_path = save_path
         self.num_workers = max(1, num_workers)
-        self.batch_size = batch_size
 
     # -- phase 1: map ------------------------------------------------------ #
     def _shard_range(self, worker_id: int):

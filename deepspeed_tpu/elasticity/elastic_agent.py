@@ -65,15 +65,17 @@ class DSElasticAgent:
         idx = 0
         while True:
             world = self.device_counts[min(idx, len(self.device_counts) - 1)]
-            final_batch, micro, gas = self._resolve(world)
-            rec = RunRecord(world_size=world, micro_batch=micro, gas=gas,
+            rec = RunRecord(world_size=world, micro_batch=0, gas=0,
                             restarts=attempt)
-            logger.info(f"elastic agent: starting ws={world} micro={micro} "
-                        f"gas={gas} (global batch {final_batch}), "
-                        f"attempt {attempt}")
             try:
-                self.run_fn(world_size=world, micro_batch=micro, gas=gas,
-                            resume=attempt > 0)
+                # resolve INSIDE the retry scope: an incompatible resized world
+                # size must advance to the next membership, not abort the agent
+                final_batch, rec.micro_batch, rec.gas = self._resolve(world)
+                logger.info(f"elastic agent: starting ws={world} "
+                            f"micro={rec.micro_batch} gas={rec.gas} "
+                            f"(global batch {final_batch}), attempt {attempt}")
+                self.run_fn(world_size=world, micro_batch=rec.micro_batch,
+                            gas=rec.gas, resume=attempt > 0)
                 self.records.append(rec)
                 return rec
             except Exception as e:

@@ -327,24 +327,40 @@ class DecoderLM(nn.Module):
             return self.embed.attend(x.astype(jnp.float32))
         return (x @ self.lm_head.astype(cfg.dtype)).astype(jnp.float32)
 
+    def _hidden(self, input_ids, positions):
+        cfg = self.config
+        x = self._embed_in(input_ids, positions)
+        x = apply_checkpointed_layers(
+            self, x, lambda mdl, h, i: mdl.layers[i](h, positions),
+            cfg.num_hidden_layers, cfg.remat, cfg.remat_policy)
+        return self.final_norm(x)
+
     def forward_logits(self, input_ids, positions=None):
         cfg = self.config
         B, T = input_ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
-        x = self._embed_in(input_ids, positions)
-        x = apply_checkpointed_layers(
-            self, x, lambda mdl, h, i: mdl.layers[i](h, positions),
-            cfg.num_hidden_layers, cfg.remat, cfg.remat_policy)
-        return self._logits(x)
+        x = self._hidden(input_ids, positions)
+        if cfg.tied_lm_head:
+            return self.embed.attend(x.astype(jnp.float32))
+        return (x @ self.lm_head.astype(cfg.dtype)).astype(jnp.float32)
 
     def __call__(self, batch, deterministic: bool = True):
+        cfg = self.config
         if isinstance(batch, dict):
             input_ids = batch["input_ids"]
             labels = batch.get("labels", input_ids)
         else:
             input_ids, labels = batch, batch
-        return causal_lm_loss(self.forward_logits(input_ids), labels)
+        B, T = input_ids.shape
+        positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        x = self._hidden(input_ids, positions)
+        # fused chunked projection+CE (chunked_causal_lm_loss): works for both
+        # the tied embedding [V, C] and the untied lm_head param [C, V]
+        from deepspeed_tpu.models.llama import chunked_causal_lm_loss
+        if cfg.tied_lm_head:
+            return chunked_causal_lm_loss(x, self.embed.embedding, labels)
+        return chunked_causal_lm_loss(x, self.lm_head, labels, transpose=True)
 
     def decode(self, input_ids, cache, cache_index, positions=None):
         B, T = input_ids.shape

@@ -133,15 +133,12 @@ class GPT2LMHead(nn.Module):
         x = apply_checkpointed_layers(self, x, call_layer, cfg.n_layer,
                                       cfg.remat, cfg.remat_policy)
         x = self.ln_f(x)
-        logits = self.wte.attend(x.astype(jnp.float32))  # tied LM head, fp32 logits
 
         if labels is None and isinstance(batch, dict) and "input_ids" in batch:
             labels = input_ids  # LM objective: predict next token of the same ids
         if labels is None:
-            return logits
-        # shift: predict token t+1 from position t
-        logits_s = logits[:, :-1, :]
-        labels_s = labels[:, 1:]
-        logp = jax.nn.log_softmax(logits_s, axis=-1)
-        nll = -jnp.take_along_axis(logp, labels_s[..., None], axis=-1)[..., 0]
-        return jnp.mean(nll)
+            return self.wte.attend(x.astype(jnp.float32))  # tied head, fp32 logits
+        # fused chunked projection+CE: the [B, T, V] logits never materialise
+        # (see models/llama.py chunked_causal_lm_loss)
+        from deepspeed_tpu.models.llama import chunked_causal_lm_loss
+        return chunked_causal_lm_loss(x, self.wte.embedding, labels)

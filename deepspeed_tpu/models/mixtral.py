@@ -132,7 +132,7 @@ class MixtralForCausalLM(nn.Module):
         logits, _ = self._forward(input_ids, positions)
         return logits
 
-    def _forward(self, input_ids, positions=None):
+    def _trunk_aux(self, input_ids, positions=None):
         B, T = input_ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
@@ -148,6 +148,10 @@ class MixtralForCausalLM(nn.Module):
             self, (x, jnp.float32(0.0)), call_layer,
             cfg.num_hidden_layers, cfg.remat, cfg.remat_policy)
         x = self.norm(x)
+        return x, aux_total
+
+    def _forward(self, input_ids, positions=None):
+        x, aux_total = self._trunk_aux(input_ids, positions)
         return self.lm_head(x).astype(jnp.float32), aux_total
 
     def __call__(self, batch, deterministic: bool = True):
@@ -156,8 +160,12 @@ class MixtralForCausalLM(nn.Module):
             labels = batch.get("labels", input_ids)
         else:
             input_ids, labels = batch, batch
-        logits, aux_total = self._forward(input_ids)
-        loss = causal_lm_loss(logits, labels)
+        x, aux_total = self._trunk_aux(input_ids)
+        # fused chunked projection+CE (see models/llama.py)
+        _ = self.lm_head(x[:, :1])
+        kernel = self.lm_head.variables["params"]["kernel"]
+        from deepspeed_tpu.models.llama import chunked_causal_lm_loss
+        loss = chunked_causal_lm_loss(x, kernel, labels, transpose=True)
         cfg = self.config
         return loss + cfg.router_aux_loss_coef * aux_total / cfg.num_hidden_layers
 
