@@ -136,8 +136,8 @@ class BertForMaskedLM(nn.Module):
         # bidirectional: only padding is masked
         bias = None
         if mask is not None:
-            bias = jnp.where(mask[:, None, None, :] > 0, 0.0,
-                             jnp.finfo(jnp.float32).min)
+            from deepspeed_tpu.ops.attention import padding_mask_to_bias
+            bias = padding_mask_to_bias(mask)
         x = apply_checkpointed_layers(
             self, x, lambda mdl, h, i: mdl.layers[i](h, bias),
             cfg.num_hidden_layers, cfg.remat, cfg.remat_policy)

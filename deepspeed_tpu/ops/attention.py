@@ -36,6 +36,13 @@ def _use_pallas() -> bool:
 FLASH_MIN_SEQ = int(os.environ.get("DSTPU_FLASH_MIN_SEQ", 2048))
 
 
+def padding_mask_to_bias(mask: jax.Array) -> jax.Array:
+    """HF-style [B, S] key mask (1 = attend) -> additive fp32 bias
+    [B, 1, 1, S]. Shared by the model zoo and the fused transformer layer."""
+    return jnp.where(mask[:, None, None, :] > 0, 0.0,
+                     jnp.finfo(jnp.float32).min)
+
+
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           causal: bool = False,
                           bias: Optional[jax.Array] = None,

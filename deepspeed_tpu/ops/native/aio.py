@@ -49,11 +49,19 @@ def aligned_empty(shape, dtype=np.float32) -> np.ndarray:
     shape = (shape,) if isinstance(shape, int) else tuple(shape)
     dtype = np.dtype(dtype)
     nbytes = int(np.prod(shape)) * dtype.itemsize
+
+    def numpy_aligned():
+        # over-allocate and slice to a 4096 boundary: the O_DIRECT/pinning
+        # contract holds even without the native allocator
+        raw = np.empty(nbytes + 4096, np.uint8)
+        off = (-raw.ctypes.data) % 4096
+        return raw[off:off + nbytes].view(dtype).reshape(shape)
+
     if lib is None:
-        return np.empty(shape, dtype)
+        return numpy_aligned()
     ptr = lib.ds_alloc_aligned(max(nbytes, 1))
     if not ptr:
-        return np.empty(shape, dtype)
+        return numpy_aligned()
     buf = (ctypes.c_uint8 * max(nbytes, 1)).from_address(ptr)
     arr = np.frombuffer(buf, np.uint8, count=nbytes).view(dtype).reshape(shape)
     weakref.finalize(buf, lib.ds_free_aligned, ptr)

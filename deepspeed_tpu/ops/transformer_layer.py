@@ -1,0 +1,123 @@
+"""DeepSpeedTransformerLayer: the legacy fused BERT-style training block.
+
+Parity: reference ``ops/transformer/transformer.py:296 DeepSpeedTransformerLayer``
++ ``DeepSpeedTransformerConfig`` over ~8k LoC of hand-fused CUDA
+(``csrc/transformer/``: fused qkv GEMMs, softmax, layernorm, gelu, dropout, and
+the stochastic-mode variant). On TPU the entire block is ONE jitted flax module:
+XLA performs the fusion the CUDA kernels hand-build (SURVEY §2.2 marks this op
+"low priority — XLA fuses well"), so this module's value is the config surface
+(batch/hidden/heads/dropout/pre-or-post-layernorm/stochastic-mode knobs parse
+unchanged) and drop-in block semantics for code ported from the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.attention import (dot_product_attention,
+                                          padding_mask_to_bias)
+
+
+@dataclass
+class DeepSpeedTransformerConfig:
+    """Parity: ``DeepSpeedTransformerConfig`` (ops/transformer/transformer.py:22)."""
+
+    batch_size: int = -1
+    hidden_size: int = -1
+    intermediate_size: int = -1
+    heads: int = -1
+    attn_dropout_ratio: float = 0.0
+    hidden_dropout_ratio: float = 0.0
+    num_hidden_layers: int = -1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    seed: int = -1
+    fp16: bool = False          # accepted; compute dtype below governs
+    pre_layer_norm: bool = True
+    normalize_invertible: bool = False   # memory knob; remat supersedes
+    gelu_checkpoint: bool = False        # memory knob; remat supersedes
+    adjust_init_range: bool = True
+    attn_dropout_checkpoint: bool = False
+    stochastic_mode: bool = False        # fast-math mode; XLA governs numerics
+    return_tuple: bool = False
+    training: bool = True
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.intermediate_size == -1 and self.hidden_size > 0:
+            self.intermediate_size = 4 * self.hidden_size
+
+
+class DeepSpeedTransformerLayer(nn.Module):
+    """Parity surface: ``DeepSpeedTransformerLayer`` (transformer.py:296) —
+    ``__call__(hidden_states, attention_mask)`` -> hidden_states. Post-LN or
+    pre-LN BERT block with GELU MLP; dropout keys from the 'dropout' rng."""
+
+    config: DeepSpeedTransformerConfig
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask=None,
+                 deterministic: bool = True):
+        cfg = self.config
+        H = cfg.heads
+        C = hidden_states.shape[-1]
+        B, T = hidden_states.shape[0], hidden_states.shape[1]
+        init = nn.initializers.normal(cfg.initializer_range)
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                       dtype=cfg.dtype, name=name)
+
+        def attn(x):
+            qkv = nn.Dense(3 * C, dtype=cfg.dtype, kernel_init=init,
+                           name="attn_qkvw")(x)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            shape = (B, T, H, C // H)
+            bias = None
+            if attention_mask is not None:
+                # HF-style [B, S] (1 = attend) or pre-built additive bias
+                if attention_mask.ndim == 2:
+                    bias = padding_mask_to_bias(attention_mask)
+                else:
+                    bias = attention_mask
+            qh, kh, vh = (t.reshape(shape) for t in (q, k, v))
+            if cfg.attn_dropout_ratio > 0 and not deterministic:
+                # reference semantics: dropout on the softmax PROBABILITIES
+                # before the V matmul (csrc softmax_dropout fusion)
+                scores = jnp.einsum("bqhd,bkhd->bhqk", qh, kh)
+                scores = scores.astype(jnp.float32) / ((C // H) ** 0.5)
+                if bias is not None:
+                    scores = scores + bias
+                probs = jax.nn.softmax(scores, axis=-1)
+                probs = nn.Dropout(cfg.attn_dropout_ratio,
+                                   deterministic=False)(probs)
+                out = jnp.einsum("bhqk,bkhd->bqhd",
+                                 probs.astype(cfg.dtype), vh)
+            else:
+                out = dot_product_attention(qh, kh, vh, bias=bias)
+            out = out.reshape(B, T, C)
+            return nn.Dense(C, dtype=cfg.dtype, kernel_init=init,
+                            name="attn_ow")(out)
+
+        def mlp(x):
+            h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                         kernel_init=init, name="inter_w")(x)
+            h = nn.gelu(h)
+            h = nn.Dense(C, dtype=cfg.dtype, kernel_init=init,
+                         name="output_w")(h)
+            if cfg.hidden_dropout_ratio > 0 and not deterministic:
+                h = nn.Dropout(cfg.hidden_dropout_ratio,
+                               deterministic=False)(h)
+            return h
+
+        x = hidden_states
+        if cfg.pre_layer_norm:
+            x = x + attn(ln("attn_nw")(x))
+            x = x + mlp(ln("norm_w")(x))
+        else:  # post-LN (original BERT)
+            x = ln("attn_nw")(x + attn(x))
+            x = ln("norm_w")(x + mlp(x))
+        return (x,) if cfg.return_tuple else x

@@ -1,0 +1,127 @@
+"""Accelerator abstraction + legacy transformer layer + CLI tests.
+
+Parity model: reference ``tests/accelerator`` + ``tests/unit/ops/transformer``
+— the get_accelerator() surface answers device/memory/RNG/op-builder queries,
+and the fused-layer config parses reference-style kwargs.
+"""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.accelerator import TPUAccelerator, get_accelerator
+from deepspeed_tpu.ops.transformer_layer import (DeepSpeedTransformerConfig,
+                                                 DeepSpeedTransformerLayer)
+
+
+def test_get_accelerator_singleton_and_identity():
+    acc = get_accelerator()
+    assert acc is get_accelerator()
+    assert acc.is_available()
+    assert acc.device_name() == "tpu" and acc.device_name(2) == "tpu:2"
+    assert acc.device_count() == len(jax.devices())
+    assert acc.communication_backend_name() == "xla"
+    assert acc.is_bf16_supported() and not acc.is_triton_supported()
+
+
+def test_accelerator_streams_events_sync():
+    acc = get_accelerator()
+    with acc.Stream() as s:
+        s.synchronize()
+    e1, e2 = acc.Event(), acc.Event()
+    e1.record()
+    e2.record()
+    assert e1.elapsed_time(e2) >= 0.0
+    acc.synchronize()
+
+
+def test_accelerator_pinned_memory():
+    acc = get_accelerator()
+    x = np.arange(1000, dtype=np.float32)
+    p = acc.pin_memory(x)
+    np.testing.assert_array_equal(p, x)
+    assert acc.is_pinned(p)
+
+
+def test_accelerator_op_builder_registry():
+    acc = get_accelerator()
+    aio = acc.create_op_builder("AsyncIOBuilder")
+    assert hasattr(aio, "AsyncIOHandle")
+    adam = acc.get_op_builder("CPUAdamBuilder")
+    assert hasattr(adam, "HostAdam")
+    with pytest.raises(ValueError, match="unknown op builder"):
+        acc.create_op_builder("CUDAOnlyBuilder")
+
+
+def test_accelerator_on_accelerator_and_rng():
+    acc = get_accelerator()
+    assert acc.on_accelerator(jnp.zeros(3))
+    assert not acc.on_accelerator(np.zeros(3))
+    k = acc.manual_seed(7)
+    assert np.array_equal(np.asarray(k), np.asarray(jax.random.PRNGKey(7)))
+
+
+# --------------------------------------------------------------------------- #
+# DeepSpeedTransformerLayer
+# --------------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("pre_ln", [True, False])
+def test_transformer_layer_forward_and_grads(pre_ln):
+    cfg = DeepSpeedTransformerConfig(batch_size=2, hidden_size=64, heads=4,
+                                     num_hidden_layers=1, pre_layer_norm=pre_ln)
+    assert cfg.intermediate_size == 256  # 4x default fill-in
+    layer = DeepSpeedTransformerLayer(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 64))
+    mask = jnp.ones((2, 16)).at[:, 12:].set(0)
+    params = layer.init(jax.random.PRNGKey(1), x, mask)
+    out = layer.apply(params, x, mask)
+    assert out.shape == x.shape and np.isfinite(np.asarray(out)).all()
+    # masked keys don't affect unmasked outputs' values
+    x2 = x.at[:, 12:, :].add(100.0)
+    out2 = layer.apply(params, x2, mask)
+    # (queries at masked positions still change; check an unmasked query row)
+    if pre_ln:
+        np.testing.assert_allclose(np.asarray(out[:, :4]),
+                                   np.asarray(out2[:, :4]), atol=1e-4)
+    g = jax.grad(lambda p: jnp.sum(layer.apply(p, x, mask) ** 2))(params)
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree_util.tree_leaves(g))
+
+
+def test_transformer_layer_return_tuple():
+    cfg = DeepSpeedTransformerConfig(hidden_size=32, heads=2, return_tuple=True)
+    layer = DeepSpeedTransformerLayer(cfg)
+    x = jnp.ones((1, 8, 32))
+    params = layer.init(jax.random.PRNGKey(0), x)
+    out = layer.apply(params, x)
+    assert isinstance(out, tuple) and out[0].shape == x.shape
+
+
+# --------------------------------------------------------------------------- #
+# ds_elastic CLI
+# --------------------------------------------------------------------------- #
+
+def test_ds_elastic_cli(tmp_path):
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
+                          "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                          "max_gpus": 8, "version": 0.1}}
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    import pathlib
+    repo_root = str(pathlib.Path(__file__).resolve().parents[2])
+    r = subprocess.run([sys.executable, "-m", "deepspeed_tpu.elasticity.cli",
+                        "-c", str(p), "-w", "4"],
+                       capture_output=True, text=True,
+                       env={"PYTHONPATH": repo_root, "PATH": "/usr/bin:/bin",
+                            "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert out["world_size"] == 4
+    assert out["micro_batch"] * out["gradient_accumulation_steps"] * 4 == \
+        out["final_batch_size"]
