@@ -1,0 +1,30 @@
+"""TP-sharded inference with int8 weight-only quantization (init_inference).
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/inference_v1_tp.py
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+def main():
+    model = LlamaForCausalLM(LlamaConfig.tiny(hidden_size=128,
+                                              intermediate_size=256))
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+    engine = ds.init_inference(model, model_parameters=params, config={
+        "dtype": "float32",
+        "tensor_parallel": {"tp_size": 2},
+        "quant": {"enabled": True, "bits": 8, "group_size": 64},
+    })
+    out = engine.generate(np.array([[1, 17, 42]], np.int32), max_new_tokens=8)
+    print("generated:", np.asarray(out).tolist())
+
+
+if __name__ == "__main__":
+    main()

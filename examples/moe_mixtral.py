@@ -1,0 +1,33 @@
+"""Mixtral-style MoE training with expert parallelism.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/moe_mixtral.py
+"""
+
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+
+CONFIG = {
+    "train_batch_size": 8,
+    "train_micro_batch_size_per_gpu": 2,
+    "zero_optimization": {"stage": 1},
+    "optimizer": {"type": "AdamW", "params": {"lr": 3e-4}},
+    "mesh": {"data": -1, "expert": 2},   # 2-way expert parallelism
+}
+
+
+def main():
+    model = MixtralForCausalLM(MixtralConfig.tiny(num_local_experts=4,
+                                                  num_experts_per_tok=2))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=CONFIG)
+    rng = np.random.default_rng(0)
+    for step in range(10):
+        batch = {"input_ids": rng.integers(0, 256, (8, 32)).astype(np.int32)}
+        loss = engine.train_batch(batch)
+    print(f"final loss {float(loss):.4f} (includes router aux loss)")
+
+
+if __name__ == "__main__":
+    main()

@@ -5,7 +5,6 @@ order-of-magnitude regressions without being timing-flaky."""
 import time
 
 import numpy as np
-import pytest
 
 from deepspeed_tpu.ops.native.cpu_optimizer import HostAdam
 from deepspeed_tpu.ops.native.aio import AsyncIOHandle, aligned_empty
@@ -31,19 +30,21 @@ def test_host_adam_throughput():
 
 def test_aio_write_read_bandwidth(tmp_path):
     h = AsyncIOHandle(block_size=1 << 20, thread_count=4)
-    arr = aligned_empty(32 << 20 >> 2, np.float32)  # 32 MiB
-    arr[...] = 1.0
-    path = str(tmp_path / "bw.bin")
-    t0 = time.perf_counter()
-    assert h.async_pwrite(arr, path) == 0
-    assert h.wait() == 1
-    w_bw = arr.nbytes / (time.perf_counter() - t0)
-    out = aligned_empty(arr.shape, np.float32)
-    t0 = time.perf_counter()
-    assert h.async_pread(out, path) == 0
-    assert h.wait() == 1
-    r_bw = arr.nbytes / (time.perf_counter() - t0)
-    np.testing.assert_array_equal(out[:16], arr[:16])
-    # floors far below any real disk (tmpfs/page cache typically GB/s)
-    assert w_bw > 20e6 and r_bw > 20e6, (w_bw, r_bw)
-    h.close()
+    try:
+        arr = aligned_empty(32 << 20 >> 2, np.float32)  # 32 MiB
+        arr[...] = 1.0
+        path = str(tmp_path / "bw.bin")
+        t0 = time.perf_counter()
+        assert h.async_pwrite(arr, path) == 0
+        assert h.wait() == 1
+        w_bw = arr.nbytes / (time.perf_counter() - t0)
+        out = aligned_empty(arr.shape, np.float32)
+        t0 = time.perf_counter()
+        assert h.async_pread(out, path) == 0
+        assert h.wait() == 1
+        r_bw = arr.nbytes / (time.perf_counter() - t0)
+        np.testing.assert_array_equal(out[:16], arr[:16])
+        # floors far below any real disk (tmpfs/page cache typically GB/s)
+        assert w_bw > 20e6 and r_bw > 20e6, (w_bw, r_bw)
+    finally:
+        h.close()
