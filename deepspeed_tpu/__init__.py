@@ -31,7 +31,8 @@ def initialize(args=None,
                config_params=None,
                rngs=None,
                tp_rules=None,
-               model_family=None):
+               model_family=None,
+               param_specs=None):
     """Initialize the training engine.
 
     Parity: ``deepspeed.initialize`` (``deepspeed/__init__.py:64``). Returns a tuple
@@ -71,6 +72,7 @@ def initialize(args=None,
         rngs=rngs,
         tp_rules=tp_rules,
         model_family=model_family,
+        param_specs=param_specs,
         **engine_kwargs,
     )
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler

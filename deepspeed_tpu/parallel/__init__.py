@@ -8,5 +8,5 @@ from deepspeed_tpu.parallel.tensor_parallel import (derive_tp_specs, tp_rules_fo
                                                     COLUMN, ROW, VOCAB, REPLICATE,
                                                     MODEL_TP_RULES, GENERIC_TP_RULES)
 from deepspeed_tpu.parallel.moe import MoE, Experts, top1_gating, topk_gating, derive_ep_specs
-from deepspeed_tpu.parallel.pipeline import (PipelineModule, gpipe_apply,
+from deepspeed_tpu.parallel.pipeline import (PipelineLM, PipelineModule, gpipe_apply,
                                              partition_uniform, partition_balanced)

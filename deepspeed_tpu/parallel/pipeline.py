@@ -170,3 +170,56 @@ class PipelineModule:
         return gpipe_apply(
             lambda p, h: self.block.apply({"params": p}, h),
             stacked_params, x, self.n_micro, mesh=mesh)
+
+
+class PipelineLM:
+    """A complete pipeline-parallel causal LM, engine-compatible.
+
+    Parity: the reference trains a ``PipelineModule`` holding
+    ``[EmbeddingPipe, *blocks, LMHead]`` through ``PipelineEngine.train_batch``
+    (pipe/engine.py:321). Here the embedding/head live replicated outside the
+    pipeline region, the block stack rides :func:`gpipe_apply`, and the CORE
+    engine trains it like any model::
+
+        lm = PipelineLM(vocab_size=V, block=MyBlock(), n_layers=L, n_micro=M)
+        params = lm.init(rng, batch)["params"]
+        engine, *_ = deepspeed_tpu.initialize(
+            model=lm, model_parameters=params,
+            param_specs=lm.param_specs(params),   # stack shards over 'pipe'
+            config={..., "mesh": {"pipe": P, ...}})
+
+    ``init``/``apply`` duck-type a flax module: ``apply(params, batch) ->
+    mean next-token loss`` (fused chunked CE, so [B, T, V] never materialises).
+    """
+
+    def __init__(self, vocab_size: int, d_model: int, block, n_layers: int,
+                 n_micro: int = 1, init_scale: float = 0.02):
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.pipe = PipelineModule(block, n_layers, n_micro)
+        self.init_scale = init_scale
+
+    def init(self, rng, batch):
+        ids = jnp.asarray(batch["input_ids"] if isinstance(batch, dict) else batch)
+        k_wte, k_stack = jax.random.split(rng)
+        wte = self.init_scale * jax.random.normal(
+            k_wte, (self.vocab_size, self.d_model), jnp.float32)
+        sample_x = wte[ids[:1]]
+        stacked = self.pipe.init_stacked(k_stack, sample_x)
+        return {"params": {"wte": wte, "stack": stacked}}
+
+    def apply(self, variables, batch, rngs=None, mesh=None):
+        p = variables["params"] if "params" in variables else variables
+        ids = jnp.asarray(batch["input_ids"] if isinstance(batch, dict) else batch)
+        labels = batch.get("labels", ids) if isinstance(batch, dict) else ids
+        x = p["wte"][ids]  # gather FIRST; dtype follows the engine's cast
+        h = self.pipe(p["stack"], x, mesh=mesh)
+        from deepspeed_tpu.models.llama import chunked_causal_lm_loss
+        return chunked_causal_lm_loss(h, p["wte"], labels)
+
+    def param_specs(self, params):
+        """Explicit engine shardings: the stack's leading (layer) dim over
+        'pipe'; embedding replicated (pass as ``initialize(param_specs=...)``)."""
+        p = params["params"] if "params" in params else params
+        return {"wte": P(),
+                "stack": self.pipe.stacked_param_specs(p["stack"])}

@@ -84,7 +84,8 @@ class DeepSpeedTPUEngine:
                  rngs: Optional[jax.Array] = None,
                  loss_fn: Optional[Callable] = None,
                  tp_rules=None,
-                 model_family: Optional[str] = None):
+                 model_family: Optional[str] = None,
+                 param_specs=None):
         self.config = config if isinstance(config, DeepSpeedTPUConfig) else DeepSpeedTPUConfig.load(config)
         # ZeRO++ hpZ / MiCS factorize the fsdp axis into (inter, intra) so
         # secondary-partition gathers ride the intra-node axis
@@ -100,7 +101,11 @@ class DeepSpeedTPUEngine:
             self.config.mesh.fsdp_sub = sub
             if self.config.mesh.fsdp > 0:
                 self.config.mesh.fsdp //= sub
-        self.topology = mesh_topology or set_topology(build_topology(self.config.mesh))
+        # the engine's mesh is also the ambient (global) topology: model code
+        # that reads get_topology() at trace time (pipeline/MoE constraints)
+        # must see the same mesh the engine shards over
+        self.topology = set_topology(mesh_topology) if mesh_topology is not None \
+            else set_topology(build_topology(self.config.mesh))
         self.train_batch_size_, self.micro_batch_size_, self.gas_ = \
             self.config.resolve_batch(self.topology.dp_world_size)
         dist.configure(self.config)
@@ -119,7 +124,10 @@ class DeepSpeedTPUEngine:
         # delegates training TP to an external Megatron mpu — SURVEY §2.3)
         self._tp_rules = tp_rules
         self._model_family = model_family
-        self._tp_specs = None
+        # explicit per-leaf PartitionSpecs override rule derivation entirely
+        # (pipeline stacks, custom layouts); merged with ZeRO axes in the
+        # partitioner like TP specs
+        self._tp_specs = param_specs
         # compression (parity: compression_training / init_compression wiring)
         self._compression_plan = None
         self.compression_scheduler = None

@@ -1,6 +1,6 @@
 """TP-sharded inference with int8 weight-only quantization (init_inference).
 
-    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    PYTHONPATH=. XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python examples/inference_v1_tp.py
 """
 

@@ -1,6 +1,6 @@
 """FastGen-style continuous batching: paged KV + Dynamic SplitFuse.
 
-    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    PYTHONPATH=. XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python examples/inference_v2_fastgen.py
 """
 

@@ -1,6 +1,6 @@
 """Mixtral-style MoE training with expert parallelism.
 
-    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    PYTHONPATH=. XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python examples/moe_mixtral.py
 """
 

@@ -1,6 +1,6 @@
 """RLHF actor loop with the hybrid engine: generate rollouts, then train.
 
-    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    PYTHONPATH=. XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python examples/rlhf_hybrid_engine.py
 """
 

@@ -1,7 +1,7 @@
 """Train GPT-2 with ZeRO + bf16 (the minimum end-to-end slice).
 
 Run (any host; 8 virtual devices make a test mesh):
-    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    PYTHONPATH=. XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python examples/train_gpt2_zero.py
 
 DeepSpeed users: the config dict below is a DeepSpeed config — same keys.

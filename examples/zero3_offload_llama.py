@@ -1,6 +1,6 @@
 """Llama ZeRO-3 with hpZ + host-offloaded optimizer (ZeRO-Offload/Infinity).
 
-    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    PYTHONPATH=. XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python examples/zero3_offload_llama.py
 
 Swap "device": "cpu" for {"device": "nvme", "nvme_path": "/tmp/nvme"} to spill

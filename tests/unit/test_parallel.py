@@ -334,3 +334,41 @@ def test_top2_capacity_dropped_token_renormalises_to_survivor():
     # token 1 loses e0 (capacity) but keeps e1? e1 slot taken by token0 -> gets e1 dropped too... 
     # token 2 loses e0, keeps e2 -> must renormalise to 1.0 on e2
     np.testing.assert_allclose(sums[2], 1.0, rtol=1e-5)
+
+
+def test_pipeline_lm_trains_through_engine(eight_devices):
+    """End-to-end: the CORE engine trains a pipeline-parallel LM (parity:
+    PipelineEngine.train_batch pipe/engine.py:321) — stack sharded over
+    'pipe' via explicit param_specs, loss decreases, sharding preserved."""
+    import flax.linen as nn
+    import deepspeed_tpu
+    from deepspeed_tpu.parallel import PipelineLM
+
+    class Block(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return x + nn.Dense(32, name="fc")(jnp.tanh(x))
+
+    topo = make_topo(pipe=2, data=4)
+    lm = PipelineLM(vocab_size=128, d_model=32, block=Block(), n_layers=4,
+                    n_micro=2)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 128, (8, 16)).astype(np.int32)}
+    params = lm.init(jax.random.PRNGKey(0), batch)["params"]
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=lm, model_parameters=params, mesh_topology=topo,
+        param_specs=lm.param_specs(params),
+        config={
+            "train_batch_size": 8,
+            "train_micro_batch_size_per_gpu": 2,
+            "zero_optimization": {"stage": 1},
+            "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+            "steps_per_print": 0,
+        })
+    # memorize one batch: a clear learnable signal
+    losses = [float(engine.train_batch(batch)) for _ in range(10)]
+    assert losses[-1] < losses[0] - 0.05, losses
+    # the stack's master params stay sharded over 'pipe'
+    stack_leaf = jax.tree_util.tree_leaves(engine.state["master"]["stack"])[0]
+    assert "pipe" in str(stack_leaf.sharding.spec)
