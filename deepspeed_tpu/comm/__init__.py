@@ -6,6 +6,12 @@ and XLA collectives.
 """
 
 from deepspeed_tpu.comm.comm import (
+    all_gather_into_tensor,
+    reduce_scatter_tensor,
+    all_to_all_single,
+    send_recv,
+    send,
+    recv,
     all_reduce,
     all_gather,
     reduce_scatter,

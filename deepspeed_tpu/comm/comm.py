@@ -114,6 +114,49 @@ def ring_shift(tensor, axis_name: str, shift: int = 1):
     return ppermute(tensor, axis_name, perm)
 
 
+def send_recv(tensor, src: int, dst: int, axis_name: AxisName):
+    """Static point-to-point transfer: ``dst`` receives ``src``'s value; every
+    other rank receives zeros (ppermute semantics).
+
+    Parity: ``deepspeed.comm.send``/``recv`` and ``runtime/pipe/p2p.py``. Under
+    SPMD there is no one-sided P2P — a send/recv PAIR is one collective
+    ``ppermute`` with the static (src, dst) route, which is exactly how the
+    reference's pipeline uses p2p (stage -> stage+1). All ranks must call this
+    with the same (src, dst)."""
+    return ppermute(tensor, axis_name, [(src, dst)])
+
+
+def send(tensor, dst: int, axis_name: AxisName, *, src: int):
+    """Reference-shaped alias of :func:`send_recv`. SPMD has no implicit
+    "caller" rank, so the sender must be named explicitly — omitting ``src``
+    is a TypeError rather than silently routing rank 0's data."""
+    return send_recv(tensor, src, dst, axis_name)
+
+
+def recv(tensor_like, src: int, axis_name: AxisName, *, dst: int):
+    """Reference-shaped alias of :func:`send_recv`; ``dst`` (the receiver)
+    must be named explicitly (see :func:`send`)."""
+    return send_recv(tensor_like, src, dst, axis_name)
+
+
+# reference-spelled aliases (deepspeed.comm API names; comm.py:246-330);
+# **kwargs forward timed_op extras like log_name
+def all_gather_into_tensor(tensor, axis_name: AxisName, axis: int = 0, **kw):
+    """Parity alias: ``deepspeed.comm.all_gather_into_tensor``."""
+    return all_gather(tensor, axis_name, axis=axis, tiled=True, **kw)
+
+
+def reduce_scatter_tensor(tensor, axis_name: AxisName, axis: int = 0, **kw):
+    """Parity alias: ``deepspeed.comm.reduce_scatter_tensor``."""
+    return reduce_scatter(tensor, axis_name, axis=axis, tiled=True, **kw)
+
+
+def all_to_all_single(tensor, axis_name: AxisName, split_axis: int = 0,
+                      concat_axis: int = 0, **kw):
+    """Parity alias: ``deepspeed.comm.all_to_all_single``."""
+    return all_to_all(tensor, axis_name, split_axis, concat_axis, tiled=True, **kw)
+
+
 def axis_index(axis_name: AxisName):
     return lax.axis_index(axis_name)
 
