@@ -85,6 +85,24 @@ def init_inference(model=None, config=None, model_parameters=None,
     from deepspeed_tpu.inference.engine import InferenceEngine
     from deepspeed_tpu.inference.config import InferenceConfig
     cfg = InferenceConfig.load(config, **kwargs)
+    if isinstance(model, str):
+        # local path / cached HF identifier (parity: reference accepts model
+        # names and loads via transformers)
+        from transformers import AutoConfig, AutoModelForCausalLM
+        from transformers import AutoModelForMaskedLM
+        auto_cls = (AutoModelForMaskedLM
+                    if AutoConfig.from_pretrained(model).model_type == "bert"
+                    else AutoModelForCausalLM)
+        model = auto_cls.from_pretrained(model)
+    from deepspeed_tpu.module_inject import convert_hf_model, is_hf_model
+    if is_hf_model(model):
+        # injection-policy path (parity: _apply_injection_policy engine.py:408).
+        # Caller-supplied model_parameters (a pre-converted flax tree) win over
+        # the torch state_dict.
+        model, _zoo_cfg, variables = convert_hf_model(model,
+                                                      dtype=cfg.compute_dtype)
+        if model_parameters is None:
+            model_parameters = variables["params"]
     return InferenceEngine(model=model, config=cfg,
                            model_parameters=model_parameters,
                            mesh_topology=mesh_topology,

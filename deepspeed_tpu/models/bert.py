@@ -35,6 +35,8 @@ class BertConfig:
     max_position_embeddings: int = 512
     type_vocab_size: int = 2
     layer_norm_eps: float = 1e-12
+    exact_gelu: bool = False   # HF "gelu" is erf-exact; default keeps tanh approx
+    mlm_bias: bool = False     # HF cls.predictions.decoder carries a bias
     dtype: Any = jnp.float32
     remat: bool = False
     remat_policy: Optional[str] = None
@@ -92,7 +94,7 @@ class BertLayer(nn.Module):
         x = ln("attention_layernorm")(x + attn)
         h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
                      name="intermediate")(x)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=not cfg.exact_gelu)
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="output")(h)
         return ln("output_layernorm")(x + h)
 
@@ -118,6 +120,9 @@ class BertForMaskedLM(nn.Module):
                                       name="mlm_transform")
         self.mlm_ln = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                                    name="mlm_layernorm")
+        if cfg.mlm_bias:
+            self.mlm_decoder_bias = self.param("mlm_bias", nn.initializers.zeros,
+                                               (cfg.vocab_size,), jnp.float32)
 
     def __call__(self, batch, deterministic: bool = True):
         cfg = self.config
@@ -144,9 +149,11 @@ class BertForMaskedLM(nn.Module):
 
         # MLM head: transform + tied decoder (HF cls.predictions shape)
         h = self.mlm_transform(x)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=not cfg.exact_gelu)
         h = self.mlm_ln(h)
         logits = self.wte.attend(h.astype(jnp.float32))
+        if cfg.mlm_bias:
+            logits = logits + self.mlm_decoder_bias
 
         labels = batch.get("labels") if isinstance(batch, dict) else None
         if labels is None:

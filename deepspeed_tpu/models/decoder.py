@@ -18,6 +18,12 @@ Family structural facts encoded here:
     (rotary_pct < 1), GELU, biases on projections.
   - **GPT-NeoX**: parallel residual with TWO norms (attn from ln1(x), MLP from
     ln2(x)), partial rotary, GELU, biases.
+  - **GPT-J**: parallel block off one layernorm, partial *interleaved* rotary
+    (matches this zoo's native convention), no attention biases, MLP biases,
+    untied LM head with bias.
+  - **BLOOM**: sequential pre-LN, ALiBi position bias (no rotary/learned
+    positions), layernorm directly after the embedding, fused-qkv ancestry,
+    tied LM head.
 
 Call paths match the llama zoo protocol: ``__call__(batch) -> loss``,
 ``forward_logits``, ``decode(ids, cache, index)`` with the dense KV cache from
@@ -26,6 +32,7 @@ Call paths match the llama zoo protocol: ``__call__(batch) -> loss``,
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
@@ -50,17 +57,20 @@ class DecoderConfig:
     num_key_value_heads: Optional[int] = None   # None -> MHA
     max_position_embeddings: int = 2048
     norm: str = "ln"                 # "ln" | "rms"
-    activation: str = "relu"         # "relu" | "gelu" | "swiglu"
+    activation: str = "relu"         # "relu" | "gelu" (tanh) | "gelu_exact" | "swiglu"
     rope_theta: Optional[float] = None          # None -> no rotary
     rotary_pct: float = 1.0                     # fraction of head_dim that rotates
     learned_pos: bool = False
     pos_offset: int = 0              # OPT: positions offset by 2 in the table
+    alibi: bool = False              # BLOOM: per-head linear position bias
+    embed_norm: bool = False         # BLOOM: layernorm right after the embedding
     parallel_block: bool = False     # attn + mlp in one residual add
     parallel_dual_norm: bool = False # neox: MLP from ln2(x) instead of ln1(x)
     qkv_bias: bool = True
     out_bias: bool = True
     mlp_bias: bool = True
     tied_lm_head: bool = False
+    head_bias: bool = False          # phi/gpt-j: bias on the LM head projection
     eps: float = 1e-5
     dtype: Any = jnp.float32
     remat: bool = False
@@ -125,6 +135,23 @@ class DecoderConfig:
         d.update(kw); return cls(**d)
 
     @classmethod
+    def bloom_560m(cls, **kw):
+        d = dict(family="bloom", vocab_size=250880, hidden_size=1024,
+                 intermediate_size=4096, num_hidden_layers=24,
+                 num_attention_heads=16, alibi=True, embed_norm=True,
+                 activation="gelu", tied_lm_head=True)
+        d.update(kw); return cls(**d)
+
+    @classmethod
+    def gptj_6b(cls, **kw):
+        d = dict(family="gptj", vocab_size=50400, hidden_size=4096,
+                 intermediate_size=16384, num_hidden_layers=28,
+                 num_attention_heads=16, rope_theta=10000.0, rotary_pct=0.25,
+                 activation="gelu", parallel_block=True, qkv_bias=False,
+                 out_bias=False, head_bias=True)
+        d.update(kw); return cls(**d)
+
+    @classmethod
     def tiny(cls, family: str = "opt", **kw):
         base = {
             "opt": dict(learned_pos=True, pos_offset=2, activation="relu",
@@ -136,6 +163,11 @@ class DecoderConfig:
                         parallel_block=True),
             "gpt_neox": dict(rope_theta=10000.0, rotary_pct=0.5, activation="gelu",
                              parallel_block=True, parallel_dual_norm=True),
+            "bloom": dict(alibi=True, embed_norm=True, activation="gelu",
+                          tied_lm_head=True),
+            "gptj": dict(rope_theta=10000.0, rotary_pct=0.5, activation="gelu",
+                         parallel_block=True, qkv_bias=False, out_bias=False,
+                         head_bias=True),
         }[family]
         d = dict(family=family, vocab_size=256, hidden_size=64,
                  intermediate_size=128, num_hidden_layers=2,
@@ -162,6 +194,29 @@ class _Norm(nn.Module):
             var = jnp.var(xf, axis=-1, keepdims=True)
             y = (xf - mean) * jax.lax.rsqrt(var + self.eps) * scale + bias
         return y.astype(self.dtype)
+
+
+def alibi_slopes(n_heads: int) -> jnp.ndarray:
+    """Per-head ALiBi slopes (geometric in 2^(-8/n), with the standard
+    interpolation for non-power-of-two head counts). fp32, shape [H]."""
+    def pow2(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start ** (i + 1) for i in range(n)]
+    if math.log2(n_heads).is_integer():
+        s = pow2(n_heads)
+    else:
+        closest = 2 ** math.floor(math.log2(n_heads))
+        s = pow2(closest) + pow2(2 * closest)[0::2][: n_heads - closest]
+    return jnp.asarray(s, dtype=jnp.float32)
+
+
+def alibi_bias(q_positions: jnp.ndarray, k_positions: jnp.ndarray,
+               n_heads: int) -> jnp.ndarray:
+    """Additive attention bias [B, H, Tq, Tk]: slope_h * (k_pos - q_pos).
+    Shift-invariant per softmax row, so it matches the reference's
+    key-absolute-position formulation exactly."""
+    rel = (k_positions[:, None, None, :] - q_positions[:, None, :, None])
+    return alibi_slopes(n_heads)[None, :, None, None] * rel.astype(jnp.float32)
 
 
 def _partial_rope(x, positions, theta: float, rotary_dim: Optional[int]):
@@ -198,7 +253,12 @@ class _Mlp(nn.Module):
             if cfg.mlp_bias:
                 h = h + self.param("b_up", nn.initializers.zeros, (ff,), jnp.float32) \
                     .astype(cfg.dtype)
-            h = nn.gelu(h) if cfg.activation == "gelu" else nn.relu(h)
+            if cfg.activation == "gelu":
+                h = nn.gelu(h)
+            elif cfg.activation == "gelu_exact":
+                h = nn.gelu(h, approximate=False)
+            else:
+                h = nn.relu(h)
         w_down = self.param("w_down", init, (ff, hid), jnp.float32)
         out = h @ w_down.astype(cfg.dtype)
         if cfg.mlp_bias and cfg.activation != "swiglu":
@@ -265,18 +325,20 @@ class DecoderBlock(nn.Module):
         x = x + attn_out
         return x + self.mlp(self.ln2(x))
 
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, attn_bias=None):
         cfg = self.config
         B, T, _ = x.shape
         h1 = self.ln1(x)
         q, k, v = self._qkv(h1, positions)
         rep = cfg.num_attention_heads // cfg.kv_heads
         out = dot_product_attention(q, repeat_kv(k, rep), repeat_kv(v, rep),
-                                    causal=True)
+                                    causal=True, bias=attn_bias)
         return self._combine(x, h1, self._proj_out(out, B, T))
 
-    def decode(self, x, positions, layer_cache, cache_index):
-        """Dense-cache incremental step (v1 engine protocol, cf. llama.py)."""
+    def decode(self, x, positions, layer_cache, cache_index, attn_bias=None):
+        """Dense-cache incremental step (v1 engine protocol, cf. llama.py).
+        ``attn_bias`` is the shared [B, {1|H}, T, S] mask built once by the
+        caller (window mask + optional ALiBi)."""
         cfg = self.config
         B, T, _ = x.shape
         h1 = self.ln1(x)
@@ -287,9 +349,11 @@ class DecoderBlock(nn.Module):
             layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, cache_index, 0, 0))
         S = ck.shape[1]
         rep = cfg.num_attention_heads // cfg.kv_heads
-        k_pos = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
-        bias = _window_bias(positions, k_pos, None)
-        out = reference_attention(q, repeat_kv(ck, rep), repeat_kv(cv, rep), bias=bias)
+        if attn_bias is None:
+            k_pos = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+            attn_bias = _window_bias(positions, k_pos, None)
+        out = reference_attention(q, repeat_kv(ck, rep), repeat_kv(cv, rep),
+                                  bias=attn_bias)
         return self._combine(x, h1, self._proj_out(out, B, T)), {"k": ck, "v": cv}
 
 
@@ -306,32 +370,49 @@ class DecoderLM(nn.Module):
             self.pos_embed = nn.Embed(cfg.max_position_embeddings + cfg.pos_offset,
                                       cfg.hidden_size, dtype=cfg.dtype,
                                       name="pos_embed")
+        if cfg.embed_norm:
+            self.embed_ln = _Norm(cfg.norm, cfg.eps, cfg.dtype, name="embed_norm")
         self.layers = [DecoderBlock(cfg, name=f"layers_{i}")
                        for i in range(cfg.num_hidden_layers)]
         self.final_norm = _Norm(cfg.norm, cfg.eps, cfg.dtype, name="final_norm")
         if not cfg.tied_lm_head:
             self.lm_head = self.param("lm_head", nn.initializers.normal(0.02),
                                       (cfg.hidden_size, cfg.vocab_size), jnp.float32)
+        if cfg.head_bias:
+            self.lm_head_bias = self.param("lm_head_bias", nn.initializers.zeros,
+                                           (cfg.vocab_size,), jnp.float32)
 
     def _embed_in(self, input_ids, positions):
         cfg = self.config
         x = self.embed(input_ids)
         if cfg.learned_pos:
             x = x + self.pos_embed(positions + cfg.pos_offset)
-        return x.astype(cfg.dtype)
+        x = x.astype(cfg.dtype)
+        if cfg.embed_norm:
+            x = self.embed_ln(x)
+        return x
+
+    def _head(self, logits):
+        if self.config.head_bias:
+            return logits + self.lm_head_bias
+        return logits
 
     def _logits(self, x):
         cfg = self.config
         x = self.final_norm(x)
         if cfg.tied_lm_head:
-            return self.embed.attend(x.astype(jnp.float32))
-        return (x @ self.lm_head.astype(cfg.dtype)).astype(jnp.float32)
+            return self._head(self.embed.attend(x.astype(jnp.float32)))
+        return self._head((x @ self.lm_head.astype(cfg.dtype)).astype(jnp.float32))
 
     def _hidden(self, input_ids, positions):
         cfg = self.config
         x = self._embed_in(input_ids, positions)
+        # shared across layers: built once here, threaded through the (possibly
+        # rematerialised) blocks as an argument so remat saves it, not recomputes
+        bias = (alibi_bias(positions, positions, cfg.num_attention_heads)
+                if cfg.alibi else None)
         x = apply_checkpointed_layers(
-            self, x, lambda mdl, h, i: mdl.layers[i](h, positions),
+            self, x, lambda mdl, h, i: mdl.layers[i](h, positions, bias),
             cfg.num_hidden_layers, cfg.remat, cfg.remat_policy)
         return self.final_norm(x)
 
@@ -342,8 +423,8 @@ class DecoderLM(nn.Module):
             positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
         x = self._hidden(input_ids, positions)
         if cfg.tied_lm_head:
-            return self.embed.attend(x.astype(jnp.float32))
-        return (x @ self.lm_head.astype(cfg.dtype)).astype(jnp.float32)
+            return self._head(self.embed.attend(x.astype(jnp.float32)))
+        return self._head((x @ self.lm_head.astype(cfg.dtype)).astype(jnp.float32))
 
     def __call__(self, batch, deterministic: bool = True):
         cfg = self.config
@@ -358,19 +439,28 @@ class DecoderLM(nn.Module):
         # fused chunked projection+CE (chunked_causal_lm_loss): works for both
         # the tied embedding [V, C] and the untied lm_head param [C, V]
         from deepspeed_tpu.models.llama import chunked_causal_lm_loss
+        hb = self.lm_head_bias if cfg.head_bias else None
         if cfg.tied_lm_head:
-            return chunked_causal_lm_loss(x, self.embed.embedding, labels)
-        return chunked_causal_lm_loss(x, self.lm_head, labels, transpose=True)
+            return chunked_causal_lm_loss(x, self.embed.embedding, labels,
+                                          head_bias=hb)
+        return chunked_causal_lm_loss(x, self.lm_head, labels, transpose=True,
+                                      head_bias=hb)
 
     def decode(self, input_ids, cache, cache_index, positions=None):
+        cfg = self.config
         B, T = input_ids.shape
         if positions is None:
             positions = cache_index + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
         x = self._embed_in(input_ids, positions)
+        S = cache["k"].shape[2]
+        k_pos = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+        bias = _window_bias(positions, k_pos, None)
+        if cfg.alibi:
+            bias = bias + alibi_bias(positions, k_pos, cfg.num_attention_heads)
         new_k, new_v = [], []
         for i, layer in enumerate(self.layers):
             x, nc = layer.decode(x, positions, {"k": cache["k"][i], "v": cache["v"][i]},
-                                 cache_index)
+                                 cache_index, bias)
             new_k.append(nc["k"])
             new_v.append(nc["v"])
         return self._logits(x), {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}

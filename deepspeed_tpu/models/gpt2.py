@@ -33,6 +33,7 @@ class GPT2Config:
     n_head: int = 12
     mlp_ratio: int = 4
     dropout: float = 0.0
+    eps: float = 1e-5        # HF GPT-2 layer_norm_epsilon
     dtype: Any = jnp.float32
     # activation checkpointing (parity: reference
     # runtime/activation_checkpointing/checkpointing.py; on TPU = jax.checkpoint
@@ -85,8 +86,8 @@ class Block(nn.Module):
     def __call__(self, x, deterministic: bool = True):
         cfg = self.config
         x = x + CausalSelfAttention(cfg, name="attn")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x), deterministic)
-        x = x + MLP(cfg, name="mlp")(nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x))
+            nn.LayerNorm(epsilon=cfg.eps, dtype=cfg.dtype, name="ln_1")(x), deterministic)
+        x = x + MLP(cfg, name="mlp")(nn.LayerNorm(epsilon=cfg.eps, dtype=cfg.dtype, name="ln_2")(x))
         return x
 
 
@@ -100,7 +101,7 @@ class GPT2LMHead(nn.Module):
         self.wte = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype, name="wte")
         self.wpe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype, name="wpe")
         self.blocks = [Block(cfg, name=f"h_{i}") for i in range(cfg.n_layer)]
-        self.ln_f = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")
+        self.ln_f = nn.LayerNorm(epsilon=cfg.eps, dtype=cfg.dtype, name="ln_f")
 
     def __call__(self, batch, deterministic: bool = True):
         cfg = self.config

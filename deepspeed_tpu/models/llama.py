@@ -293,7 +293,8 @@ def causal_lm_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
 
 def chunked_causal_lm_loss(x: jax.Array, vocab_weight: jax.Array,
                            labels: jax.Array, batch_chunk: int = 4,
-                           transpose: bool = False) -> jax.Array:
+                           transpose: bool = False,
+                           head_bias: Optional[jax.Array] = None) -> jax.Array:
     """Fused projection + cross entropy over batch chunks.
 
     ``x`` [B, T, C] final hidden states; ``vocab_weight`` [V, C] (embedding
@@ -315,6 +316,8 @@ def chunked_causal_lm_loss(x: jax.Array, vocab_weight: jax.Array,
     def body(acc, inp):
         h, y = inp
         logits = (h.astype(jnp.float32) @ w.astype(jnp.float32))
+        if head_bias is not None:
+            logits = logits + head_bias.astype(jnp.float32)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         picked = jnp.take_along_axis(logits, y[..., None], axis=-1)[..., 0]
         return acc + jnp.sum(lse - picked), None

@@ -1,0 +1,79 @@
+"""HF-transformers bridge ("module injection").
+
+Parity role: the reference's ``deepspeed/module_inject`` — ``replace_module`` /
+``replace_transformer_layer`` rewrite a torch HF model in place with fused,
+TP-sharded DeepSpeed modules chosen by per-architecture policies
+(``replace_module.py``, ``containers/``).  TPU-native re-design: instead of
+mutating torch modules, :func:`convert_hf_model` maps a HF model (or its config
++ state_dict) onto the zoo's pure flax models and returns ``(flax_module,
+zoo_config, params)``.  TP/"kernel injection" then come for free: the zoo
+models already route through the Pallas ops layer and carry PartitionSpec
+sharding rules (``parallel/tensor_parallel.py``), so ``init_inference`` shards
+the converted params over the mesh exactly where the reference inserts
+``LinearAllreduce`` modules.
+
+Supported HF ``model_type``s: gpt2, bert, llama, mistral, mixtral, opt,
+falcon, phi, gpt_neox, gptj, bloom (see ``containers.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.module_inject import containers  # noqa: F401  (registers)
+from deepspeed_tpu.module_inject.policy import (HFInjectionPolicy, get_policy,
+                                                register_policy,
+                                                registered_model_types)
+
+__all__ = ["convert_hf_model", "replace_module", "get_policy",
+           "register_policy", "registered_model_types", "HFInjectionPolicy",
+           "is_hf_model"]
+
+
+def is_hf_model(model: Any) -> bool:
+    """True for a HuggingFace ``PreTrainedModel`` (duck-typed: torch module
+    with a ``config.model_type`` and a ``state_dict`` method)."""
+    cfg = getattr(model, "config", None)
+    return (cfg is not None and hasattr(cfg, "model_type")
+            and callable(getattr(model, "state_dict", None))
+            and not hasattr(model, "init"))  # excludes flax modules
+
+
+def convert_hf_model(model: Any, dtype: Any = jnp.bfloat16,
+                     hf_config: Any = None,
+                     state_dict: Optional[Dict[str, Any]] = None
+                     ) -> Tuple[Any, Any, Dict[str, Any]]:
+    """Convert a HF transformers model to a zoo flax model.
+
+    Accepts either a ``PreTrainedModel`` instance, or ``hf_config`` +
+    ``state_dict`` explicitly (e.g. weights streamed from disk shards).
+    Returns ``(flax_module, zoo_config, params)`` where ``params`` is the
+    full variable collection ``{"params": ...}`` ready for ``module.apply``.
+    """
+    if model is not None:
+        hf_config = model.config
+        state_dict = model.state_dict()
+    if hf_config is None or state_dict is None:
+        raise ValueError("need a HF model instance or hf_config + state_dict")
+    policy = get_policy(hf_config)
+    module, cfg = policy.build(hf_config, dtype)
+    tree = policy.convert(hf_config, state_dict)
+    params = {"params": _cast_tree(tree, dtype)}
+    return module, cfg, params
+
+
+def _cast_tree(tree, dtype):
+    import jax
+    # fp32 master-layout leaves stay fp32 where the zoo keeps them fp32 (the
+    # models cast at use sites); inference casting happens in the engine, so
+    # here we only convert numpy -> jnp arrays without changing precision.
+    return jax.tree_util.tree_map(jnp.asarray, tree)
+
+
+def replace_module(model: Any, dtype: Any = jnp.bfloat16, **_ignored):
+    """Reference-spelled alias (``module_inject/replace_module.py``): returns
+    the converted ``(flax_module, params)`` pair instead of mutating torch."""
+    module, _cfg, params = convert_hf_model(model, dtype=dtype)
+    return module, params

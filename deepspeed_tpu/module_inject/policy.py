@@ -1,0 +1,171 @@
+"""Injection-policy base + weight-transform helpers for the HF bridge.
+
+Parity role: the reference's ``module_inject/policy.py:224`` (``DSPolicy`` /
+``TransformerPolicy``) — the per-architecture contract that tells the engine how
+to pull (q, k, v, o, mlp, norm) tensors out of a HuggingFace module tree.  The
+reference consumes those tensors by *mutating* the torch model (swapping modules
+for fused/TP-sharded ones, ``replace_module.py``).  TPU-native re-design: models
+are pure functions over a param pytree, so a policy here is a **converter** —
+it maps a HF ``transformers`` config to one of the zoo's flax model configs and
+a torch ``state_dict`` to the matching param tree.  Sharding then falls out of
+the existing PartitionSpec rules (``parallel/tensor_parallel.py``); nothing is
+mutated.
+
+Key numeric transforms (documented once, used by every rotary family):
+
+* torch ``nn.Linear`` stores ``weight`` as [out, in]; flax ``Dense`` kernels are
+  [in, out] → :func:`linear_t`.
+* HF's rotary families (llama/mistral/mixtral/falcon/phi/gpt-neox) use the
+  *rotate-half* convention: the head dim is split in two halves and (x1, x2) =
+  (x[:d/2], x[d/2:]).  This zoo (like GPT-J and the reference's
+  ``apply_rotary_pos_emb.cu``) uses the *interleaved* convention (pairs
+  (x[2i], x[2i+1])).  The two are related by a fixed permutation of the rotary
+  rows of the q/k projections, so conversion is exact: out-channel ``2i`` takes
+  rotate-half channel ``i`` and ``2i+1`` takes ``i + rd/2`` →
+  :func:`rope_permute`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def to_np(t) -> np.ndarray:
+    """torch tensor (any dtype/device) -> fp32 numpy."""
+    return t.detach().to("cpu").float().numpy()
+
+
+def linear_t(t) -> np.ndarray:
+    """torch Linear weight [out, in] -> flax kernel [in, out]."""
+    return to_np(t).T
+
+
+def rope_permute(kernel: np.ndarray, n_heads: int, head_dim: int,
+                 rotary_dim: Optional[int] = None) -> np.ndarray:
+    """Permute a flax q/k kernel's out-channels from rotate-half to interleaved
+    layout, per head, over the first ``rotary_dim`` channels (see module doc).
+
+    kernel: [in, n_heads * head_dim] (or [n_heads * head_dim] for a bias —
+    handled by reshaping through a leading axis of size 1).
+    """
+    vec = kernel.ndim == 1
+    if vec:
+        kernel = kernel[None, :]
+    rd = rotary_dim if rotary_dim is not None else head_dim
+    in_dim = kernel.shape[0]
+    w = kernel.reshape(in_dim, n_heads, head_dim)
+    rot = w[:, :, :rd]
+    half = rd // 2
+    inter = np.empty_like(rot)
+    inter[..., 0::2] = rot[..., :half]
+    inter[..., 1::2] = rot[..., half:]
+    w = np.concatenate([inter, w[:, :, rd:]], axis=-1)
+    out = w.reshape(in_dim, n_heads * head_dim)
+    return out[0] if vec else out
+
+
+def split_fused_qkv_per_head(w: np.ndarray, n_heads: int, head_dim: int
+                             ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Split a [3*H*D, in]-shaped fused qkv weight whose rows interleave per
+    head as [H, 3, D] (GPT-NeoX / BLOOM fused layout) into (q, k, v), each
+    [H*D, in].  Also accepts 1-d biases."""
+    vec = w.ndim == 1
+    if vec:
+        w = w[:, None]
+    in_dim = w.shape[1]
+    v3 = w.reshape(n_heads, 3, head_dim, in_dim)
+    q, k, v = (v3[:, i].reshape(n_heads * head_dim, in_dim) for i in range(3))
+    if vec:
+        q, k, v = q[:, 0], k[:, 0], v[:, 0]
+    return q, k, v
+
+
+def split_fused_qkv_grouped(w: np.ndarray, n_kv: int, q_per_kv: int,
+                            head_dim: int
+                            ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Split Falcon's fused qkv rows laid out as [n_kv, q_per_kv + 2, D]
+    (queries of the group, then its key head, then its value head) into
+    (q [n_kv*q_per_kv*D, in], k [n_kv*D, in], v [n_kv*D, in]).
+    ``multi_query`` (falcon-7b) is the n_kv == 1 special case."""
+    in_dim = w.shape[1]
+    g = w.reshape(n_kv, q_per_kv + 2, head_dim, in_dim)
+    q = g[:, :-2].reshape(n_kv * q_per_kv * head_dim, in_dim)
+    k = g[:, -2].reshape(n_kv * head_dim, in_dim)
+    v = g[:, -1].reshape(n_kv * head_dim, in_dim)
+    return q, k, v
+
+
+def ln_params(sd: Dict[str, Any], prefix: str) -> Dict[str, np.ndarray]:
+    """HF LayerNorm {weight, bias} -> flax {scale, bias}."""
+    out = {"scale": to_np(sd[f"{prefix}.weight"])}
+    if f"{prefix}.bias" in sd:
+        out["bias"] = to_np(sd[f"{prefix}.bias"])
+    return out
+
+
+def dense_params(sd: Dict[str, Any], prefix: str,
+                 bias: bool = True) -> Dict[str, np.ndarray]:
+    """HF Linear -> flax Dense {kernel[, bias]}."""
+    out = {"kernel": linear_t(sd[f"{prefix}.weight"])}
+    if bias and f"{prefix}.bias" in sd:
+        out["bias"] = to_np(sd[f"{prefix}.bias"])
+    return out
+
+
+def map_hf_activation(act: str) -> str:
+    """HF activation string -> DecoderConfig activation."""
+    if act in ("gelu_new", "gelu_fast", "gelu_pytorch_tanh", "gelu_python_tanh"):
+        return "gelu"          # tanh approximation (flax nn.gelu default)
+    if act in ("gelu", "gelu_python"):
+        return "gelu_exact"    # erf-exact
+    if act == "relu":
+        return "relu"
+    if act in ("silu", "swish"):
+        return "swiglu"
+    raise ValueError(f"unsupported HF activation: {act}")
+
+
+class HFInjectionPolicy:
+    """Base class: one policy per HF architecture family.
+
+    Subclasses set ``model_types`` (HF ``config.model_type`` strings) and
+    implement ``build(hf_config, dtype) -> (flax_module, zoo_config)`` and
+    ``convert(hf_config, state_dict) -> params`` (the inner ``{"params": ...}``
+    content, numpy leaves).
+    """
+
+    model_types: Tuple[str, ...] = ()
+
+    @classmethod
+    def matches(cls, hf_config) -> bool:
+        return getattr(hf_config, "model_type", None) in cls.model_types
+
+    def build(self, hf_config, dtype):
+        raise NotImplementedError
+
+    def convert(self, hf_config, state_dict) -> Dict[str, Any]:
+        raise NotImplementedError
+
+
+_REGISTRY: List[type] = []
+
+
+def register_policy(cls):
+    _REGISTRY.append(cls)
+    return cls
+
+
+def get_policy(hf_config) -> HFInjectionPolicy:
+    for cls in _REGISTRY:
+        if cls.matches(hf_config):
+            return cls()
+    raise ValueError(
+        f"no injection policy for HF model_type="
+    f"{getattr(hf_config, 'model_type', '?')}; supported: "
+        f"{sorted(t for c in _REGISTRY for t in c.model_types)}")
+
+
+def registered_model_types() -> List[str]:
+    return sorted(t for c in _REGISTRY for t in c.model_types)

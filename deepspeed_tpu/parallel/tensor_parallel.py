@@ -91,6 +91,8 @@ MODEL_TP_RULES: Dict[str, List[Tuple[str, str]]] = {
     "falcon": DECODER_TP_RULES,
     "phi": DECODER_TP_RULES,
     "gpt_neox": DECODER_TP_RULES,
+    "gptj": DECODER_TP_RULES,
+    "bloom": DECODER_TP_RULES,
 }
 
 # generic fallback patterns for unknown HF-style models (parity: AutoTP's
