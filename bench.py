@@ -41,10 +41,11 @@ def main():
     if on_tpu:
         cfg = GPT2Config(vocab_size=50257, n_positions=1024, n_embd=1024,
                          n_layer=24, n_head=16, dtype=jnp.bfloat16, remat=True)
-        # v5e-1 sweet spot from the bs sweep (8/16/24/32/48 -> 15.1k/18.2k/
-        # 19.2k/20.0k/OOM tok/s); the fused chunked CE keeps [B,T,V] logits
-        # out of HBM, which is what admits bs=32 at vocab 50257
-        bs, seq, steps, warmup = 32, 1024, 10, 3
+        # v5e-1 sweet spot from the bs sweep with Pallas flash attention at
+        # T=1024 (32/48/64/96 -> 24.8k/25.8k/26.7k/OOM tok/s; dense-XLA
+        # attention topped out at 20.1k @ bs=32). Flash's O(T) memory plus the
+        # fused chunked CE (no [B,T,V] logits) is what admits bs=64.
+        bs, seq, steps, warmup = 64, 1024, 10, 3
     else:  # CI / no-TPU fallback keeps the script honest but fast
         cfg = GPT2Config.tiny(dtype=jnp.bfloat16)
         bs, seq, steps, warmup = 8, 64, 3, 1

@@ -57,7 +57,7 @@ class DecoderConfig:
     num_key_value_heads: Optional[int] = None   # None -> MHA
     max_position_embeddings: int = 2048
     norm: str = "ln"                 # "ln" | "rms"
-    activation: str = "relu"         # "relu" | "gelu" (tanh) | "gelu_exact" | "swiglu"
+    activation: str = "relu"  # "relu" | "gelu" (tanh) | "gelu_exact" | "silu" | "swiglu"
     rope_theta: Optional[float] = None          # None -> no rotary
     rotary_pct: float = 1.0                     # fraction of head_dim that rotates
     learned_pos: bool = False
@@ -257,6 +257,8 @@ class _Mlp(nn.Module):
                 h = nn.gelu(h)
             elif cfg.activation == "gelu_exact":
                 h = nn.gelu(h, approximate=False)
+            elif cfg.activation == "silu":
+                h = nn.silu(h)
             else:
                 h = nn.relu(h)
         w_down = self.param("w_down", init, (ff, hid), jnp.float32)

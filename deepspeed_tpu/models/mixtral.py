@@ -35,6 +35,10 @@ class MixtralConfig(LlamaConfig):
     router_aux_loss_coef: float = 0.02
     capacity_factor: float = 2.0
     min_capacity: int = 4
+    # "capacity" = one-hot dispatch with capacity dropping (EP all-to-all
+    # capable); "dropless" = grouped-GEMM routing (lax.ragged_dot), exact HF
+    # Mixtral semantics (no token dropping), faster on a single expert shard
+    dispatch_mode: str = "capacity"
 
     @classmethod
     def mixtral_8x7b(cls, **kw):
@@ -70,6 +74,24 @@ class MixtralSparseMoeBlock(nn.Module):
 
         router = nn.Dense(E, use_bias=False, dtype=jnp.float32, name="gate")
         logits = router(tokens.astype(jnp.float32))           # fp32 routing
+
+        init = nn.initializers.normal(0.02)
+        w_gate = self.param("w_gate", init, (E, C, cfg.intermediate_size), cfg.dtype)
+        w_up = self.param("w_up", init, (E, C, cfg.intermediate_size), cfg.dtype)
+        w_down = self.param("w_down", init, (E, cfg.intermediate_size, C), cfg.dtype)
+
+        if cfg.dispatch_mode == "dropless":
+            from deepspeed_tpu.parallel.moe import dropless_moe
+
+            def swiglu_grouped(rows, group_sizes):
+                g = jax.lax.ragged_dot(rows, w_gate, group_sizes)
+                u = jax.lax.ragged_dot(rows, w_up, group_sizes)
+                return jax.lax.ragged_dot(nn.silu(g) * u, w_down, group_sizes)
+
+            out, l_aux = dropless_moe(tokens, logits, cfg.num_experts_per_tok,
+                                      swiglu_grouped)
+            return out.reshape(B, T, C), l_aux.astype(jnp.float32)
+
         cap = _capacity(N, E, cfg.capacity_factor * cfg.num_experts_per_tok,
                         cfg.min_capacity)
         combine, dispatch, l_aux = topk_gating(logits, cfg.num_experts_per_tok, cap)
@@ -79,10 +101,6 @@ class MixtralSparseMoeBlock(nn.Module):
         xs = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), tokens)
         xs = _constrain_expert(xs)
 
-        init = nn.initializers.normal(0.02)
-        w_gate = self.param("w_gate", init, (E, C, cfg.intermediate_size), cfg.dtype)
-        w_up = self.param("w_up", init, (E, C, cfg.intermediate_size), cfg.dtype)
-        w_down = self.param("w_down", init, (E, cfg.intermediate_size, C), cfg.dtype)
         h = nn.silu(jnp.einsum("ecd,edf->ecf", xs, w_gate)) * \
             jnp.einsum("ecd,edf->ecf", xs, w_up)
         ys = _constrain_expert(jnp.einsum("ecf,efd->ecd", h, w_down))  # [E, C_cap, d]

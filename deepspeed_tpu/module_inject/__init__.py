@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.module_inject import containers  # noqa: F401  (registers)
@@ -60,16 +61,11 @@ def convert_hf_model(model: Any, dtype: Any = jnp.bfloat16,
     policy = get_policy(hf_config)
     module, cfg = policy.build(hf_config, dtype)
     tree = policy.convert(hf_config, state_dict)
-    params = {"params": _cast_tree(tree, dtype)}
+    # leaves stay fp32 (the zoo's master-weight layout; models cast at use
+    # sites and the inference engine casts to its compute dtype) — `dtype`
+    # only selects the compute dtype baked into the returned zoo config.
+    params = {"params": jax.tree_util.tree_map(jnp.asarray, tree)}
     return module, cfg, params
-
-
-def _cast_tree(tree, dtype):
-    import jax
-    # fp32 master-layout leaves stay fp32 where the zoo keeps them fp32 (the
-    # models cast at use sites); inference casting happens in the engine, so
-    # here we only convert numpy -> jnp arrays without changing precision.
-    return jax.tree_util.tree_map(jnp.asarray, tree)
 
 
 def replace_module(model: Any, dtype: Any = jnp.bfloat16, **_ignored):

@@ -39,7 +39,8 @@ class GPT2Policy(HFInjectionPolicy):
         cfg = GPT2Config(vocab_size=hf_config.vocab_size,
                          n_positions=hf_config.n_positions,
                          n_embd=hf_config.n_embd, n_layer=hf_config.n_layer,
-                         n_head=hf_config.n_head, dtype=dtype)
+                         n_head=hf_config.n_head,
+                         eps=hf_config.layer_norm_epsilon, dtype=dtype)
         return GPT2LMHead(cfg), cfg
 
     def convert(self, hf_config, sd) -> Dict[str, Any]:
@@ -234,7 +235,7 @@ class _DecoderBase(HFInjectionPolicy):
     def _decoder_kwargs(self, hf_config) -> Dict[str, Any]:
         raise NotImplementedError
 
-    def _assemble(self, cfg, embed, layers, final_norm, pos_embed=None,
+    def _assemble(self, embed, layers, final_norm, pos_embed=None,
                   embed_norm=None, lm_head=None, lm_head_bias=None):
         p: Dict[str, Any] = {"embed": {"embedding": embed},
                              "final_norm": final_norm}
@@ -315,10 +316,12 @@ class OPTPolicy(_DecoderBase):
                 "mlp": self._mlp(sd, f"{l}.fc1", f"{l}.fc2"),
             })
         cfg = DecoderConfig(**self._decoder_kwargs(hf_config))
+        tied = cfg.tied_lm_head
         return self._assemble(
-            cfg, to_np(sd[f"{dec}.embed_tokens.weight"]), layers,
+            to_np(sd[f"{dec}.embed_tokens.weight"]), layers,
             ln_params(sd, f"{dec}.final_layer_norm"),
-            pos_embed=to_np(sd[f"{dec}.embed_positions.weight"]))
+            pos_embed=to_np(sd[f"{dec}.embed_positions.weight"]),
+            lm_head=None if tied else linear_t(sd["lm_head.weight"]))
 
 
 @register_policy
@@ -384,7 +387,7 @@ class FalconPolicy(_DecoderBase):
             layers.append(lp)
         tied = cfg.tied_lm_head
         return self._assemble(
-            cfg, to_np(sd["transformer.word_embeddings.weight"]), layers,
+            to_np(sd["transformer.word_embeddings.weight"]), layers,
             ln_params(sd, "transformer.ln_f"),
             lm_head=None if tied else linear_t(sd["lm_head.weight"]))
 
@@ -437,7 +440,7 @@ class PhiPolicy(_DecoderBase):
                 "mlp": self._mlp(sd, f"{l}.mlp.fc1", f"{l}.mlp.fc2"),
             })
         return self._assemble(
-            cfg, to_np(sd["model.embed_tokens.weight"]), layers,
+            to_np(sd["model.embed_tokens.weight"]), layers,
             ln_params(sd, "model.final_layernorm"),
             lm_head=linear_t(sd["lm_head.weight"]),
             lm_head_bias=to_np(sd["lm_head.bias"]))
@@ -493,7 +496,7 @@ class GPTNeoXPolicy(_DecoderBase):
             })
         tied = cfg.tied_lm_head
         return self._assemble(
-            cfg, to_np(sd["gpt_neox.embed_in.weight"]), layers,
+            to_np(sd["gpt_neox.embed_in.weight"]), layers,
             ln_params(sd, "gpt_neox.final_layer_norm"),
             lm_head=None if tied else linear_t(sd["embed_out.weight"]))
 
@@ -534,7 +537,7 @@ class GPTJPolicy(_DecoderBase):
                 "mlp": self._mlp(sd, f"{l}.mlp.fc_in", f"{l}.mlp.fc_out"),
             })
         return self._assemble(
-            None, to_np(sd["transformer.wte.weight"]), layers,
+            to_np(sd["transformer.wte.weight"]), layers,
             ln_params(sd, "transformer.ln_f"),
             lm_head=linear_t(sd["lm_head.weight"]),
             lm_head_bias=to_np(sd["lm_head.bias"]))
@@ -577,7 +580,9 @@ class BloomPolicy(_DecoderBase):
                 "mlp": self._mlp(sd, f"{l}.mlp.dense_h_to_4h",
                                  f"{l}.mlp.dense_4h_to_h"),
             })
+        tied = cfg.tied_lm_head
         return self._assemble(
-            cfg, to_np(sd["transformer.word_embeddings.weight"]), layers,
+            to_np(sd["transformer.word_embeddings.weight"]), layers,
             ln_params(sd, "transformer.ln_f"),
-            embed_norm=ln_params(sd, "transformer.word_embeddings_layernorm"))
+            embed_norm=ln_params(sd, "transformer.word_embeddings_layernorm"),
+            lm_head=None if tied else linear_t(sd["lm_head.weight"]))

@@ -123,7 +123,7 @@ def map_hf_activation(act: str) -> str:
     if act == "relu":
         return "relu"
     if act in ("silu", "swish"):
-        return "swiglu"
+        return "silu"     # plain (non-gated) silu MLP
     raise ValueError(f"unsupported HF activation: {act}")
 
 

@@ -29,11 +29,13 @@ def _use_pallas() -> bool:
         return False
 
 
-# Below this sequence length XLA's fused attention beats the Pallas kernel on a
-# v5e-1 microbenchmark (fwd+bwd, B=4/H=16/D=64: flash 9.2ms vs XLA 6.8ms at T=1024;
-# flash 9.0ms vs XLA 13.0ms at T=2048 — see git history of this line to re-tune).
-# Env override for memory-constrained runs: flash is O(T) memory, XLA path is O(T^2).
-FLASH_MIN_SEQ = int(os.environ.get("DSTPU_FLASH_MIN_SEQ", 2048))
+# Threshold re-tuned on the full GPT-2-medium train step (v5e-1, bf16, remat,
+# T=1024): flash 24.8k tok/s vs XLA-dense 20.1k at bs=32, and flash's O(T)
+# memory admits bs=64 (26.7k) where the dense path OOMs — the earlier small-B
+# microbenchmark (B=4: XLA 6.8ms vs flash 9.2ms) was misleading at training
+# batch sizes, where the [B,H,T,T] fp32 score tensor is HBM-bound.
+# Env override: DSTPU_FLASH_MIN_SEQ (raise it for tiny-batch inference).
+FLASH_MIN_SEQ = int(os.environ.get("DSTPU_FLASH_MIN_SEQ", 1024))
 
 
 def padding_mask_to_bias(mask: jax.Array) -> jax.Array:

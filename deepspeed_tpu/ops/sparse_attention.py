@@ -280,6 +280,23 @@ def sparse_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     B, H, S, D = q.shape
     layout = sparsity_config.make_layout(S)
+
+    # hot path: the Pallas block-sparse kernel (skips inactive blocks) when no
+    # dynamic masks are attached; dense-mask fallback otherwise / on CPU
+    import os
+    if (key_padding_mask is None and attn_mask is None
+            and jax.default_backend() == "tpu"
+            and not os.environ.get("DSTPU_DISABLE_PALLAS")):
+        from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+            block_sparse_attention)
+        causal = (sparsity_config.attention == "unidirectional"
+                  and causal_within_block)
+        out = block_sparse_attention(
+            jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
+            jnp.swapaxes(v, 1, 2), layout, sparsity_config.block,
+            causal=causal)
+        return jnp.swapaxes(out, 1, 2)
+
     mask = layout_to_mask(layout, sparsity_config.block)  # [H, S, S]
     if sparsity_config.attention == "unidirectional" and causal_within_block:
         causal = np.triu(np.full((S, S), -1e9, np.float32), k=1)

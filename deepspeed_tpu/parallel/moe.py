@@ -107,7 +107,9 @@ def topk_gating(logits: jax.Array, k: int, capacity: int
 
 class Experts(nn.Module):
     """Parity: ``Experts`` (moe/experts.py) — E FFNs evaluated batched on the MXU;
-    weights [E, ...] sharded over the 'expert' axis by the TP/EP spec rules."""
+    weights [E, ...] sharded over the 'expert' axis by the TP/EP spec rules.
+    Two compute paths over the same params: ``__call__`` (capacity layout
+    [E, C, d]) and ``grouped`` (ragged rows sorted by expert)."""
 
     num_experts: int
     d_model: int
@@ -115,21 +117,79 @@ class Experts(nn.Module):
     activation: Callable = nn.gelu
     dtype: Any = jnp.float32
 
-    @nn.compact
+    def setup(self):
+        self.wi = self.param("wi", nn.initializers.normal(0.02),
+                             (self.num_experts, self.d_model, self.d_ff),
+                             jnp.float32)
+        self.wo = self.param("wo", nn.initializers.normal(0.02),
+                             (self.num_experts, self.d_ff, self.d_model),
+                             jnp.float32)
+
     def __call__(self, x):  # x: [E, C, d_model]
-        wi = self.param("wi", nn.initializers.normal(0.02),
-                        (self.num_experts, self.d_model, self.d_ff), jnp.float32)
-        wo = self.param("wo", nn.initializers.normal(0.02),
-                        (self.num_experts, self.d_ff, self.d_model), jnp.float32)
-        h = jnp.einsum("ecd,edf->ecf", x, wi.astype(self.dtype))
+        h = jnp.einsum("ecd,edf->ecf", x, self.wi.astype(self.dtype))
         h = self.activation(h)
-        return jnp.einsum("ecf,efd->ecd", h, wo.astype(self.dtype))
+        return jnp.einsum("ecf,efd->ecd", h, self.wo.astype(self.dtype))
+
+    def grouped(self, x, group_sizes):  # x: [M, d_model] rows sorted by expert
+        """Grouped GEMM over contiguous per-expert row blocks
+        (``jax.lax.ragged_dot`` — the MoE-GEMM analog of the reference's
+        CUTLASS grouped kernels, ``inference/v2/kernels/cutlass_ops/moe_gemm``)."""
+        h = jax.lax.ragged_dot(x, self.wi.astype(self.dtype), group_sizes)
+        h = self.activation(h)
+        return jax.lax.ragged_dot(h, self.wo.astype(self.dtype), group_sizes)
+
+
+def dropless_moe(tokens: jax.Array, gate_logits: jax.Array, k: int,
+                 grouped_ffn: Callable) -> Tuple[jax.Array, jax.Array]:
+    """Dropless token-routing via grouped GEMM.
+
+    TPU-native alternative to the reference's capacity-einsum dispatch
+    (``sharded_moe.py:477``): instead of one-hot dispatch/combine einsums with a
+    fixed per-expert capacity (which both drops overflow tokens and burns
+    N*E*C*D dispatch FLOPs), sort the N*k (token, expert) assignments by expert
+    id and run the expert FFNs as ragged GEMMs over contiguous groups — no
+    token dropped, no capacity padding, and the MXU sees dense [N*k, D] tiles.
+    This is the Mixtral/Megablocks-style "dropless" formulation; shapes stay
+    static (N*k rows) so it jits cleanly.
+
+    tokens [N, D]; gate_logits [N, E] fp32; ``grouped_ffn(rows, group_sizes)``
+    applies the per-expert FFN to expert-sorted rows (``Experts.grouped``).
+    Returns (out [N, D], l_aux) with the reference's top-1 aux loss.
+    """
+    N, D = tokens.shape
+    E = gate_logits.shape[-1]
+    gates = jax.nn.softmax(gate_logits, axis=-1)                # [N, E]
+    top_w, top_e = jax.lax.top_k(gates, k)                      # [N, k]
+    top_w = top_w / jnp.maximum(jnp.sum(top_w, axis=-1, keepdims=True), 1e-9)
+
+    # aux loss (reference l_aux: E * sum_e mean(gates_e) * mean(top1_mask_e))
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(top_e[:, 0], E, dtype=gates.dtype), axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    flat_e = top_e.reshape(-1)                                  # [N*k]
+    flat_w = top_w.reshape(-1)
+    flat_tok = jnp.repeat(jnp.arange(N), k)
+    order = jnp.argsort(flat_e)                                 # stable: groups by expert
+    src = flat_tok[order]
+    group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+
+    expert_out = grouped_ffn(tokens[src], group_sizes)          # [N*k, D]
+    weighted = expert_out * flat_w[order][:, None].astype(expert_out.dtype)
+    out = jnp.zeros((N, D), expert_out.dtype).at[src].add(weighted)
+    return out, l_aux
 
 
 class MoE(nn.Module):
     """Parity: ``MoE`` (moe/layer.py:16) + ``MOELayer.forward``
     (sharded_moe.py:477): gate -> dispatch einsum -> expert-sharded FFN ->
-    combine einsum. Returns (output, l_aux)."""
+    combine einsum. Returns (output, l_aux).
+
+    ``dispatch_mode``: 'capacity' = reference-parity one-hot dispatch with
+    capacity dropping (required for expert-parallel all-to-all); 'dropless' =
+    grouped-GEMM routing (``dropless_moe``) — faster on a single expert shard
+    (TP/DP meshes), keeps every token.
+    """
 
     d_model: int
     d_ff: int
@@ -140,6 +200,7 @@ class MoE(nn.Module):
     activation: Callable = nn.gelu
     dtype: Any = jnp.float32
     use_ep_sharding: bool = True
+    dispatch_mode: str = "capacity"   # "capacity" | "dropless"
 
     @nn.compact
     def __call__(self, x):  # x: [B, S, d]
@@ -148,6 +209,14 @@ class MoE(nn.Module):
         tokens = x.reshape(N, D)
         gate_logits = nn.Dense(self.num_experts, use_bias=False, dtype=jnp.float32,
                                name="gate")(tokens.astype(jnp.float32))
+        experts = Experts(self.num_experts, D, self.d_ff, self.activation,
+                          self.dtype, name="experts")
+
+        if self.dispatch_mode == "dropless":
+            out, l_aux = dropless_moe(tokens, gate_logits, self.k,
+                                      experts.grouped)
+            return out.reshape(B, S, D), l_aux
+
         cap = _capacity(N, self.num_experts, self.capacity_factor * self.k,
                         self.min_capacity)
         combine, dispatch, l_aux = topk_gating(gate_logits, self.k, cap)
@@ -156,8 +225,7 @@ class MoE(nn.Module):
         expert_in = jnp.einsum("nd,nec->ecd", tokens, dispatch.astype(x.dtype))
         if self.use_ep_sharding:
             expert_in = _constrain_expert(expert_in)  # -> all-to-all over 'expert'
-        expert_out = Experts(self.num_experts, D, self.d_ff, self.activation,
-                             self.dtype, name="experts")(expert_in)
+        expert_out = experts(expert_in)
         if self.use_ep_sharding:
             expert_out = _constrain_expert(expert_out)
         # combine: [E,C,d] x [N,E,C] -> [N,d]

@@ -129,6 +129,17 @@ def test_opt():
     _check(transformers.OPTForCausalLM(cfg), _ids(103))
 
 
+def test_opt_untied_head():
+    torch.manual_seed(SEED)
+    cfg = transformers.OPTConfig(vocab_size=103, hidden_size=32, ffn_dim=64,
+                                 num_hidden_layers=2, num_attention_heads=4,
+                                 max_position_embeddings=64, dropout=0.0,
+                                 attention_dropout=0.0, activation_dropout=0.0,
+                                 word_embed_proj_dim=32,
+                                 tie_word_embeddings=False)
+    _check(transformers.OPTForCausalLM(cfg), _ids(103))
+
+
 @pytest.mark.parametrize("new_arch", [False, True])
 def test_falcon(new_arch):
     torch.manual_seed(SEED)
