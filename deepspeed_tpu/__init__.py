@@ -16,6 +16,25 @@ from deepspeed_tpu import comm
 from deepspeed_tpu import ops  # noqa: F401
 from deepspeed_tpu.utils.logging import logger
 
+# reference-spelled subpackage surface (parity: deepspeed/__init__.py imports
+# ops/module_inject/zero/pipe/moe/... eagerly so `deepspeed.X` works)
+from deepspeed_tpu import accelerator  # noqa: F401
+from deepspeed_tpu import checkpoint  # noqa: F401
+from deepspeed_tpu import module_inject  # noqa: F401
+from deepspeed_tpu import moe  # noqa: F401
+from deepspeed_tpu import monitor  # noqa: F401
+from deepspeed_tpu import pipe  # noqa: F401
+from deepspeed_tpu import profiling  # noqa: F401
+from deepspeed_tpu import runtime  # noqa: F401
+from deepspeed_tpu import sequence  # noqa: F401
+from deepspeed_tpu import utils  # noqa: F401
+from deepspeed_tpu import zero  # noqa: F401
+from deepspeed_tpu.comm.comm import init_distributed  # noqa: F401
+from deepspeed_tpu.pipe import PipelineModule  # noqa: F401
+from deepspeed_tpu.runtime import activation_checkpointing as checkpointing  # noqa: F401
+from deepspeed_tpu.runtime.engine import DeepSpeedTPUEngine as DeepSpeedEngine  # noqa: F401
+from deepspeed_tpu.utils.init_on_device import OnDevice  # noqa: F401
+
 
 def initialize(args=None,
                model=None,

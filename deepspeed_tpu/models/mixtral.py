@@ -81,7 +81,9 @@ class MixtralSparseMoeBlock(nn.Module):
         w_down = self.param("w_down", init, (E, cfg.intermediate_size, C), cfg.dtype)
 
         if cfg.dispatch_mode == "dropless":
-            from deepspeed_tpu.parallel.moe import dropless_moe
+            from deepspeed_tpu.parallel.moe import (_reject_ep_dropless,
+                                                    dropless_moe)
+            _reject_ep_dropless(True)
 
             def swiglu_grouped(rows, group_sizes):
                 g = jax.lax.ragged_dot(rows, w_gate, group_sizes)

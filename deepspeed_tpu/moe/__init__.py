@@ -1,0 +1,11 @@
+"""Reference-spelled ``deepspeed.moe`` package (re-exports of parallel/moe.py).
+
+Parity: ``deepspeed/moe/__init__.py`` + ``moe/layer.py`` + ``moe/utils.py``.
+"""
+from deepspeed_tpu.parallel.moe import (MoE, Experts, dropless_moe,
+                                        top1_gating, topk_gating,
+                                        derive_ep_specs, is_moe_param)
+from deepspeed_tpu.moe import layer, sharded_moe, utils  # noqa: F401
+
+__all__ = ["MoE", "Experts", "dropless_moe", "top1_gating", "topk_gating",
+           "derive_ep_specs", "is_moe_param", "layer", "sharded_moe", "utils"]

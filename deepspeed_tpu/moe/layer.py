@@ -1,0 +1,2 @@
+"""Parity spelling: ``deepspeed.moe.layer`` (``moe/layer.py:16``)."""
+from deepspeed_tpu.parallel.moe import MoE, Experts  # noqa: F401

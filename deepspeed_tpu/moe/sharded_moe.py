@@ -1,0 +1,5 @@
+"""Parity spelling: ``deepspeed.moe.sharded_moe`` (gating fns, ``sharded_moe.py``)."""
+from deepspeed_tpu.parallel.moe import (_capacity, dropless_moe,  # noqa: F401
+                                        top1_gating, topk_gating)
+top1gating = top1_gating
+top2gating = topk_gating

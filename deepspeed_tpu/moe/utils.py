@@ -1,0 +1,2 @@
+"""Parity spelling: ``deepspeed.moe.utils`` (``moe/utils.py``)."""
+from deepspeed_tpu.parallel.moe import derive_ep_specs, is_moe_param  # noqa: F401

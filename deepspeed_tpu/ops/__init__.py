@@ -15,6 +15,7 @@ from deepspeed_tpu.ops.lion import FusedLion, DeepSpeedCPULion
 from deepspeed_tpu.ops.adagrad import DeepSpeedCPUAdagrad, Adagrad
 from deepspeed_tpu.ops.onebit import OnebitAdam, OnebitLamb, ZeroOneAdam
 from deepspeed_tpu.ops.sgd import SGD
+from deepspeed_tpu.ops import spatial  # noqa: F401  (diffusers bias-add parity)
 
 # Names accepted in config optimizer.type, matching the reference's
 # _configure_basic_optimizer dispatch (runtime/engine.py:1258: adam/adamw/lamb/

@@ -75,12 +75,20 @@ def _row_tables(layout_c: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
 def _masked_scores(q, k, mask_ref, q_base, k_base, scale, causal):
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-    m = mask_ref[0, :, :] > 0
+    m = mask_ref[0, :, :].astype(jnp.int32) > 0
     if causal:
         q_idx = q_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_idx = k_base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         m = jnp.logical_and(m, q_idx >= k_idx)
     return jnp.where(m, s, NEG_INF)
+
+
+def _safe_exp(s, ref):
+    """exp(s - ref) with fully-masked rows forced to 0.  When every element
+    of a row is masked, s == ref == NEG_INF and a naive exp(s - ref) would be
+    exp(0) = 1, silently attending to everything the tile visited (and, in the
+    backward, exploding p for rows whose saved lse is the NEG_INF sentinel)."""
+    return jnp.where(s <= NEG_INF * 0.5, 0.0, jnp.exp(s - ref))
 
 
 def _fwd_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, mask_ref,
@@ -102,8 +110,8 @@ def _fwd_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, mask_ref,
         v = v_ref[0, 0, :, :]
         m_prev = m_sc[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
+        p = _safe_exp(s, m_new)
+        alpha = jnp.exp(jnp.minimum(m_prev - m_new, 0.0))
         l_sc[:, 0:1] = l_sc[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
         m_sc[:, 0:1] = m_new
         acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
@@ -134,7 +142,7 @@ def _dq_kernel(cols_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         k = k_ref[0, 0, :, :]
         s = _masked_scores(q_ref[0, 0, :, :], k, mask_ref,
                            iq * bq, cols_ref[hl, iq, s_i] * bk, scale, causal)
-        p = jnp.exp(s - lse_ref[0, 0, :, :])
+        p = _safe_exp(s, lse_ref[0, 0, :, :])
         dp = jax.lax.dot_general(do_ref[0, 0, :, :], v_ref[0, 0, :, :],
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -165,7 +173,7 @@ def _dkv_kernel(rows_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         do = do_ref[0, 0, :, :]
         s = _masked_scores(q, k_ref[0, 0, :, :], mask_ref,
                            rows_ref[hl, ik, s_i] * bq, ik * bk, scale, causal)
-        p = jnp.exp(s - lse_ref[0, 0, :, :])
+        p = _safe_exp(s, lse_ref[0, 0, :, :])
         dv_sc[:] += jax.lax.dot_general(p.astype(do.dtype), do,
                                         (((0,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
@@ -214,21 +222,21 @@ class _BSA:
         self.snum_c = self.rows.shape[2]
 
     def _fine_tiles(self, layout, table, counts, transpose):
-        """uint8 [Hl * n_outer * snum, bq, bk] elementwise tile masks.  For the
+        """int8 [Hl * n_outer * snum, bq, bk] elementwise tile masks.  For the
         row orientation outer = q-block and table holds k-cols; for the column
         orientation outer = k-block and table holds q-rows."""
         Hl, nb, _ = layout.shape
         m, b = self.mult, self.block
         n_outer, snum = table.shape[1], table.shape[2]
-        out = np.zeros((Hl, n_outer, snum, self.bq, self.bk), np.int32)
+        out = np.zeros((Hl, n_outer, snum, self.bq, self.bk), np.int8)
         for h in range(Hl):
             for i in range(n_outer):
                 for s in range(int(counts[h, i])):
                     j = int(table[h, i, s])
                     qi, ki = (j, i) if transpose else (i, j)
                     fine = layout[h, qi * m:(qi + 1) * m, ki * m:(ki + 1) * m]
-                    out[h, i, s] = np.kron(fine.astype(np.int32),
-                                           np.ones((b, b), np.int32))
+                    out[h, i, s] = np.kron(fine.astype(np.int8),
+                                           np.ones((b, b), np.int8))
         return out.reshape(Hl * n_outer * snum, self.bq, self.bk)
 
     def _common(self, kernel, grid, scalars, tensors, in_specs, out_specs,
@@ -336,18 +344,18 @@ def _get_bsa(layout_bytes, shape, block, causal, block_mult) -> _BSA:
     return _CACHE[key]
 
 
-def block_sparse_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                           layout: np.ndarray, block: int,
-                           causal: bool = False,
-                           softmax_scale: Optional[float] = None,
-                           block_mult: int = 8) -> jax.Array:
-    """Block-sparse attention over [B, T, H, D] with a static [H, nb, nb]
-    layout (1 = attend).  Compute/HBM scale with active blocks, not T^2.
-
-    ``block`` is the layout's block granularity; kernel tiles fuse
+def block_sparse_attention_bhsd(q: jax.Array, k: jax.Array, v: jax.Array,
+                                layout: np.ndarray, block: int,
+                                causal: bool = False,
+                                softmax_scale: Optional[float] = None,
+                                block_mult: int = 8) -> jax.Array:
+    """Block-sparse attention over [B, H, S, D] (the kernel's native layout;
+    ``sparse_self_attention`` calls this directly to avoid transposes).
+    Static [H, nb, nb] layout, 1 = attend; compute/HBM scale with active
+    blocks, not T^2.  ``block`` is the layout granularity; kernel tiles fuse
     ``block_mult`` layout blocks per side.  Fully-masked rows produce zeros
     (matching the dense-mask reference path's safe-softmax guard)."""
-    B, T, H, D = q.shape
+    B, H, T, D = q.shape
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
     layout = np.ascontiguousarray(layout.astype(np.uint8))
     if layout.ndim == 2:
@@ -355,8 +363,6 @@ def block_sparse_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     bsa = _get_bsa(layout.tobytes(), layout.shape, block, causal, block_mult)
     if T % bsa.bq != 0:
         raise ValueError(f"T={T} not divisible by kernel tile {bsa.bq}")
-
-    qt, kt, vt = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))  # [B, H, T, D]
 
     @jax.custom_vjp
     def run(qt, kt, vt):
@@ -372,4 +378,18 @@ def block_sparse_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return bsa.bwd(qt, kt, vt, o, lse, g, scale)
 
     run.defvjp(run_fwd, run_bwd)
-    return jnp.swapaxes(run(qt, kt, vt), 1, 2)
+    return run(q, k, v)
+
+
+def block_sparse_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                           layout: np.ndarray, block: int,
+                           causal: bool = False,
+                           softmax_scale: Optional[float] = None,
+                           block_mult: int = 8) -> jax.Array:
+    """[B, T, H, D] convenience wrapper over
+    :func:`block_sparse_attention_bhsd`."""
+    out = block_sparse_attention_bhsd(
+        jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
+        layout, block, causal=causal, softmax_scale=softmax_scale,
+        block_mult=block_mult)
+    return jnp.swapaxes(out, 1, 2)

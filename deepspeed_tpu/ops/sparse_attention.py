@@ -288,14 +288,12 @@ def sparse_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             and jax.default_backend() == "tpu"
             and not os.environ.get("DSTPU_DISABLE_PALLAS")):
         from deepspeed_tpu.ops.pallas.block_sparse_attention import (
-            block_sparse_attention)
+            block_sparse_attention_bhsd)
         causal = (sparsity_config.attention == "unidirectional"
                   and causal_within_block)
-        out = block_sparse_attention(
-            jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2),
-            jnp.swapaxes(v, 1, 2), layout, sparsity_config.block,
-            causal=causal)
-        return jnp.swapaxes(out, 1, 2)
+        return block_sparse_attention_bhsd(q, k, v, layout,
+                                           sparsity_config.block,
+                                           causal=causal)
 
     mask = layout_to_mask(layout, sparsity_config.block)  # [H, S, S]
     if sparsity_config.attention == "unidirectional" and causal_within_block:

@@ -1,0 +1,61 @@
+"""Spatial (diffusers/UNet/VAE) fused ops.
+
+Parity: ``csrc/spatial/csrc/opt_bias_add.cu`` (``SpatialInferenceBuilder``) —
+fused bias-add variants used by the reference's diffusers acceleration
+(``model_implementations/diffusers/``).  On TPU these are single XLA fusions;
+the functions exist so user code and the kernel registry have the same
+surface, and so the channels-last layout guidance is encoded in one place
+(NHWC is the TPU-native conv layout; NCHW inputs are transposed through lax).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def nhwc(x: jax.Array) -> jax.Array:
+    """NCHW -> NHWC (TPU conv layout)."""
+    return jnp.transpose(x, (0, 2, 3, 1))
+
+
+def nchw(x: jax.Array) -> jax.Array:
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+def bias_add(activation: jax.Array, bias: jax.Array) -> jax.Array:
+    """Parity: ``opt_bias_add`` — activation [N, H, W, C] (or [N, C, H, W]),
+    bias [C]."""
+    if activation.ndim == 4 and activation.shape[1] == bias.shape[0] \
+            and activation.shape[-1] != bias.shape[0]:
+        return activation + bias[None, :, None, None]
+    return activation + bias
+
+
+def bias_add_add(activation: jax.Array, bias: jax.Array,
+                 other: jax.Array) -> jax.Array:
+    """Parity: ``opt_bias_add_add`` — (activation + bias) + other, one fusion."""
+    return bias_add(activation, bias) + other
+
+
+def bias_add_residual(activation: jax.Array, bias: Optional[jax.Array],
+                      residual: jax.Array,
+                      attention_output: Optional[jax.Array] = None,
+                      attention_bias: Optional[jax.Array] = None,
+                      mp_size: int = 1) -> jax.Array:
+    """Parity: ``ds_bias_add_residual`` composition used by the diffusers
+    UNet blocks: residual + (activation + bias)/mp + optional attention term."""
+    out = activation
+    if bias is not None:
+        out = bias_add(out, bias)
+    if mp_size > 1:
+        out = out / mp_size
+    out = out + residual
+    if attention_output is not None:
+        att = attention_output
+        if attention_bias is not None:
+            att = bias_add(att, attention_bias)
+        out = out + att
+    return out

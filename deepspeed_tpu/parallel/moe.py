@@ -213,6 +213,7 @@ class MoE(nn.Module):
                           self.dtype, name="experts")
 
         if self.dispatch_mode == "dropless":
+            _reject_ep_dropless(self.use_ep_sharding)
             out, l_aux = dropless_moe(tokens, gate_logits, self.k,
                                       experts.grouped)
             return out.reshape(B, S, D), l_aux
@@ -231,6 +232,24 @@ class MoE(nn.Module):
         # combine: [E,C,d] x [N,E,C] -> [N,d]
         out = jnp.einsum("ecd,nec->nd", expert_out, combine.astype(x.dtype))
         return out.reshape(B, S, D), l_aux
+
+
+def _reject_ep_dropless(use_ep_sharding: bool) -> None:
+    """Dropless routing keeps the full [E, ...] expert stacks on every shard
+    (ragged GEMM over contiguous groups has no all-to-all form here yet); on an
+    expert-parallel mesh that would silently all-gather every expert's weights.
+    Fail loudly instead of scaling badly."""
+    if not use_ep_sharding:
+        return
+    try:
+        topo = get_topology()
+    except Exception:
+        return
+    if topo.ep_world_size > 1:
+        raise ValueError(
+            "dispatch_mode='dropless' does not shard experts over the "
+            "'expert' mesh axis; use dispatch_mode='capacity' for "
+            f"expert-parallel meshes (ep={topo.ep_world_size})")
 
 
 def _constrain_expert(t: jax.Array) -> jax.Array:

@@ -1,0 +1,53 @@
+"""Reference-spelled ``deepspeed.pipe`` API surface.
+
+Parity: ``deepspeed.pipe`` re-exports ``PipelineModule``, ``LayerSpec``,
+``TiedLayerSpec`` (``runtime/pipe/__init__.py``).  The TPU pipeline engine
+lives in ``parallel/pipeline.py`` (gpipe/1F1B over shard_map+ppermute);
+``LayerSpec`` maps to a deferred flax-module constructor.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Tuple
+
+from deepspeed_tpu.parallel.pipeline import (PipelineLM, PipelineModule,
+                                             gpipe_apply, partition_balanced,
+                                             partition_uniform)
+
+
+@dataclass
+class LayerSpec:
+    """Parity: ``LayerSpec`` (runtime/pipe/module.py) — a deferred layer
+    constructor so stages only build their own layers.  Under JAX, building is
+    lazy anyway; this keeps user code source-compatible."""
+
+    typename: Callable
+    module_args: Tuple = ()
+    module_kwargs: Dict[str, Any] = field(default_factory=dict)
+
+    def __init__(self, typename, *args, **kwargs):
+        self.typename = typename
+        self.module_args = args
+        self.module_kwargs = kwargs
+
+    def build(self):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+
+@dataclass
+class TiedLayerSpec(LayerSpec):
+    """Parity: ``TiedLayerSpec`` — layers sharing params across stages (e.g.
+    embedding/LM-head).  The TPU pipeline keeps tied weights replicated
+    outside the pipeline region (``PipelineLM``), so ``key`` is advisory."""
+
+    def __init__(self, key, typename, *args, forward_fn=None,
+                 tied_weight_attr="weight", **kwargs):
+        super().__init__(typename, *args, **kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+__all__ = ["PipelineModule", "PipelineLM", "LayerSpec", "TiedLayerSpec",
+           "gpipe_apply", "partition_balanced", "partition_uniform"]

@@ -1,0 +1,12 @@
+"""Reference-spelled ``deepspeed.sequence`` package (Ulysses SP).
+
+Parity: ``deepspeed/sequence/layer.py`` — ``DistributedAttention`` and
+``single_all_to_all`` live in ``parallel/ulysses.py`` (plus the TPU-natural
+ring-attention CP in ``parallel/ring.py``, absent from the reference).
+"""
+from deepspeed_tpu.sequence import layer  # noqa: F401
+from deepspeed_tpu.parallel.ulysses import (DistributedAttention,  # noqa: F401
+                                            single_all_to_all, ulysses_attention)
+
+__all__ = ["DistributedAttention", "single_all_to_all", "ulysses_attention",
+           "layer"]

@@ -1,0 +1,3 @@
+"""Parity spelling: ``deepspeed.sequence.layer``."""
+from deepspeed_tpu.parallel.ulysses import (DistributedAttention,  # noqa: F401
+                                            single_all_to_all, ulysses_attention)

@@ -1,0 +1,90 @@
+"""NUMA-aware process binding (parity: ``deepspeed/utils/numa.py``, 202 LoC).
+
+The reference launcher binds each local rank to a NUMA node (``numactl``
+prefixes built by ``get_numactl_cmd``) so host-side optimizer/offload threads
+stay NUMA-local.  On TPU VMs the same concern applies to host-offloaded
+optimizer steps and the AIO spill path (``ops/native``): one process per host
+serves all chips, so binding matters mainly for the ``--bind_cores_to_rank``
+launcher mode with multiple processes per host.
+
+Pure-python sysfs parsing (no numactl dependency at import time); the launcher
+prepends ``numactl`` only when requested and available.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Dict, List, Tuple
+
+
+def available() -> bool:
+    """True when the host exposes NUMA topology and numactl exists."""
+    return os.path.isdir("/sys/devices/system/node") and \
+        shutil.which("numactl") is not None
+
+
+def get_numa_cores() -> Dict[int, List[int]]:
+    """node id -> cpu list, parsed from sysfs (empty dict when not exposed)."""
+    base = "/sys/devices/system/node"
+    out: Dict[int, List[int]] = {}
+    if not os.path.isdir(base):
+        return out
+    for entry in sorted(os.listdir(base)):
+        if not entry.startswith("node") or not entry[4:].isdigit():
+            continue
+        node = int(entry[4:])
+        cpulist = os.path.join(base, entry, "cpulist")
+        try:
+            with open(cpulist) as f:
+                spec = f.read().strip()
+        except OSError:
+            continue
+        cpus: List[int] = []
+        for part in spec.split(","):
+            if "-" in part:
+                a, b = part.split("-")
+                cpus.extend(range(int(a), int(b) + 1))
+            elif part:
+                cpus.append(int(part))
+        out[node] = cpus
+    return out
+
+
+def check_for_numactl() -> bool:
+    """Parity: reference checks numactl is installed before binding."""
+    return shutil.which("numactl") is not None
+
+
+def get_numactl_cmd(bind_core_list: str, num_local_procs: int,
+                    local_rank: int) -> Tuple[List[str], List[int]]:
+    """Parity: ``get_numactl_cmd`` — build the ``numactl`` prefix binding
+    ``local_rank`` to its slice of cores (and to a NUMA node when the slice
+    falls entirely inside one node).
+
+    ``bind_core_list``: comma/dash core spec ("0-27,56-83") or "" for all.
+    Returns (numactl argv prefix, core ids for this rank).
+    """
+    cores: List[int] = []
+    if bind_core_list:
+        for part in bind_core_list.split(","):
+            if "-" in part:
+                a, b = part.split("-")
+                cores.extend(range(int(a), int(b) + 1))
+            elif part:
+                cores.append(int(part))
+    else:
+        cores = list(range(os.cpu_count() or 1))
+    per = max(len(cores) // max(num_local_procs, 1), 1)
+    mine = cores[local_rank * per:(local_rank + 1) * per] or cores[-per:]
+
+    argv = ["numactl"]
+    numa_map = get_numa_cores()
+    for node, node_cpus in numa_map.items():
+        if mine and set(mine) <= set(node_cpus):
+            argv += ["-m", str(node)]
+            break
+    spec = ",".join(str(c) for c in mine)
+    argv += ["-C", spec]
+    return argv, mine

