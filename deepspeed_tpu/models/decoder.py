@@ -42,6 +42,8 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.models.llama import (causal_lm_loss, repeat_kv,
                                         rope_frequencies, _window_bias)
+from jax.ad_checkpoint import checkpoint_name
+
 from deepspeed_tpu.ops.attention import dot_product_attention, reference_attention
 from deepspeed_tpu.runtime.activation_checkpointing import apply_checkpointed_layers
 
@@ -335,6 +337,7 @@ class DecoderBlock(nn.Module):
         rep = cfg.num_attention_heads // cfg.kv_heads
         out = dot_product_attention(q, repeat_kv(k, rep), repeat_kv(v, rep),
                                     causal=True, bias=attn_bias)
+        out = checkpoint_name(out, "attn_out")
         return self._combine(x, h1, self._proj_out(out, B, T))
 
     def decode(self, x, positions, layer_cache, cache_index, attn_bias=None):

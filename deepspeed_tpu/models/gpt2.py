@@ -20,6 +20,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from jax.ad_checkpoint import checkpoint_name
+
 from deepspeed_tpu.ops.attention import dot_product_attention
 from deepspeed_tpu.runtime.activation_checkpointing import apply_checkpointed_layers
 
@@ -64,7 +66,10 @@ class CausalSelfAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         heads = lambda t: t.reshape(B, T, cfg.n_head, C // cfg.n_head)
         out = dot_product_attention(heads(q), heads(k), heads(v), causal=True)
-        out = out.reshape(B, T, C)
+        # tag for the selective remat policies ("attn_out_saveable"): saving
+        # this [B, T, C] tensor lets backward skip recomputing the attention
+        # kernel while everything else still rematerialises
+        out = checkpoint_name(out.reshape(B, T, C), "attn_out")
         return nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="c_proj")(out)
 
 

@@ -29,6 +29,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from jax.ad_checkpoint import checkpoint_name
+
 from deepspeed_tpu.ops.attention import dot_product_attention, reference_attention
 from deepspeed_tpu.runtime.activation_checkpointing import apply_checkpointed_layers
 
@@ -216,7 +218,9 @@ class LlamaAttention(nn.Module):
             out = sliding_window_attention(q, k, v, positions, cfg.sliding_window)
         else:
             out = dot_product_attention(q, k, v, causal=True)
-        return self.o_proj(out.reshape(B, T, cfg.num_attention_heads * cfg.head_dim))
+        out = checkpoint_name(
+            out.reshape(B, T, cfg.num_attention_heads * cfg.head_dim), "attn_out")
+        return self.o_proj(out)
 
     def decode(self, x, positions, layer_cache, cache_index):
         """Incremental step: append this step's K/V at ``cache_index`` and attend
@@ -313,9 +317,16 @@ def chunked_causal_lm_loss(x: jax.Array, vocab_weight: jax.Array,
     ys = labels[:, 1:].reshape(B // chunk, chunk, T - 1)
     w = vocab_weight if transpose else vocab_weight.T  # [C, V]
 
+    # bf16 models project in bf16 with fp32 MXU accumulation (the v5e runs
+    # fp32 matmuls at a fraction of bf16 rate; accumulation stays exact).
+    # fp32 models keep the fp32 path bit-for-bit.
+    mm_dtype = jnp.bfloat16 if x.dtype == jnp.bfloat16 else jnp.float32
+
     def body(acc, inp):
         h, y = inp
-        logits = (h.astype(jnp.float32) @ w.astype(jnp.float32))
+        logits = jax.lax.dot_general(
+            h.astype(mm_dtype), w.astype(mm_dtype),
+            (((2,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         if head_bias is not None:
             logits = logits + head_bias.astype(jnp.float32)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
