@@ -66,6 +66,11 @@ class DecoderConfig:
     pos_offset: int = 0              # OPT: positions offset by 2 in the table
     alibi: bool = False              # BLOOM: per-head linear position bias
     embed_norm: bool = False         # BLOOM: layernorm right after the embedding
+    attn_scale: Optional[float] = None  # GPT-Neo: 1.0 (no 1/sqrt(D) scaling)
+    local_window: Optional[int] = None  # GPT-Neo: sliding window for 'local' layers
+    # per-layer attention kinds ("global" | "local"), e.g. GPT-Neo alternates;
+    # None -> all global
+    attention_layers: Optional[tuple] = None
     parallel_block: bool = False     # attn + mlp in one residual add
     parallel_dual_norm: bool = False # neox: MLP from ln2(x) instead of ln1(x)
     qkv_bias: bool = True
@@ -170,6 +175,10 @@ class DecoderConfig:
             "gptj": dict(rope_theta=10000.0, rotary_pct=0.5, activation="gelu",
                          parallel_block=True, qkv_bias=False, out_bias=False,
                          head_bias=True),
+            "gpt_neo": dict(learned_pos=True, activation="gelu",
+                            qkv_bias=False, tied_lm_head=True, attn_scale=1.0,
+                            local_window=8,
+                            attention_layers=("global", "local")),
         }[family]
         d = dict(family=family, vocab_size=256, hidden_size=64,
                  intermediate_size=128, num_hidden_layers=2,
@@ -273,6 +282,7 @@ class _Mlp(nn.Module):
 
 class DecoderBlock(nn.Module):
     config: DecoderConfig
+    window: Optional[int] = None   # sliding-window span for 'local' layers
 
     def setup(self):
         cfg = self.config
@@ -335,8 +345,12 @@ class DecoderBlock(nn.Module):
         h1 = self.ln1(x)
         q, k, v = self._qkv(h1, positions)
         rep = cfg.num_attention_heads // cfg.kv_heads
+        if self.window is not None:
+            # local layer: banded causal bias (window includes causality)
+            attn_bias = _window_bias(positions, positions, self.window)
         out = dot_product_attention(q, repeat_kv(k, rep), repeat_kv(v, rep),
-                                    causal=True, bias=attn_bias)
+                                    causal=True, bias=attn_bias,
+                                    softmax_scale=cfg.attn_scale)
         out = checkpoint_name(out, "attn_out")
         return self._combine(x, h1, self._proj_out(out, B, T))
 
@@ -354,11 +368,11 @@ class DecoderBlock(nn.Module):
             layer_cache["v"], v.astype(layer_cache["v"].dtype), (0, cache_index, 0, 0))
         S = ck.shape[1]
         rep = cfg.num_attention_heads // cfg.kv_heads
-        if attn_bias is None:
+        if attn_bias is None or self.window is not None:
             k_pos = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
-            attn_bias = _window_bias(positions, k_pos, None)
+            attn_bias = _window_bias(positions, k_pos, self.window)
         out = reference_attention(q, repeat_kv(ck, rep), repeat_kv(cv, rep),
-                                  bias=attn_bias)
+                                  bias=attn_bias, softmax_scale=cfg.attn_scale)
         return self._combine(x, h1, self._proj_out(out, B, T)), {"k": ck, "v": cv}
 
 
@@ -377,7 +391,10 @@ class DecoderLM(nn.Module):
                                       name="pos_embed")
         if cfg.embed_norm:
             self.embed_ln = _Norm(cfg.norm, cfg.eps, cfg.dtype, name="embed_norm")
-        self.layers = [DecoderBlock(cfg, name=f"layers_{i}")
+        kinds = cfg.attention_layers or ("global",) * cfg.num_hidden_layers
+        self.layers = [DecoderBlock(cfg, name=f"layers_{i}",
+                                    window=(cfg.local_window
+                                            if kinds[i] == "local" else None))
                        for i in range(cfg.num_hidden_layers)]
         self.final_norm = _Norm(cfg.norm, cfg.eps, cfg.dtype, name="final_norm")
         if not cfg.tied_lm_head:

@@ -47,6 +47,7 @@ class LlamaConfig:
     rope_theta: float = 10000.0
     rms_norm_eps: float = 1e-5
     sliding_window: Optional[int] = None  # Mistral: 4096
+    qkv_bias: bool = False               # Qwen2 lineage: biased q/k/v projections
     dtype: Any = jnp.float32
     remat: bool = False
     remat_policy: Optional[str] = None
@@ -191,11 +192,12 @@ class LlamaAttention(nn.Module):
 
     def setup(self):
         cfg = self.config
-        dense = lambda feats, name: nn.Dense(feats, use_bias=False, dtype=cfg.dtype,
-                                             name=name)
-        self.q_proj = dense(cfg.num_attention_heads * cfg.head_dim, "q_proj")
-        self.k_proj = dense(cfg.num_key_value_heads * cfg.head_dim, "k_proj")
-        self.v_proj = dense(cfg.num_key_value_heads * cfg.head_dim, "v_proj")
+        dense = lambda feats, name, bias=False: nn.Dense(
+            feats, use_bias=bias, dtype=cfg.dtype, name=name)
+        qb = cfg.qkv_bias
+        self.q_proj = dense(cfg.num_attention_heads * cfg.head_dim, "q_proj", qb)
+        self.k_proj = dense(cfg.num_key_value_heads * cfg.head_dim, "k_proj", qb)
+        self.v_proj = dense(cfg.num_key_value_heads * cfg.head_dim, "v_proj", qb)
         self.o_proj = dense(cfg.hidden_size, "o_proj")
 
     def _qkv(self, x, positions):

@@ -190,6 +190,37 @@ class LlamaPolicy(_LlamaBase):
 
 
 @register_policy
+class Qwen2Policy(_LlamaBase):
+    """HF Qwen2ForCausalLM -> models.llama.LlamaForCausalLM with qkv_bias
+    (the Qwen2 lineage is llama + biased q/k/v projections)."""
+
+    model_types = ("qwen2",)
+
+    def build(self, hf_config, dtype):
+        from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        kw = self._cfg_kwargs(hf_config)
+        if getattr(hf_config, "use_sliding_window", False) and \
+                getattr(hf_config, "sliding_window", None):
+            kw["sliding_window"] = hf_config.sliding_window
+        cfg = LlamaConfig(qkv_bias=True, dtype=dtype, **kw)
+        return LlamaForCausalLM(cfg), cfg
+
+    def convert(self, hf_config, sd):
+        p = super().convert(hf_config, sd)
+        hd = hf_config.hidden_size // hf_config.num_attention_heads
+        H, Hkv = hf_config.num_attention_heads, hf_config.num_key_value_heads
+        for i in range(hf_config.num_hidden_layers):
+            a = f"model.layers.{i}.self_attn"
+            attn = p[f"layers_{i}"]["self_attn"]
+            attn["q_proj"]["bias"] = rope_permute(
+                to_np(sd[f"{a}.q_proj.bias"]), H, hd)
+            attn["k_proj"]["bias"] = rope_permute(
+                to_np(sd[f"{a}.k_proj.bias"]), Hkv, hd)
+            attn["v_proj"]["bias"] = to_np(sd[f"{a}.v_proj.bias"])
+        return p
+
+
+@register_policy
 class MixtralPolicy(_LlamaBase):
     """HF MixtralForCausalLM -> models.mixtral.MixtralForCausalLM.  Per-expert
     w1/w3/w2 Linears stack into [E, ...] tensors for the grouped expert FFN."""
@@ -322,6 +353,57 @@ class OPTPolicy(_DecoderBase):
             ln_params(sd, f"{dec}.final_layer_norm"),
             pos_embed=to_np(sd[f"{dec}.embed_positions.weight"]),
             lm_head=None if tied else linear_t(sd["lm_head.weight"]))
+
+
+@register_policy
+class GPTNeoPolicy(_DecoderBase):
+    """HF GPTNeoForCausalLM -> DecoderLM(family='gpt_neo_local').  Learned
+    positions, alternating global/local attention layers (window_size), no
+    attention-score scaling, bias-free qkv."""
+
+    model_types = ("gpt_neo",)
+
+    @staticmethod
+    def _kinds(hf_config):
+        kinds = []
+        for block, reps in hf_config.attention_types:
+            kinds.extend(list(block) * reps)
+        return tuple(kinds)
+
+    def _decoder_kwargs(self, hf_config):
+        return dict(family="gpt_neo", vocab_size=hf_config.vocab_size,
+                    hidden_size=hf_config.hidden_size,
+                    intermediate_size=hf_config.intermediate_size
+                    or 4 * hf_config.hidden_size,
+                    num_hidden_layers=hf_config.num_layers,
+                    num_attention_heads=hf_config.num_heads,
+                    max_position_embeddings=hf_config.max_position_embeddings,
+                    activation=map_hf_activation(hf_config.activation_function),
+                    learned_pos=True, attn_scale=1.0,
+                    local_window=hf_config.window_size,
+                    attention_layers=self._kinds(hf_config),
+                    qkv_bias=False, eps=hf_config.layer_norm_epsilon,
+                    tied_lm_head=getattr(hf_config, "tie_word_embeddings", True))
+
+    def convert(self, hf_config, sd):
+        layers = []
+        for i in range(hf_config.num_layers):
+            l = f"transformer.h.{i}"
+            a = f"{l}.attn.attention"
+            layers.append({
+                "ln1": ln_params(sd, f"{l}.ln_1"),
+                "ln2": ln_params(sd, f"{l}.ln_2"),
+                **self._attn(to_np(sd[f"{a}.q_proj.weight"]),
+                             to_np(sd[f"{a}.k_proj.weight"]),
+                             to_np(sd[f"{a}.v_proj.weight"]),
+                             to_np(sd[f"{a}.out_proj.weight"]),
+                             bo=to_np(sd[f"{a}.out_proj.bias"])),
+                "mlp": self._mlp(sd, f"{l}.mlp.c_fc", f"{l}.mlp.c_proj"),
+            })
+        return self._assemble(
+            to_np(sd["transformer.wte.weight"]), layers,
+            ln_params(sd, "transformer.ln_f"),
+            pos_embed=to_np(sd["transformer.wpe.weight"]))
 
 
 @register_policy

@@ -179,6 +179,31 @@ def test_gpt_neox(parallel):
     _check(transformers.GPTNeoXForCausalLM(cfg), _ids(113))
 
 
+def test_qwen2():
+    torch.manual_seed(SEED)
+    cfg = transformers.Qwen2Config(vocab_size=151, hidden_size=32,
+                                   intermediate_size=64, num_hidden_layers=2,
+                                   num_attention_heads=4,
+                                   num_key_value_heads=2,
+                                   max_position_embeddings=64,
+                                   use_sliding_window=False,
+                                   attention_dropout=0.0)
+    _check(transformers.Qwen2ForCausalLM(cfg), _ids(151))
+
+
+def test_gpt_neo():
+    torch.manual_seed(SEED)
+    cfg = transformers.GPTNeoConfig(vocab_size=137, hidden_size=32,
+                                    num_layers=2, num_heads=4,
+                                    intermediate_size=64,
+                                    attention_types=[[["global", "local"], 1]],
+                                    window_size=8,
+                                    max_position_embeddings=64,
+                                    embed_dropout=0.0, attention_dropout=0.0,
+                                    resid_dropout=0.0)
+    _check(transformers.GPTNeoForCausalLM(cfg), _ids(137))
+
+
 def test_gptj():
     torch.manual_seed(SEED)
     cfg = transformers.GPTJConfig(vocab_size=127, n_embd=32, n_layer=2,
