@@ -37,6 +37,24 @@ from deepspeed_tpu.inference.v2.scheduler import DynamicSplitFuseScheduler
 from deepspeed_tpu.utils.logging import log_dist
 
 
+import functools
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _dev_sample(arr, rows, key, do_sample: bool, top_k: int, temperature=1.0):
+    """Gather rows + greedy / temperature / top-k sampling, ONE device call.
+    arr [P, V] (or [V] with rows=None semantics handled by caller reshaping);
+    rows [n] int32."""
+    logits = arr[rows]
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1)
+    z = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    if top_k > 0:
+        kth = jax.lax.top_k(z, top_k)[0][:, -1:]
+        z = jnp.where(z < kth, -jnp.inf, z)
+    return jax.random.categorical(key, z, axis=-1)
+
+
 class InferenceEngineV2:
 
     def __init__(self,
@@ -93,7 +111,13 @@ class InferenceEngineV2:
         fwd = build_ragged_forward(self.spec, mesh=self.topology.mesh, tp=eff_tp)
         self._pass = jax.jit(fwd, donate_argnums=(1, 2))
         self._rng = np.random.RandomState(cfg.seed)
+        self._rng_key = jax.random.PRNGKey(cfg.seed)
         self._last_logits: Dict[int, np.ndarray] = {}
+        # device-resident logits refs: uid -> (device_array, row | None).
+        # Materialised to numpy lazily (put()) or sampled on device without
+        # ever shipping the [S, V] tensor to host (sample_next()).
+        self._last_ref: Dict[int, Tuple[Any, Optional[int]]] = {}
+        self._multistep: Dict[Tuple, Any] = {}
         log_dist(f"engine_v2: family={family} tp={eff_tp} blocks={nb} "
                  f"block_size={kv_cfg.block_size} budget={sm.max_ragged_batch_size}",
                  ranks=[0])
@@ -135,10 +159,124 @@ class InferenceEngineV2:
         want = set(uids)
         while self.scheduler.has_pending():
             self._run_pass()
+        self._materialize(want)
         missing = want - set(self._last_logits)
         if missing:
             raise RuntimeError(f"no logits produced for uids {sorted(missing)}")
         return np.stack([self._last_logits[u] for u in uids])
+
+    def _put_nofetch(self, uids: Sequence[int],
+                     tokens_list: Sequence[np.ndarray]) -> None:
+        """Like put(), but leaves the logits on device (see sample_next)."""
+        uids = [int(u) for u in uids]
+        for uid, toks in zip(uids, tokens_list):
+            self.scheduler.add_tokens(uid, np.asarray(toks, np.int32))
+        while self.scheduler.has_pending():
+            self._run_pass()
+
+    def _materialize(self, uids) -> None:
+        """Fetch pending device logits to numpy, one transfer per pass array."""
+        by_array: Dict[int, Tuple[Any, list]] = {}
+        for uid in uids:
+            ref = self._last_ref.pop(uid, None)
+            if ref is None:
+                continue
+            arr, row = ref
+            by_array.setdefault(id(arr), (arr, []))[1].append((uid, row))
+        for arr, pairs in by_array.values():
+            host = np.asarray(arr)
+            for uid, row in pairs:
+                self._last_logits[uid] = host if row is None else host[row]
+
+    def sample_next(self, uids: Sequence[int], do_sample: bool = False,
+                    temperature: float = 1.0, top_k: int = 0) -> np.ndarray:
+        """Sample the next token for each uid ON DEVICE from its last logits,
+        fetching only the token ids (4 bytes/seq instead of the [S, V] logits
+        tensor — through a remote tunnel or PCIe this is the difference between
+        transfer-bound and compute-bound decode)."""
+        return np.asarray(self._sample_device([int(u) for u in uids],
+                                              do_sample, temperature, top_k))
+
+    def _sample_device(self, uids: Sequence[int], do_sample: bool,
+                       temperature: float, top_k: int):
+        """Sample next tokens on device, returning a device array aligned with
+        ``uids`` (no host fetch)."""
+        order = np.empty(len(uids), np.int64)
+        parts = []
+        by_array: Dict[int, Tuple[Any, list]] = {}
+        host_rows, host_idx = [], []
+        for i, uid in enumerate(uids):
+            ref = self._last_ref.get(int(uid))
+            if ref is None:
+                # logits were materialised to host (a prior put()); re-upload
+                host_idx.append(i)
+                host_rows.append(self._last_logits[int(uid)])
+                continue
+            arr, row = ref
+            by_array.setdefault(id(arr), (arr, []))[1].append((i, row))
+        if host_rows:
+            arr = jnp.asarray(np.stack(host_rows))
+            by_array[id(arr)] = (arr, [(i, j) for j, i in enumerate(host_idx)])
+        n_done = 0
+        for arr, pairs in by_array.values():
+            rows = [r for _, r in pairs]
+            if rows[0] is None:
+                arr, rows = arr[None, :], [0]
+            if do_sample:
+                self._rng_key, sub = jax.random.split(self._rng_key)
+            else:
+                sub = self._rng_key
+            parts.append(_dev_sample(arr, np.asarray(rows, np.int32), sub,
+                                     bool(do_sample), int(top_k),
+                                     float(temperature)))
+            for j, (i, _) in enumerate(pairs):
+                order[i] = n_done + j
+            n_done += len(pairs)
+        flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        return flat[jnp.asarray(order, jnp.int32)].astype(jnp.int32)
+
+    def decode_steps(self, uids: Sequence[int], n_steps: int,
+                     do_sample: bool = False, temperature: float = 1.0,
+                     top_k: int = 0) -> np.ndarray:
+        """Generate ``n_steps`` tokens for every uid with ONE device program
+        (fused sample->forward->sample loop; see build_multistep_decode).
+        All uids must be in steady decode state (no pending tokens).  Returns
+        the generated ids [len(uids), n_steps]; the engine's last-logits refs
+        advance so normal put()/sample_next() calls can continue after."""
+        uids = [int(u) for u in uids]
+        S = len(uids)
+        assert not self.scheduler.has_pending(), \
+            "decode_steps requires a drained scheduler"
+        for u in uids:
+            self.scheduler.reserve(u, n_steps + 1)
+        seqs = [self.scheduler.seqs[u] for u in uids]
+        mb = self.scheduler.max_blocks
+        bt = np.stack([s.block_table(mb) for s in seqs])
+        pos0 = np.asarray([s.seen_tokens for s in seqs], np.int32)
+        ctx0 = pos0 + 1
+
+        key = (n_steps, S, bool(do_sample), int(top_k))
+        fn = self._multistep.get(key)
+        if fn is None:
+            from deepspeed_tpu.inference.v2.ragged_model import (
+                build_multistep_decode)
+            eff_tp = self.topology.tp_world_size if self.topology.tp_world_size > 1 else 1
+            fwd = build_multistep_decode(self.spec, n_steps,
+                                         mesh=self.topology.mesh,
+                                         tp=1 if eff_tp <= 1 else eff_tp,
+                                         do_sample=do_sample, top_k=top_k)
+            fn = self._multistep[key] = jax.jit(fwd, donate_argnums=(1, 2))
+        ids0 = self._sample_device(uids, do_sample, temperature, top_k)
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        out_ids, final_logits, new_k, new_v = fn(
+            self.weights, self.kv.k, self.kv.v, ids0, pos0, bt, ctx0, sub,
+            jnp.float32(temperature))
+        self.kv.update(new_k, new_v)
+        for i, u in enumerate(uids):
+            self.scheduler.advance(u, n_steps)
+            self._last_ref[u] = (final_logits, i)
+            self._last_logits.pop(u, None)
+        return np.asarray(out_ids).T    # [S, n_steps]
 
     def _run_pass(self) -> None:
         batch = self.scheduler.schedule_pass()
@@ -148,16 +286,13 @@ class InferenceEngineV2:
         chunk_logits, decode_logits, new_k, new_v = self._pass(
             self.weights, self.kv.k, self.kv.v, arrays)
         self.kv.update(new_k, new_v)
-        decode_np = None
         finished = self.scheduler.complete_pass(batch)
         for uid in finished:
             if batch.chunk_uid == uid and batch.chunk_is_final:
-                self._last_logits[uid] = np.asarray(chunk_logits)
+                self._last_ref[uid] = (chunk_logits, None)
             else:
-                if decode_np is None:
-                    decode_np = np.asarray(decode_logits)
-                row = batch.decode_uids.index(uid)
-                self._last_logits[uid] = decode_np[row]
+                self._last_ref[uid] = (decode_logits,
+                                       batch.decode_uids.index(uid))
 
     def query(self, uid: int, max_request_tokens: int) -> Tuple[int, int]:
         return self.scheduler.query(uid, max_request_tokens)
@@ -169,6 +304,7 @@ class InferenceEngineV2:
         for uid in uids:
             self.scheduler.flush(int(uid))
             self._last_logits.pop(int(uid), None)
+            self._last_ref.pop(int(uid), None)
 
     @property
     def free_blocks(self) -> int:
@@ -209,13 +345,39 @@ class InferenceEngineV2:
             nxt += 1
         idx_of = {u: i for i, u in enumerate(uids)}
         outs: List[List[int]] = [list(map(int, p)) for p in prompts]
-        arr = self.put(uids, [np.asarray(p, np.int32) for p in prompts])
-        logits_map = {u: arr[i] for i, u in enumerate(uids)}
+        if not self.can_schedule(uids, [len(p) for p in prompts]):
+            raise RuntimeError("cannot schedule: insufficient KV blocks or "
+                               "sequence slots")
+        self._put_nofetch(uids, [np.asarray(p, np.int32) for p in prompts])
+        if eos_token_id is None:
+            # no early-exit condition: run the fused multi-step device loop
+            # (one host sync per CHUNK tokens); the sub-chunk remainder uses
+            # the per-token path so odd lengths never trigger a fresh
+            # multi-step compile
+            CHUNK = 32
+            done = 0
+            while max_new_tokens - done >= CHUNK:
+                ids = self.decode_steps(uids, CHUNK, do_sample=do_sample,
+                                        temperature=temperature, top_k=top_k)
+                for i, u in enumerate(uids):
+                    outs[idx_of[u]].extend(int(t) for t in ids[i])
+                done += CHUNK
+            for _ in range(max_new_tokens - done):
+                toks = self.sample_next(uids, do_sample, temperature, top_k)
+                for u, t in zip(uids, toks):
+                    outs[idx_of[u]].append(int(t))
+                self._put_nofetch(uids, [np.asarray([t], np.int32)
+                                         for t in toks])
+            self.flush(uids)
+            return outs
         live = set(uids)
         for _ in range(max_new_tokens):
+            batch_uids = sorted(live)
+            # on-device sampling: only the token ids cross the host boundary
+            toks = self.sample_next(batch_uids, do_sample, temperature, top_k)
             next_toks: Dict[int, int] = {}
-            for u in sorted(live):
-                t = self._sample(logits_map[u], do_sample, temperature, top_k)
+            for u, t in zip(batch_uids, toks):
+                t = int(t)
                 outs[idx_of[u]].append(t)
                 if eos_token_id is not None and t == eos_token_id:
                     live.discard(u)
@@ -224,10 +386,9 @@ class InferenceEngineV2:
                     next_toks[u] = t
             if not next_toks:
                 break
-            batch_uids = sorted(next_toks)
-            arr = self.put(batch_uids, [np.asarray([next_toks[u]], np.int32)
-                                        for u in batch_uids])
-            logits_map = {u: arr[i] for i, u in enumerate(batch_uids)}
+            self._put_nofetch(sorted(next_toks),
+                              [np.asarray([next_toks[u]], np.int32)
+                               for u in sorted(next_toks)])
         self.flush(sorted(live))
         return outs
 

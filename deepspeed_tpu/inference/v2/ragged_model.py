@@ -96,6 +96,10 @@ def adapt_llama(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
             "wv": attn["v_proj"]["kernel"],
             "wo": attn["o_proj"]["kernel"],
         }
+        if "bias" in attn["q_proj"]:   # Qwen2 lineage: biased q/k/v
+            layer["bq"] = attn["q_proj"]["bias"]
+            layer["bk"] = attn["k_proj"]["bias"]
+            layer["bv"] = attn["v_proj"]["bias"]
         if moe:
             mb = lp["block_sparse_moe"]
             layer["moe"] = {
@@ -268,6 +272,77 @@ def _moe_ffn(x: jax.Array, w: Dict, top_k: int, dtype) -> jax.Array:
     return out.astype(dtype)
 
 
+
+def _transformer_layer(spec: "RaggedModelSpec", w, x, positions, attend):
+    """Shared per-layer transformer body for BOTH the ragged forward (put
+    passes) and the fused multistep decode — one implementation so the two
+    paths cannot diverge.  ``attend(q, k, v, k_l, v_l) -> (attn_raw [N, H, D],
+    k_l, v_l)`` performs the KV page write + attention for its pass shape.
+    Returns ``(x_out, (k_l, v_l))``; call under lax.scan with
+    ``scanned = (w, k_l, v_l)``.
+    """
+    H, Hkv, D = spec.num_heads, spec.num_kv_heads, spec.head_dim
+    dtype = spec.dtype
+    k_l, v_l = None, None  # provided via attend closure state
+    h1 = _norm(x, w["ln1"], spec.norm, spec.eps, dtype)
+    q = (h1 @ w["wq"]).reshape(-1, H, D)
+    k = (h1 @ w["wk"]).reshape(-1, Hkv, D)
+    v = (h1 @ w["wv"]).reshape(-1, Hkv, D)
+    if "bq" in w:
+        q = q + w["bq"].reshape(H, D)
+        k = k + w["bk"].reshape(Hkv, D)
+        v = v + w["bv"].reshape(Hkv, D)
+    if spec.rope_theta is not None:
+        q = _rope_flat(q, positions, spec.rope_theta, spec.rotary_dim)
+        k = _rope_flat(k, positions, spec.rope_theta, spec.rotary_dim)
+
+    attn_raw, k_l, v_l = attend(q, k, v)
+    attn_out = attn_raw.reshape(-1, H * D) @ w["wo"]
+    if "bo" in w:
+        attn_out = attn_out + w["bo"]
+
+    if spec.parallel_block:
+        mlp_in = (_norm(x, w["ln2"], spec.norm, spec.eps, dtype)
+                  if spec.parallel_dual_norm else h1)
+    else:
+        x = x + attn_out
+        mlp_in = _norm(x, w["ln2"], spec.norm, spec.eps, dtype)
+
+    if spec.moe is not None:
+        mlp_out = _moe_ffn(mlp_in, w["moe"], spec.moe["top_k"], dtype)
+    else:
+        m = w["mlp"]
+        if spec.activation == "swiglu":
+            hmid = jax.nn.silu(mlp_in @ m["w_gate"]) * (mlp_in @ m["w_up"])
+        else:
+            act = jax.nn.gelu if spec.activation == "gelu" else jax.nn.relu
+            hmid = mlp_in @ m["w_up"]
+            if "b_up" in m:
+                hmid = hmid + m["b_up"]
+            hmid = act(hmid)
+        mlp_out = hmid @ m["w_down"]
+        if "b_down" in m:
+            mlp_out = mlp_out + m["b_down"]
+
+    if spec.parallel_block:
+        x = x + attn_out + mlp_out
+    else:
+        x = x + mlp_out
+    return x.astype(dtype), (k_l, v_l)
+
+
+def _kv_page_write(k_l, v_l, k, v, dest):
+    """Flat scatter of new K/V rows into the paged cache; out-of-range dest
+    rows (padding sentinels) are dropped."""
+    NB, bs = k_l.shape[0], k_l.shape[1]
+    Hkv, D = k_l.shape[2], k_l.shape[3]
+    kf = k_l.reshape(NB * bs, Hkv, D).at[dest].set(k.astype(k_l.dtype),
+                                                  mode="drop")
+    vf = v_l.reshape(NB * bs, Hkv, D).at[dest].set(v.astype(v_l.dtype),
+                                                  mode="drop")
+    return kf.reshape(NB, bs, Hkv, D), vf.reshape(NB, bs, Hkv, D)
+
+
 def build_ragged_forward(spec: RaggedModelSpec,
                          mesh=None,
                          tp: int = 1) -> Callable:
@@ -322,67 +397,18 @@ def build_ragged_forward(spec: RaggedModelSpec,
         x = x.astype(dtype)
 
         def layer_fn(x, scanned):
-            w, k_l, v_l = scanned
-            h1 = _norm(x, w["ln1"], spec.norm, spec.eps, dtype)
-            q = (h1 @ w["wq"]).reshape(-1, H, D)
-            k = (h1 @ w["wk"]).reshape(-1, Hkv, D)
-            v = (h1 @ w["wv"]).reshape(-1, Hkv, D)
-            if "bq" in w:
-                q = q + w["bq"].reshape(H, D)
-                k = k + w["bk"].reshape(Hkv, D)
-                v = v + w["bv"].reshape(Hkv, D)
-            if spec.rope_theta is not None:
-                q = _rope_flat(q, positions, spec.rope_theta, spec.rotary_dim)
-                k = _rope_flat(k, positions, spec.rope_theta, spec.rotary_dim)
+            w, k_l0, v_l0 = scanned
 
-            # KV write: one flat scatter over the fused (page, slot) dim; padding
-            # rows carry an out-of-bounds sentinel and are dropped
-            NB, bs = k_l.shape[0], k_l.shape[1]
-            kf = k_l.reshape(NB * bs, Hkv, D)
-            vf = v_l.reshape(NB * bs, Hkv, D)
-            kf = kf.at[b["kv_dest"]].set(k.astype(kf.dtype), mode="drop")
-            vf = vf.at[b["kv_dest"]].set(v.astype(vf.dtype), mode="drop")
-            k_l = kf.reshape(NB, bs, Hkv, D)
-            v_l = vf.reshape(NB, bs, Hkv, D)
+            def attend(q, k, v):
+                k_l, v_l = _kv_page_write(k_l0, v_l0, k, v, b["kv_dest"])
+                q0 = b["chunk_positions"][0]
+                out_c = _chunk_attn(q[:C], k_l, v_l, b["chunk_block_table"],
+                                    q0, b["chunk_ctx_len"])
+                out_d = _decode_attn(q[C:], k_l, v_l, b["decode_block_tables"],
+                                     b["decode_ctx_lens"])
+                return jnp.concatenate([out_c, out_d], axis=0), k_l, v_l
 
-            q0 = b["chunk_positions"][0]
-            out_c = _chunk_attn(q[:C], k_l, v_l, b["chunk_block_table"],
-                                q0, b["chunk_ctx_len"])
-            out_d = _decode_attn(q[C:], k_l, v_l, b["decode_block_tables"],
-                                 b["decode_ctx_lens"])
-            out = jnp.concatenate([out_c, out_d], axis=0).reshape(-1, H * D)
-            attn_out = out @ w["wo"]
-            if "bo" in w:
-                attn_out = attn_out + w["bo"]
-
-            if spec.parallel_block:
-                mlp_in = (_norm(x, w["ln2"], spec.norm, spec.eps, dtype)
-                          if spec.parallel_dual_norm else h1)
-            else:
-                x = x + attn_out
-                mlp_in = _norm(x, w["ln2"], spec.norm, spec.eps, dtype)
-
-            if spec.moe is not None:
-                mlp_out = _moe_ffn(mlp_in, w["moe"], spec.moe["top_k"], dtype)
-            else:
-                m = w["mlp"]
-                if spec.activation == "swiglu":
-                    hmid = jax.nn.silu(mlp_in @ m["w_gate"]) * (mlp_in @ m["w_up"])
-                else:
-                    act = jax.nn.gelu if spec.activation == "gelu" else jax.nn.relu
-                    hmid = mlp_in @ m["w_up"]
-                    if "b_up" in m:
-                        hmid = hmid + m["b_up"]
-                    hmid = act(hmid)
-                mlp_out = hmid @ m["w_down"]
-                if "b_down" in m:
-                    mlp_out = mlp_out + m["b_down"]
-
-            if spec.parallel_block:
-                x = x + attn_out + mlp_out
-            else:
-                x = x + mlp_out
-            return x.astype(dtype), (k_l, v_l)
+            return _transformer_layer(spec, w, x, positions, attend)
 
         x, (new_k, new_v) = jax.lax.scan(
             layer_fn, x, (weights["layers"], k_pages, v_pages))
@@ -398,5 +424,99 @@ def build_ragged_forward(spec: RaggedModelSpec,
         else:
             logits = (xs @ weights["lm_head"]).astype(jnp.float32)
         return logits[0], logits[1:], new_k, new_v
+
+    return fwd
+
+
+def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
+                           mesh=None, tp: int = 1,
+                           do_sample: bool = False,
+                           top_k: int = 0) -> Callable:
+    """Fused N-step greedy/sampled decode: the sample->embed->forward->sample
+    feedback loop runs entirely on device for ``n_steps`` tokens per sequence.
+
+    TPU-native rationale: the per-token serving loop pays one host<->device
+    round trip per generated token (sample + descriptor upload); over a remote
+    runtime or PCIe that round trip dwarfs the ~ms decode pass.  Fusing N steps
+    amortises it N-fold — the host only pre-reserves KV pages for N tokens and
+    syncs sequence lengths afterwards.  (Same motivation as the reference's
+    CUDA-graph capture of the decode step, ``InferenceEngine._create_cuda_graph``
+    engine.py:524, taken further: the whole token loop is one XLA program.)
+
+    Returns ``fwd(weights, k_pages, v_pages, ids0 [S], positions0 [S],
+    block_tables [S, MB], ctx0 [S], key) -> (out_ids [n_steps, S],
+    final_logits [S, V], new_k, new_v)`` where ``out_ids[j]`` is the token
+    *consumed* by step j (ids0 first), and ``final_logits`` predict the token
+    after the last generated one (so the serving loop can continue seamlessly).
+    """
+    H, Hkv, D = spec.num_heads, spec.num_kv_heads, spec.head_dim
+    dtype = spec.dtype
+
+    def _decode_attn(q, k_l, v_l, bts, cls_):
+        if tp > 1:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+            from deepspeed_tpu.comm.mesh import TENSOR_AXIS
+            fn = shard_map(
+                paged_decode_attention, mesh=mesh,
+                in_specs=(P(None, TENSOR_AXIS, None),
+                          P(None, None, TENSOR_AXIS, None),
+                          P(None, None, TENSOR_AXIS, None), P(None, None), P(None)),
+                out_specs=P(None, TENSOR_AXIS, None), check_vma=False)
+            return fn(q, k_l, v_l, bts, cls_)
+        return paged_decode_attention(q, k_l, v_l, bts, cls_)
+
+    def fwd(weights, k_pages, v_pages, ids0, positions0, block_tables, ctx0,
+            key, temperature=1.0):
+        S = ids0.shape[0]
+        NB, bs = k_pages.shape[1], k_pages.shape[2]
+
+        def one_pass(x_ids, pos, ctx, kp, vp):
+            x = weights["embed"][x_ids]
+            if spec.learned_pos:
+                x = x + weights["pos_embed"][pos + spec.pos_offset]
+            x = x.astype(dtype)
+
+            def layer_fn(x, scanned):
+                w, k_l0, v_l0 = scanned
+
+                def attend(q, k, v):
+                    dest = (block_tables[jnp.arange(S), pos // bs] * bs
+                            + pos % bs)
+                    k_l, v_l = _kv_page_write(k_l0, v_l0, k, v, dest)
+                    out = _decode_attn(q, k_l, v_l, block_tables, ctx)
+                    return out, k_l, v_l
+
+                return _transformer_layer(spec, w, x, pos, attend)
+
+            x, (kp, vp) = jax.lax.scan(layer_fn, x, (weights["layers"], kp, vp))
+            x = _norm(x, weights["final_norm"], spec.norm, spec.eps, dtype)
+            if spec.tied_lm_head:
+                logits = x.astype(jnp.float32) @ weights["embed"].astype(jnp.float32).T
+            else:
+                logits = (x @ weights["lm_head"]).astype(jnp.float32)
+            return logits, kp, vp
+
+        def sample(logits, step_key):
+            if not do_sample:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            z = logits / jnp.maximum(temperature, 1e-6)
+            if top_k > 0:
+                kth = jax.lax.top_k(z, top_k)[0][:, -1:]
+                z = jnp.where(z < kth, -jnp.inf, z)
+            return jax.random.categorical(step_key, z, axis=-1).astype(jnp.int32)
+
+        def step(carry, j):
+            ids, pos, ctx, kp, vp, _ = carry
+            logits, kp, vp = one_pass(ids, pos, ctx, kp, vp)
+            nxt = sample(logits, jax.random.fold_in(key, j))
+            return (nxt, pos + 1, ctx + 1, kp, vp, logits), ids
+
+        V = weights["embed"].shape[0]
+        init_logits = jnp.zeros((ids0.shape[0], V), jnp.float32)
+        (_, _, _, kp, vp, final_logits), out_ids = jax.lax.scan(
+            step, (ids0, positions0, ctx0, k_pages, v_pages, init_logits),
+            jnp.arange(n_steps))
+        return out_ids, final_logits, kp, vp
 
     return fwd

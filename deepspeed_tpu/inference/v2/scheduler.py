@@ -88,6 +88,28 @@ class DynamicSplitFuseScheduler:
         return any(len(s.pending) > 0 for s in self.seqs.values())
 
     # ------------------------------------------------------------------ #
+    # multi-step decode support (device-fused token loop)
+    # ------------------------------------------------------------------ #
+
+    def reserve(self, uid: int, n_tokens: int) -> None:
+        """Pre-allocate KV blocks so ``uid`` can append ``n_tokens`` without
+        host intervention (the fused N-step decode writes pages directly).
+        Enforces the same max_context bound as ``add_tokens``."""
+        seq = self.seqs[uid]
+        total = seq.seen_tokens + len(seq.pending) + n_tokens
+        if total > self.config.max_context:
+            raise ValueError(f"sequence {uid}: {total} tokens > max_context "
+                             f"{self.config.max_context}")
+        self._ensure_blocks(seq, n_tokens)
+
+    def advance(self, uid: int, n_tokens: int) -> None:
+        """Record ``n_tokens`` device-generated tokens (their KV was written
+        by the fused loop; no pending compute remains)."""
+        seq = self.seqs[uid]
+        assert len(seq.pending) == 0, "advance() with pending host tokens"
+        seq.seen_tokens += n_tokens
+
+    # ------------------------------------------------------------------ #
     # pass construction
     # ------------------------------------------------------------------ #
 

@@ -400,10 +400,12 @@ class GPTNeoPolicy(_DecoderBase):
                              bo=to_np(sd[f"{a}.out_proj.bias"])),
                 "mlp": self._mlp(sd, f"{l}.mlp.c_fc", f"{l}.mlp.c_proj"),
             })
+        tied = getattr(hf_config, "tie_word_embeddings", True)
         return self._assemble(
             to_np(sd["transformer.wte.weight"]), layers,
             ln_params(sd, "transformer.ln_f"),
-            pos_embed=to_np(sd["transformer.wpe.weight"]))
+            pos_embed=to_np(sd["transformer.wpe.weight"]),
+            lm_head=None if tied else linear_t(sd["lm_head.weight"]))
 
 
 @register_policy

@@ -24,8 +24,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+# Re-tuned on v5e-1 (B=64/T=1024 and B=16/T=2048, H=16, D=64, causal,
+# fwd+bwd): 1024/1024 beats 512/512 by ~23% and ~6% respectively — the larger
+# score tile (4 MB fp32) amortises grid overhead and stays well inside VMEM.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
 
 
