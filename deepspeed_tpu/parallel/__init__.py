@@ -3,7 +3,7 @@ module_inject/auto_tp — see each module's docstring)."""
 
 from deepspeed_tpu.parallel.ulysses import (DistributedAttention, ulysses_attention,
                                             single_all_to_all)
-from deepspeed_tpu.parallel.ring import ring_attention
+from deepspeed_tpu.parallel.ring import ring_attention, ring_flash_attention
 from deepspeed_tpu.parallel.tensor_parallel import (derive_tp_specs, tp_rules_for,
                                                     COLUMN, ROW, VOCAB, REPLICATE,
                                                     MODEL_TP_RULES, GENERIC_TP_RULES)

@@ -75,3 +75,128 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     safe_l = jnp.where(l_run > 0.0, l_run, 1.0)
     out = (acc / safe_l).astype(q.dtype)                         # [B,H,T,D]
     return jnp.transpose(out, (0, 2, 1, 3))                      # -> [B,T,H,D]
+
+
+# --------------------------------------------------------------------------- #
+# flash-kernel ring attention (the long-context production path)
+# --------------------------------------------------------------------------- #
+
+def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                         causal: bool = True, axis_name: str = SEQ_AXIS,
+                         softmax_scale: Optional[float] = None) -> jax.Array:
+    """Ring attention whose per-step block attention is the Pallas flash
+    kernel (``ops/pallas/flash_attention``) instead of a dense einsum.
+
+    Why: the dense ring step materialises [B, H, T/P, T/P] fp32 scores — at
+    the long contexts ring attention exists for, that per-step tensor is
+    exactly the memory wall the method should avoid.  Here each step runs the
+    O(T) flash kernel on the (q_local, kv_block) pair and merges blocks with
+    the standard logsumexp algebra; memory stays O(T/P) per device and the
+    MXU sees the tuned kernel tiles.  Causality across ranks: step 0 is the
+    diagonal (flash causal=True); later steps are all-past (full) or
+    all-future (dropped via an lse sentinel) per rank.
+
+    Backward is the standard ring reversal: (dk, dv) accumulators travel the
+    ring with the kv blocks and arrive home after one final ppermute, while
+    the flash backward kernels recompute per-block probabilities from the
+    saved global logsumexp.
+
+    Local shards [B, T/P, H, D] inside shard_map; returns the same layout.
+    """
+    B, T, H, D = q.shape
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+    qt, kt, vt = (jnp.transpose(t, (0, 2, 1, 3)) for t in (q, k, v))
+    out = _ring_flash(qt, kt, vt, scale, causal, axis_name)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+def _merge_block(m_run, l_run, acc, o_b, lse_b):
+    """Merge one flash block (normalised output + lse) into the running
+    online-softmax state."""
+    m_new = jnp.maximum(jnp.maximum(m_run, lse_b), _NEG_INF / 2)
+    alpha = jnp.exp(jnp.maximum(m_run, _NEG_INF / 2) - m_new)
+    beta = jnp.exp(lse_b - m_new)                       # 0 for masked blocks
+    acc = acc * alpha + o_b.astype(jnp.float32) * beta
+    l_run = l_run * alpha + beta
+    return m_new, l_run, acc
+
+
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, scale, causal, axis_name):
+    out, _ = _ring_flash_fwd_impl(q, k, v, scale, causal, axis_name)
+    return out
+
+
+def _ring_flash_fwd_impl(q, k, v, scale, causal, axis_name):
+    from deepspeed_tpu.ops.pallas.flash_attention import (_fwd, DEFAULT_BLOCK_Q,
+                                                          DEFAULT_BLOCK_K)
+    P_ = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, H, T, D = q.shape
+    m_run = jnp.full((B, H, T, 1), _NEG_INF, jnp.float32)
+    l_run = jnp.zeros((B, H, T, 1), jnp.float32)
+    acc = jnp.zeros((B, H, T, D), jnp.float32)
+    perm = [(i, (i + 1) % P_) for i in range(P_)]
+    cur_k, cur_v = k, v
+    for step in range(P_):
+        kv_idx = (my - step) % P_
+        o_b, lse_b = _fwd(q, cur_k, cur_v, scale, causal and step == 0,
+                          DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+        if causal and step > 0:
+            # all-future blocks contribute nothing (lse sentinel -> beta = 0)
+            lse_b = jnp.where(kv_idx > my, _NEG_INF, lse_b)
+        m_run, l_run, acc = _merge_block(m_run, l_run, acc, o_b, lse_b)
+        if step != P_ - 1:
+            cur_k = lax.ppermute(cur_k, axis_name, perm)
+            cur_v = lax.ppermute(cur_v, axis_name, perm)
+    safe_l = jnp.where(l_run > 0.0, l_run, 1.0)
+    out = (acc / safe_l).astype(q.dtype)
+    lse = jnp.where(l_run > 0.0, m_run + jnp.log(safe_l), _NEG_INF)
+    return out, lse
+
+
+def _ring_flash_vjp_fwd(q, k, v, scale, causal, axis_name):
+    out, lse = _ring_flash_fwd_impl(q, k, v, scale, causal, axis_name)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(scale, causal, axis_name, res, do):
+    from deepspeed_tpu.ops.pallas.flash_attention import (_bwd, DEFAULT_BLOCK_Q,
+                                                          DEFAULT_BLOCK_K)
+    q, k, v, out, lse = res
+    P_ = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % P_) for i in range(P_)]
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk_acc = jnp.zeros(k.shape, jnp.float32)
+    dv_acc = jnp.zeros(v.shape, jnp.float32)
+    # guard: rows with no visible keys keep p = 0 in the block backward
+    lse_safe = jnp.where(lse <= _NEG_INF / 2, -_NEG_INF, lse)
+    cur_k, cur_v = k, v
+    for step in range(P_):
+        kv_idx = (my - step) % P_
+        lse_in = lse_safe
+        if causal and step > 0:
+            # future blocks: +inf sentinel -> exp(s - inf) = 0 -> zero grads
+            lse_in = jnp.where(kv_idx > my, -_NEG_INF, lse_safe)
+        dq_b, dk_b, dv_b = _bwd(scale, causal and step == 0,
+                                DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+                                (q, cur_k, cur_v, out, lse_in), do)
+        dq = dq + dq_b.astype(jnp.float32)
+        dk_acc = dk_acc + dk_b.astype(jnp.float32)
+        dv_acc = dv_acc + dv_b.astype(jnp.float32)
+        if step != P_ - 1:
+            cur_k = lax.ppermute(cur_k, axis_name, perm)
+            cur_v = lax.ppermute(cur_v, axis_name, perm)
+            dk_acc = lax.ppermute(dk_acc, axis_name, perm)
+            dv_acc = lax.ppermute(dv_acc, axis_name, perm)
+    # accumulators sit one hop short of their owners: deliver
+    dk_acc = lax.ppermute(dk_acc, axis_name, perm)
+    dv_acc = lax.ppermute(dv_acc, axis_name, perm)
+    return (dq.astype(q.dtype), dk_acc.astype(k.dtype), dv_acc.astype(v.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)

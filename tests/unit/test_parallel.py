@@ -16,6 +16,7 @@ from deepspeed_tpu.ops.attention import reference_attention
 from deepspeed_tpu.parallel import (DistributedAttention, MoE, PipelineModule,
                                     derive_tp_specs, gpipe_apply, partition_uniform,
                                     partition_balanced, ring_attention,
+                                    ring_flash_attention,
                                     top1_gating, topk_gating, tp_rules_for,
                                     ulysses_attention)
 
@@ -90,6 +91,44 @@ def test_ring_attention_gradients(eight_devices):
     def ring_loss(q, k, v):
         f = shard_map(
             lambda a, b, c: ring_attention(a, b, c, causal=True),
+            mesh=topo.mesh,
+            in_specs=(P(None, "seq", None, None),) * 3,
+            out_specs=P(None, "seq", None, None), check_vma=False)
+        return jnp.sum(f(q, k, v) ** 2)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    g1 = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    g2 = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, n in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-4, err_msg=f"d{n}")
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_attention_matches_serial(eight_devices, causal):
+    topo = make_topo(seq=4)
+    q, k, v = qkv()
+
+    f = shard_map(
+        lambda a, b, c: ring_flash_attention(a, b, c, causal=causal),
+        mesh=topo.mesh,
+        in_specs=(P(None, "seq", None, None),) * 3,
+        out_specs=P(None, "seq", None, None), check_vma=False)
+    out = jax.jit(f)(q, k, v)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5,
+                               atol=2e-5)
+
+
+def test_ring_flash_attention_gradients(eight_devices):
+    topo = make_topo(seq=4)
+    q, k, v = qkv(B=1, T=32, H=2, D=8)
+
+    def ring_loss(q, k, v):
+        f = shard_map(
+            lambda a, b, c: ring_flash_attention(a, b, c, causal=True),
             mesh=topo.mesh,
             in_specs=(P(None, "seq", None, None),) * 3,
             out_specs=P(None, "seq", None, None), check_vma=False)
