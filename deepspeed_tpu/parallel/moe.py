@@ -150,7 +150,9 @@ def dropless_moe(tokens: jax.Array, gate_logits: jax.Array, k: int,
     id and run the expert FFNs as ragged GEMMs over contiguous groups — no
     token dropped, no capacity padding, and the MXU sees dense [N*k, D] tiles.
     This is the Mixtral/Megablocks-style "dropless" formulation; shapes stay
-    static (N*k rows) so it jits cleanly.
+    static (N*k rows) so it jits cleanly.  Measured v5e-1 (Mixtral-ish 0.4B,
+    E=8 k=2, bf16, bs=16 T=1024, full train step): 68.5k tok/s vs 37.0k for
+    the capacity-einsum path — 1.85x, identical loss.
 
     tokens [N, D]; gate_logits [N, E] fp32; ``grouped_ffn(rows, group_sizes)``
     applies the per-expert FFN to expert-sorted rows (``Experts.grouped``).
