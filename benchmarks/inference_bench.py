@@ -85,7 +85,7 @@ def main():
     # into one XLA program, so the host syncs once per CHUNK tokens.  Warm
     # thoroughly first: the remote runtime's first ~50 executions pay one-off
     # costs that would otherwise pollute the window.
-    CHUNK = 16
+    CHUNK = 32
     for _ in range(3):
         engine.decode_steps(uids, CHUNK)
     t0 = time.time()
