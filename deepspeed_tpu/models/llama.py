@@ -48,12 +48,19 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     sliding_window: Optional[int] = None  # Mistral: 4096
     qkv_bias: bool = False               # Qwen2 lineage: biased q/k/v projections
+    # Gemma lineage structural flags:
+    head_dim_override: Optional[int] = None  # head_dim decoupled from hidden/heads
+    embed_scale_by_sqrt_dim: bool = False    # x *= sqrt(hidden) after embedding
+    norm_plus_one: bool = False              # RMSNorm scales by (1 + weight)
+    mlp_act: str = "silu"                    # "silu" | "gelu" (tanh) gate act
     dtype: Any = jnp.float32
     remat: bool = False
     remat_policy: Optional[str] = None
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.hidden_size // self.num_attention_heads
 
     @classmethod
@@ -98,14 +105,17 @@ class LlamaConfig:
 class RMSNorm(nn.Module):
     eps: float = 1e-5
     dtype: Any = jnp.float32
+    plus_one: bool = False   # Gemma: y * (1 + weight), weight zero-centred
 
     @nn.compact
     def __call__(self, x):
-        w = self.param("weight", nn.initializers.ones, (x.shape[-1],))
+        init = nn.initializers.zeros if self.plus_one else nn.initializers.ones
+        w = self.param("weight", init, (x.shape[-1],))
         xf = x.astype(jnp.float32)
         var = jnp.mean(xf * xf, axis=-1, keepdims=True)
         y = xf * jax.lax.rsqrt(var + self.eps)
-        return (y * w).astype(self.dtype)
+        scale = (1.0 + w) if self.plus_one else w
+        return (y * scale).astype(self.dtype)
 
 
 def rope_frequencies(head_dim: int, theta: float) -> jax.Array:
@@ -256,7 +266,8 @@ class LlamaMLP(nn.Module):
                         name="gate_proj")(x)
         up = nn.Dense(cfg.intermediate_size, use_bias=False, dtype=cfg.dtype,
                       name="up_proj")(x)
-        h = nn.silu(gate) * up
+        act = nn.gelu if cfg.mlp_act == "gelu" else nn.silu
+        h = act(gate) * up
         return nn.Dense(cfg.hidden_size, use_bias=False, dtype=cfg.dtype,
                         name="down_proj")(h)
 
@@ -266,8 +277,10 @@ class LlamaBlock(nn.Module):
 
     def setup(self):
         cfg = self.config
-        self.input_layernorm = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")
+        self.input_layernorm = RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                                       cfg.norm_plus_one, name="input_layernorm")
         self.post_attention_layernorm = RMSNorm(cfg.rms_norm_eps, cfg.dtype,
+                                                cfg.norm_plus_one,
                                                 name="post_attention_layernorm")
         self.self_attn = LlamaAttention(cfg, name="self_attn")
         self.mlp = LlamaMLP(cfg, name="mlp")
@@ -346,6 +359,9 @@ def decode_layers(model, input_ids, cache, cache_index, positions):
     if positions is None:
         positions = cache_index + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
     x = model.embed_tokens(input_ids)
+    if getattr(model.config, "embed_scale_by_sqrt_dim", False):
+        x = (x.astype(jnp.float32)
+             * (model.config.hidden_size ** 0.5)).astype(x.dtype)
     new_k, new_v = [], []
     for i, layer in enumerate(model.layers):
         layer_cache = {"k": cache["k"][i], "v": cache["v"][i]}
@@ -369,13 +385,17 @@ class LlamaForCausalLM(nn.Module):
                                      dtype=cfg.dtype, name="embed_tokens")
         self.layers = [LlamaBlock(cfg, name=f"layers_{i}")
                        for i in range(cfg.num_hidden_layers)]
-        self.norm = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")
+        self.norm = RMSNorm(cfg.rms_norm_eps, cfg.dtype, cfg.norm_plus_one,
+                            name="norm")
         self.lm_head = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                                 name="lm_head")
 
     def _trunk(self, input_ids, positions):
         cfg = self.config
         x = self.embed_tokens(input_ids)
+        if cfg.embed_scale_by_sqrt_dim:
+            # Gemma normaliser; fp32 round-trip matches HF's bf16 cast order
+            x = (x.astype(jnp.float32) * (cfg.hidden_size ** 0.5)).astype(x.dtype)
         x = apply_checkpointed_layers(
             self, x, lambda mdl, h, i: mdl.layers[i](h, positions),
             cfg.num_hidden_layers, cfg.remat, cfg.remat_policy)

@@ -12,8 +12,9 @@ sharding rules (``parallel/tensor_parallel.py``), so ``init_inference`` shards
 the converted params over the mesh exactly where the reference inserts
 ``LinearAllreduce`` modules.
 
-Supported HF ``model_type``s: gpt2, bert, llama, mistral, mixtral, opt,
-falcon, phi, gpt_neox, gptj, bloom (see ``containers.py``).
+Supported HF ``model_type``s: gpt2, bert, llama, mistral, mixtral, qwen2,
+gemma, opt, falcon, phi, gpt_neox, gpt_neo, gptj, gpt_bigcode, bloom
+(see ``containers.py``).
 """
 
 from __future__ import annotations

@@ -134,6 +134,11 @@ def _llama_attn(sd, prefix, n_heads, n_kv, head_dim):
 
 
 class _LlamaBase(HFInjectionPolicy):
+    @staticmethod
+    def _head_dim(hf_config):
+        return getattr(hf_config, "head_dim", None) or \
+            hf_config.hidden_size // hf_config.num_attention_heads
+
     def _cfg_kwargs(self, hf_config):
         return dict(vocab_size=hf_config.vocab_size,
                     hidden_size=hf_config.hidden_size,
@@ -146,7 +151,7 @@ class _LlamaBase(HFInjectionPolicy):
                     rms_norm_eps=hf_config.rms_norm_eps)
 
     def convert(self, hf_config, sd) -> Dict[str, Any]:
-        hd = hf_config.hidden_size // hf_config.num_attention_heads
+        hd = self._head_dim(hf_config)
         H, Hkv = hf_config.num_attention_heads, hf_config.num_key_value_heads
         tied = getattr(hf_config, "tie_word_embeddings", False)
         head = sd["model.embed_tokens.weight" if tied else "lm_head.weight"]
@@ -186,6 +191,25 @@ class LlamaPolicy(_LlamaBase):
         if getattr(hf_config, "sliding_window", None):
             kw["sliding_window"] = hf_config.sliding_window
         cfg = LlamaConfig(dtype=dtype, **kw)
+        return LlamaForCausalLM(cfg), cfg
+
+
+@register_policy
+class GemmaPolicy(_LlamaBase):
+    """HF GemmaForCausalLM -> models.llama.LlamaForCausalLM with the Gemma
+    structural flags: sqrt(hidden)-scaled embeddings, (1 + weight) RMSNorm,
+    GeGLU MLP, decoupled head_dim, tied head."""
+
+    model_types = ("gemma",)
+
+    def build(self, hf_config, dtype):
+        from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        kw = self._cfg_kwargs(hf_config)
+        act = getattr(hf_config, "hidden_activation", None) or hf_config.hidden_act
+        cfg = LlamaConfig(head_dim_override=hf_config.head_dim,
+                          embed_scale_by_sqrt_dim=True, norm_plus_one=True,
+                          mlp_act="gelu" if "gelu" in act else "silu",
+                          dtype=dtype, **kw)
         return LlamaForCausalLM(cfg), cfg
 
 
@@ -299,6 +323,60 @@ class _DecoderBase(HFInjectionPolicy):
             m["b_up"] = to_np(sd[f"{up}.bias"])
             m["b_down"] = to_np(sd[f"{down}.bias"])
         return m
+
+
+@register_policy
+class GPTBigCodePolicy(_DecoderBase):
+    """HF GPTBigCodeForCausalLM (StarCoder lineage) -> DecoderLM: GPT-2-style
+    learned positions + multi-query attention (1 kv head), tanh GELU."""
+
+    model_types = ("gpt_bigcode",)
+
+    def _decoder_kwargs(self, hf_config):
+        n_kv = 1 if hf_config.multi_query else hf_config.n_head
+        return dict(family="gpt_bigcode", vocab_size=hf_config.vocab_size,
+                    hidden_size=hf_config.n_embd,
+                    intermediate_size=hf_config.n_inner or 4 * hf_config.n_embd,
+                    num_hidden_layers=hf_config.n_layer,
+                    num_attention_heads=hf_config.n_head,
+                    num_key_value_heads=n_kv,
+                    max_position_embeddings=hf_config.n_positions,
+                    activation=map_hf_activation(hf_config.activation_function),
+                    learned_pos=True, eps=hf_config.layer_norm_epsilon,
+                    tied_lm_head=getattr(hf_config, "tie_word_embeddings", True))
+
+    def convert(self, hf_config, sd) -> Dict[str, Any]:
+        from deepspeed_tpu.models.decoder import DecoderConfig
+        cfg = DecoderConfig(**self._decoder_kwargs(hf_config))
+        hid, D, Hkv = cfg.hidden_size, cfg.head_dim, cfg.kv_heads
+        layers = []
+        for i in range(hf_config.n_layer):
+            l = f"transformer.h.{i}"
+            w = to_np(sd[f"{l}.attn.c_attn.weight"])   # [hid + 2*Hkv*D, hid]
+            b = to_np(sd[f"{l}.attn.c_attn.bias"])
+            if hf_config.multi_query:
+                # MQA rows: [q (hid), k (D), v (D)] contiguous
+                wq, wk, wv = w[:hid], w[hid:hid + Hkv * D], w[hid + Hkv * D:]
+                bq, bk, bv = b[:hid], b[hid:hid + Hkv * D], b[hid + Hkv * D:]
+            else:
+                # MHA rows interleave per head as [H, 3, D] (NeoX-style)
+                wq, wk, wv = split_fused_qkv_per_head(
+                    w, hf_config.n_head, D)
+                bq, bk, bv = split_fused_qkv_per_head(
+                    b, hf_config.n_head, D)
+            layers.append({
+                "ln1": ln_params(sd, f"{l}.ln_1"),
+                "ln2": ln_params(sd, f"{l}.ln_2"),
+                **self._attn(wq, wk, wv, to_np(sd[f"{l}.attn.c_proj.weight"]),
+                             bq, bk, bv, to_np(sd[f"{l}.attn.c_proj.bias"])),
+                "mlp": self._mlp(sd, f"{l}.mlp.c_fc", f"{l}.mlp.c_proj"),
+            })
+        tied = cfg.tied_lm_head
+        return self._assemble(
+            to_np(sd["transformer.wte.weight"]), layers,
+            ln_params(sd, "transformer.ln_f"),
+            pos_embed=to_np(sd["transformer.wpe.weight"]),
+            lm_head=None if tied else linear_t(sd["lm_head.weight"]))
 
 
 @register_policy

@@ -94,7 +94,9 @@ MODEL_TP_RULES: Dict[str, List[Tuple[str, str]]] = {
     "gptj": DECODER_TP_RULES,
     "bloom": DECODER_TP_RULES,
     "gpt_neo": DECODER_TP_RULES,
+    "gpt_bigcode": DECODER_TP_RULES,
     "qwen2": LLAMA_TP_RULES,
+    "gemma": LLAMA_TP_RULES,
 }
 
 # generic fallback patterns for unknown HF-style models (parity: AutoTP's

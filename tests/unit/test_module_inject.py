@@ -241,3 +241,25 @@ def test_init_inference_hf_path():
     ref = _hf_logits(hf, ids)
     got = np.asarray(engine.forward(jnp.asarray(ids)))
     np.testing.assert_allclose(got, ref, atol=2e-2, rtol=1e-2)
+
+
+def test_gemma():
+    torch.manual_seed(SEED)
+    cfg = transformers.GemmaConfig(vocab_size=163, hidden_size=32,
+                                   intermediate_size=64, num_hidden_layers=2,
+                                   num_attention_heads=4,
+                                   num_key_value_heads=2, head_dim=16,
+                                   max_position_embeddings=64,
+                                   attention_dropout=0.0)
+    _check(transformers.GemmaForCausalLM(cfg), _ids(163))
+
+
+@pytest.mark.parametrize("multi_query", [True, False])
+def test_gpt_bigcode(multi_query):
+    torch.manual_seed(SEED)
+    cfg = transformers.GPTBigCodeConfig(vocab_size=157, n_embd=32, n_layer=2,
+                                        n_head=4, n_inner=64, n_positions=64,
+                                        multi_query=multi_query,
+                                        attn_pdrop=0.0, embd_pdrop=0.0,
+                                        resid_pdrop=0.0)
+    _check(transformers.GPTBigCodeForCausalLM(cfg), _ids(157))
