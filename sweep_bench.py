@@ -1,0 +1,80 @@
+"""One-off perf sweep on the real chip (not part of the package)."""
+import itertools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def run_one(bs, remat, policy, flash_min, steps=8, warmup=2):
+    import deepspeed_tpu.ops.attention as att
+    att.FLASH_MIN_SEQ = flash_min
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+    cfg = GPT2Config(vocab_size=50257, n_positions=1024, n_embd=1024,
+                     n_layer=24, n_head=16, dtype=jnp.bfloat16, remat=remat,
+                     remat_policy=policy)
+    seq = 1024
+    model = GPT2LMHead(cfg)
+    ds_config = {
+        "train_batch_size": bs,
+        "steps_per_print": 0,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+    }
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, 50257, size=(bs, seq)).astype(np.int32)}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config)
+    for _ in range(warmup):
+        float(engine.train_batch(batch))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        float(engine.train_batch(batch))
+    dt = time.perf_counter() - t0
+    return bs * seq * steps / dt
+
+
+def main():
+    combos = [
+        # (bs, remat, policy, flash_min_seq)
+        (32, True, None, 4096),              # current baseline
+        (32, True, "dots_with_no_batch_dims_saveable", 4096),
+        (48, True, "dots_with_no_batch_dims_saveable", 4096),
+        (32, True, None, 1024),              # flash attention on
+        (48, True, None, 1024),
+        (64, True, None, 1024),
+        (48, True, "dots_with_no_batch_dims_saveable", 1024),
+        (64, True, "dots_with_no_batch_dims_saveable", 1024),
+        (96, True, None, 1024),
+        (32, False, None, 1024),   # 9: no remat, flash
+        (48, False, None, 1024),   # 10
+        (24, False, None, 1024),   # 11
+        (64, True, "attn_out_saveable", 1024),  # 12
+        (48, True, "attn_out_saveable", 1024),  # 13
+        (64, True, "offload_attn_out", 1024),   # 14
+        (80, True, None, 1024),                 # 15
+    ]
+    if len(sys.argv) > 1:
+        sel = [int(x) for x in sys.argv[1].split(",")]
+        combos = [combos[i] for i in sel]
+    for bs, remat, policy, fmin in combos:
+        try:
+            tps = run_one(bs, remat, policy, fmin)
+            print(json.dumps({"bs": bs, "remat": remat, "policy": policy,
+                              "flash_min": fmin, "tok_s": round(tps, 1)}),
+                  flush=True)
+        except Exception as e:
+            print(json.dumps({"bs": bs, "remat": remat, "policy": policy,
+                              "flash_min": fmin,
+                              "error": str(e)[:200]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
