@@ -117,7 +117,12 @@ class InferenceEngineV2:
         # Materialised to numpy lazily (put()) or sampled on device without
         # ever shipping the [S, V] tensor to host (sample_next()).
         self._last_ref: Dict[int, Tuple[Any, Optional[int]]] = {}
-        self._multistep: Dict[Tuple, Any] = {}
+        # LRU-bounded compiled multistep programs: keyed by (n_steps, S,
+        # do_sample, top_k); serving with many batch sizes must not accumulate
+        # XLA executables without eviction (round S to buckets upstream when
+        # batch sizes vary a lot)
+        from deepspeed_tpu.utils.caching import LRUCache
+        self._multistep: LRUCache = LRUCache(maxsize=8)
         log_dist(f"engine_v2: family={family} tp={eff_tp} blocks={nb} "
                  f"block_size={kv_cfg.block_size} budget={sm.max_ragged_batch_size}",
                  ranks=[0])
@@ -255,17 +260,18 @@ class InferenceEngineV2:
         pos0 = np.asarray([s.seen_tokens for s in seqs], np.int32)
         ctx0 = pos0 + 1
 
-        key = (n_steps, S, bool(do_sample), int(top_k))
-        fn = self._multistep.get(key)
-        if fn is None:
+        def _build():
             from deepspeed_tpu.inference.v2.ragged_model import (
                 build_multistep_decode)
-            eff_tp = self.topology.tp_world_size if self.topology.tp_world_size > 1 else 1
+            tp = self.topology.tp_world_size
             fwd = build_multistep_decode(self.spec, n_steps,
                                          mesh=self.topology.mesh,
-                                         tp=1 if eff_tp <= 1 else eff_tp,
+                                         tp=tp if tp > 1 else 1,
                                          do_sample=do_sample, top_k=top_k)
-            fn = self._multistep[key] = jax.jit(fwd, donate_argnums=(1, 2))
+            return jax.jit(fwd, donate_argnums=(1, 2))
+
+        fn = self._multistep.get_or_create(
+            (n_steps, S, bool(do_sample), int(top_k)), _build)
         ids0 = self._sample_device(uids, do_sample, temperature, top_k)
         self._rng_key, sub = jax.random.split(self._rng_key)
         out_ids, final_logits, new_k, new_v = fn(
@@ -362,16 +368,18 @@ class InferenceEngineV2:
                 for i, u in enumerate(uids):
                     outs[idx_of[u]].extend(int(t) for t in ids[i])
                 done += CHUNK
-            for _ in range(max_new_tokens - done):
+            rem = max_new_tokens - done
+            for j in range(rem):
                 toks = self.sample_next(uids, do_sample, temperature, top_k)
                 for u, t in zip(uids, toks):
                     outs[idx_of[u]].append(int(t))
-                self._put_nofetch(uids, [np.asarray([t], np.int32)
-                                         for t in toks])
+                if j < rem - 1:  # final token's forward pass is never read
+                    self._put_nofetch(uids, [np.asarray([t], np.int32)
+                                             for t in toks])
             self.flush(uids)
             return outs
         live = set(uids)
-        for _ in range(max_new_tokens):
+        for step in range(max_new_tokens):
             batch_uids = sorted(live)
             # on-device sampling: only the token ids cross the host boundary
             toks = self.sample_next(batch_uids, do_sample, temperature, top_k)
@@ -384,8 +392,8 @@ class InferenceEngineV2:
                     self.flush([u])   # recycle KV blocks immediately
                 else:
                     next_toks[u] = t
-            if not next_toks:
-                break
+            if not next_toks or step == max_new_tokens - 1:
+                break  # last token's forward pass would never be read
             self._put_nofetch(sorted(next_toks),
                               [np.asarray([next_toks[u]], np.int32)
                                for u in sorted(next_toks)])

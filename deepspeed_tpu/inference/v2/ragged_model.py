@@ -43,7 +43,9 @@ class RaggedModelSpec:
     head_dim: int
     vocab_size: int
     norm: str = "rms"              # "rms" | "ln"
-    activation: str = "swiglu"     # "swiglu" | "gelu" | "relu"
+    # gated: "swiglu" (silu gate) | "geglu" (tanh-gelu gate, Gemma)
+    # plain: "gelu" (tanh) | "gelu_exact" (erf) | "silu" | "relu"
+    activation: str = "swiglu"
     rope_theta: Optional[float] = 10000.0   # None -> no rotary
     rotary_dim: Optional[int] = None        # partial rotary (phi); None = full head
     learned_pos: bool = False      # gpt2/opt learned position embeddings
@@ -51,6 +53,9 @@ class RaggedModelSpec:
     parallel_block: bool = False   # falcon/phi: attn + mlp both from the same norm
     parallel_dual_norm: bool = False  # gpt_neox: parallel, but MLP from ln2(x)
     tied_lm_head: bool = False     # gpt2: logits = x @ embed.T
+    head_bias: bool = False        # phi/gpt-j: bias added to the logits
+    embed_scale_by_sqrt_dim: bool = False  # gemma: x *= sqrt(hidden) after embed
+    norm_plus_one: bool = False    # gemma: RMSNorm scales by (1 + weight)
     eps: float = 1e-5
     moe: Optional[Dict[str, int]] = None    # {"num_experts": E, "top_k": k}
     dtype: Any = jnp.bfloat16
@@ -73,6 +78,12 @@ def adapt_llama(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
     if hasattr(config, "num_local_experts"):
         moe = {"num_experts": config.num_local_experts,
                "top_k": config.num_experts_per_tok}
+    # Gemma lineage rides the llama adapter: its structural differences are
+    # config flags on LlamaConfig (module_inject/containers.py GemmaPolicy)
+    mlp_act = getattr(config, "mlp_act", "silu")
+    if mlp_act not in ("silu", "gelu"):
+        raise ValueError(f"llama-lineage mlp_act '{mlp_act}' has no ragged "
+                         "gated-MLP mapping (expected 'silu' or 'gelu')")
     spec = RaggedModelSpec(
         family="mixtral" if moe else "llama",
         num_layers=config.num_hidden_layers,
@@ -81,7 +92,11 @@ def adapt_llama(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
         num_kv_heads=config.num_key_value_heads,
         head_dim=config.head_dim,
         vocab_size=config.vocab_size,
-        norm="rms", activation="swiglu", rope_theta=config.rope_theta,
+        norm="rms",
+        activation="swiglu" if mlp_act == "silu" else "geglu",
+        rope_theta=config.rope_theta,
+        embed_scale_by_sqrt_dim=getattr(config, "embed_scale_by_sqrt_dim", False),
+        norm_plus_one=getattr(config, "norm_plus_one", False),
         eps=config.rms_norm_eps, moe=moe, dtype=config.dtype)
 
     layers = []
@@ -184,7 +199,8 @@ def adapt_decoder(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
         learned_pos=config.learned_pos, pos_offset=config.pos_offset,
         parallel_block=config.parallel_block,
         parallel_dual_norm=config.parallel_dual_norm,
-        tied_lm_head=config.tied_lm_head, eps=config.eps, dtype=config.dtype)
+        tied_lm_head=config.tied_lm_head, head_bias=config.head_bias,
+        eps=config.eps, dtype=config.dtype)
 
     layers = [params[f"layers_{i}"] for i in range(config.num_hidden_layers)]
     weights = {
@@ -196,6 +212,8 @@ def adapt_decoder(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
         weights["pos_embed"] = params["pos_embed"]["embedding"]
     if not config.tied_lm_head:
         weights["lm_head"] = params["lm_head"]
+    if config.head_bias:
+        weights["lm_head_bias"] = params["lm_head_bias"]
     return spec, weights
 
 
@@ -222,16 +240,37 @@ def adapt_model(family: str, params: Dict, config) -> Tuple[RaggedModelSpec, Dic
 # generic ragged forward
 # --------------------------------------------------------------------------- #
 
-def _norm(x, w, kind: str, eps: float, dtype):
+def _norm(x, w, kind: str, eps: float, dtype, plus_one: bool = False):
     xf = x.astype(jnp.float32)
+    scale = (1.0 + w["scale"]) if plus_one else w["scale"]
     if kind == "rms":
         var = jnp.mean(xf * xf, axis=-1, keepdims=True)
-        y = xf * jax.lax.rsqrt(var + eps) * w["scale"]
+        y = xf * jax.lax.rsqrt(var + eps) * scale
     else:
         mean = jnp.mean(xf, axis=-1, keepdims=True)
         var = jnp.var(xf, axis=-1, keepdims=True)
-        y = (xf - mean) * jax.lax.rsqrt(var + eps) * w["scale"] + w["bias"]
+        y = (xf - mean) * jax.lax.rsqrt(var + eps) * scale + w["bias"]
     return y.astype(dtype)
+
+
+_PLAIN_ACTS = {
+    "gelu": jax.nn.gelu,                                      # tanh approx
+    "gelu_exact": lambda x: jax.nn.gelu(x, approximate=False),  # erf-exact
+    "silu": jax.nn.silu,
+    "relu": jax.nn.relu,
+}
+
+
+def _plain_act(name: str) -> Callable:
+    """Non-gated MLP activation. Raising on unknown names (rather than a relu
+    fallback) is what keeps a new zoo activation from silently serving garbage
+    through the v2 path."""
+    try:
+        return _PLAIN_ACTS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown MLP activation '{name}' for the ragged path "
+            f"(gated: swiglu/geglu; plain: {sorted(_PLAIN_ACTS)})") from None
 
 
 def _rope_flat(x: jax.Array, positions: jax.Array, theta: float,
@@ -284,7 +323,7 @@ def _transformer_layer(spec: "RaggedModelSpec", w, x, positions, attend):
     H, Hkv, D = spec.num_heads, spec.num_kv_heads, spec.head_dim
     dtype = spec.dtype
     k_l, v_l = None, None  # provided via attend closure state
-    h1 = _norm(x, w["ln1"], spec.norm, spec.eps, dtype)
+    h1 = _norm(x, w["ln1"], spec.norm, spec.eps, dtype, spec.norm_plus_one)
     q = (h1 @ w["wq"]).reshape(-1, H, D)
     k = (h1 @ w["wk"]).reshape(-1, Hkv, D)
     v = (h1 @ w["wv"]).reshape(-1, Hkv, D)
@@ -302,20 +341,23 @@ def _transformer_layer(spec: "RaggedModelSpec", w, x, positions, attend):
         attn_out = attn_out + w["bo"]
 
     if spec.parallel_block:
-        mlp_in = (_norm(x, w["ln2"], spec.norm, spec.eps, dtype)
+        mlp_in = (_norm(x, w["ln2"], spec.norm, spec.eps, dtype,
+                        spec.norm_plus_one)
                   if spec.parallel_dual_norm else h1)
     else:
         x = x + attn_out
-        mlp_in = _norm(x, w["ln2"], spec.norm, spec.eps, dtype)
+        mlp_in = _norm(x, w["ln2"], spec.norm, spec.eps, dtype,
+                       spec.norm_plus_one)
 
     if spec.moe is not None:
         mlp_out = _moe_ffn(mlp_in, w["moe"], spec.moe["top_k"], dtype)
     else:
         m = w["mlp"]
-        if spec.activation == "swiglu":
-            hmid = jax.nn.silu(mlp_in @ m["w_gate"]) * (mlp_in @ m["w_up"])
+        if spec.activation in ("swiglu", "geglu"):
+            gate_act = jax.nn.silu if spec.activation == "swiglu" else jax.nn.gelu
+            hmid = gate_act(mlp_in @ m["w_gate"]) * (mlp_in @ m["w_up"])
         else:
-            act = jax.nn.gelu if spec.activation == "gelu" else jax.nn.relu
+            act = _plain_act(spec.activation)
             hmid = mlp_in @ m["w_up"]
             if "b_up" in m:
                 hmid = hmid + m["b_up"]
@@ -329,6 +371,28 @@ def _transformer_layer(spec: "RaggedModelSpec", w, x, positions, attend):
     else:
         x = x + mlp_out
     return x.astype(dtype), (k_l, v_l)
+
+
+def _embed_in(spec: "RaggedModelSpec", weights, tokens, positions):
+    """Token (+ learned position) embedding with the Gemma sqrt(hidden)
+    normaliser — fp32 round-trip matches models/llama.py ``_trunk``."""
+    x = weights["embed"][tokens]
+    if spec.learned_pos:
+        x = x + weights["pos_embed"][positions + spec.pos_offset]
+    if spec.embed_scale_by_sqrt_dim:
+        x = x.astype(jnp.float32) * (spec.hidden_size ** 0.5)
+    return x.astype(spec.dtype)
+
+
+def _unembed(spec: "RaggedModelSpec", weights, xs):
+    """Final-hidden rows -> fp32 logits (tied or untied head, optional bias)."""
+    if spec.tied_lm_head:
+        logits = xs.astype(jnp.float32) @ weights["embed"].astype(jnp.float32).T
+    else:
+        logits = (xs @ weights["lm_head"]).astype(jnp.float32)
+    if spec.head_bias:
+        logits = logits + weights["lm_head_bias"].astype(jnp.float32)
+    return logits
 
 
 def _kv_page_write(k_l, v_l, k, v, dest):
@@ -391,10 +455,7 @@ def build_ragged_forward(spec: RaggedModelSpec,
         tokens = jnp.concatenate([b["chunk_tokens"], b["decode_tokens"]])
         positions = jnp.concatenate([b["chunk_positions"], b["decode_positions"]])
 
-        x = weights["embed"][tokens]
-        if spec.learned_pos:
-            x = x + weights["pos_embed"][positions + spec.pos_offset]
-        x = x.astype(dtype)
+        x = _embed_in(spec, weights, tokens, positions)
 
         def layer_fn(x, scanned):
             w, k_l0, v_l0 = scanned
@@ -413,16 +474,14 @@ def build_ragged_forward(spec: RaggedModelSpec,
         x, (new_k, new_v) = jax.lax.scan(
             layer_fn, x, (weights["layers"], k_pages, v_pages))
 
-        x = _norm(x, weights["final_norm"], spec.norm, spec.eps, dtype)
+        x = _norm(x, weights["final_norm"], spec.norm, spec.eps, dtype,
+                  spec.norm_plus_one)
         # only 1 + S rows are ever read (parity: ragged_ops/logits_gather — the
         # reference also gathers the needed rows before the unembed GEMM)
         last = jnp.maximum(b["chunk_num_tokens"] - 1, 0)
         chunk_row = jax.lax.dynamic_index_in_dim(x[:C], last, keepdims=True)
         xs = jnp.concatenate([chunk_row, x[C:]], axis=0)       # [1 + S, hid]
-        if spec.tied_lm_head:
-            logits = xs.astype(jnp.float32) @ weights["embed"].astype(jnp.float32).T
-        else:
-            logits = (xs @ weights["lm_head"]).astype(jnp.float32)
+        logits = _unembed(spec, weights, xs)
         return logits[0], logits[1:], new_k, new_v
 
     return fwd
@@ -472,10 +531,7 @@ def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
         NB, bs = k_pages.shape[1], k_pages.shape[2]
 
         def one_pass(x_ids, pos, ctx, kp, vp):
-            x = weights["embed"][x_ids]
-            if spec.learned_pos:
-                x = x + weights["pos_embed"][pos + spec.pos_offset]
-            x = x.astype(dtype)
+            x = _embed_in(spec, weights, x_ids, pos)
 
             def layer_fn(x, scanned):
                 w, k_l0, v_l0 = scanned
@@ -490,11 +546,9 @@ def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
                 return _transformer_layer(spec, w, x, pos, attend)
 
             x, (kp, vp) = jax.lax.scan(layer_fn, x, (weights["layers"], kp, vp))
-            x = _norm(x, weights["final_norm"], spec.norm, spec.eps, dtype)
-            if spec.tied_lm_head:
-                logits = x.astype(jnp.float32) @ weights["embed"].astype(jnp.float32).T
-            else:
-                logits = (x @ weights["lm_head"]).astype(jnp.float32)
+            x = _norm(x, weights["final_norm"], spec.norm, spec.eps, dtype,
+                      spec.norm_plus_one)
+            logits = _unembed(spec, weights, x)
             return logits, kp, vp
 
         def sample(logits, step_key):

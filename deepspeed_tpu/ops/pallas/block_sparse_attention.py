@@ -333,15 +333,18 @@ class _BSA:
         return dq, dk, dv
 
 
-_CACHE = {}
+from deepspeed_tpu.utils.caching import LRUCache
+
+# LRU-bounded: layouts are host tables + jitted kernels; long-lived serving
+# with many distinct layouts must not accumulate them without eviction.
+_CACHE: LRUCache = LRUCache(maxsize=32)
 
 
 def _get_bsa(layout_bytes, shape, block, causal, block_mult) -> _BSA:
     key = (layout_bytes, shape, block, causal, block_mult)
-    if key not in _CACHE:
-        layout = np.frombuffer(layout_bytes, dtype=np.uint8).reshape(shape)
-        _CACHE[key] = _BSA(layout, block, causal, block_mult)
-    return _CACHE[key]
+    return _CACHE.get_or_create(
+        key, lambda: _BSA(np.frombuffer(layout_bytes, np.uint8).reshape(shape),
+                          block, causal, block_mult))
 
 
 def block_sparse_attention_bhsd(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -1,0 +1,36 @@
+"""Small bounded-LRU cache shared by long-lived serving paths.
+
+Compiled XLA executables and host-side layout tables are cached per
+(shape/config) key; a serving process that sees many distinct keys must evict
+or it leaks executables indefinitely. One helper so every such cache behaves
+identically (inference v2 multistep programs, block-sparse layouts, ...).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Generic, Hashable, TypeVar
+
+V = TypeVar("V")
+
+
+class LRUCache(Generic[V]):
+    def __init__(self, maxsize: int):
+        assert maxsize > 0
+        self.maxsize = maxsize
+        self._d: "OrderedDict[Hashable, V]" = OrderedDict()
+
+    def get_or_create(self, key: Hashable, factory: Callable[[], V]) -> V:
+        hit = self._d.get(key)
+        if hit is None:
+            hit = self._d[key] = factory()
+        self._d.move_to_end(key)
+        while len(self._d) > self.maxsize:
+            self._d.popitem(last=False)
+        return hit
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def __contains__(self, key: Hashable) -> bool:
+        return key in self._d

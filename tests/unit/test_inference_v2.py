@@ -166,6 +166,61 @@ class TestEngineV2:
         out = eng.generate(PROMPTS[:2], max_new_tokens=4)
         assert out == ref
 
+    def test_gemma_flags_match_v1(self):
+        """Gemma rides the llama adapter via config flags (sqrt(dim) embed
+        scale, (1+w) RMSNorm, GeGLU); the v2 path must honour all three."""
+        from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig.tiny(dtype=jnp.float32, embed_scale_by_sqrt_dim=True,
+                               norm_plus_one=True, mlp_act="gelu")
+        model = LlamaForCausalLM(cfg)
+        params = model.init(jax.random.PRNGKey(3),
+                            {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+        ref = self._v1_greedy(model, params, PROMPTS[:2], 4)
+        eng = InferenceEngineV2(model=model,
+                                config=RaggedInferenceEngineConfig.load(dict(V2_CONFIG)),
+                                model_parameters=params)
+        out = eng.generate(PROMPTS[:2], max_new_tokens=4)
+        assert out == ref
+
+    def test_head_bias_matches_v1(self):
+        """phi/gpt-j LM-head bias must reach the v2 logits (zero-init would
+        hide the bug, so the bias is perturbed first)."""
+        from deepspeed_tpu.models.decoder import DecoderConfig, DecoderLM
+        cfg = DecoderConfig.tiny("phi", head_bias=True, dtype=jnp.float32)
+        model = DecoderLM(cfg)
+        params = model.init(jax.random.PRNGKey(4),
+                            {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+        params = dict(params)
+        params["lm_head_bias"] = 5.0 * jax.random.normal(
+            jax.random.PRNGKey(5), (cfg.vocab_size,), jnp.float32)
+        ref = self._v1_greedy(model, params, PROMPTS[:2], 4)
+        eng = InferenceEngineV2(model=model,
+                                config=RaggedInferenceEngineConfig.load(dict(V2_CONFIG)),
+                                model_parameters=params)
+        out = eng.generate(PROMPTS[:2], max_new_tokens=4)
+        assert out == ref
+
+    def test_gelu_exact_matches_v1(self):
+        """Converted HF falcon/gpt_neox use erf-exact gelu — previously this
+        silently fell back to relu in the v2 MLP."""
+        from deepspeed_tpu.models.decoder import DecoderConfig, DecoderLM
+        cfg = DecoderConfig.tiny("falcon", activation="gelu_exact",
+                                 dtype=jnp.float32)
+        model = DecoderLM(cfg)
+        params = model.init(jax.random.PRNGKey(6),
+                            {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+        ref = self._v1_greedy(model, params, PROMPTS[:2], 4)
+        eng = InferenceEngineV2(model=model,
+                                config=RaggedInferenceEngineConfig.load(dict(V2_CONFIG)),
+                                model_parameters=params)
+        out = eng.generate(PROMPTS[:2], max_new_tokens=4)
+        assert out == ref
+
+    def test_unknown_activation_raises(self):
+        from deepspeed_tpu.inference.v2.ragged_model import _plain_act
+        with pytest.raises(ValueError, match="unknown MLP activation"):
+            _plain_act("swish_42")
+
     def test_gpt2_family(self):
         from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
         cfg = GPT2Config.tiny(dtype=jnp.float32)
