@@ -1,16 +1,32 @@
 """Benchmark entry point (driver-run, real TPU).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 
-Metric: training tokens/sec/chip for a GPT-2-class LM (bf16, fused-Adam, full
-train step through deepspeed_tpu.initialize). ``vs_baseline`` is model FLOPs
-utilisation relative to a 50%-MFU A100-class baseline (the BASELINE.json north star
-is 90% of A100 tokens/sec — tokens/sec scales with MFU x peak/param-count, so
-MFU/0.50 is the per-chip proxy measurable on one chip; >= 0.9 meets the target).
+Headline metric: training tokens/sec/chip for a GPT-2-350M-class LM (bf16,
+fused-Adam, full train step through deepspeed_tpu.initialize). ``vs_baseline``
+is model FLOPs utilisation relative to a 50%-MFU A100-class baseline (the
+BASELINE.json north star is 90% of A100 tokens/sec — tokens/sec scales with
+MFU x peak/param-count, so MFU/0.50 is the per-chip proxy measurable on one
+chip; >= 0.9 meets the target).
+
+``extra`` carries the rest of the policed surface:
+  - per-phase timings + per-step diagnostic timings (self-diagnosing: a slow
+    driver environment shows up as compile_s / dispatch stalls, not as a
+    mystery headline regression)
+  - inference v2 fused-multistep decode + prefill tokens/sec (FastGen analog)
+  - dropless-MoE training tokens/sec
+  - an on-TPU Pallas kernel smoke grid (flash fwd/bwd, paged decode/chunk,
+    block-sparse) asserted against jnp references — catches TPU-only lowering
+    regressions the CPU interpreter suite can't.
+
+Diagnostics go to stderr; stdout carries only the single JSON line.
 """
 
 import json
+import os
+import sys
 import time
+import traceback
 
 import numpy as np
 
@@ -24,6 +40,12 @@ PEAK_FLOPS = {
     "cpu": 1e12,            # nominal, CI fallback
 }
 
+_T0 = time.time()
+
+
+def log(msg: str) -> None:
+    print(f"[bench +{time.time() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
 
 def peak_for(device) -> float:
     kind = getattr(device, "device_kind", "cpu").lower()
@@ -33,19 +55,21 @@ def peak_for(device) -> float:
     return 1e12
 
 
-def main():
+# --------------------------------------------------------------------------- #
+# headline: GPT-2-350M training
+# --------------------------------------------------------------------------- #
+
+def bench_train(on_tpu: bool) -> dict:
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
 
-    on_tpu = jax.default_backend() not in ("cpu",)
     if on_tpu:
         cfg = GPT2Config(vocab_size=50257, n_positions=1024, n_embd=1024,
                          n_layer=24, n_head=16, dtype=jnp.bfloat16, remat=True)
         # v5e-1 sweet spot from the bs sweep with Pallas flash attention at
         # T=1024 (32/48/64/96 -> 24.8k/25.8k/26.7k/OOM tok/s; dense-XLA
         # attention topped out at 20.1k @ bs=32). Flash's O(T) memory plus the
-        # fused chunked CE (no [B,T,V] logits) is what admits bs=64; 1024-wide
-        # flash blocks + chained-dispatch timing take it to 30.9k tok/s.
+        # fused chunked CE (no [B,T,V] logits) is what admits bs=64.
         bs, seq, steps, warmup = 64, 1024, 10, 3
     else:  # CI / no-TPU fallback keeps the script honest but fast
         cfg = GPT2Config.tiny(dtype=jnp.bfloat16)
@@ -58,10 +82,13 @@ def main():
         return {"input_ids": rng.integers(0, cfg.vocab_size,
                                           size=(bs, seq)).astype(np.int32)}
 
+    t = time.time()
     params = model.init(jax.random.PRNGKey(0),
                         {"input_ids": make_batch(0)["input_ids"][:1]})["params"]
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    log(f"train: params built ({n_params/1e6:.0f}M) in {time.time()-t:.1f}s")
 
+    t = time.time()
     engine, *_ = deepspeed_tpu.initialize(
         model=model, model_parameters=params,
         config={
@@ -71,37 +98,343 @@ def main():
             "bf16": {"enabled": True},
             "zero_optimization": {"stage": 0},
         })
+    t_engine = time.time() - t
+
+    # First step = compile; time it separately so a slow-compile environment
+    # is visible in the artifact rather than polluting the window.
+    t = time.time()
+    float(engine.train_batch(make_batch(0)))
+    t_compile = time.time() - t
+    log(f"train: engine {t_engine:.1f}s, compile+first step {t_compile:.1f}s")
+    for i in range(1, warmup):
+        float(engine.train_batch(make_batch(i)))
 
     # Timing discipline: dispatch all steps, then fetch the FINAL loss to host.
     # Step i+1's input state is step i's donated output, so the steps serialise
     # on device and the one host fetch at the end is a true barrier over the
-    # whole window (through the axon tunnel block_until_ready does not
-    # synchronise, and a per-step fetch would add one tunnel RTT per step —
-    # measured ~4% at 10 steps).
-    for i in range(warmup):
-        float(engine.train_batch(make_batch(i)))
+    # whole window (a per-step fetch would add one tunnel RTT per step).
     t0 = time.time()
     loss_dev = None
     for i in range(steps):
         loss_dev = engine.train_batch(make_batch(warmup + i))
     loss = float(loss_dev)
     dt = time.time() - t0
-
     tokens_per_sec = bs * seq * steps / dt
+    log(f"train: {steps} chained steps in {dt:.2f}s -> {tokens_per_sec:,.0f} tok/s")
+
+    # Diagnostic window: per-step synced timings. If these are much slower
+    # than the chained window, the environment pays a large per-dispatch /
+    # sync cost (remote tunnel) — the chained number is the honest one.
+    step_times = []
+    for i in range(3):
+        t1 = time.time()
+        float(engine.train_batch(make_batch(100 + i)))
+        step_times.append(round(time.time() - t1, 3))
+    log(f"train: synced per-step times {step_times}")
+
     flops_per_token = 6 * n_params  # fwd+bwd dense transformer approximation
     mfu = tokens_per_sec * flops_per_token / peak_for(jax.devices()[0])
+    return {
+        "tokens_per_sec": tokens_per_sec,
+        "mfu": mfu,
+        "n_params": int(n_params),
+        "final_loss": round(loss, 4),
+        "engine_s": round(t_engine, 1),
+        "compile_s": round(t_compile, 1),
+        "chained_window_s": round(dt, 2),
+        "synced_step_s": step_times,
+    }
+
+
+# --------------------------------------------------------------------------- #
+# inference v2: FastGen-analog decode + prefill (parity target:
+# blogs/deepspeed-fastgen/README.md throughput evaluation)
+# --------------------------------------------------------------------------- #
+
+def bench_decode(on_tpu: bool) -> dict:
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        layers, hidden, heads, vocab = 12, 1536, 12, 32000
+        seqs, prompt, gen, chunk = 32, 128, 64, 32
+    else:
+        layers, hidden, heads, vocab = 2, 64, 4, 256
+        seqs, prompt, gen, chunk = 4, 16, 8, 8
+
+    # context budget: prompt + warmup decode chunks (2x) + gen + reserve slack
+    ctx = prompt + gen + 3 * chunk + 64
+    cfg = LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                      intermediate_size=hidden * 4, num_hidden_layers=layers,
+                      num_attention_heads=heads, num_key_value_heads=heads,
+                      max_position_embeddings=ctx,
+                      dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+    engine = InferenceEngineV2(
+        model=model, model_parameters=params,
+        config={"state_manager": {
+            "max_tracked_sequences": seqs,
+            "max_ragged_sequence_count": seqs,
+            "max_ragged_batch_size": max(seqs * 2, prompt * 2),
+            "max_context": ctx,
+        }})
+    prompts = [rng.randint(0, vocab, size=(prompt,)).astype(np.int32)
+               for _ in range(seqs)]
+    uids = list(range(seqs))
+
+    t = time.time()
+    engine.put(uids, prompts)          # cold: compiles chunk shapes
+    engine.flush(uids)
+    log(f"decode: prefill compile {time.time()-t:.1f}s")
+    t0 = time.time()
+    engine.put(uids, prompts)
+    prefill_tput = seqs * prompt / (time.time() - t0)
+
+    t = time.time()
+    engine.decode_steps(uids, chunk)   # cold: compiles the fused loop
+    log(f"decode: multistep compile {time.time()-t:.1f}s")
+    engine.decode_steps(uids, chunk)   # warm once more
+    t0 = time.time()
+    done = 0
+    while done < gen:
+        engine.decode_steps(uids, chunk)
+        done += chunk
+    decode_tput = seqs * done / (time.time() - t0)
+    engine.flush(uids)
+    log(f"decode: {decode_tput:,.0f} tok/s decode, {prefill_tput:,.0f} tok/s prefill")
+    return {
+        "decode_tokens_per_sec": round(decode_tput, 1),
+        "prefill_tokens_per_sec": round(prefill_tput, 1),
+        "n_params": int(n_params), "seqs": seqs,
+        "prompt": prompt, "gen": gen,
+    }
+
+
+# --------------------------------------------------------------------------- #
+# MoE: dropless grouped-GEMM training throughput
+# --------------------------------------------------------------------------- #
+
+def bench_moe(on_tpu: bool) -> dict:
+    import deepspeed_tpu
+    from deepspeed_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+
+    if on_tpu:
+        cfg = MixtralConfig(vocab_size=32000, hidden_size=1024,
+                            intermediate_size=2048, num_hidden_layers=8,
+                            num_attention_heads=16, num_key_value_heads=8,
+                            num_local_experts=8, num_experts_per_tok=2,
+                            max_position_embeddings=1024, remat=True,
+                            dtype=jnp.bfloat16, dispatch_mode="dropless")
+        bs, seq, steps, warmup = 16, 512, 8, 2
+    else:
+        cfg = MixtralConfig.tiny(dispatch_mode="dropless")
+        bs, seq, steps, warmup = 4, 16, 2, 1
+
+    model = MixtralForCausalLM(cfg)
+
+    def make_batch(i):
+        rng = np.random.default_rng(1000 + i)
+        return {"input_ids": rng.integers(0, cfg.vocab_size,
+                                          size=(bs, seq)).astype(np.int32)}
+
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": make_batch(0)["input_ids"][:1]})["params"]
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={
+            "train_batch_size": bs,
+            "steps_per_print": 0,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "bf16": {"enabled": bool(on_tpu)},
+            "zero_optimization": {"stage": 0},
+        })
+    t = time.time()
+    for i in range(warmup):
+        float(engine.train_batch(make_batch(i)))
+    log(f"moe: compile+warmup {time.time()-t:.1f}s ({n_params/1e6:.0f}M params)")
+    t0 = time.time()
+    loss_dev = None
+    for i in range(steps):
+        loss_dev = engine.train_batch(make_batch(warmup + i))
+    float(loss_dev)
+    tput = bs * seq * steps / (time.time() - t0)
+    log(f"moe: {tput:,.0f} tok/s (dropless, E={cfg.num_local_experts} "
+        f"k={cfg.num_experts_per_tok})")
+    return {"moe_train_tokens_per_sec": round(tput, 1),
+            "n_params": int(n_params),
+            "experts": cfg.num_local_experts,
+            "top_k": cfg.num_experts_per_tok}
+
+
+# --------------------------------------------------------------------------- #
+# Pallas kernel smoke grid (real-TPU lowering check vs jnp references)
+# --------------------------------------------------------------------------- #
+
+def bench_kernels(on_tpu: bool) -> dict:
+    """flash fwd+bwd, paged decode/chunk, block-sparse at a few shape/dtype
+    points, asserted against the jnp references to ~1e-2. The CPU suite runs
+    these kernels through the Pallas interpreter; only this grid exercises the
+    actual Mosaic lowering on hardware."""
+    from deepspeed_tpu.ops.attention import reference_attention
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+    from deepspeed_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention, paged_decode_attention_reference,
+        paged_chunk_attention, paged_chunk_attention_reference)
+    from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+        block_sparse_attention_bhsd)
+
+    results = {}
+    key = jax.random.PRNGKey(7)
+
+    def mk(*shape, dtype=jnp.bfloat16, k=0):
+        return jax.random.normal(jax.random.fold_in(key, k), shape, dtype)
+
+    # flash fwd + bwd: (B, T, H, D) incl. odd T and GQA
+    for i, (B, T, H, Hkv, D, dtype) in enumerate([
+            (2, 256, 8, 8, 64, jnp.bfloat16),
+            (1, 384, 8, 2, 64, jnp.bfloat16),     # GQA, non-pow2 T
+            (2, 128, 4, 4, 128, jnp.float32)]):
+        q = mk(B, T, H, D, dtype=dtype, k=3 * i)
+        k_ = mk(B, T, Hkv, D, dtype=dtype, k=3 * i + 1)
+        v = mk(B, T, Hkv, D, dtype=dtype, k=3 * i + 2)
+        rep = H // Hkv  # reference path has no GQA auto-repeat
+
+        def loss_flash(q, k_, v):
+            return jnp.sum(flash_attention(q, k_, v, causal=True) ** 2)
+
+        def loss_ref(q, k_, v):
+            return jnp.sum(reference_attention(
+                q, jnp.repeat(k_, rep, 2), jnp.repeat(v, rep, 2),
+                causal=True) ** 2)
+
+        o = flash_attention(q, k_, v, causal=True)
+        o_ref = reference_attention(q, jnp.repeat(k_, rep, 2),
+                                    jnp.repeat(v, rep, 2), causal=True)
+        err_f = float(jnp.max(jnp.abs(o.astype(jnp.float32)
+                                      - o_ref.astype(jnp.float32))))
+        g = jax.grad(loss_flash)(q, k_, v)
+        g_ref = jax.grad(loss_ref)(q, k_, v)
+        err_b = float(jnp.max(jnp.abs(g.astype(jnp.float32)
+                                      - g_ref.astype(jnp.float32))))
+        # grads scale with T; normalise by the reference magnitude
+        err_b /= max(1.0, float(jnp.max(jnp.abs(g_ref.astype(jnp.float32)))))
+        assert err_f < 2e-2 and err_b < 2e-2, \
+            f"flash mismatch at case {i}: fwd {err_f:.4f} bwd-rel {err_b:.4f}"
+        results[f"flash_{B}x{T}x{H}x{D}_{jnp.dtype(dtype).name}"] = \
+            round(max(err_f, err_b), 5)
+
+    # paged decode + chunk attention over a paged KV pool
+    NB, bs_, Hkv, D, S = 16, 8, 4, 64, 3
+    H = 8
+    k_pages = mk(NB, bs_, Hkv, D, k=100)
+    v_pages = mk(NB, bs_, Hkv, D, k=101)
+    q = mk(S, H, D, k=102)
+    bts = jnp.asarray(np.arange(S * 4).reshape(S, 4) % NB, jnp.int32)
+    cls_ = jnp.asarray([9, 17, 30], jnp.int32)
+    o = paged_decode_attention(q, k_pages, v_pages, bts, cls_)
+    o_ref = paged_decode_attention_reference(q, k_pages, v_pages, bts, cls_)
+    err = float(jnp.max(jnp.abs(o.astype(jnp.float32)
+                                - o_ref.astype(jnp.float32))))
+    assert err < 2e-2, f"paged decode mismatch {err:.4f}"
+    results["paged_decode"] = round(err, 5)
+
+    C = 16
+    qc = mk(C, H, D, k=103)
+    bt = jnp.asarray(np.arange(8) % NB, jnp.int32)
+    o = paged_chunk_attention(qc, k_pages, v_pages, bt,
+                              jnp.int32(8), jnp.int32(8 + C))
+    o_ref = paged_chunk_attention_reference(qc, k_pages, v_pages, bt,
+                                            jnp.int32(8), jnp.int32(8 + C))
+    err = float(jnp.max(jnp.abs(o.astype(jnp.float32)
+                                - o_ref.astype(jnp.float32))))
+    assert err < 2e-2, f"paged chunk mismatch {err:.4f}"
+    results["paged_chunk"] = round(err, 5)
+
+    # block-sparse attention (bigbird-style mixed layout) vs dense masked ref
+    T, blk = 512, 64
+    nb = T // blk
+    H = 4
+    layout = np.zeros((H, nb, nb), np.uint8)
+    for h in range(H):
+        for i in range(nb):
+            layout[h, i, max(0, i - 1):i + 1] = 1   # local band
+            layout[h, i, 0] = 1                     # global col
+    q = mk(1, H, T, 64, k=104)
+    k_ = mk(1, H, T, 64, k=105)
+    v = mk(1, H, T, 64, k=106)
+    o = block_sparse_attention_bhsd(q, k_, v, layout, blk, causal=True)
+    mask = np.kron(layout, np.ones((blk, blk), np.uint8))
+    mask = np.tril(mask)
+    logits = (jnp.einsum("bhsd,bhtd->bhst", q.astype(jnp.float32),
+                         k_.astype(jnp.float32)) / (64 ** 0.5))
+    logits = jnp.where(mask[None] > 0, logits, -1e30)
+    o_ref = jnp.einsum("bhst,bhtd->bhsd", jax.nn.softmax(logits, axis=-1),
+                       v.astype(jnp.float32))
+    # fully-masked rows (none here: diag always active) — direct compare
+    err = float(jnp.max(jnp.abs(o.astype(jnp.float32) - o_ref)))
+    assert err < 2e-2, f"block-sparse mismatch {err:.4f}"
+    results["block_sparse"] = round(err, 5)
+
+    log(f"kernels: all pass {results}")
+    return results
+
+
+# --------------------------------------------------------------------------- #
+
+def main():
+    # Persistent XLA compile cache: the 350M train step costs ~3 min to
+    # compile through the remote tunnel, <1 s to reload (measured 37.7 s ->
+    # 0.84 s on a probe). Lives inside the repo so driver runs share it.
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                       ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:
+        pass  # cache is an optimisation, never a requirement
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    dev = getattr(jax.devices()[0], "device_kind", "?")
+    log(f"backend={jax.default_backend()} device={dev}")
+
+    extra = {"backend": jax.default_backend(), "device": dev}
+
+    train = bench_train(on_tpu)   # headline — let a failure here fail loudly
+    extra.update({k: train[k] for k in
+                  ("mfu", "n_params", "final_loss", "engine_s", "compile_s",
+                   "chained_window_s", "synced_step_s")})
+
+    fast = os.environ.get("DSTPU_BENCH_FAST") == "1"
+    for name, fn in (("kernels", bench_kernels), ("decode", bench_decode),
+                     ("moe", bench_moe)):
+        # Each phase builds its own model/engine; drop the previous phase's
+        # device state (params, optimizer, KV pools) before the next one or
+        # the 350M train state alone exhausts a v5e chip's HBM.
+        import gc
+        gc.collect()
+        jax.clear_caches()
+        if fast:
+            extra[name] = "skipped (DSTPU_BENCH_FAST=1)"
+            continue
+        try:
+            extra[name] = fn(on_tpu)
+        except Exception as e:  # sub-bench failure must not kill the headline
+            traceback.print_exc(file=sys.stderr)
+            extra[name] = f"FAILED: {type(e).__name__}: {e}"
+
+    mfu = extra.pop("mfu")
     out = {
         "metric": "gpt2_350m_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
+        "value": round(train["tokens_per_sec"], 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.50, 4),
-        "extra": {
-            "mfu": round(mfu, 4),
-            "n_params": int(n_params),
-            "final_loss": round(loss, 4),
-            "backend": jax.default_backend(),
-            "device": getattr(jax.devices()[0], "device_kind", "?"),
-        },
+        "extra": {"mfu": round(mfu, 4), **extra},
     }
     print(json.dumps(out))
 

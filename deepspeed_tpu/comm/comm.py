@@ -210,7 +210,29 @@ def init_distributed(dist_backend: Optional[str] = None,
     global _INITIALIZED
     if _INITIALIZED:
         return
-    in_multiproc = (world_size > 1 or int(os.environ.get("WORLD_SIZE", "1")) > 1
+    # Scheduler env discovery (parity: mpi_discovery, reference comm.py:673):
+    # srun/mpirun assign ranks through their own variables; fold them into the
+    # RANK/WORLD_SIZE contract the rest of the stack reads. SLURM vars are
+    # only trusted inside an srun step (SLURM_STEP_ID): a plain `python
+    # train.py` inside an sbatch allocation inherits SLURM_NTASKS but is a
+    # single process — folding it in would make a previously-working script
+    # wait forever for peers.
+    env_rank = os.environ.get("RANK")
+    env_world = os.environ.get("WORLD_SIZE")
+    if auto_mpi_discovery:
+        in_srun_step = os.environ.get("SLURM_STEP_ID") is not None
+        rank_vars = ["OMPI_COMM_WORLD_RANK", "PMI_RANK"]
+        world_vars = ["OMPI_COMM_WORLD_SIZE", "PMI_SIZE"]
+        if in_srun_step:
+            rank_vars.insert(0, "SLURM_PROCID")
+            world_vars.insert(0, "SLURM_NTASKS")
+        for var in rank_vars:
+            if env_rank is None and os.environ.get(var) is not None:
+                env_rank = os.environ[var]
+        for var in world_vars:
+            if env_world is None and os.environ.get(var) is not None:
+                env_world = os.environ[var]
+    in_multiproc = (world_size > 1 or int(env_world or "1") > 1
                     or os.environ.get("COORDINATOR_ADDRESS"))
     if in_multiproc:
         kwargs = {}
@@ -219,10 +241,10 @@ def init_distributed(dist_backend: Optional[str] = None,
             coord = f"{os.environ['MASTER_ADDR']}:{os.environ.get('MASTER_PORT', distributed_port)}"
         if coord:
             kwargs["coordinator_address"] = coord
-        if rank >= 0 or os.environ.get("RANK"):
-            kwargs["process_id"] = rank if rank >= 0 else int(os.environ["RANK"])
-        if world_size > 0 or os.environ.get("WORLD_SIZE"):
-            kwargs["num_processes"] = world_size if world_size > 0 else int(os.environ["WORLD_SIZE"])
+        if rank >= 0 or env_rank is not None:
+            kwargs["process_id"] = rank if rank >= 0 else int(env_rank)
+        if world_size > 0 or env_world is not None:
+            kwargs["num_processes"] = world_size if world_size > 0 else int(env_world)
         if verbose:
             logger.info(f"init_distributed: jax.distributed.initialize({kwargs})")
         jax.distributed.initialize(**kwargs)

@@ -47,7 +47,8 @@ def parse_args(args=None):
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--launcher", type=str, default="pdsh",
-                        choices=["pdsh", "ssh", "openmpi", "local"])
+                        choices=["pdsh", "ssh", "openmpi", "mpich", "impi",
+                                 "slurm", "mvapich", "local"])
     parser.add_argument("--launcher_args", type=str, default="")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("--elastic_training", action="store_true")
@@ -233,6 +234,150 @@ def args_script(args) -> str:
     return args.user_script
 
 
+class _MPIStyleRunner(MultiNodeRunner):
+    """Shared shape for mpirun-family runners (parity:
+    ``launcher/multinode_runner.py:170 MPICHRunner`` / ``:241 IMPIRunner``):
+    one flat mpirun with per-rank ``-env RANK <r>`` segments joined by ``:``,
+    common rendezvous env via ``-genv``. Hydra mpiexec parses ``-env``/
+    ``-genv`` as TWO tokens (name, value) — the ``NAME=VALUE`` single-token
+    form misparses. On TPU a "slot" is one host process (a chip/subslice
+    group), so ranks = sum of hostfile slots."""
+
+    def __init__(self, args, world_info_b64, active_resources):
+        super().__init__(args, world_info_b64)
+        self.active_resources = active_resources
+
+    def backend_exists(self) -> bool:
+        import shutil
+        return shutil.which("mpirun") is not None
+
+    def _genv(self, k: str, v: str) -> List[str]:
+        return ["-genv", k, v]
+
+    def _env(self, k: str, v: str) -> List[str]:
+        return ["-env", k, v]
+
+    def _common_env(self) -> Dict[str, str]:
+        world = sum(len(s) for s in self.active_resources.values())
+        return {
+            **self.exports,
+            "COORDINATOR_ADDRESS":
+                f"{self.args.master_addr}:{self.args.master_port}",
+            "MASTER_ADDR": str(self.args.master_addr),
+            "MASTER_PORT": str(self.args.master_port),
+            "WORLD_SIZE": str(world),
+        }
+
+    def _mpirun_head(self) -> List[str]:
+        return ["mpirun"] + shlex.split(self.args.launcher_args)
+
+    def get_cmd(self, environment, active_resources) -> List[str]:
+        cmd = self._mpirun_head()
+        for k, v in self._common_env().items():
+            cmd += self._genv(k, v)
+        rank = 0
+        segments: List[str] = []
+        for host, slots in active_resources.items():
+            for local_rank in range(len(slots)):
+                seg = (["-n", "1", "-host", host]
+                       + self._env("RANK", str(rank))
+                       + self._env("LOCAL_RANK", str(local_rank))
+                       + [sys.executable, "-u", self.args.user_script]
+                       + list(self.args.user_args))
+                segments = segments + ([":"] if segments else []) + seg
+                rank += 1
+        return cmd + segments
+
+
+class MPICHRunner(_MPIStyleRunner):
+    """Parity: ``multinode_runner.py:170 MPICHRunner``."""
+
+
+class IMPIRunner(_MPIStyleRunner):
+    """Intel MPI (parity: ``multinode_runner.py:241 IMPIRunner``): adds -ppn
+    and pins I_MPI_PIN off (host threading is managed by the runtime)."""
+
+    def _mpirun_head(self) -> List[str]:
+        per_node = {len(s) for s in self.active_resources.values()}
+        if len(per_node) != 1:
+            raise ValueError("Intel MPI requires the same number of slots "
+                             "per node")
+        return (["mpirun", "-ppn", str(per_node.pop())]
+                + shlex.split(self.args.launcher_args))
+
+    def _common_env(self) -> Dict[str, str]:
+        env = super()._common_env()
+        env["I_MPI_PIN"] = "0"
+        return env
+
+
+class SlurmRunner(MultiNodeRunner):
+    """srun fan-out (parity: ``multinode_runner.py:326 SlurmRunner``): slurm
+    assigns ranks, so we only pass -n / nodelists and export the rendezvous
+    env; each task derives RANK from SLURM_PROCID (see launcher/launch.py
+    env fallbacks)."""
+
+    def __init__(self, args, world_info_b64, active_resources):
+        super().__init__(args, world_info_b64)
+        self.active_resources = active_resources
+
+    def backend_exists(self) -> bool:
+        import shutil
+        return shutil.which("sinfo") is not None
+
+    def get_cmd(self, environment, active_resources) -> List[str]:
+        world = sum(len(s) for s in active_resources.values())
+        cmd = ["srun", "-n", str(world)] + shlex.split(self.args.launcher_args)
+        # --include/--exclude were already applied by main() when computing
+        # active_resources; srun has no --include flag, so hand it the
+        # resolved host list instead.
+        cmd += ["--nodelist", ",".join(active_resources.keys())]
+        if self.args.num_nodes > 0:
+            cmd += ["--nodes", str(self.args.num_nodes)]
+        exports = "--export=ALL"
+        world_env = {
+            **self.exports,
+            "COORDINATOR_ADDRESS":
+                f"{self.args.master_addr}:{self.args.master_port}",
+            "MASTER_ADDR": str(self.args.master_addr),
+            "MASTER_PORT": str(self.args.master_port),
+            "WORLD_SIZE": str(world),
+        }
+        for k, v in world_env.items():
+            exports += f",{k}={v}"
+        return (cmd + [exports, sys.executable, "-u", self.args.user_script]
+                + list(self.args.user_args))
+
+
+class MVAPICHRunner(_MPIStyleRunner):
+    """Parity: ``multinode_runner.py:374 MVAPICHRunner`` — the reference's
+    CUDA/IB tuning exports become no-ops on TPU; what remains is the mpirun
+    shape with MV2 affinity disabled (host process manages its own threads).
+    mvapich's launcher takes env as single ``-env NAME=VALUE`` tokens."""
+
+    def _genv(self, k: str, v: str) -> List[str]:
+        return ["-env", f"{k}={v}"]
+
+    def _env(self, k: str, v: str) -> List[str]:
+        return ["-env", f"{k}={v}"]
+
+    def backend_exists(self) -> bool:
+        import shutil
+        if shutil.which("mpiname") is None:
+            return False
+        try:
+            out = subprocess.check_output(["mpiname"]).decode()
+        except Exception:
+            return False
+        return "MVAPICH" in out
+
+    def _common_env(self) -> Dict[str, str]:
+        env = super()._common_env()
+        env["MV2_ENABLE_AFFINITY"] = "0"
+        env["MV2_SUPPORT_DL"] = "1"
+        return env
+
+
 def main(args=None):
     args = parse_args(args)
     if args.num_hosts > 0 and args.num_nodes < 0:
@@ -262,7 +407,9 @@ def main(args=None):
 
     env = os.environ.copy()
     runner_cls = {"pdsh": PDSHRunner, "ssh": SSHRunner,
-                  "openmpi": OpenMPIRunner, "local": None}[args.launcher]
+                  "openmpi": OpenMPIRunner, "mpich": MPICHRunner,
+                  "impi": IMPIRunner, "slurm": SlurmRunner,
+                  "mvapich": MVAPICHRunner, "local": None}[args.launcher]
     if runner_cls is None:
         cmd = build_launch_cmd(args, active, args.master_addr)
         logger.info(f"dstpu local multi-launch: {' '.join(cmd)}")
@@ -270,7 +417,10 @@ def main(args=None):
         proc.wait()
         sys.exit(proc.returncode)
 
-    runner = runner_cls(args, encode_world_info(active))
+    if issubclass(runner_cls, (_MPIStyleRunner, SlurmRunner)):
+        runner = runner_cls(args, encode_world_info(active), active)
+    else:
+        runner = runner_cls(args, encode_world_info(active))
     if not runner.backend_exists():
         raise RuntimeError(f"launcher backend for {runner.name} not found in PATH")
     for var in EXPORT_ENVS:

@@ -460,7 +460,8 @@ class DeepSpeedTPUEngine:
             # params pass through to the output state, so donation aliases the
             # old buffers instead of double-allocating device state
             self._fused_step = jax.jit(self._build_offload_grad_step(),
-                                       donate_argnums=(0,))
+                                       donate_argnums=(0,),
+                                       compiler_options=self._compiler_options())
         if self._offload_merge is None:
             self._offload_train_merge_warmup()
         self.state, host_g, metrics = self._fused_step(self.state, sharded_batch)
@@ -725,6 +726,21 @@ class DeepSpeedTPUEngine:
 
         return jax.tree_util.tree_map(place, batch)
 
+    def _compiler_options(self, backend: Optional[str] = None):
+        """ZeRO bucket sizes -> XLA collective-combiner thresholds, applied to
+        the jitted step's compile options (parity: ``reduce_bucket_size`` /
+        ``allgather_bucket_size``, reference ``runtime/zero/config.py`` — there
+        they bound hand-scheduled collective buckets; here they bound XLA's
+        collective combining). TPU-only flags: other backends reject them."""
+        backend = backend or jax.default_backend()
+        if backend != "tpu":
+            return None
+        z = self.config.zero_optimization
+        if z.stage < 1:
+            return None
+        from deepspeed_tpu.runtime.zero.partition import xla_bucket_flags
+        return xla_bucket_flags(z.reduce_bucket_size, z.allgather_bucket_size)
+
     def train_batch(self, batch=None, data_iter=None):
         """One full training step over a global batch (parity:
         ``PipelineEngine.train_batch`` pipe/engine.py:321 and the
@@ -741,7 +757,8 @@ class DeepSpeedTPUEngine:
             batch = next(data_iter)
         self._ensure_state(batch)
         if self._fused_step is None and self._offload is None:
-            self._fused_step = jax.jit(self._build_fused_step(), donate_argnums=(0,))
+            self._fused_step = jax.jit(self._build_fused_step(), donate_argnums=(0,),
+                                       compiler_options=self._compiler_options())
         fp_cfg = self.config.flops_profiler
         if fp_cfg.enabled and self.global_steps + 1 == fp_cfg.profile_step:
             self._run_flops_profile(batch)
@@ -762,8 +779,14 @@ class DeepSpeedTPUEngine:
             metrics = self._offload_train_step(sharded)
         else:
             self.state, metrics = self._fused_step(self.state, sharded)
-        self.timers(STEP_GLOBAL_TIMER).stop(sync_obj=metrics["loss"])
-        self.tput_timer.stop(sync_obj=metrics["loss"])
+        # Only force a device sync for exact timings when the user asked for a
+        # wall-clock breakdown (parity: reference timers run under the
+        # wall_clock_breakdown flag). An unconditional block_until_ready here
+        # serialises dispatch — each step would pay the full device+tunnel
+        # round trip instead of queueing behind the previous one.
+        sync = metrics["loss"] if self.config.wall_clock_breakdown else None
+        self.timers(STEP_GLOBAL_TIMER).stop(sync_obj=sync)
+        self.tput_timer.stop(sync_obj=sync)
         self._after_step(metrics)
         return metrics["loss"]
 

@@ -26,6 +26,14 @@ import pytest  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
+# Persistent XLA compile cache: the suite is compile-dominated (engine fused
+# steps, ragged decode programs, ...). Warm reruns cut wall-clock several-fold
+# (measured 37.7s -> 0.84s per program reload).
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.dirname(
+                      os.path.abspath(__file__))), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 
 @pytest.fixture(autouse=True)
 def _reset_global_state():

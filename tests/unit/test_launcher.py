@@ -95,3 +95,114 @@ def test_env_report_runs():
     text = "\n".join(lines)
     assert "jax version" in text
     assert "kernel registry" in text
+
+
+def _runner_args(launcher, extra=None):
+    argv = ["--launcher", launcher, "--master_port", "2950",
+            "train.py", "--lr", "0.1"]
+    args = parse_args((extra or []) + argv)
+    args.master_addr = "w0"
+    return args
+
+
+def test_mpich_runner_cmd():
+    from deepspeed_tpu.launcher.runner import MPICHRunner, encode_world_info
+    active = {"w0": [0, 1], "w1": [0, 1]}
+    r = MPICHRunner(_runner_args("mpich"), encode_world_info(active), active)
+    r.add_export("PYTHONPATH", "/x")
+    cmd = r.get_cmd({}, active)
+    assert cmd[0] == "mpirun"
+    # common env via two-token -genv (Hydra syntax), incl. rendezvous contract
+    joined = " ".join(cmd)
+    assert "-genv PYTHONPATH /x" in joined
+    assert "-genv WORLD_SIZE 4" in joined
+    assert "-genv COORDINATOR_ADDRESS w0:2950" in joined
+    # one ':'-separated segment per rank with two-token RANK/LOCAL_RANK
+    assert cmd.count(":") == 3
+    assert "-env RANK 0" in joined and "-env RANK 3" in joined
+    assert joined.count("-env LOCAL_RANK 1") == 2
+    assert joined.count("train.py") == 4 and "--lr" in cmd
+
+
+def test_impi_runner_cmd_and_uneven_slots():
+    from deepspeed_tpu.launcher.runner import IMPIRunner, encode_world_info
+    active = {"w0": [0, 1], "w1": [0, 1]}
+    r = IMPIRunner(_runner_args("impi"), encode_world_info(active), active)
+    cmd = r.get_cmd({}, active)
+    assert cmd[:3] == ["mpirun", "-ppn", "2"]
+    assert "-genv I_MPI_PIN 0" in " ".join(cmd)
+    uneven = {"w0": [0, 1], "w1": [0]}
+    r = IMPIRunner(_runner_args("impi"), encode_world_info(uneven), uneven)
+    with pytest.raises(ValueError, match="same number of slots"):
+        r.get_cmd({}, uneven)
+
+
+def test_slurm_runner_cmd():
+    from deepspeed_tpu.launcher.runner import SlurmRunner, encode_world_info
+    active = {"w0": [0], "w1": [0], "w2": [0]}
+    args = _runner_args("slurm", extra=["--num_nodes", "3"])
+    r = SlurmRunner(args, encode_world_info(active), active)
+    r.add_export("XLA_FLAGS", "--f=1")
+    cmd = r.get_cmd({}, active)
+    assert cmd[:3] == ["srun", "-n", "3"]
+    # filters resolve to --nodelist (srun has no --include flag)
+    assert "--include" not in cmd
+    assert cmd[cmd.index("--nodelist") + 1] == "w0,w1,w2"
+    assert "--nodes" in cmd and cmd[cmd.index("--nodes") + 1] == "3"
+    exports = [c for c in cmd if c.startswith("--export=ALL")][0]
+    assert "XLA_FLAGS=--f=1" in exports
+    assert "WORLD_SIZE=3" in exports and "MASTER_ADDR=w0" in exports
+    i = cmd.index(sys.executable)
+    assert cmd[i:i + 3] == [sys.executable, "-u", "train.py"]
+    assert cmd[i + 3:] == ["--lr", "0.1"]
+
+
+def test_mvapich_runner_cmd():
+    from deepspeed_tpu.launcher.runner import MVAPICHRunner, encode_world_info
+    active = {"w0": [0], "w1": [0]}
+    r = MVAPICHRunner(_runner_args("mvapich"), encode_world_info(active), active)
+    cmd = r.get_cmd({}, active)
+    joined = " ".join(cmd)
+    assert cmd[0] == "mpirun"
+    # mvapich spells env as single NAME=VALUE tokens
+    assert "-env MV2_ENABLE_AFFINITY=0" in joined
+    assert "-env RANK=1" in joined
+
+
+def test_slurm_env_discovery(monkeypatch):
+    """SLURM_PROCID/SLURM_NTASKS must fold into the RANK/WORLD_SIZE contract
+    (parity: mpi_discovery, reference comm/comm.py:673)."""
+    import deepspeed_tpu.comm.comm as comm_mod
+    seen = {}
+    monkeypatch.setattr(comm_mod, "_INITIALIZED", False)
+    monkeypatch.setattr(comm_mod.jax.distributed, "initialize",
+                        lambda **kw: seen.update(kw))
+    monkeypatch.setenv("SLURM_PROCID", "2")
+    monkeypatch.setenv("SLURM_NTASKS", "4")
+    monkeypatch.setenv("SLURM_STEP_ID", "0")   # srun step marker
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "w0:2950")
+    monkeypatch.delenv("RANK", raising=False)
+    monkeypatch.delenv("WORLD_SIZE", raising=False)
+    comm_mod.init_distributed(verbose=False)
+    monkeypatch.setattr(comm_mod, "_INITIALIZED", False)
+    assert seen == {"coordinator_address": "w0:2950", "process_id": 2,
+                    "num_processes": 4}
+
+
+def test_sbatch_without_srun_stays_single_process(monkeypatch):
+    """SLURM_NTASKS inherited from an sbatch allocation (no srun step) must
+    NOT trigger distributed init for a plain `python train.py` child."""
+    import deepspeed_tpu.comm.comm as comm_mod
+    called = []
+    monkeypatch.setattr(comm_mod, "_INITIALIZED", False)
+    monkeypatch.setattr(comm_mod.jax.distributed, "initialize",
+                        lambda **kw: called.append(kw))
+    monkeypatch.setenv("SLURM_NTASKS", "8")
+    monkeypatch.delenv("SLURM_STEP_ID", raising=False)
+    monkeypatch.delenv("SLURM_PROCID", raising=False)
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("RANK", raising=False)
+    monkeypatch.delenv("WORLD_SIZE", raising=False)
+    comm_mod.init_distributed(verbose=False)
+    monkeypatch.setattr(comm_mod, "_INITIALIZED", False)
+    assert called == []
