@@ -11,9 +11,14 @@ pattern), and jax AD differentiates straight through the loop — the backward
 schedule falls out of autodiff instead of hand-written BackwardPass instructions.
 
 Schedule: GPipe-style fill/drain over ``n_micro`` microbatches (bubble fraction
-(P-1)/(M+P-1)); the 1F1B memory optimisation is a remat policy here, not a
-different instruction stream, since XLA already frees per-microbatch activations
-after their backward use.
+(P-1)/(M+P-1)). The 1F1B *memory* optimisation (reference ``schedule.py:189
+TrainSchedule`` keeps <= P microbatches of residuals live instead of M) is a
+remat boundary here, not a different instruction stream: ``remat_ticks=True``
+checkpoints each (stage, microbatch) tick of the scan, so backward stores only
+tick inputs and recomputes the local stack serially — stored bytes then SHRINK
+as n_micro grows (per-tick inputs get smaller), the 1F1B residency bound.
+Measured on the v5e AOT topology (tests/unit/test_pipeline_memory.py, n_micro
+in {4, 16}): plain {4: 1110, 16: 748} MB vs remat {4: 245, 16: 52} MB.
 """
 
 from __future__ import annotations
@@ -71,7 +76,8 @@ def gpipe_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
                 x: jax.Array,
                 n_micro: int,
                 mesh=None,
-                axis_name: str = PIPE_AXIS) -> jax.Array:
+                axis_name: str = PIPE_AXIS,
+                remat_ticks: bool = False) -> jax.Array:
     """Run a homogeneous block stack as a pipeline.
 
     ``stacked_params``: pytree whose leaves have leading dim L (total layers),
@@ -80,6 +86,15 @@ def gpipe_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
 
     Differentiable end-to-end (jax AD through ppermute); use inside the engine's
     loss like any other function.
+
+    ``remat_ticks=True`` checkpoints each (stage, microbatch) tick: only the
+    tick's INPUT activation is stored for backward and the local stack is
+    recomputed — peak activation memory stays ~flat in ``n_micro`` instead of
+    growing with it (measured: see tests/unit/test_pipeline_memory.py). This is
+    the memory shape 1F1B buys the reference (schedule.py:189 TrainSchedule
+    keeps <= P microbatches of residuals in flight); on TPU the same bound
+    comes from a remat boundary, with recompute traded for the reference's
+    schedule complexity.
     """
     mesh = mesh or get_topology().mesh
     n_stages = mesh.shape[axis_name]
@@ -94,14 +109,10 @@ def gpipe_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
         recv = jnp.zeros((mb,) + x_full.shape[1:], x_full.dtype)
         fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
 
-        def apply_local_stack(h):
-            def scan_fn(carry, p):
-                return block_fn(p, carry), None
-            h, _ = lax.scan(scan_fn, h, local_params)
-            return h
-
-        total_ticks = n_micro + n_stages - 1
-        for t in range(total_ticks):
+        # params are an EXPLICIT argument so jax.checkpoint can prune the tick
+        # body's residuals (closure captures don't get residual-pruned)
+        def tick(carry, t, params):
+            recv, out_buf = carry
             mb_idx = t - stage
             active = jnp.logical_and(mb_idx >= 0, mb_idx < n_micro)
             safe_idx = jnp.clip(mb_idx, 0, n_micro - 1)
@@ -109,15 +120,32 @@ def gpipe_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
                             lax.dynamic_index_in_dim(micros, safe_idx, 0,
                                                      keepdims=False),
                             recv)
-            out = apply_local_stack(inp)
+
+            def scan_fn(h, lp):
+                return block_fn(lp, h), None
+            out, _ = lax.scan(scan_fn, inp, params)
             out = jnp.where(active, out, jnp.zeros_like(out))
             # last stage stores its finished microbatch
             store = jnp.logical_and(active, stage == n_stages - 1)
             cur = lax.dynamic_slice_in_dim(out_buf, safe_idx, 1, 0)
             out_buf = lax.dynamic_update_slice_in_dim(
                 out_buf, jnp.where(store, out[None], cur), safe_idx, 0)
-            if n_stages > 1 and t != total_ticks - 1:
-                recv = lax.ppermute(out, axis_name, fwd_perm)
+            recv = (lax.ppermute(out, axis_name, fwd_perm)
+                    if n_stages > 1 else out)
+            return (recv, out_buf)
+
+        if remat_ticks:
+            tick = jax.checkpoint(tick)
+
+        # lax.scan over ticks (not a Python loop): reverse-mode AD then runs
+        # one tick's backward — and, under remat_ticks, one tick's recompute —
+        # at a time, which is what actually bounds peak memory. An unrolled
+        # loop lets XLA overlap the recomputes and the bound is lost
+        # (measured on the v5e AOT topology; see test_pipeline_memory.py).
+        total_ticks = n_micro + n_stages - 1
+        (recv, out_buf), _ = lax.scan(
+            lambda c, t: (tick(c, t, local_params), None),
+            (recv, out_buf), jnp.arange(total_ticks))
         # share final activations from the last stage with everyone (tiny psum —
         # keeps the output replicated so the loss/head runs outside the pipeline)
         out_full = out_buf.reshape(x_full.shape)
@@ -144,7 +172,8 @@ class PipelineModule:
     """
 
     def __init__(self, block, n_layers: int, n_micro: int = 1,
-                 partition_method: str = "uniform"):
+                 partition_method: str = "uniform",
+                 remat_ticks: bool = False):
         # For a homogeneous block stack, 'uniform' and 'parameters' coincide
         # (equal per-layer weight): the stacked leading dim shards evenly over
         # 'pipe'. Heterogeneous weighting needs per-stage layer lists — use
@@ -157,6 +186,7 @@ class PipelineModule:
         self.n_layers = n_layers
         self.n_micro = n_micro
         self.partition_method = partition_method
+        self.remat_ticks = remat_ticks
 
     def init_stacked(self, rng, sample_x):
         rngs = jax.random.split(rng, self.n_layers)
@@ -169,7 +199,8 @@ class PipelineModule:
     def __call__(self, stacked_params, x, mesh=None):
         return gpipe_apply(
             lambda p, h: self.block.apply({"params": p}, h),
-            stacked_params, x, self.n_micro, mesh=mesh)
+            stacked_params, x, self.n_micro, mesh=mesh,
+            remat_ticks=self.remat_ticks)
 
 
 class PipelineLM:
@@ -193,10 +224,12 @@ class PipelineLM:
     """
 
     def __init__(self, vocab_size: int, d_model: int, block, n_layers: int,
-                 n_micro: int = 1, init_scale: float = 0.02):
+                 n_micro: int = 1, init_scale: float = 0.02,
+                 remat_ticks: bool = False):
         self.vocab_size = vocab_size
         self.d_model = d_model
-        self.pipe = PipelineModule(block, n_layers, n_micro)
+        self.pipe = PipelineModule(block, n_layers, n_micro,
+                                   remat_ticks=remat_ticks)
         self.init_scale = init_scale
 
     def init(self, rng, batch):

@@ -1,0 +1,153 @@
+"""Pipeline memory + tied-weight evidence (VERDICT r1 item 7).
+
+(a) Compiled-memory comparison at n_micro in {4, 16} on the real TPU
+    compiler: with ``remat_ticks=True`` the backward stores only per-tick
+    inputs and recomputes serially, so stored bytes SHRINK as n_micro grows
+    — the memory bound the reference's 1F1B ``TrainSchedule``
+    (runtime/pipe/schedule.py:189) achieves by interleaving; without it,
+    the full residual set of every microbatch stays live (GPipe).
+(b) Tied-weight grad sync: the embedding is used at stage 0 (embed) and
+    after the last stage (LM head). Its gradient must be the SUM of both
+    use-site gradients (parity: reference TiedLayerSpec allreduce,
+    runtime/pipe/module.py).
+"""
+
+import numpy as np
+import pytest
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import build_topology, set_topology
+from deepspeed_tpu.config import MeshConfig
+from deepspeed_tpu.parallel import PipelineLM
+
+
+class Block(nn.Module):
+    width: int = 64
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(4 * self.width, name="up")(jnp.tanh(x))
+        return x + nn.Dense(self.width, name="down")(jnp.tanh(h))
+
+
+def _tpu_pipe_mesh():
+    """AOT v5e 2x4 topology: the CPU backend's memory_analysis does not model
+    buffer reuse (remat shows no savings there), so the memory claim is
+    checked against the real TPU compiler via abstract-topology AOT compile
+    (works without chips; execution never happens)."""
+    from jax.experimental import topologies
+    try:
+        topo = topologies.get_topology_desc(platform="tpu",
+                                            topology_name="v5e:2x4")
+    except Exception as e:  # no libtpu/PJRT TPU plugin in this environment
+        pytest.skip(f"TPU AOT topology unavailable: {e}")
+    from jax.sharding import Mesh
+    return Mesh(np.array(topo.devices).reshape(2, 4), ("pipe", "data"))
+
+
+def _compiled_temp_bytes(n_micro: int, remat_ticks: bool, mesh,
+                         width=512, n_layers=8, B=64, S=128) -> int:
+    """Temp bytes of loss+grad through gpipe_apply alone (no LM head — the
+    residual store of the block stack is the quantity under test)."""
+    from jax.sharding import NamedSharding
+    from deepspeed_tpu.parallel.pipeline import PipelineModule
+    pipe = PipelineModule(Block(width=width), n_layers=n_layers,
+                          n_micro=n_micro, remat_ticks=remat_ticks)
+    x = jax.ShapeDtypeStruct((B, S, width), jnp.float32,
+                             sharding=NamedSharding(mesh, P()))
+    shapes = jax.eval_shape(
+        lambda r: pipe.init_stacked(r, jnp.ones((1, S, width), jnp.float32)),
+        jax.random.PRNGKey(0))
+    specs = pipe.stacked_param_specs(shapes)
+    p_structs = jax.tree_util.tree_map(
+        lambda sh, sp: jax.ShapeDtypeStruct(sh.shape, sh.dtype,
+                                            sharding=NamedSharding(mesh, sp)),
+        shapes, specs, is_leaf=lambda z: isinstance(z, jax.ShapeDtypeStruct))
+
+    def loss_grad(p, x):
+        return jax.value_and_grad(
+            lambda p: jnp.sum(pipe(p, x, mesh=mesh) ** 2))(p)
+
+    c = jax.jit(loss_grad).lower(p_structs, x).compile()
+    ma = c.memory_analysis()
+    assert ma is not None
+    return int(ma.temp_size_in_bytes)
+
+
+def test_remat_ticks_bounds_memory_in_n_micro():
+    """Compiled-memory evidence for the module docstring's claim, from the
+    real TPU compiler: remat_ticks + scan-over-ticks holds <= one tick's
+    residuals (the 1F1B residency bound — stored bytes DROP as n_micro grows,
+    like P*B/M), while plain GPipe-through-AD keeps every microbatch's stack
+    residuals. Measured v5e AOT (width 512, L=8, B=64, S=128):
+    plain {4: 1110, 16: 748} MB vs remat {4: 245, 16: 52} MB."""
+    mesh = _tpu_pipe_mesh()
+    plain = {m: _compiled_temp_bytes(m, False, mesh) for m in (4, 16)}
+    remat = {m: _compiled_temp_bytes(m, True, mesh) for m in (4, 16)}
+    # substantially smaller residual set at every microbatch count...
+    for m in (4, 16):
+        assert remat[m] < plain[m] * 0.5, (plain, remat)
+    # ...and the remat bound SHRINKS as n_micro grows (per-tick inputs get
+    # smaller), the opposite of storing the full residual set
+    assert remat[16] < remat[4], (plain, remat)
+
+
+def test_remat_ticks_same_loss_and_grads(eight_devices):
+    """remat is a scheduling choice, not a numerics choice."""
+    set_topology(build_topology(MeshConfig(pipe=2, data=4)))
+    rng = np.random.default_rng(1)
+    batch = {"input_ids": rng.integers(0, 128, (8, 16)).astype(np.int32)}
+    lm_a = PipelineLM(vocab_size=128, d_model=32, block=Block(width=32),
+                      n_layers=4, n_micro=4, remat_ticks=False)
+    lm_b = PipelineLM(vocab_size=128, d_model=32, block=Block(width=32),
+                      n_layers=4, n_micro=4, remat_ticks=True)
+    params = lm_a.init(jax.random.PRNGKey(3), batch)["params"]
+
+    # jit is required: the remat'd scan body inside shard_map has no eager
+    # path (and the engine always runs the step jitted anyway)
+    la, ga = jax.jit(jax.value_and_grad(
+        lambda p: lm_a.apply({"params": p}, batch)))(params)
+    lb, gb = jax.jit(jax.value_and_grad(
+        lambda p: lm_b.apply({"params": p}, batch)))(params)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(ga), jax.tree_util.tree_leaves(gb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_tied_embedding_grads_sum_across_stages(eight_devices):
+    """The tied wte is consumed on the FIRST stage (embedding gather) and
+    after the LAST stage (LM head projection). Under jax AD + SPMD its grad
+    must equal the sum of the two use-site grads — the functional equivalent
+    of the reference's tied-weight allreduce between the owner stages."""
+    set_topology(build_topology(MeshConfig(pipe=2, data=4)))
+    rng = np.random.default_rng(2)
+    batch = {"input_ids": rng.integers(0, 128, (8, 16)).astype(np.int32)}
+    lm = PipelineLM(vocab_size=128, d_model=32, block=Block(width=32),
+                    n_layers=4, n_micro=2)
+    params = lm.init(jax.random.PRNGKey(4), batch)["params"]
+
+    def loss_split(wte_embed, wte_head, stack):
+        """Same model, but the two tie points take separate tensors."""
+        ids = jnp.asarray(batch["input_ids"])
+        x = wte_embed[ids]
+        h = lm.pipe(stack, x)
+        from deepspeed_tpu.models.llama import chunked_causal_lm_loss
+        return chunked_causal_lm_loss(h, wte_head, ids)
+
+    wte, stack = params["wte"], params["stack"]
+    g_tied = jax.grad(lambda w: loss_split(w, w, stack))(wte)
+    g_embed = jax.grad(lambda w: loss_split(w, wte, stack))(wte)
+    g_head = jax.grad(lambda w: loss_split(wte, w, stack))(wte)
+
+    # both tie points contribute a real (nonzero) gradient...
+    assert float(jnp.abs(g_embed).max()) > 0
+    assert float(jnp.abs(g_head).max()) > 0
+    # ...and the tied grad is exactly their sum
+    np.testing.assert_allclose(np.asarray(g_tied),
+                               np.asarray(g_embed) + np.asarray(g_head),
+                               rtol=1e-5, atol=1e-6)
