@@ -386,6 +386,64 @@ def bench_kernels(on_tpu: bool) -> dict:
 
 
 # --------------------------------------------------------------------------- #
+# comm: tunnel transfer bandwidth + collective sweep (parity: the reference
+# treats comm benchmarking as a first-class deliverable — calc_bw_log,
+# deepspeed/utils/comms_logging.py:34; suite in DeepSpeedExamples)
+# --------------------------------------------------------------------------- #
+
+def bench_comm(on_tpu: bool) -> dict:
+    import subprocess
+    out = {}
+
+    # host <-> device bandwidth on the real link (through the tunnel this is
+    # the serving-path constraint that motivates on-device sampling etc.);
+    # one warmup transfer, then the mean of 3 timed trials each way
+    x = np.random.randn(8 * 1024 * 1024).astype(np.float32)   # 32 MB
+    jax.block_until_ready(jax.device_put(x))                   # warmup
+    trials = 3
+    t0 = time.time()
+    for _ in range(trials):
+        dev = jax.device_put(x)
+        jax.block_until_ready(dev)
+    h2d = trials * x.nbytes / (time.time() - t0) / 1e9
+    _ = np.asarray(dev)                                        # warmup
+    t0 = time.time()
+    for _ in range(trials):
+        _ = np.asarray(dev)
+    d2h = trials * x.nbytes / (time.time() - t0) / 1e9
+    out["h2d_GBps"] = round(h2d, 3)
+    out["d2h_GBps"] = round(d2h, 3)
+    log(f"comm: h2d {h2d:.2f} GB/s, d2h {d2h:.2f} GB/s")
+
+    # collective sweep over an 8-device virtual CPU mesh (single real chip
+    # has no ICI; this polices the collectives plumbing + busbw accounting
+    # end to end — on a real slice the same script measures real ICI)
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    import re
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+\s*", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count=8 " + flags).strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "benchmarks", "comm_bench.py"),
+         "--sizes-mb", "1,4", "--trials", "5"],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=420)
+    rows = []
+    for line in proc.stdout.splitlines():
+        try:
+            rows.append(json.loads(line))
+        except ValueError:
+            pass
+    if proc.returncode != 0 or not rows:
+        raise RuntimeError(f"comm sweep rc={proc.returncode}: "
+                           f"{proc.stderr[-300:]}")
+    out["mesh_sweep"] = rows
+    log(f"comm: sweep {len(rows)} rows over the virtual mesh")
+    return out
+
+
+# --------------------------------------------------------------------------- #
 
 def main():
     # Persistent XLA compile cache: the 350M train step costs ~3 min to
@@ -412,7 +470,7 @@ def main():
 
     fast = os.environ.get("DSTPU_BENCH_FAST") == "1"
     for name, fn in (("kernels", bench_kernels), ("decode", bench_decode),
-                     ("moe", bench_moe)):
+                     ("moe", bench_moe), ("comm", bench_comm)):
         # Each phase builds its own model/engine; drop the previous phase's
         # device state (params, optimizer, KV pools) before the next one or
         # the 350M train state alone exhausts a v5e chip's HBM.

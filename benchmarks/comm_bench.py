@@ -28,6 +28,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 import jax
+
+# honour an explicit CPU request even when a site config pins the platform
+# to a real accelerator (e.g. the axon tunnel) — same discipline as
+# tests/conftest.py; lets bench.py run the sweep on a virtual mesh
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 

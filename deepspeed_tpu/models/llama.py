@@ -53,6 +53,17 @@ class LlamaConfig:
     embed_scale_by_sqrt_dim: bool = False    # x *= sqrt(hidden) after embedding
     norm_plus_one: bool = False              # RMSNorm scales by (1 + weight)
     mlp_act: str = "silu"                    # "silu" | "gelu" (tanh) gate act
+    # Ulysses sequence parallelism for training: attention runs through two
+    # all-to-alls on the 'seq' mesh axis (parallel/ulysses.py); no-op when
+    # the mesh has no seq axis. Requires heads and T divisible by seq size.
+    sequence_parallel: bool = False
+
+    def __post_init__(self):
+        if self.sequence_parallel and self.sliding_window is not None:
+            raise ValueError(
+                "sequence_parallel does not support sliding_window attention "
+                "yet (the Ulysses path always runs full causal attention); "
+                "unset one of the two")
     dtype: Any = jnp.float32
     remat: bool = False
     remat_policy: Optional[str] = None
@@ -224,12 +235,23 @@ class LlamaAttention(nn.Module):
         cfg = self.config
         B, T, _ = x.shape
         q, k, v = self._qkv(x, positions)
-        n_rep = cfg.num_attention_heads // cfg.num_key_value_heads
-        k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
-        if cfg.sliding_window is not None and T > cfg.sliding_window:
-            out = sliding_window_attention(q, k, v, positions, cfg.sliding_window)
+        if cfg.sequence_parallel:
+            # Ulysses (DeepSpeed sequence parallelism, sequence/layer.py:60):
+            # T shards over the 'seq' mesh axis; two all-to-alls around local
+            # attention. K/V stay at Hkv heads across the wire — the GQA
+            # repeat happens post-scatter inside the local attention, so the
+            # all-to-all moves 1/n_rep of the repeated volume. No-op when the
+            # mesh's seq axis is 1. (sliding_window rejected in __post_init__)
+            from deepspeed_tpu.parallel.ulysses import sequence_parallel_attention
+            out = sequence_parallel_attention(q, k, v, causal=True)
         else:
-            out = dot_product_attention(q, k, v, causal=True)
+            n_rep = cfg.num_attention_heads // cfg.num_key_value_heads
+            k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
+            if cfg.sliding_window is not None and T > cfg.sliding_window:
+                out = sliding_window_attention(q, k, v, positions,
+                                               cfg.sliding_window)
+            else:
+                out = dot_product_attention(q, k, v, causal=True)
         out = checkpoint_name(
             out.reshape(B, T, cfg.num_attention_heads * cfg.head_dim), "attn_out")
         return self.o_proj(out)

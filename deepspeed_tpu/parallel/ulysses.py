@@ -53,6 +53,61 @@ def single_all_to_all(x: jax.Array, scatter_idx: int, gather_idx: int,
                           concat_axis=gather_idx, tiled=True)
 
 
+def sequence_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                                causal: bool = True,
+                                softmax_scale: Optional[float] = None,
+                                mesh=None) -> jax.Array:
+    """Training-path Ulysses for the model zoo: [B, T, H, D] attention with T
+    sharded over the 'seq' mesh axis.
+
+    Uses the explicit shard_map + all-to-all form (``DistributedAttention``)
+    rather than GSPMD constraints so the local attention can be the Pallas
+    flash kernel — a ``pallas_call`` under plain-jit GSPMD with sharded
+    operands has no SPMD rule, while under shard_map each shard calls the
+    kernel on its local [B, T, H/P, D] block. Degenerates to the plain
+    routed attention when the seq axis is 1.
+
+    Head/seq divisibility by the axis size is required (reference
+    ``DistributedAttention`` has the same constraint, sequence/layer.py:60).
+    """
+    topo = get_topology()
+    mesh = mesh or topo.mesh
+    P_seq = mesh.shape[SEQ_AXIS]
+    from deepspeed_tpu.ops.attention import dot_product_attention
+
+    def _gqa_repeat(q, k, v):
+        if k.shape[2] != q.shape[2]:
+            rep = q.shape[2] // k.shape[2]
+            k = jnp.repeat(k, rep, 2)
+            v = jnp.repeat(v, rep, 2)
+        return k, v
+
+    if P_seq <= 1:
+        k, v = _gqa_repeat(q, k, v)
+        return dot_product_attention(q, k, v, causal=causal,
+                                     softmax_scale=softmax_scale)
+    H, Hkv, T = q.shape[2], k.shape[2], q.shape[1]
+    if H % P_seq or Hkv % P_seq or T % P_seq:
+        raise ValueError(
+            f"sequence_parallel_attention needs heads ({H}/{Hkv}) and T ({T}) "
+            f"divisible by the seq axis size {P_seq}")
+
+    def _local(q, k, v):
+        # GQA: repeat kv heads post-scatter, so the all-to-all moved only
+        # Hkv/P heads per link instead of H/P
+        k, v = _gqa_repeat(q, k, v)
+        return dot_product_attention(q, k, v, causal=causal,
+                                     softmax_scale=softmax_scale)
+
+    dist_attn = DistributedAttention(_local)
+    fn = jax.shard_map(
+        dist_attn, mesh=mesh,
+        in_specs=(P(BATCH_AXES, SEQ_AXIS, None, None),) * 3,
+        out_specs=P(BATCH_AXES, SEQ_AXIS, None, None),
+        check_vma=False)
+    return fn(q, k, v)
+
+
 class DistributedAttention:
     """Parity: ``DistributedAttention`` (sequence/layer.py:60).
 
