@@ -406,10 +406,13 @@ def bench_comm(on_tpu: bool) -> dict:
         dev = jax.device_put(x)
         jax.block_until_ready(dev)
     h2d = trials * x.nbytes / (time.time() - t0) / 1e9
-    _ = np.asarray(dev)                                        # warmup
+    # d2h: jax.Array caches its host copy after the first fetch, so each
+    # trial must fetch a FRESH on-device array (dev + i, blocked before the
+    # timer) or the loop measures a pointer lookup
+    fresh = [jax.block_until_ready(dev + np.float32(i)) for i in range(trials)]
     t0 = time.time()
-    for _ in range(trials):
-        _ = np.asarray(dev)
+    for f in fresh:
+        _ = np.asarray(f)
     d2h = trials * x.nbytes / (time.time() - t0) / 1e9
     out["h2d_GBps"] = round(h2d, 3)
     out["d2h_GBps"] = round(d2h, 3)

@@ -57,16 +57,25 @@ class LlamaConfig:
     # all-to-alls on the 'seq' mesh axis (parallel/ulysses.py); no-op when
     # the mesh has no seq axis. Requires heads and T divisible by seq size.
     sequence_parallel: bool = False
-
-    def __post_init__(self):
-        if self.sequence_parallel and self.sliding_window is not None:
-            raise ValueError(
-                "sequence_parallel does not support sliding_window attention "
-                "yet (the Ulysses path always runs full causal attention); "
-                "unset one of the two")
+    # Ring-attention context parallelism (parallel/ring.py): KV rotates the
+    # ICI ring while T stays sharded over 'seq'. The long-sequence choice
+    # when head counts can't divide the seq axis. Mutually exclusive with
+    # sequence_parallel.
+    context_parallel: bool = False
     dtype: Any = jnp.float32
     remat: bool = False
     remat_policy: Optional[str] = None
+
+    def __post_init__(self):
+        if ((self.sequence_parallel or self.context_parallel)
+                and self.sliding_window is not None):
+            raise ValueError(
+                "sequence_parallel/context_parallel do not support "
+                "sliding_window attention yet (both run full causal "
+                "attention); unset one of the two")
+        if self.sequence_parallel and self.context_parallel:
+            raise ValueError("sequence_parallel and context_parallel are "
+                             "mutually exclusive")
 
     @property
     def head_dim(self) -> int:
@@ -244,6 +253,9 @@ class LlamaAttention(nn.Module):
             # mesh's seq axis is 1. (sliding_window rejected in __post_init__)
             from deepspeed_tpu.parallel.ulysses import sequence_parallel_attention
             out = sequence_parallel_attention(q, k, v, causal=True)
+        elif cfg.context_parallel:
+            from deepspeed_tpu.parallel.ulysses import context_parallel_attention
+            out = context_parallel_attention(q, k, v, causal=True)
         else:
             n_rep = cfg.num_attention_heads // cfg.num_key_value_heads
             k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)

@@ -39,6 +39,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     P_ = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     B, T, H, D = q.shape
+    Hkv = k.shape[2]
+    assert H % Hkv == 0, f"GQA heads {H} not divisible by kv heads {Hkv}"
+    rep = H // Hkv
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
 
     m_run = jnp.full((B, H, T, 1), _NEG_INF, jnp.float32)
@@ -53,7 +56,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     for step in range(P_):
         # kv block currently held was originally owned by rank (my_idx - step) % P
         kv_idx = (my_idx - step) % P_
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, cur_k).astype(jnp.float32) * scale
+        # GQA: KV travels the ring at Hkv heads (1/rep of the repeated
+        # bytes); the repeat happens per step, on the local block only
+        k_blk = jnp.repeat(cur_k, rep, 2) if rep > 1 else cur_k
+        v_blk = jnp.repeat(cur_v, rep, 2) if rep > 1 else cur_v
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
         if causal:
             q_glob = my_idx * T + q_local
             k_glob = kv_idx * T + k_local
@@ -65,7 +72,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(jnp.maximum(m_run, _NEG_INF / 2) - m_new)
         l_run = l_run * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.einsum("bhqk,bkhd->bhqd", p, cur_v.astype(jnp.float32))
+        acc = acc * alpha + jnp.einsum("bhqk,bkhd->bhqd", p,
+                                       v_blk.astype(jnp.float32))
         m_run = m_new
 
         if step != P_ - 1:

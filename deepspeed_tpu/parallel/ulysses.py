@@ -53,6 +53,17 @@ def single_all_to_all(x: jax.Array, scatter_idx: int, gather_idx: int,
                           concat_axis=gather_idx, tiled=True)
 
 
+def _gqa_repeat(q, k, v):
+    """Repeat KV heads up to the query head count (GQA). Kept here (rather
+    than importing models.llama.repeat_kv) so the parallel wrappers stay
+    model-agnostic; ONE copy for both the Ulysses and ring paths."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, 2)
+        v = jnp.repeat(v, rep, 2)
+    return k, v
+
+
 def sequence_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                                 causal: bool = True,
                                 softmax_scale: Optional[float] = None,
@@ -75,13 +86,6 @@ def sequence_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     P_seq = mesh.shape[SEQ_AXIS]
     from deepspeed_tpu.ops.attention import dot_product_attention
 
-    def _gqa_repeat(q, k, v):
-        if k.shape[2] != q.shape[2]:
-            rep = q.shape[2] // k.shape[2]
-            k = jnp.repeat(k, rep, 2)
-            v = jnp.repeat(v, rep, 2)
-        return k, v
-
     if P_seq <= 1:
         k, v = _gqa_repeat(q, k, v)
         return dot_product_attention(q, k, v, causal=causal,
@@ -102,6 +106,44 @@ def sequence_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     dist_attn = DistributedAttention(_local)
     fn = jax.shard_map(
         dist_attn, mesh=mesh,
+        in_specs=(P(BATCH_AXES, SEQ_AXIS, None, None),) * 3,
+        out_specs=P(BATCH_AXES, SEQ_AXIS, None, None),
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def context_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                               causal: bool = True,
+                               softmax_scale: Optional[float] = None,
+                               mesh=None) -> jax.Array:
+    """Ring-attention context parallelism for the model zoo: [B, T, H, D]
+    with T sharded over 'seq'; KV blocks rotate the ICI ring via ppermute
+    while each shard accumulates online-softmax partials for its local Q
+    (parallel/ring.py — the TPU-natural CP strategy; the reference snapshot
+    has no CP at all, SURVEY.md §2.3). Unlike Ulysses there is NO head-count
+    divisibility requirement — only T must divide by the axis size."""
+    topo = get_topology()
+    mesh = mesh or topo.mesh
+    P_seq = mesh.shape[SEQ_AXIS]
+    from deepspeed_tpu.ops.attention import dot_product_attention
+
+    if P_seq <= 1:
+        k, v = _gqa_repeat(q, k, v)
+        return dot_product_attention(q, k, v, causal=causal,
+                                     softmax_scale=softmax_scale)
+    if q.shape[1] % P_seq:
+        raise ValueError(f"context_parallel_attention needs T ({q.shape[1]}) "
+                         f"divisible by the seq axis size {P_seq}")
+    from deepspeed_tpu.parallel.ring import ring_attention
+
+    def _local(q, k, v):
+        # KV enters the ring at Hkv heads; ring_attention repeats per step
+        # on the local block only, so ICI carries 1/n_rep of the bytes
+        return ring_attention(q, k, v, causal=causal,
+                              softmax_scale=softmax_scale)
+
+    fn = jax.shard_map(
+        _local, mesh=mesh,
         in_specs=(P(BATCH_AXES, SEQ_AXIS, None, None),) * 3,
         out_specs=P(BATCH_AXES, SEQ_AXIS, None, None),
         check_vma=False)

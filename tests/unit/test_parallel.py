@@ -459,3 +459,38 @@ def test_sequence_parallel_attention_rejects_indivisible(eight_devices):
     q, k, v = qkv(T=64, H=6)   # 6 heads not divisible by seq=4
     with pytest.raises(ValueError, match="divisible"):
         sequence_parallel_attention(q, k, v)
+
+
+def test_context_parallel_llama_training_matches_serial(eight_devices):
+    """context_parallel=True (ring attention over 'seq'): full engine train
+    steps match the serial run — the CP capability the reference lacks
+    (SURVEY.md §2.3), trained end-to-end."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    rng = np.random.default_rng(11)
+    batches = [{"input_ids": rng.integers(0, 256, (8, 16)).astype(np.int32)}
+               for _ in range(3)]
+
+    def run(cp):
+        mesh = {"seq": 4, "data": 2} if cp else {"data": 8}
+        cfg = LlamaConfig.tiny(context_parallel=cp)
+        model = LlamaForCausalLM(cfg)
+        params = model.init(jax.random.PRNGKey(1), batches[0])["params"]
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_batch_size": 8, "steps_per_print": 0,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 1},
+                    "mesh": mesh})
+        return [float(engine.train_batch(b)) for b in batches]
+
+    serial = run(False)
+    cp = run(True)
+    np.testing.assert_allclose(cp, serial, rtol=2e-4, atol=2e-5)
+
+
+def test_seq_and_context_parallel_mutually_exclusive():
+    from deepspeed_tpu.models.llama import LlamaConfig
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        LlamaConfig.tiny(sequence_parallel=True, context_parallel=True)
