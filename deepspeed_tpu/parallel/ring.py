@@ -44,9 +44,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     rep = H // Hkv
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
 
-    m_run = jnp.full((B, H, T, 1), _NEG_INF, jnp.float32)
-    l_run = jnp.zeros((B, H, T, 1), jnp.float32)
-    acc = jnp.zeros((B, H, T, D), jnp.float32)
+    # GQA stays GROUPED end to end: KV travels the ring at Hkv heads and the
+    # einsums contract the (Hkv, rep) query grouping against the un-repeated
+    # block — no [B, T/P, H, D] repeated KV tensor ever materialises.
+    qg = q.reshape(B, T, Hkv, rep, D)
+    m_run = jnp.full((B, Hkv, rep, T, 1), _NEG_INF, jnp.float32)
+    l_run = jnp.zeros((B, Hkv, rep, T, 1), jnp.float32)
+    acc = jnp.zeros((B, Hkv, rep, T, D), jnp.float32)
 
     q_local = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)
     k_local = jax.lax.broadcasted_iota(jnp.int32, (T, T), 1)
@@ -56,15 +60,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     for step in range(P_):
         # kv block currently held was originally owned by rank (my_idx - step) % P
         kv_idx = (my_idx - step) % P_
-        # GQA: KV travels the ring at Hkv heads (1/rep of the repeated
-        # bytes); the repeat happens per step, on the local block only
-        k_blk = jnp.repeat(cur_k, rep, 2) if rep > 1 else cur_k
-        v_blk = jnp.repeat(cur_v, rep, 2) if rep > 1 else cur_v
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk).astype(jnp.float32) * scale
+        s = jnp.einsum("bqhrd,bkhd->bhrqk", qg,
+                       cur_k).astype(jnp.float32) * scale
         if causal:
             q_glob = my_idx * T + q_local
             k_glob = kv_idx * T + k_local
-            s = jnp.where((q_glob >= k_glob)[None, None], s, _NEG_INF)
+            s = jnp.where((q_glob >= k_glob)[None, None, None], s, _NEG_INF)
         m_b = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_run, m_b)
         # clamp so fully-masked steps (m_b == -inf) don't produce exp(-inf - -inf)
@@ -72,8 +73,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(jnp.maximum(m_run, _NEG_INF / 2) - m_new)
         l_run = l_run * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.einsum("bhqk,bkhd->bhqd", p,
-                                       v_blk.astype(jnp.float32))
+        acc = acc * alpha + jnp.einsum("bhrqk,bkhd->bhrqd", p,
+                                       cur_v.astype(jnp.float32))
         m_run = m_new
 
         if step != P_ - 1:
@@ -81,8 +82,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             cur_v = lax.ppermute(cur_v, axis_name, perm)
 
     safe_l = jnp.where(l_run > 0.0, l_run, 1.0)
-    out = (acc / safe_l).astype(q.dtype)                         # [B,H,T,D]
-    return jnp.transpose(out, (0, 2, 1, 3))                      # -> [B,T,H,D]
+    out = (acc / safe_l).astype(q.dtype)             # [B, Hkv, rep, T, D]
+    out = out.reshape(B, H, T, D)
+    return jnp.transpose(out, (0, 2, 1, 3))          # -> [B, T, H, D]
 
 
 # --------------------------------------------------------------------------- #

@@ -494,3 +494,53 @@ def test_seq_and_context_parallel_mutually_exclusive():
     from deepspeed_tpu.models.llama import LlamaConfig
     with pytest.raises(ValueError, match="mutually exclusive"):
         LlamaConfig.tiny(sequence_parallel=True, context_parallel=True)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_gqa_matches_serial(eight_devices, causal):
+    """Direct unit test of the grouped (rep > 1) ring path: KV at Hkv heads
+    around the ring must match the serially repeated reference exactly."""
+    topo = make_topo(seq=4)
+    q, _, _ = qkv(B=2, T=64, H=8, D=16, seed=3)
+    ks = jax.random.split(jax.random.PRNGKey(9), 2)
+    k = jax.random.normal(ks[0], (2, 64, 2, 16), jnp.float32)   # Hkv=2, rep=4
+    v = jax.random.normal(ks[1], (2, 64, 2, 16), jnp.float32)
+
+    f = shard_map(
+        lambda a, b, c: ring_attention(a, b, c, causal=causal),
+        mesh=topo.mesh,
+        in_specs=(P(None, "seq", None, None),) * 3,
+        out_specs=P(None, "seq", None, None), check_vma=False)
+    out = jax.jit(f)(q, k, v)
+    ref = reference_attention(q, jnp.repeat(k, 4, 2), jnp.repeat(v, 4, 2),
+                              causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_gqa_gradients(eight_devices):
+    topo = make_topo(seq=4)
+    q, _, _ = qkv(B=1, T=32, H=4, D=8, seed=5)
+    ks = jax.random.split(jax.random.PRNGKey(10), 2)
+    k = jax.random.normal(ks[0], (1, 32, 2, 8), jnp.float32)    # rep=2
+    v = jax.random.normal(ks[1], (1, 32, 2, 8), jnp.float32)
+
+    def ring_loss(q, k, v):
+        f = shard_map(
+            lambda a, b, c: ring_attention(a, b, c, causal=True),
+            mesh=topo.mesh,
+            in_specs=(P(None, "seq", None, None),) * 3,
+            out_specs=P(None, "seq", None, None), check_vma=False)
+        return jnp.sum(f(q, k, v) ** 2)
+
+    def ref_loss(q, k, v):
+        return jnp.sum(reference_attention(
+            q, jnp.repeat(k, 2, 2), jnp.repeat(v, 2, 2), causal=True) ** 2)
+
+    g1 = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    # ref_loss repeats INSIDE, so autodiff already reduces the kv groups —
+    # its k/v grads come back at Hkv heads, directly comparable
+    g2 = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b, n in zip(g1, g2, ("dq", "dk", "dv")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                                   atol=1e-4, err_msg=n)

@@ -86,14 +86,16 @@ def test_remat_ticks_bounds_memory_in_n_micro():
     residuals. Measured v5e AOT (width 512, L=8, B=64, S=128):
     plain {4: 1110, 16: 748} MB vs remat {4: 245, 16: 52} MB."""
     mesh = _tpu_pipe_mesh()
-    plain = {m: _compiled_temp_bytes(m, False, mesh) for m in (4, 16)}
+    # 3 AOT compiles (not 4): plain@16 anchors the full-residual cost; the
+    # remat pair pins both claims. (These compile via the remote AOT path,
+    # which the persistent cache can't deserialize — keep the count low.)
+    plain16 = _compiled_temp_bytes(16, False, mesh)
     remat = {m: _compiled_temp_bytes(m, True, mesh) for m in (4, 16)}
-    # substantially smaller residual set at every microbatch count...
-    for m in (4, 16):
-        assert remat[m] < plain[m] * 0.5, (plain, remat)
+    # substantially smaller residual set than the full-residual backward...
+    assert remat[16] < plain16 * 0.5, (plain16, remat)
     # ...and the remat bound SHRINKS as n_micro grows (per-tick inputs get
     # smaller), the opposite of storing the full residual set
-    assert remat[16] < remat[4], (plain, remat)
+    assert remat[16] < remat[4], (plain16, remat)
 
 
 def test_remat_ticks_same_loss_and_grads(eight_devices):
@@ -126,9 +128,9 @@ def test_tied_embedding_grads_sum_across_stages(eight_devices):
     of the reference's tied-weight allreduce between the owner stages."""
     set_topology(build_topology(MeshConfig(pipe=2, data=4)))
     rng = np.random.default_rng(2)
-    batch = {"input_ids": rng.integers(0, 128, (8, 16)).astype(np.int32)}
-    lm = PipelineLM(vocab_size=128, d_model=32, block=Block(width=32),
-                    n_layers=4, n_micro=2)
+    batch = {"input_ids": rng.integers(0, 64, (8, 16)).astype(np.int32)}
+    lm = PipelineLM(vocab_size=64, d_model=16, block=Block(width=16),
+                    n_layers=2, n_micro=2)
     params = lm.init(jax.random.PRNGKey(4), batch)["params"]
 
     def loss_split(wte_embed, wte_head, stack):
