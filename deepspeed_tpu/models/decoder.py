@@ -78,10 +78,25 @@ class DecoderConfig:
     mlp_bias: bool = True
     tied_lm_head: bool = False
     head_bias: bool = False          # phi/gpt-j: bias on the LM head projection
+    # Ulysses sequence parallelism (parallel/ulysses.py): attention through
+    # two all-to-alls on the 'seq' mesh axis. Incompatible with ALiBi and
+    # local-window layers (both need a bias the SP path doesn't carry).
+    sequence_parallel: bool = False
     eps: float = 1e-5
     dtype: Any = jnp.float32
     remat: bool = False
     remat_policy: Optional[str] = None
+
+    def __post_init__(self):
+        if not self.sequence_parallel:
+            return
+        has_local = any(kind == "local" for kind in self.attention_layers or ())
+        if self.alibi or self.local_window is not None or has_local:
+            raise ValueError(
+                "sequence_parallel is incompatible with alibi, local_window, "
+                "and 'local' entries in attention_layers (the Ulysses path "
+                "carries no attention bias); disable sequence_parallel or "
+                "remove those settings")
 
     @property
     def head_dim(self) -> int:
@@ -344,13 +359,20 @@ class DecoderBlock(nn.Module):
         B, T, _ = x.shape
         h1 = self.ln1(x)
         q, k, v = self._qkv(h1, positions)
-        rep = cfg.num_attention_heads // cfg.kv_heads
-        if self.window is not None:
-            # local layer: banded causal bias (window includes causality)
-            attn_bias = _window_bias(positions, positions, self.window)
-        out = dot_product_attention(q, repeat_kv(k, rep), repeat_kv(v, rep),
-                                    causal=True, bias=attn_bias,
-                                    softmax_scale=cfg.attn_scale)
+        if cfg.sequence_parallel:
+            # Ulysses over the 'seq' mesh axis (parallel/ulysses.py); bias
+            # variants (ALiBi/local windows) are rejected at config time
+            from deepspeed_tpu.parallel.ulysses import sequence_parallel_attention
+            out = sequence_parallel_attention(q, k, v, causal=True,
+                                              softmax_scale=cfg.attn_scale)
+        else:
+            rep = cfg.num_attention_heads // cfg.kv_heads
+            if self.window is not None:
+                # local layer: banded causal bias (window includes causality)
+                attn_bias = _window_bias(positions, positions, self.window)
+            out = dot_product_attention(q, repeat_kv(k, rep), repeat_kv(v, rep),
+                                        causal=True, bias=attn_bias,
+                                        softmax_scale=cfg.attn_scale)
         out = checkpoint_name(out, "attn_out")
         return self._combine(x, h1, self._proj_out(out, B, T))
 

@@ -137,8 +137,9 @@ def context_parallel_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     from deepspeed_tpu.parallel.ring import ring_attention
 
     def _local(q, k, v):
-        # KV enters the ring at Hkv heads; ring_attention repeats per step
-        # on the local block only, so ICI carries 1/n_rep of the bytes
+        # KV enters (and rotates) the ring at Hkv heads; ring_attention
+        # contracts the (Hkv, rep) query grouping against the un-repeated
+        # block, so neither ICI nor per-step memory ever sees repeated KV
         return ring_attention(q, k, v, causal=causal,
                               softmax_scale=softmax_scale)
 

@@ -544,3 +544,41 @@ def test_ring_attention_gqa_gradients(eight_devices):
     for a, b, n in zip(g1, g2, ("dq", "dk", "dv")):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
                                    atol=1e-4, err_msg=n)
+
+
+@pytest.mark.parametrize("family", ["phi", "gpt_neox"])
+def test_sequence_parallel_decoder_matches_serial(eight_devices, family):
+    """DecoderConfig(sequence_parallel=True) for rotary families: engine
+    train steps match the serial run (SP beyond the llama lineage)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.decoder import DecoderConfig, DecoderLM
+
+    rng = np.random.default_rng(13)
+    batches = [{"input_ids": rng.integers(0, 256, (8, 16)).astype(np.int32)}
+               for _ in range(2)]
+
+    def run(sp):
+        mesh = {"seq": 2, "data": 4} if sp else {"data": 8}
+        cfg = DecoderConfig.tiny(family, sequence_parallel=sp)
+        model = DecoderLM(cfg)
+        params = model.init(jax.random.PRNGKey(2), batches[0])["params"]
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_batch_size": 8, "steps_per_print": 0,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 1},
+                    "mesh": mesh})
+        return [float(engine.train_batch(b)) for b in batches]
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-4, atol=2e-5)
+
+
+def test_sequence_parallel_rejects_alibi_and_local_windows():
+    from deepspeed_tpu.models.decoder import DecoderConfig
+    with pytest.raises(ValueError, match="alibi"):
+        DecoderConfig.tiny("bloom", sequence_parallel=True)
+    with pytest.raises(ValueError, match="local"):
+        DecoderConfig.tiny("gpt_neo", sequence_parallel=True)
+    # an all-'global' attention_layers tuple is SP-compatible
+    DecoderConfig.tiny("phi", sequence_parallel=True,
+                       attention_layers=("global", "global"))
