@@ -42,6 +42,10 @@ class GPT2Config:
     # around each block, letting XLA re-materialise instead of storing activations)
     remat: bool = False
     remat_policy: Optional[str] = None
+    # Ulysses sequence parallelism (parallel/ulysses.py): attention through
+    # two all-to-alls on the 'seq' mesh axis; no-op when the mesh has no seq
+    # axis. Requires n_head and T divisible by the seq axis size.
+    sequence_parallel: bool = False
 
     @classmethod
     def small(cls, **kw):
@@ -65,7 +69,12 @@ class CausalSelfAttention(nn.Module):
         qkv = nn.Dense(3 * cfg.n_embd, dtype=cfg.dtype, name="c_attn")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         heads = lambda t: t.reshape(B, T, cfg.n_head, C // cfg.n_head)
-        out = dot_product_attention(heads(q), heads(k), heads(v), causal=True)
+        if cfg.sequence_parallel:
+            from deepspeed_tpu.parallel.ulysses import sequence_parallel_attention
+            out = sequence_parallel_attention(heads(q), heads(k), heads(v),
+                                              causal=True)
+        else:
+            out = dot_product_attention(heads(q), heads(k), heads(v), causal=True)
         # tag for the selective remat policies ("attn_out_saveable"): saving
         # this [B, T, C] tensor lets backward skip recomputing the attention
         # kernel while everything else still rematerialises
