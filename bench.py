@@ -64,16 +64,22 @@ def bench_train(on_tpu: bool) -> dict:
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
 
     if on_tpu:
+        # NO remat + GAS: the engine scans microbatches inside the fused step
+        # (runtime/engine.py _accumulate_grads), so activation memory is one
+        # microbatch's worth while the optimizer amortises over the global
+        # batch — which lets the backward skip the remat recompute entirely.
+        # Measured v5e-1 sweep: remat bs=64 33.3k tok/s; no-remat standalone
+        # bs=8 39.8k (bs>=12 OOM); no-remat GAS mb∈{2,4,8} -> 45.8/46.7/46.1k
+        # tok/s. mb=4 is the sweet spot: 4 compute units per token drop to 3
+        # (fwd=1, bwd=2, no recompute), i.e. MFU 0.36 -> 0.50.
         cfg = GPT2Config(vocab_size=50257, n_positions=1024, n_embd=1024,
-                         n_layer=24, n_head=16, dtype=jnp.bfloat16, remat=True)
-        # v5e-1 sweet spot from the bs sweep with Pallas flash attention at
-        # T=1024 (32/48/64/96 -> 24.8k/25.8k/26.7k/OOM tok/s; dense-XLA
-        # attention topped out at 20.1k @ bs=32). Flash's O(T) memory plus the
-        # fused chunked CE (no [B,T,V] logits) is what admits bs=64.
-        bs, seq, steps, warmup = 64, 1024, 10, 3
+                         n_layer=24, n_head=16, dtype=jnp.bfloat16, remat=False)
+        bs, mb, seq, steps, warmup = 64, 4, 1024, 10, 3
     else:  # CI / no-TPU fallback keeps the script honest but fast
         cfg = GPT2Config.tiny(dtype=jnp.bfloat16)
-        bs, seq, steps, warmup = 8, 64, 3, 1
+        # mb stays unset: a multi-device CPU env (forced host device count)
+        # derives mb = bs/dp itself; pinning it would break divisibility
+        bs, mb, seq, steps, warmup = 8, None, 64, 3, 1
 
     model = GPT2LMHead(cfg)
 
@@ -89,15 +95,17 @@ def bench_train(on_tpu: bool) -> dict:
     log(f"train: params built ({n_params/1e6:.0f}M) in {time.time()-t:.1f}s")
 
     t = time.time()
+    train_cfg = {
+        "train_batch_size": bs,
+        "steps_per_print": 0,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+    }
+    if mb is not None:
+        train_cfg["train_micro_batch_size_per_gpu"] = mb
     engine, *_ = deepspeed_tpu.initialize(
-        model=model, model_parameters=params,
-        config={
-            "train_batch_size": bs,
-            "steps_per_print": 0,
-            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
-            "bf16": {"enabled": True},
-            "zero_optimization": {"stage": 0},
-        })
+        model=model, model_parameters=params, config=train_cfg)
     t_engine = time.time() - t
 
     # First step = compile; time it separately so a slow-compile environment
