@@ -55,6 +55,21 @@ def peak_for(device) -> float:
     return 1e12
 
 
+def _train_engine_cfg(bs, mb, bf16: bool = True) -> dict:
+    """Shared engine config for the training phases — ONE place so the
+    train and MoE benchmarks can never drift apart on engine settings."""
+    cfg = {
+        "train_batch_size": bs,
+        "steps_per_print": 0,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": bf16},
+        "zero_optimization": {"stage": 0},
+    }
+    if mb is not None:
+        cfg["train_micro_batch_size_per_gpu"] = mb
+    return cfg
+
+
 # --------------------------------------------------------------------------- #
 # headline: GPT-2-350M training
 # --------------------------------------------------------------------------- #
@@ -95,17 +110,8 @@ def bench_train(on_tpu: bool) -> dict:
     log(f"train: params built ({n_params/1e6:.0f}M) in {time.time()-t:.1f}s")
 
     t = time.time()
-    train_cfg = {
-        "train_batch_size": bs,
-        "steps_per_print": 0,
-        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
-        "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 0},
-    }
-    if mb is not None:
-        train_cfg["train_micro_batch_size_per_gpu"] = mb
     engine, *_ = deepspeed_tpu.initialize(
-        model=model, model_parameters=params, config=train_cfg)
+        model=model, model_parameters=params, config=_train_engine_cfg(bs, mb))
     t_engine = time.time() - t
 
     # First step = compile; time it separately so a slow-compile environment
@@ -232,16 +238,19 @@ def bench_moe(on_tpu: bool) -> dict:
     from deepspeed_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
 
     if on_tpu:
+        # same recipe as the train headline: no remat + in-step GAS scan.
+        # Sweep (v5e-1, bs=32 global): mb {4, 8, 16} -> 48.7/52.4/55.0k
+        # tok/s; flat bs=32 no-remat OOMs, remat bs=16 flat was 43.9k.
         cfg = MixtralConfig(vocab_size=32000, hidden_size=1024,
                             intermediate_size=2048, num_hidden_layers=8,
                             num_attention_heads=16, num_key_value_heads=8,
                             num_local_experts=8, num_experts_per_tok=2,
-                            max_position_embeddings=1024, remat=True,
+                            max_position_embeddings=1024, remat=False,
                             dtype=jnp.bfloat16, dispatch_mode="dropless")
-        bs, seq, steps, warmup = 16, 512, 8, 2
+        bs, mb, seq, steps, warmup = 32, 16, 512, 8, 2
     else:
         cfg = MixtralConfig.tiny(dispatch_mode="dropless")
-        bs, seq, steps, warmup = 4, 16, 2, 1
+        bs, mb, seq, steps, warmup = 4, None, 16, 2, 1
 
     model = MixtralForCausalLM(cfg)
 
@@ -255,13 +264,7 @@ def bench_moe(on_tpu: bool) -> dict:
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     engine, *_ = deepspeed_tpu.initialize(
         model=model, model_parameters=params,
-        config={
-            "train_batch_size": bs,
-            "steps_per_print": 0,
-            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
-            "bf16": {"enabled": bool(on_tpu)},
-            "zero_optimization": {"stage": 0},
-        })
+        config=_train_engine_cfg(bs, mb, bf16=bool(on_tpu)))
     t = time.time()
     for i in range(warmup):
         float(engine.train_batch(make_batch(i)))
