@@ -84,6 +84,14 @@ def adapt_llama(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
     if mlp_act not in ("silu", "gelu"):
         raise ValueError(f"llama-lineage mlp_act '{mlp_act}' has no ragged "
                          "gated-MLP mapping (expected 'silu' or 'gelu')")
+    if getattr(config, "sliding_window", None) is not None:
+        # mistral/qwen2 window attention: the paged kernels attend the full
+        # context — silently dropping the window would diverge from v1
+        raise ValueError(
+            "sliding_window attention is not supported by the ragged (paged) "
+            "path — serve through deepspeed_tpu.init_inference (v1 dense "
+            "engine), or unset sliding_window if the model tolerates full "
+            "attention at your context lengths")
     spec = RaggedModelSpec(
         family="mixtral" if moe else "llama",
         num_layers=config.num_hidden_layers,
@@ -183,9 +191,28 @@ def adapt_gpt2(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
 
 
 def adapt_decoder(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
-    """models/decoder.py (DecoderLM — opt/falcon/phi/gpt_neox): canonical names,
-    so adaptation is re-rooting + stacking. Parity anchors: reference
-    ``inference/v2/model_implementations/{opt,falcon,phi}``."""
+    """models/decoder.py (DecoderLM — opt/falcon/phi/gpt_neox/gptj/
+    gpt_bigcode): canonical names, so adaptation is re-rooting + stacking.
+    Parity anchors: reference ``inference/v2/model_implementations/
+    {opt,falcon,phi}``. Guards on the FEATURES the ragged path can't carry
+    (not family names), so a config with e.g. alibi under any family is
+    rejected instead of silently served wrong."""
+    unsupported = []
+    if getattr(config, "alibi", False):
+        unsupported.append("alibi")
+    if getattr(config, "local_window", None) is not None:
+        unsupported.append("local_window")
+    if any(k == "local" for k in getattr(config, "attention_layers", None) or ()):
+        unsupported.append("attention_layers with 'local' entries")
+    if getattr(config, "attn_scale", None) is not None:
+        unsupported.append("attn_scale")
+    if getattr(config, "embed_norm", False):
+        unsupported.append("embed_norm")
+    if unsupported:
+        raise ValueError(
+            f"config features {unsupported} are not supported by the ragged "
+            "(paged) attention path — serve through deepspeed_tpu."
+            "init_inference (v1 dense engine) instead")
     spec = RaggedModelSpec(
         family=config.family,
         num_layers=config.num_hidden_layers,
@@ -218,18 +245,37 @@ def adapt_decoder(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
 
 
 ADAPTERS: Dict[str, Callable] = {
+    # llama lineage (qwen2 = biased qkv; gemma = structural flags — both are
+    # LlamaConfig features the adapter reads)
     "llama": adapt_llama,
     "mistral": adapt_llama,
     "mixtral": adapt_llama,
+    "qwen2": adapt_llama,
+    "gemma": adapt_llama,
     "gpt2": adapt_gpt2,
+    # generic-decoder lineage (canonical param names; re-root + stack)
     "opt": adapt_decoder,
     "falcon": adapt_decoder,
     "phi": adapt_decoder,
     "gpt_neox": adapt_decoder,
+    "gptj": adapt_decoder,
+    "gpt_bigcode": adapt_decoder,
+}
+
+#: families whose attention needs a bias the ragged kernels don't carry —
+#: serve these through the v1 dense engine instead
+_UNSUPPORTED = {
+    "bloom": "ALiBi position bias",
+    "gpt_neo": "local-window attention layers",
 }
 
 
 def adapt_model(family: str, params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
+    if family in _UNSUPPORTED:
+        raise ValueError(
+            f"family '{family}' uses {_UNSUPPORTED[family]}, which the ragged "
+            "(paged) attention path does not support — serve it through "
+            "deepspeed_tpu.init_inference (v1 dense engine) instead")
     if family not in ADAPTERS:
         raise ValueError(f"no ragged adapter for family '{family}' "
                          f"(have {sorted(ADAPTERS)})")

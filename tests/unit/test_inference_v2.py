@@ -221,6 +221,69 @@ class TestEngineV2:
         with pytest.raises(ValueError, match="unknown MLP activation"):
             _plain_act("swish_42")
 
+    @pytest.mark.parametrize("family,kw", [
+        ("gptj", {}),                              # partial rotary + head bias
+        ("gpt_bigcode", {"num_key_value_heads": 1,  # StarCoder: MQA + learned pos
+                         "learned_pos": True, "activation": "gelu",
+                         "rope_theta": None, "tied_lm_head": True,
+                         "qkv_bias": True, "out_bias": True}),
+    ])
+    def test_decoder_families_match_v1(self, family, kw):
+        from deepspeed_tpu.models.decoder import DecoderConfig, DecoderLM
+        if family == "gpt_bigcode":
+            cfg = DecoderConfig(family="gpt_bigcode", vocab_size=256,
+                                hidden_size=64, intermediate_size=128,
+                                num_hidden_layers=2, num_attention_heads=4,
+                                max_position_embeddings=128,
+                                dtype=jnp.float32, **kw)
+        else:
+            cfg = DecoderConfig.tiny(family, dtype=jnp.float32, **kw)
+        model = DecoderLM(cfg)
+        params = model.init(jax.random.PRNGKey(7),
+                            {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+        if cfg.head_bias:  # zero-init bias would hide a dropped-bias bug
+            params = dict(params)
+            params["lm_head_bias"] = 3.0 * jax.random.normal(
+                jax.random.PRNGKey(9), (cfg.vocab_size,), jnp.float32)
+        ref = self._v1_greedy(model, params, PROMPTS[:2], 4)
+        eng = InferenceEngineV2(model=model,
+                                config=RaggedInferenceEngineConfig.load(dict(V2_CONFIG)),
+                                model_parameters=params)
+        out = eng.generate(PROMPTS[:2], max_new_tokens=4)
+        assert out == ref
+
+    def test_sliding_window_rejected_in_ragged_path(self):
+        from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig.tiny(dtype=jnp.float32, sliding_window=8)
+        model = LlamaForCausalLM(cfg)
+        params = model.init(jax.random.PRNGKey(10),
+                            {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+        with pytest.raises(ValueError, match="sliding_window"):
+            InferenceEngineV2(model=model,
+                              config=RaggedInferenceEngineConfig.load(dict(V2_CONFIG)),
+                              model_parameters=params)
+
+    def test_feature_guard_catches_alibi_under_any_family(self):
+        from deepspeed_tpu.inference.v2.ragged_model import adapt_decoder
+        from deepspeed_tpu.models.decoder import DecoderConfig, DecoderLM
+        cfg = DecoderConfig.tiny("opt", alibi=True, dtype=jnp.float32)
+        model = DecoderLM(cfg)
+        params = model.init(jax.random.PRNGKey(11),
+                            {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+        with pytest.raises(ValueError, match="alibi"):
+            adapt_decoder(params, cfg)
+
+    def test_alibi_family_rejected_with_guidance(self):
+        from deepspeed_tpu.models.decoder import DecoderConfig, DecoderLM
+        cfg = DecoderConfig.tiny("bloom", dtype=jnp.float32)
+        model = DecoderLM(cfg)
+        params = model.init(jax.random.PRNGKey(8),
+                            {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+        with pytest.raises(ValueError, match="v1 dense engine"):
+            InferenceEngineV2(model=model,
+                              config=RaggedInferenceEngineConfig.load(dict(V2_CONFIG)),
+                              model_parameters=params)
+
     def test_gpt2_family(self):
         from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
         cfg = GPT2Config.tiny(dtype=jnp.float32)
