@@ -245,8 +245,10 @@ def bench_decode(on_tpu: bool) -> dict:
         # GQA variant (4 kv heads, 64 seqs): decode is KV-read bound, so
         # grouped KV is the representative modern-serving number — MHA stops
         # scaling past ~32 seqs (KV reads dominate the 1.1 GB weight reads)
-        # while GQA keeps scaling: measured 2.35k MHA@32 vs 3.9k/5.7k
-        # GQA@32/64 on v5e-1. A GQA failure must not discard the MHA result.
+        # while GQA keeps scaling: measured 2.4k MHA@32 vs 3.9k/6.8k GQA@32/64
+        # on v5e-1 (the 64-seq figure needs the MHA engine's weights freed
+        # first — see the gc below). A GQA failure must not discard the MHA
+        # result.
         import gc
         gc.collect()
         try:
