@@ -495,14 +495,10 @@ def bench_comm(on_tpu: bool) -> dict:
 def main():
     # Persistent XLA compile cache: the 350M train step costs ~3 min to
     # compile through the remote tunnel, <1 s to reload (measured 37.7 s ->
-    # 0.84 s on a probe). Lives inside the repo so driver runs share it.
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                       ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-    except Exception:
-        pass  # cache is an optimisation, never a requirement
+    # 0.84 s on a probe). Lives inside the repo so driver runs share it; CPU
+    # entries are host-feature-keyed (utils/compile_cache.py SIGILL note).
+    from deepspeed_tpu.utils.compile_cache import setup_compile_cache
+    setup_compile_cache(os.path.dirname(os.path.abspath(__file__)))
 
     on_tpu = jax.default_backend() not in ("cpu",)
     dev = getattr(jax.devices()[0], "device_kind", "?")

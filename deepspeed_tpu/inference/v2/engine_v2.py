@@ -83,7 +83,8 @@ class InferenceEngineV2:
             raise ValueError("InferenceEngineV2 needs model_parameters")
         from deepspeed_tpu.utils.tree import tree_cast
         params = tree_cast(model_parameters, cfg.dtype)
-        self.spec, weights = adapt_model(family, params, model_config)
+        self.spec, weights = adapt_model(family, params, model_config,
+                                         max_context=cfg.state_manager.max_context)
         self.spec.dtype = cfg.dtype
         self.weights = self._shard_weights(weights)
 

@@ -69,7 +69,8 @@ def _stack(trees: List[Any]) -> Any:
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
 
 
-def adapt_llama(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
+def adapt_llama(params: Dict, config,
+                max_context: Optional[int] = None) -> Tuple[RaggedModelSpec, Dict]:
     """models/llama.py param tree (LlamaForCausalLM / MixtralForCausalLM).
 
     Parity anchors: reference ``inference/v2/model_implementations/llama_v2`` /
@@ -84,14 +85,20 @@ def adapt_llama(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
     if mlp_act not in ("silu", "gelu"):
         raise ValueError(f"llama-lineage mlp_act '{mlp_act}' has no ragged "
                          "gated-MLP mapping (expected 'silu' or 'gelu')")
-    if getattr(config, "sliding_window", None) is not None:
+    window = getattr(config, "sliding_window", None)
+    if window is not None and (max_context is None or max_context > window):
         # mistral/qwen2 window attention: the paged kernels attend the full
-        # context — silently dropping the window would diverge from v1
+        # context. When the engine's max_context <= window no position can
+        # ever see past the window, so full attention is exactly equivalent
+        # and serving proceeds; beyond that, silently dropping the window
+        # would diverge from v1.
         raise ValueError(
-            "sliding_window attention is not supported by the ragged (paged) "
-            "path — serve through deepspeed_tpu.init_inference (v1 dense "
-            "engine), or unset sliding_window if the model tolerates full "
-            "attention at your context lengths")
+            f"sliding_window={window} attention is not supported by the "
+            "ragged (paged) path when contexts can exceed the window "
+            f"(engine max_context={max_context}) — cap state_manager."
+            f"max_context at {window} (exact equivalence), serve through "
+            "deepspeed_tpu.init_inference (v1 dense engine), or unset "
+            "sliding_window if the model tolerates full attention")
     spec = RaggedModelSpec(
         family="mixtral" if moe else "llama",
         num_layers=config.num_hidden_layers,
@@ -146,7 +153,8 @@ def adapt_llama(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
     return spec, weights
 
 
-def adapt_gpt2(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
+def adapt_gpt2(params: Dict, config,
+               max_context: Optional[int] = None) -> Tuple[RaggedModelSpec, Dict]:
     """models/gpt2.py param tree (GPT2LMHead): fused c_attn qkv, tied head."""
     spec = RaggedModelSpec(
         family="gpt2",
@@ -190,7 +198,8 @@ def adapt_gpt2(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
     return spec, weights
 
 
-def adapt_decoder(params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
+def adapt_decoder(params: Dict, config,
+                  max_context: Optional[int] = None) -> Tuple[RaggedModelSpec, Dict]:
     """models/decoder.py (DecoderLM — opt/falcon/phi/gpt_neox/gptj/
     gpt_bigcode): canonical names, so adaptation is re-rooting + stacking.
     Parity anchors: reference ``inference/v2/model_implementations/
@@ -270,7 +279,8 @@ _UNSUPPORTED = {
 }
 
 
-def adapt_model(family: str, params: Dict, config) -> Tuple[RaggedModelSpec, Dict]:
+def adapt_model(family: str, params: Dict, config,
+                max_context: Optional[int] = None) -> Tuple[RaggedModelSpec, Dict]:
     if family in _UNSUPPORTED:
         raise ValueError(
             f"family '{family}' uses {_UNSUPPORTED[family]}, which the ragged "
@@ -279,7 +289,7 @@ def adapt_model(family: str, params: Dict, config) -> Tuple[RaggedModelSpec, Dic
     if family not in ADAPTERS:
         raise ValueError(f"no ragged adapter for family '{family}' "
                          f"(have {sorted(ADAPTERS)})")
-    return ADAPTERS[family](params, config)
+    return ADAPTERS[family](params, config, max_context=max_context)
 
 
 # --------------------------------------------------------------------------- #

@@ -344,7 +344,15 @@ class SlurmRunner(MultiNodeRunner):
             "WORLD_SIZE": str(world),
         }
         for k, v in world_env.items():
-            exports += f",{k}={v}"
+            v = str(v)
+            if "," in v:
+                # srun parses --export by splitting on commas, so a value like
+                # XLA_FLAGS="--a=1,--b=2" would be mangled into bogus names.
+                # --export=ALL already propagates the caller's environment, so
+                # route comma-valued vars through it instead of the flag.
+                environment[k] = v
+            else:
+                exports += f",{k}={v}"
         return (cmd + [exports, sys.executable, "-u", self.args.user_script]
                 + list(self.args.user_args))
 

@@ -108,6 +108,7 @@ def gpipe_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
         out_buf = jnp.zeros_like(micros)
         recv = jnp.zeros((mb,) + x_full.shape[1:], x_full.dtype)
         fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+        total_ticks = n_micro + n_stages - 1
 
         # params are an EXPLICIT argument so jax.checkpoint can prune the tick
         # body's residuals (closure captures don't get residual-pruned)
@@ -130,8 +131,17 @@ def gpipe_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
             cur = lax.dynamic_slice_in_dim(out_buf, safe_idx, 1, 0)
             out_buf = lax.dynamic_update_slice_in_dim(
                 out_buf, jnp.where(store, out[None], cur), safe_idx, 0)
-            recv = (lax.ppermute(out, axis_name, fwd_perm)
-                    if n_stages > 1 else out)
+            # the final tick's send is never read (the carry's recv dies with
+            # the scan) — skip the inter-stage transfer on t == total_ticks-1
+            # instead of paying one dead ppermute per step. The predicate is
+            # the replicated tick index, so every stage takes the same branch.
+            if n_stages > 1:
+                recv = lax.cond(t == total_ticks - 1,
+                                lambda o: o,
+                                lambda o: lax.ppermute(o, axis_name, fwd_perm),
+                                out)
+            else:
+                recv = out
             return (recv, out_buf)
 
         if remat_ticks:
@@ -142,7 +152,6 @@ def gpipe_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
         # at a time, which is what actually bounds peak memory. An unrolled
         # loop lets XLA overlap the recomputes and the bound is lost
         # (measured on the v5e AOT topology; see test_pipeline_memory.py).
-        total_ticks = n_micro + n_stages - 1
         (recv, out_buf), _ = lax.scan(
             lambda c, t: (tick(c, t, local_params), None),
             (recv, out_buf), jnp.arange(total_ticks))

@@ -8,6 +8,7 @@ identically (inference v2 multistep programs, block-sparse layouts, ...).
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Callable, Generic, Hashable, TypeVar
 
@@ -19,15 +20,33 @@ class LRUCache(Generic[V]):
         assert maxsize > 0
         self.maxsize = maxsize
         self._d: "OrderedDict[Hashable, V]" = OrderedDict()
+        # Serving engines may be driven from multiple threads. The cache-wide
+        # lock only guards the dict; factories (usually multi-second XLA
+        # compiles) run under a per-key lock so two threads racing the SAME
+        # cold key share one compile while hits and other keys never block
+        # behind an in-flight factory.
+        self._lock = threading.Lock()
+        self._key_locks: dict = {}
 
     def get_or_create(self, key: Hashable, factory: Callable[[], V]) -> V:
-        hit = self._d.get(key)
-        if hit is None:
-            hit = self._d[key] = factory()
-        self._d.move_to_end(key)
-        while len(self._d) > self.maxsize:
-            self._d.popitem(last=False)
-        return hit
+        with self._lock:
+            hit = self._d.get(key)
+            if hit is not None:
+                self._d.move_to_end(key)
+                return hit
+            klock = self._key_locks.setdefault(key, threading.Lock())
+        with klock:
+            with self._lock:  # a racer may have built it while we waited
+                hit = self._d.get(key)
+            if hit is None:
+                hit = factory()
+            with self._lock:
+                self._d[key] = hit
+                self._d.move_to_end(key)
+                while len(self._d) > self.maxsize:
+                    self._d.popitem(last=False)
+                self._key_locks.pop(key, None)
+            return hit
 
     def __len__(self) -> int:
         return len(self._d)

@@ -28,11 +28,12 @@ jax.config.update("jax_threefry_partitionable", True)
 
 # Persistent XLA compile cache: the suite is compile-dominated (engine fused
 # steps, ragged decode programs, ...). Warm reruns cut wall-clock several-fold
-# (measured 37.7s -> 0.84s per program reload).
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(os.path.dirname(
-                      os.path.abspath(__file__))), ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# (measured 37.7s -> 0.84s per program reload). CPU executables are keyed by
+# host CPU features (SIGILL hazard when hosts differ — utils/compile_cache.py).
+from deepspeed_tpu.utils.compile_cache import setup_compile_cache  # noqa: E402
+
+setup_compile_cache(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    min_compile_time_secs=1.0)
 
 
 @pytest.fixture(autouse=True)

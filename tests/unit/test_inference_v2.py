@@ -263,6 +263,22 @@ class TestEngineV2:
                               config=RaggedInferenceEngineConfig.load(dict(V2_CONFIG)),
                               model_parameters=params)
 
+    def test_sliding_window_served_when_context_within_window(self):
+        # engine max_context (64) <= window: no position can see past the
+        # window, so full attention is exactly the windowed semantics — the
+        # ragged path serves and matches the v1 dense engine greedily.
+        from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig.tiny(dtype=jnp.float32, sliding_window=64)
+        model = LlamaForCausalLM(cfg)
+        params = model.init(jax.random.PRNGKey(10),
+                            {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+        ref = self._v1_greedy(model, params, PROMPTS[:2], 4)
+        eng = InferenceEngineV2(model=model,
+                                config=RaggedInferenceEngineConfig.load(dict(V2_CONFIG)),
+                                model_parameters=params)
+        out = eng.generate(PROMPTS[:2], max_new_tokens=4)
+        assert out == ref
+
     def test_feature_guard_catches_alibi_under_any_family(self):
         from deepspeed_tpu.inference.v2.ragged_model import adapt_decoder
         from deepspeed_tpu.models.decoder import DecoderConfig, DecoderLM

@@ -157,6 +157,23 @@ def test_slurm_runner_cmd():
     assert cmd[i + 3:] == ["--lr", "0.1"]
 
 
+def test_slurm_runner_routes_comma_values_through_environment():
+    # srun splits --export on commas, so a comma-carrying value (XLA_FLAGS
+    # with several sub-flags) must ride the inherited environment (via
+    # --export=ALL) instead of being encoded into the flag.
+    from deepspeed_tpu.launcher.runner import SlurmRunner, encode_world_info
+    active = {"w0": [0], "w1": [0]}
+    r = SlurmRunner(_runner_args("slurm"), encode_world_info(active), active)
+    r.add_export("XLA_FLAGS", "--a=1,--b=2")
+    r.add_export("DSTPU_LOG_LEVEL", "info")
+    env = {}
+    cmd = r.get_cmd(env, active)
+    exports = [c for c in cmd if c.startswith("--export=ALL")][0]
+    assert "--a=1,--b=2" not in exports          # would be mangled by srun
+    assert env["XLA_FLAGS"] == "--a=1,--b=2"     # Popen env carries it intact
+    assert "DSTPU_LOG_LEVEL=info" in exports     # comma-free path unchanged
+
+
 def test_mvapich_runner_cmd():
     from deepspeed_tpu.launcher.runner import MVAPICHRunner, encode_world_info
     active = {"w0": [0], "w1": [0]}
