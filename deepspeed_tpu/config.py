@@ -586,6 +586,12 @@ class DeepSpeedTPUConfig(ConfigModel):
     wall_clock_breakdown: bool = False
     memory_breakdown: bool = False
     seed: int = 42
+    # The engine may alias (donate) the caller's model_parameters buffers into
+    # its fp32 master state instead of copying — saves 4 bytes/param of HBM at
+    # init for billion-parameter models, but the caller's tree is dead after
+    # initialize(). Analog of the reference's ZeRO-3 taking ownership of module
+    # params at zero.Init / engine wrap (partition_parameters.py).
+    donate_model_parameters: bool = False
 
     optimizer: Optional[OptimizerConfig] = None
     scheduler: Optional[SchedulerConfig] = None

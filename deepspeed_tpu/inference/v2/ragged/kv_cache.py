@@ -2,12 +2,18 @@
 
 Parity: ``KVCacheManager`` / blocked KV configs (reference
 ``inference/v2/ragged/kv_cache.py`` + ``inference/v2/ragged/manager_configs.py``).
-Pages are device arrays ``[L, num_blocks, block_size, H_kv, D]`` — layout chosen so
+Pages are device arrays ``[L, num_blocks, H_kv, block_size, D]`` — HEAD-MAJOR
+pages, chosen so
 
-  - the per-token cache write is one flat scatter (`block * block_size + slot`)
-    over the fused (num_blocks, block_size) dim, and
-  - the paged decode kernel (``ops/pallas/paged_attention.py``) pulls one page per
-    grid step via scalar-prefetched block tables.
+  - every pool view in the serving program has (block_size, head_dim) trailing
+    dims: no padded sublane tiles for any kv-head count, so the flat-rows <->
+    paged reshapes in the layer scan are bitcasts (a head-minor layout makes
+    XLA materialise pool-sized copies at e.g. H_kv=12 — see
+    ops/pallas/paged_attention.py module docstring);
+  - the paged kernels pull whole contiguous pages via scalar-prefetched block
+    tables, one DMA per page;
+  - the per-token cache write is a flat scatter of H_kv rows at
+    ``(block * H_kv + h) * block_size + slot``.
 
 Sharding: KV heads ride the 'tensor' mesh axis when divisible (the reference slices
 KV heads across TP ranks in its sharded model implementations); layers/pages are
@@ -65,14 +71,14 @@ class BlockedKVCache:
     def __init__(self, config: KVCacheConfig, topology: Optional[MeshTopology] = None):
         self.config = config
         self.topology = topology
-        shape = (config.num_layers, config.num_blocks, config.block_size,
-                 config.num_kv_heads, config.head_dim)
+        shape = (config.num_layers, config.num_blocks, config.num_kv_heads,
+                 config.block_size, config.head_dim)
         sharding = None
         if topology is not None:
             tp = topology.tp_world_size
             spec = [None] * 5
             if tp > 1 and config.num_kv_heads % tp == 0:
-                spec[3] = TENSOR_AXIS
+                spec[2] = TENSOR_AXIS
             sharding = NamedSharding(topology.mesh, P(*spec))
         self.k = _zeros(shape, config.dtype, sharding)
         self.v = _zeros(shape, config.dtype, sharding)

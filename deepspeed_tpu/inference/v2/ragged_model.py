@@ -29,8 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.ops.pallas.paged_attention import (paged_chunk_attention,
-                                                      paged_decode_attention)
+from deepspeed_tpu.ops.pallas.paged_attention import (
+    paged_chunk_attention, paged_decode_attention, paged_decode_attention_step)
 
 
 @dataclass
@@ -451,16 +451,34 @@ def _unembed(spec: "RaggedModelSpec", weights, xs):
     return logits
 
 
-def _kv_page_write(k_l, v_l, k, v, dest):
-    """Flat scatter of new K/V rows into the paged cache; out-of-range dest
-    rows (padding sentinels) are dropped."""
-    NB, bs = k_l.shape[0], k_l.shape[1]
-    Hkv, D = k_l.shape[2], k_l.shape[3]
-    kf = k_l.reshape(NB * bs, Hkv, D).at[dest].set(k.astype(k_l.dtype),
-                                                  mode="drop")
-    vf = v_l.reshape(NB * bs, Hkv, D).at[dest].set(v.astype(v_l.dtype),
-                                                  mode="drop")
-    return kf.reshape(NB, bs, Hkv, D), vf.reshape(NB, bs, Hkv, D)
+def _kv_page_write(kp, vp, k, v, dest_tok, Hkv, bs):
+    """Scatter of new K/V rows into the FLAT head-major paged cache
+    [L*NB*Hkv*bs, D]; out-of-range dest rows (padding sentinels) drop.
+
+    ``dest_tok`` are LAYER-GLOBAL token indices (global_page * bs + slot);
+    each token lands as Hkv rows at (global_page * Hkv + h) * bs + slot.
+
+    The flat-rows-with-layer-offset layout is the load-bearing design choice:
+    the pools ride the layer scan as CARRY and this scatter is their only
+    consumer, so XLA updates the (hundreds of MB) pool in place. The earlier
+    per-layer layout — pools as scan xs/ys with a per-layer dynamic-slice +
+    scatter + re-stack — materialised two full pool copies per pass and was
+    the single largest cost in the decode step (measured ~5 ms of a 16 ms
+    step at 0.55B/32 seqs on v5e; see docs/ROUND3_NOTES.md)."""
+    T = dest_tok.shape[0]
+    page_g = dest_tok // bs
+    rows = ((page_g[:, None] * Hkv + jnp.arange(Hkv)[None, :]) * bs
+            + (dest_tok % bs)[:, None]).reshape(-1)            # [T*Hkv]
+    kf = kp.at[rows].set(k.reshape(T * Hkv, -1).astype(kp.dtype), mode="drop")
+    vf = vp.at[rows].set(v.reshape(T * Hkv, -1).astype(vp.dtype), mode="drop")
+    return kf, vf
+
+
+def _layer_dest(dest, l, NB, bs, L):
+    """Per-layer global token index: padding sentinels (>= NB*bs) must stay
+    out of range GLOBALLY — a naive l*NB*bs + sentinel would land inside the
+    next layer's pages."""
+    return jnp.where(dest >= NB * bs, L * NB * bs, l * NB * bs + dest)
 
 
 def build_ragged_forward(spec: RaggedModelSpec,
@@ -469,7 +487,8 @@ def build_ragged_forward(spec: RaggedModelSpec,
     """Returns ``fwd(weights, k_pages, v_pages, batch) ->
     (chunk_logits [V], decode_logits [S, V], new_k, new_v)``.
 
-    k/v_pages: [L, NB, bs, Hkv, D]. ``batch`` is RaggedBatch.device_arrays().
+    k/v_pages: [L, NB, Hkv, bs, D] (head-major pages — see
+    ragged/kv_cache.py). ``batch`` is RaggedBatch.device_arrays().
     When ``tp > 1`` the paged attention kernels run under shard_map on the
     'tensor' axis (heads sharded); everything else partitions via XLA SPMD.
     """
@@ -485,8 +504,8 @@ def build_ragged_forward(spec: RaggedModelSpec,
             fn = shard_map(
                 paged_decode_attention, mesh=mesh,
                 in_specs=(P(None, TENSOR_AXIS, None),
-                          P(None, None, TENSOR_AXIS, None),
-                          P(None, None, TENSOR_AXIS, None), P(None, None), P(None)),
+                          P(None, TENSOR_AXIS, None, None),
+                          P(None, TENSOR_AXIS, None, None), P(None, None), P(None)),
                 out_specs=P(None, TENSOR_AXIS, None), check_vma=False)
             return fn(q, k_l, v_l, bts, cls_)
         return paged_decode_attention(q, k_l, v_l, bts, cls_)
@@ -499,8 +518,8 @@ def build_ragged_forward(spec: RaggedModelSpec,
             fn = shard_map(
                 paged_chunk_attention, mesh=mesh,
                 in_specs=(P(None, TENSOR_AXIS, None),
-                          P(None, None, TENSOR_AXIS, None),
-                          P(None, None, TENSOR_AXIS, None), P(None), P(), P()),
+                          P(None, TENSOR_AXIS, None, None),
+                          P(None, TENSOR_AXIS, None, None), P(None), P(), P()),
                 out_specs=P(None, TENSOR_AXIS, None), check_vma=False)
             return fn(q, k_l, v_l, bt, q0, ctx)
         return paged_chunk_attention(q, k_l, v_l, bt, q0, ctx)
@@ -508,27 +527,41 @@ def build_ragged_forward(spec: RaggedModelSpec,
     def fwd(weights, k_pages, v_pages, b):
         C = b["chunk_tokens"].shape[0]
         S = b["decode_tokens"].shape[0]
+        L, NB, bs = k_pages.shape[0], k_pages.shape[1], k_pages.shape[3]
+        kp0 = k_pages.reshape(L * NB * Hkv * bs, D)  # flat rows (bitcast);
+        vp0 = v_pages.reshape(L * NB * Hkv * bs, D)  # see _kv_page_write
         tokens = jnp.concatenate([b["chunk_tokens"], b["decode_tokens"]])
         positions = jnp.concatenate([b["chunk_positions"], b["decode_positions"]])
 
         x = _embed_in(spec, weights, tokens, positions)
 
-        def layer_fn(x, scanned):
-            w, k_l0, v_l0 = scanned
+        def layer_fn(carry, scanned):
+            x, kp, vp = carry
+            w, l = scanned
 
             def attend(q, k, v):
-                k_l, v_l = _kv_page_write(k_l0, v_l0, k, v, b["kv_dest"])
+                kp_, vp_ = _kv_page_write(
+                    kp, vp, k, v, _layer_dest(b["kv_dest"], l, NB, bs, L),
+                    Hkv, bs)
+                k_l = kp_.reshape(L * NB, Hkv, bs, D)
+                v_l = vp_.reshape(L * NB, Hkv, bs, D)
                 q0 = b["chunk_positions"][0]
-                out_c = _chunk_attn(q[:C], k_l, v_l, b["chunk_block_table"],
+                out_c = _chunk_attn(q[:C], k_l, v_l,
+                                    b["chunk_block_table"] + l * NB,
                                     q0, b["chunk_ctx_len"])
-                out_d = _decode_attn(q[C:], k_l, v_l, b["decode_block_tables"],
+                out_d = _decode_attn(q[C:], k_l, v_l,
+                                     b["decode_block_tables"] + l * NB,
                                      b["decode_ctx_lens"])
-                return jnp.concatenate([out_c, out_d], axis=0), k_l, v_l
+                return jnp.concatenate([out_c, out_d], axis=0), kp_, vp_
 
-            return _transformer_layer(spec, w, x, positions, attend)
+            x, (kp, vp) = _transformer_layer(spec, w, x, positions, attend)
+            return (x, kp, vp), None
 
-        x, (new_k, new_v) = jax.lax.scan(
-            layer_fn, x, (weights["layers"], k_pages, v_pages))
+        (x, kp, vp), _ = jax.lax.scan(
+            layer_fn, (x, kp0, vp0),
+            (weights["layers"], jnp.arange(L, dtype=jnp.int32)))
+        new_k = kp.reshape(L, NB, Hkv, bs, D)
+        new_v = vp.reshape(L, NB, Hkv, bs, D)
 
         x = _norm(x, weights["final_norm"], spec.norm, spec.eps, dtype,
                   spec.norm_plus_one)
@@ -567,41 +600,55 @@ def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
     H, Hkv, D = spec.num_heads, spec.num_kv_heads, spec.head_dim
     dtype = spec.dtype
 
-    def _decode_attn(q, k_l, v_l, bts, cls_):
+    def _decode_step(q, k_new, v_new, k_l, v_l, bts, cls_):
         if tp > 1:
             from jax import shard_map
             from jax.sharding import PartitionSpec as P
             from deepspeed_tpu.comm.mesh import TENSOR_AXIS
             fn = shard_map(
-                paged_decode_attention, mesh=mesh,
+                paged_decode_attention_step, mesh=mesh,
                 in_specs=(P(None, TENSOR_AXIS, None),
-                          P(None, None, TENSOR_AXIS, None),
-                          P(None, None, TENSOR_AXIS, None), P(None, None), P(None)),
-                out_specs=P(None, TENSOR_AXIS, None), check_vma=False)
-            return fn(q, k_l, v_l, bts, cls_)
-        return paged_decode_attention(q, k_l, v_l, bts, cls_)
+                          P(None, TENSOR_AXIS, None),
+                          P(None, TENSOR_AXIS, None),
+                          P(None, TENSOR_AXIS, None, None),
+                          P(None, TENSOR_AXIS, None, None), P(None, None), P(None)),
+                out_specs=(P(None, TENSOR_AXIS, None),
+                           P(None, TENSOR_AXIS, None, None),
+                           P(None, TENSOR_AXIS, None, None)), check_vma=False)
+            return fn(q, k_new, v_new, k_l, v_l, bts, cls_)
+        return paged_decode_attention_step(q, k_new, v_new, k_l, v_l, bts, cls_)
 
     def fwd(weights, k_pages, v_pages, ids0, positions0, block_tables, ctx0,
             key, temperature=1.0):
         S = ids0.shape[0]
-        NB, bs = k_pages.shape[1], k_pages.shape[2]
+        L, NB, bs = k_pages.shape[0], k_pages.shape[1], k_pages.shape[3]
 
         def one_pass(x_ids, pos, ctx, kp, vp):
+            # kp/vp flat [L*NB*Hkv*bs, D]. The attention + page-write is one
+            # fused unit (paged_decode_attention_step): pool aliased through
+            # the kernel, new rows scattered in place after — the pools flow
+            # through the layer scan with no copies (see the kernel docstring
+            # for why a pre-kernel scatter forces XLA to clone the pool).
             x = _embed_in(spec, weights, x_ids, pos)
 
-            def layer_fn(x, scanned):
-                w, k_l0, v_l0 = scanned
+            def layer_fn(carry, scanned):
+                x, kp, vp = carry
+                w, l = scanned
 
                 def attend(q, k, v):
-                    dest = (block_tables[jnp.arange(S), pos // bs] * bs
-                            + pos % bs)
-                    k_l, v_l = _kv_page_write(k_l0, v_l0, k, v, dest)
-                    out = _decode_attn(q, k_l, v_l, block_tables, ctx)
-                    return out, k_l, v_l
+                    out, kp4, vp4 = _decode_step(
+                        q, k, v, kp.reshape(L * NB, Hkv, bs, D),
+                        vp.reshape(L * NB, Hkv, bs, D),
+                        block_tables + l * NB, ctx)
+                    return (out, kp4.reshape(L * NB * Hkv * bs, D),
+                            vp4.reshape(L * NB * Hkv * bs, D))
 
-                return _transformer_layer(spec, w, x, pos, attend)
+                x, (kp, vp) = _transformer_layer(spec, w, x, pos, attend)
+                return (x, kp, vp), None
 
-            x, (kp, vp) = jax.lax.scan(layer_fn, x, (weights["layers"], kp, vp))
+            (x, kp, vp), _ = jax.lax.scan(
+                layer_fn, (x, kp, vp),
+                (weights["layers"], jnp.arange(L, dtype=jnp.int32)))
             x = _norm(x, weights["final_norm"], spec.norm, spec.eps, dtype,
                       spec.norm_plus_one)
             logits = _unembed(spec, weights, x)
@@ -624,9 +671,12 @@ def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
 
         V = weights["embed"].shape[0]
         init_logits = jnp.zeros((ids0.shape[0], V), jnp.float32)
+        kp0 = k_pages.reshape(L * NB * Hkv * bs, D)
+        vp0 = v_pages.reshape(L * NB * Hkv * bs, D)
         (_, _, _, kp, vp, final_logits), out_ids = jax.lax.scan(
-            step, (ids0, positions0, ctx0, k_pages, v_pages, init_logits),
+            step, (ids0, positions0, ctx0, kp0, vp0, init_logits),
             jnp.arange(n_steps))
-        return out_ids, final_logits, kp, vp
+        return (out_ids, final_logits,
+                kp.reshape(L, NB, Hkv, bs, D), vp.reshape(L, NB, Hkv, bs, D))
 
     return fwd

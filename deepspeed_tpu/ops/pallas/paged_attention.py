@@ -5,24 +5,35 @@ paged KV cache (``inference/v2/kernels/ragged_ops/blocked_flash``, the CUDA
 flash-attn wrapper reading ``linear_blocked_kv_rotary``-filled KV pages). SURVEY §7
 ranks this the hardest kernel in the project; this is the TPU-native take:
 
-  - The KV cache lives in HBM as pages ``[num_blocks, block_size, H_kv, D]``
-    (``inference/ragged/kv_cache.py``); sequences own arbitrary page lists
-    (block tables), so there is no per-sequence contiguous KV to flash over.
-  - One grid step = (one sequence, one page). The page's physical index comes from
-    the block table via **scalar prefetch** (`PrefetchScalarGridSpec`): Pallas reads
-    ``block_tables[s, i]`` *before* issuing the HBM->VMEM copy for the page, so the
-    gather is free — no materialised per-sequence KV copy (the XLA fallback below
-    pays that copy; the kernel does not).
-  - Online softmax (flash) across a sequence's pages with running (m, l, acc) in
-    VMEM scratch, exactly like the training flash kernel
+  - The KV cache lives in HBM as HEAD-MAJOR pages ``[num_blocks, H_kv, bs, D]``.
+    Head-major is load-bearing twice over: (1) a page's trailing dims are
+    (block_size, head_dim) = (128, 128)-class shapes, so no array view in the
+    serving program ever carries a padded sublane tile — with the head count
+    second-minor (e.g. 12 for an MHA-12 model), XLA assigns a padded layout and
+    every pool-sized reshape in the layer scan materialises a multi-hundred-MB
+    copy (measured 26+ ms per decode step at 0.55B); (2) TP slices the pool on
+    the head dim with each shard's pages still contiguous.
+  - One grid step = (one sequence, one CHUNK of P pages). Page ids come from the
+    scalar-prefetched block table and the chunk streams HBM->VMEM through a
+    manual two-slot DMA pipeline (``pltpu.make_async_copy``): while chunk c
+    computes, chunk c+1's pages — including the NEXT sequence's first chunk at a
+    sequence boundary — are already in flight, so the whole decode batch is one
+    continuous stream of page reads with compute hidden under DMA. No
+    materialised per-sequence KV copy (the XLA fallback below pays that copy).
+  - Online softmax (flash) across a sequence's chunks with running (m, l, acc)
+    in VMEM scratch, exactly like the training flash kernel
     (``ops/pallas/flash_attention.py``).
-  - GQA: the q head block is reshaped to [H_kv, G, D] and both dots batch over
-    H_kv, so K/V pages are read once per sequence regardless of the group size.
+  - Heads: scores for all H q heads against a chunk's H_kv x T (kv head, token)
+    rows come from ONE ``[H, D] x [D, Hkv*T]`` dot with non-matching (q, kv)
+    head pairs masked block-diagonally (and one more for p@V). The H_kv-fold
+    flop overhead is irrelevant — decode attention is HBM-bandwidth bound —
+    while the alternative (H_kv separate M=G dots per page, each with ~fixed-op
+    cost) dominated the old kernel's runtime at MHA head counts.
 
 Decode-only by design (one query token per sequence): SplitFuse prompt chunks take
-the dense-flash path over a gathered context instead (``inference/v2/ragged_model``)
-— chunk attention is compute-bound where paging buys little, while decode attention
-is bandwidth-bound and must not copy the KV.
+the chunked-flash path (``paged_chunk_attention``) — chunk attention is
+compute-bound where paging buys little, while decode attention is
+bandwidth-bound and must not copy the KV.
 """
 
 from __future__ import annotations
@@ -42,10 +53,179 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
-                   acc_sc, m_sc, l_sc, *, scale, block_size, max_blocks,
-                   h_kv, groups):
+def _pick_pages_per_chunk(bs: int, h_kv: int, d: int, esize: int,
+                          max_blocks: int) -> int:
+    """Largest P with the 2-slot K+V slabs within ~8 MB of VMEM (~16 MB on
+    v5e; q/o blocks, score tiles and accumulators are small). Fatter chunks
+    amortise the per-grid-step fixed cost, the dominant decode overhead."""
+    import os
+    budget = int(os.environ.get("DSTPU_PAGED_VMEM_BUDGET",
+                                8 * 1024 * 1024))
+    per_page = 2 * 2 * bs * h_kv * d * esize        # 2 slots x (K + V)
+    return max(1, min(max_blocks, budget // per_page))
+
+
+def _chunk_mask(c, ctx_limit, T, h_kv, bs, H):
+    """[H, P*Hkv*bs] block-diagonal + context mask for a head-major chunk
+    slab: column j <-> (page p = j // (Hkv*bs), kv head (j // bs) % Hkv,
+    token p*bs + j % bs); row i's kv head is i // G. Built directly in 2D —
+    merging a (sublane, lane) pair via reshape is a relayout Mosaic
+    rejects."""
+    W = (T // bs) * h_kv * bs  # == P * Hkv * bs
+    col = jax.lax.broadcasted_iota(jnp.int32, (H, W), 1)
+    groups = H // h_kv
+    row_kv = jax.lax.broadcasted_iota(jnp.int32, (H, W), 0) // groups
+    tok = c * T + (col // (h_kv * bs)) * bs + jax.lax.rem(col, bs)
+    col_kv = jax.lax.rem(col // bs, h_kv)
+    return jnp.logical_and(col_kv == row_kv, tok < ctx_limit)
+
+
+def _flash_update(sc, mask, vv, m_sc, l_sc, acc_sc):
+    """One online-softmax update of the running (m, l, acc) scratch."""
+    sc = jnp.where(mask, sc, NEG_INF)
+    m_prev = m_sc[:, 0:1]
+    m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
+    # explicit mask, not exp(sc - m_new) alone: in an all-masked chunk
+    # (ctx 0, or garbage pages past ctx) m_new == sc == NEG_INF and the
+    # bare exp would emit 1.0 per masked column
+    p = jnp.where(mask, jnp.exp(sc - m_new), 0.0)
+    alpha = jnp.exp(m_prev - m_new)
+    l_sc[:, 0:1] = l_sc[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+    m_sc[:, 0:1] = m_new
+    pv_dot = jax.lax.dot_general(p.astype(vv.dtype), vv,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    acc_sc[:] = acc_sc[:] * alpha + pv_dot
+
+
+def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
+                 k_hbm, v_hbm, o_ref,
+                 k_buf, v_buf, sems, acc_sc, m_sc, l_sc, *,
+                 scale, block_size, pages_per_chunk, n_chunks, max_blocks,
+                 n_seqs, h_kv, groups):
+    """Shared batched-decode body (see module docstring). With
+    ``knew_ref/vnew_ref`` (step mode) the pages hold tokens [0, ctx-1) and
+    the current token's attention term folds in from registers at finalize;
+    without them the pages hold everything (ctx tokens)."""
+    inline_current = knew_ref is not None
+    ctx_off = 1 if inline_current else 0
+    P, bs, T = pages_per_chunk, block_size, pages_per_chunk * block_size
+    s, c = pl.program_id(0), pl.program_id(1)
+    g = s * n_chunks + c                   # global step: the pipeline clock
+    H = h_kv * groups
+
+    def n_chunks_of(s_):
+        # every sequence runs >= 1 chunk (ctx 0 rows mask to zeros)
+        return jax.lax.div(jnp.maximum(cl_ref[s_] - ctx_off, 1) + (T - 1), T)
+
+    def chunk_copies(s_, c_, slot):
+        """The 2P page-copy descriptors for chunk c_ of sequence s_ (built
+        identically at start and wait — same (src, dst, sem) triples)."""
+        cps = []
+        for j in range(P):
+            page = bt_ref[s_, jnp.minimum(c_ * P + j, max_blocks - 1)]
+            cps.append(pltpu.make_async_copy(
+                k_hbm.at[page], k_buf.at[slot, j], sems.at[slot]))
+            cps.append(pltpu.make_async_copy(
+                v_hbm.at[page], v_buf.at[slot, j], sems.at[slot]))
+        return cps
+
+    @pl.when(g == 0)
+    def _():                               # prime the pipeline
+        for cp in chunk_copies(0, 0, 0):
+            cp.start()
+
+    # issue the next REAL chunk's DMA before this chunk's compute; unreal
+    # steps (c beyond this sequence's chunk count) still run this control so
+    # the two-slot protocol stays consistent across skipped steps
+    s_n = jax.lax.div(g + 1, n_chunks)
+    c_n = jax.lax.rem(g + 1, n_chunks)
+    next_real = jnp.logical_and(g + 1 < n_seqs * n_chunks, c_n < n_chunks_of(s_n))
+
+    @pl.when(next_real)
+    def _():
+        for cp in chunk_copies(s_n, c_n, jax.lax.rem(g + 1, 2)):
+            cp.start()
+
+    ctx = cl_ref[s]
+    nc_s = n_chunks_of(s)
+
+    @pl.when(c < nc_s)
+    def _():
+        slot = jax.lax.rem(g, 2)
+        for cp in chunk_copies(s, c, slot):
+            cp.wait()
+
+        @pl.when(c == 0)
+        def _():
+            m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+            l_sc[:] = jnp.zeros_like(l_sc)
+            acc_sc[:] = jnp.zeros_like(acc_sc)
+
+        q = q_ref[0]                                           # [H, D]
+        kk = k_buf[slot].reshape(P * h_kv * bs, -1)            # leading-dim
+        vv = v_buf[slot].reshape(P * h_kv * bs, -1)            # collapse only
+        mask = _chunk_mask(c, ctx - ctx_off, T, h_kv, bs, H)
+        # dots run in the page dtype (bf16 MXU path for serving caches) with
+        # f32 accumulation; identical math to before for f32 pools
+        sc = jax.lax.dot_general(q.astype(kk.dtype), kk,
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32) * scale
+        _flash_update(sc, mask, vv, m_sc, l_sc, acc_sc)
+
+        @pl.when(c == nc_s - 1)
+        def _():
+            if not inline_current:
+                l = l_sc[:, 0:1]
+                safe_l = jnp.where(l > 0.0, l, 1.0)
+                o_ref[0] = (acc_sc[:] / safe_l).astype(o_ref.dtype)
+                return
+            # fold in the current token from registers (one extra softmax
+            # column per head group), then normalise
+            qf = q_ref[0].astype(jnp.float32)
+            kn = knew_ref[0]
+            vn = vnew_ref[0]
+            sc_rows = []
+            pv_rows = []
+            for h in range(h_kv):
+                qh = qf[h * groups:(h + 1) * groups, :]        # [G, D]
+                knh = kn[h, :].astype(jnp.float32)             # [D]
+                sc_rows.append(jnp.sum(qh * knh[None, :], axis=1,
+                                       keepdims=True) * scale)
+            sc_cur = jnp.concatenate(sc_rows, axis=0)          # [H, 1]
+            m_l = m_sc[:, 0:1]
+            m_f = jnp.maximum(m_l, sc_cur)
+            alpha_f = jnp.exp(m_l - m_f)
+            p_cur = jnp.exp(sc_cur - m_f)                      # [H, 1]
+            for h in range(h_kv):
+                vnh = vn[h, :].astype(jnp.float32)             # [D]
+                pv_rows.append(p_cur[h * groups:(h + 1) * groups, :]
+                               * vnh[None, :])
+            pv_term = jnp.concatenate(pv_rows, axis=0)         # [H, D]
+            l_f = l_sc[:, 0:1] * alpha_f + p_cur
+            acc_f = acc_sc[:] * alpha_f + pv_term
+            safe_l = jnp.where(l_f > 0.0, l_f, 1.0)
+            out = (acc_f / safe_l).astype(o_ref.dtype)
+            o_ref[0] = jnp.where(ctx > 0, out, jnp.zeros_like(out))
+
+
+def _decode_kernel(bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref,
+                   k_buf, v_buf, sems, acc_sc, m_sc, l_sc, **kw):
+    _decode_body(bt_ref, cl_ref, q_ref, None, None, k_hbm, v_hbm, o_ref,
+                 k_buf, v_buf, sems, acc_sc, m_sc, l_sc, **kw)
+
+
+def _decode_kernel_smalld(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
+                          acc_sc, m_sc, l_sc, *, scale, block_size,
+                          max_blocks, h_kv, groups):
+    """BlockSpec-pipelined fallback for head dims the manual-DMA path can't
+    carry (Mosaic requires DMA lane extents aligned to 128; D=64-class
+    models land here). One grid step = (sequence, page), pages pulled by the
+    Pallas pipeline via the scalar-prefetched block table, per-kv-head dots
+    — the original kernel design, adequate off the serving hot path."""
     s, i = pl.program_id(0), pl.program_id(1)
+    bs = block_size
+    H = h_kv * groups
 
     @pl.when(i == 0)
     def _():
@@ -55,39 +235,30 @@ def _decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
 
     ctx = cl_ref[s]
 
-    @pl.when(i * block_size < ctx)
+    @pl.when(i * bs < ctx)
     def _():
-        H = h_kv * groups
         q = q_ref[0].astype(jnp.float32)                       # [H, D]
-        k = k_ref[0]                                           # [bs, H_kv, D]
-        v = v_ref[0]
-        # GQA: per kv head, the group's G query rows share one K/V page slice.
-        # Mosaic wants plain 2D dots (batched dot_general with differing batch-dim
-        # positions is unsupported), and h_kv is tiny, so unroll over kv heads.
-        scs = []
+        tok = i * bs + jax.lax.broadcasted_iota(jnp.int32, (H, bs), 1)
+        mask = tok < ctx
         for h in range(h_kv):
-            qh = q[h * groups:(h + 1) * groups, :]             # [G, D]
-            kh = k[:, h, :].astype(jnp.float32)                # [bs, D]
-            scs.append(jax.lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
-                                           preferred_element_type=jnp.float32))
-        sc = jnp.concatenate(scs, axis=0) * scale              # [H, bs]
-        tok = i * block_size + jax.lax.broadcasted_iota(jnp.int32, (H, block_size), 1)
-        sc = jnp.where(tok < ctx, sc, NEG_INF)
-
-        m_prev = m_sc[:, 0:1]
-        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
-        p = jnp.exp(sc - m_new)                                # [H, bs]
-        alpha = jnp.exp(m_prev - m_new)
-        l_sc[:, 0:1] = l_sc[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        m_sc[:, 0:1] = m_new
-        pvs = []
-        for h in range(h_kv):
-            ph = p[h * groups:(h + 1) * groups, :]             # [G, bs]
-            vh = v[:, h, :].astype(jnp.float32)                # [bs, D]
-            pvs.append(jax.lax.dot_general(ph, vh, (((1,), (0,)), ((), ())),
-                                           preferred_element_type=jnp.float32))
-        pv = jnp.concatenate(pvs, axis=0)                      # [H, D]
-        acc_sc[:] = acc_sc[:] * alpha + pv
+            rows = slice(h * groups, (h + 1) * groups)
+            qh = q[rows, :]                                    # [G, D]
+            kh = k_ref[0, h].astype(jnp.float32)               # [bs, D]
+            vh = v_ref[0, h].astype(jnp.float32)
+            sc = jax.lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32) * scale
+            mh = mask[rows, :]
+            sc = jnp.where(mh, sc, NEG_INF)
+            m_prev = m_sc[rows, 0:1]
+            m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
+            p = jnp.where(mh, jnp.exp(sc - m_new), 0.0)
+            alpha = jnp.exp(m_prev - m_new)
+            l_sc[rows, 0:1] = l_sc[rows, 0:1] * alpha \
+                + jnp.sum(p, axis=1, keepdims=True)
+            m_sc[rows, 0:1] = m_new
+            acc_sc[rows, :] = acc_sc[rows, :] * alpha + jax.lax.dot_general(
+                p, vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
 
     @pl.when(i == max_blocks - 1)
     def _():
@@ -96,39 +267,21 @@ def _decode_kernel(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_sc[:] / safe_l).astype(o_ref.dtype)
 
 
-def paged_decode_attention(q: jax.Array,
-                           k_pages: jax.Array,
-                           v_pages: jax.Array,
-                           block_tables: jax.Array,
-                           ctx_lens: jax.Array,
-                           softmax_scale: Optional[float] = None) -> jax.Array:
-    """Single-token-per-sequence attention over a paged KV cache.
-
-    q:            [S, H, D]        one query token per sequence
-    k_pages:      [NB, bs, H_kv, D]
-    v_pages:      [NB, bs, H_kv, D]
-    block_tables: [S, MB] int32    physical page ids per sequence (0-padded)
-    ctx_lens:     [S] int32        tokens visible per sequence (incl. current)
-
-    Returns [S, H, D]. Rows whose ctx_len is 0 return zeros.
-    """
+def _paged_decode_smalld(q, k_pages, v_pages, block_tables, ctx_lens, scale):
     S, H, D = q.shape
-    NB, bs, Hkv, Dk = k_pages.shape
-    assert Dk == D, (Dk, D)
-    assert H % Hkv == 0, f"GQA: {H} q heads not divisible by {Hkv} kv heads"
+    NB, Hkv, bs, _ = k_pages.shape
     G = H // Hkv
     MB = block_tables.shape[1]
-    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
-
-    kernel = functools.partial(_decode_kernel, scale=scale, block_size=bs,
-                               max_blocks=MB, h_kv=Hkv, groups=G)
+    kernel = functools.partial(_decode_kernel_smalld, scale=scale,
+                               block_size=bs, max_blocks=MB, h_kv=Hkv,
+                               groups=G)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S, MB),
         in_specs=[
             pl.BlockSpec((1, H, D), lambda s, i, bt, cl: (s, 0, 0)),
-            pl.BlockSpec((1, bs, Hkv, D), lambda s, i, bt, cl: (bt[s, i], 0, 0, 0)),
-            pl.BlockSpec((1, bs, Hkv, D), lambda s, i, bt, cl: (bt[s, i], 0, 0, 0)),
+            pl.BlockSpec((1, Hkv, bs, D), lambda s, i, bt, cl: (bt[s, i], 0, 0, 0)),
+            pl.BlockSpec((1, Hkv, bs, D), lambda s, i, bt, cl: (bt[s, i], 0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, H, D), lambda s, i, bt, cl: (s, 0, 0)),
         scratch_shapes=[
@@ -144,7 +297,226 @@ def paged_decode_attention(q: jax.Array,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
-    )(block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32), q, k_pages, v_pages)
+    )(block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32),
+      q, k_pages, v_pages)
+
+
+def paged_decode_attention(q: jax.Array,
+                           k_pages: jax.Array,
+                           v_pages: jax.Array,
+                           block_tables: jax.Array,
+                           ctx_lens: jax.Array,
+                           softmax_scale: Optional[float] = None) -> jax.Array:
+    """Single-token-per-sequence attention over a paged KV cache.
+
+    q:            [S, H, D]        one query token per sequence
+    k_pages:      [NB, H_kv, bs, D] (head-major pages; see module docstring)
+    v_pages:      [NB, H_kv, bs, D]
+    block_tables: [S, MB] int32    physical page ids per sequence (0-padded)
+    ctx_lens:     [S] int32        tokens visible per sequence (incl. current)
+
+    Returns [S, H, D]. Rows whose ctx_len is 0 return zeros.
+    """
+    S, H, D = q.shape
+    NB, Hkv, bs, Dk = k_pages.shape
+    assert Dk == D, (Dk, D)
+    assert H % Hkv == 0, f"GQA: {H} q heads not divisible by {Hkv} kv heads"
+    G = H // Hkv
+    MB = block_tables.shape[1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+    if D % 128 != 0:   # manual-DMA lane-alignment limit — see _paged_decode_smalld
+        return _paged_decode_smalld(q, k_pages, v_pages, block_tables,
+                                    ctx_lens, scale)
+    P = _pick_pages_per_chunk(bs, Hkv, D, jnp.dtype(k_pages.dtype).itemsize, MB)
+    NC = -(-MB // P)
+
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, block_size=bs, pages_per_chunk=P,
+        n_chunks=NC, max_blocks=MB, n_seqs=S, h_kv=Hkv, groups=G)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, NC),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda s, c, bt, cl: (s, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),     # K pages stay in HBM;
+            pl.BlockSpec(memory_space=pl.ANY),     # chunks stream via DMA
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda s, c, bt, cl: (s, 0, 0)),
+        scratch_shapes=[
+            # pages flattened to [Hkv*bs, D] rows — (bs, D) trailing tiles,
+            # aligned for any head count
+            pltpu.VMEM((2, P, Hkv * bs, D), k_pages.dtype),
+            pltpu.VMEM((2, P, Hkv * bs, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((H, D), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+        ],
+    )
+    assert (bs * Hkv) % 8 == 0, \
+        f"page rows {Hkv}*{bs} must align to the 8-sublane tile"
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            # the 2-slot DMA pipeline hands buffers across grid steps (and
+            # across sequences), so iteration order must stay sequential
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=_interpret(),
+    )(block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32), q,
+      k_pages.reshape(NB, Hkv * bs, D), v_pages.reshape(NB, Hkv * bs, D))
+
+
+def _decode_step_kernel(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
+                        k_hbm, v_hbm, o_ref, kout_ref, vout_ref,
+                        k_buf, v_buf, sems, acc_sc, m_sc, l_sc, **kw):
+    """Decode STEP attention: the shared body in step mode — paged flash over
+    the PRIOR context (pages hold tokens [0, ctx-1)) + the current token's
+    term inline from the k_new/v_new operands; the pools pass through
+    untouched, aliased input -> output.
+
+    Why this shape: the current token's K/V must both enter attention AND
+    land in the pages. Expressing the page write as an XLA scatter BEFORE an
+    opaque kernel that reads the pool made XLA's copy-insertion clone the
+    (hundreds of MB) pool around the custom call — measured 3x decode
+    slowdown; an in-kernel DMA write is blocked by DMA tiling at arbitrary
+    sublane offsets. So: the kernel needs only tokens < ctx-1 from the pages
+    (the current token rides registers), ``input_output_aliases`` declares
+    the pool linear through the call, and the caller scatters the new rows
+    into the returned pool AFTER — every link in the carry chain is a
+    declared alias or a canonical in-place scatter, so the pool is never
+    copied.
+
+    ``cl_ref[s]`` counts tokens INCLUDING the current one."""
+    del kout_ref, vout_ref  # aliased pass-throughs; written by the caller
+    _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref, k_hbm, v_hbm,
+                 o_ref, k_buf, v_buf, sems, acc_sc, m_sc, l_sc, **kw)
+
+
+def paged_decode_attention_step(q: jax.Array,
+                                k_new: jax.Array,
+                                v_new: jax.Array,
+                                k_pages: jax.Array,
+                                v_pages: jax.Array,
+                                block_tables: jax.Array,
+                                ctx_lens: jax.Array,
+                                softmax_scale: Optional[float] = None):
+    """One fused decode step per sequence: write ``k_new/v_new`` (the current
+    token's K/V, position ``ctx_lens - 1``) into the paged cache AND return
+    attention over the full context including the current token.
+
+    q:            [S, H, D]       k_new/v_new: [S, H_kv, D]
+    k/v_pages:    [NB, H_kv, bs, D] — ALIASED: the returned pools reuse the
+                  input buffers (donate them at the jit boundary)
+    block_tables: [S, MB] int32   ctx_lens: [S] int32 (INCLUDING current)
+
+    Returns ``(out [S, H, D], k_pages, v_pages)``. ctx_lens == 0 rows write
+    nothing and return zeros.
+    """
+    S, H, D = q.shape
+    NB, Hkv, bs, Dk = k_pages.shape
+    assert Dk == D and H % Hkv == 0
+    G = H // Hkv
+    MB = block_tables.shape[1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+    if D % 128 != 0:
+        # small-D fallback: scatter first (pools here are small), then the
+        # BlockSpec-pipelined kernel over the full context
+        pv0 = jnp.maximum(ctx_lens - 1, 0)
+        page_w0 = block_tables[jnp.arange(S), pv0 // bs]
+        dest0 = ((page_w0[:, None] * Hkv + jnp.arange(Hkv)[None, :]) * bs
+                 + (pv0 % bs)[:, None])
+        dest0 = jnp.where(ctx_lens[:, None] > 0, dest0,
+                          NB * Hkv * bs).reshape(-1)
+        kf = k_pages.reshape(NB * Hkv * bs, D).at[dest0].set(
+            k_new.reshape(S * Hkv, D).astype(k_pages.dtype), mode="drop")
+        vf = v_pages.reshape(NB * Hkv * bs, D).at[dest0].set(
+            v_new.reshape(S * Hkv, D).astype(v_pages.dtype), mode="drop")
+        kf = kf.reshape(NB, Hkv, bs, D)
+        vf = vf.reshape(NB, Hkv, bs, D)
+        out = _paged_decode_smalld(q, kf, vf, block_tables, ctx_lens, scale)
+        return out, kf, vf
+    P = _pick_pages_per_chunk(bs, Hkv, D, jnp.dtype(k_pages.dtype).itemsize, MB)
+    NC = -(-MB // P)
+    assert (bs * Hkv) % 8 == 0
+
+    kernel = functools.partial(
+        _decode_step_kernel, scale=scale, block_size=bs, pages_per_chunk=P,
+        n_chunks=NC, max_blocks=MB, n_seqs=S, h_kv=Hkv, groups=G)
+    flat = (NB, Hkv * bs, D)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, NC),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda s, c, bt, cl: (s, 0, 0)),
+            pl.BlockSpec((1, Hkv, D), lambda s, c, bt, cl: (s, 0, 0)),
+            pl.BlockSpec((1, Hkv, D), lambda s, c, bt, cl: (s, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, H, D), lambda s, c, bt, cl: (s, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, P, Hkv * bs, D), k_pages.dtype),
+            pltpu.VMEM((2, P, Hkv * bs, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((H, D), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+        ],
+    )
+    out, kf, vf = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((S, H, D), q.dtype),
+                   jax.ShapeDtypeStruct(flat, k_pages.dtype),
+                   jax.ShapeDtypeStruct(flat, v_pages.dtype)],
+        # call args: (bt, cl, q, k_new, v_new, k_pool, v_pool) -> pools alias
+        input_output_aliases={5: 1, 6: 2},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=_interpret(),
+    )(block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32),
+      q, k_new, v_new, k_pages.reshape(flat), v_pages.reshape(flat))
+    # the write happens HERE, after the kernel: a canonical in-place scatter
+    # on the aliased-through pool (see _decode_step_kernel docstring).
+    # Head-major flat rows: row of (page, head, slot) = (page*Hkv + h)*bs + slot.
+    pv = jnp.maximum(ctx_lens - 1, 0)
+    page_w = block_tables[jnp.arange(S), pv // bs]
+    dest = ((page_w[:, None] * Hkv + jnp.arange(Hkv)[None, :]) * bs
+            + (pv % bs)[:, None])                              # [S, Hkv]
+    dest = jnp.where(ctx_lens[:, None] > 0, dest, NB * Hkv * bs).reshape(-1)
+    kf = kf.reshape(NB * Hkv * bs, D).at[dest].set(
+        k_new.reshape(S * Hkv, D).astype(kf.dtype), mode="drop")
+    vf = vf.reshape(NB * Hkv * bs, D).at[dest].set(
+        v_new.reshape(S * Hkv, D).astype(vf.dtype), mode="drop")
+    return (out, kf.reshape(NB, Hkv, bs, D), vf.reshape(NB, Hkv, bs, D))
+
+
+def paged_decode_attention_step_reference(q, k_new, v_new, k_pages, v_pages,
+                                          block_tables, ctx_lens,
+                                          softmax_scale: Optional[float] = None):
+    """jnp reference: scatter the new rows, then dense paged-decode reference."""
+    S, H, D = q.shape
+    NB, Hkv, bs, _ = k_pages.shape
+    pv = jnp.maximum(ctx_lens - 1, 0)
+    page_w = block_tables[jnp.arange(S), pv // bs]
+    dest = ((page_w[:, None] * Hkv + jnp.arange(Hkv)[None, :]) * bs
+            + (pv % bs)[:, None])
+    dest = jnp.where(ctx_lens[:, None] > 0, dest, NB * Hkv * bs).reshape(-1)
+    kf = k_pages.reshape(NB * Hkv * bs, D).at[dest].set(
+        k_new.reshape(S * Hkv, D).astype(k_pages.dtype),
+        mode="drop").reshape(NB, Hkv, bs, D)
+    vf = v_pages.reshape(NB * Hkv * bs, D).at[dest].set(
+        v_new.reshape(S * Hkv, D).astype(v_pages.dtype),
+        mode="drop").reshape(NB, Hkv, bs, D)
+    out = paged_decode_attention_reference(q, kf, vf, block_tables, ctx_lens,
+                                           softmax_scale)
+    return out, kf, vf
 
 
 def _chunk_kernel(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
@@ -175,15 +547,15 @@ def _chunk_kernel(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
         # per kv head: the group's bq*G query rows share one page slice
         for h in range(h_kv):
             qh = q[:, h * G:(h + 1) * G, :].reshape(bq * G, -1)
-            kh = k_ref[0, :, h, :].astype(jnp.float32)         # [bs, D]
-            vh = v_ref[0, :, h, :].astype(jnp.float32)
+            kh = k_ref[0, h].astype(jnp.float32)               # [bs, D]
+            vh = v_ref[0, h].astype(jnp.float32)
             sc = jax.lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32) * scale
             sc = jnp.where(mask, sc, NEG_INF)
             rows = slice(h * bq * G, (h + 1) * bq * G)
             m_prev = m_sc[rows, 0:1]
             m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
-            p = jnp.exp(sc - m_new)
+            p = jnp.where(mask, jnp.exp(sc - m_new), 0.0)
             alpha = jnp.exp(m_prev - m_new)
             l_sc[rows, 0:1] = l_sc[rows, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
             m_sc[rows, 0:1] = m_new
@@ -218,7 +590,7 @@ def paged_chunk_attention(q: jax.Array,
     absolute position.
 
     q:           [C, H, D]
-    k/v_pages:   [NB, bs, H_kv, D]
+    k/v_pages:   [NB, H_kv, bs, D] (head-major pages)
     block_table: [MB] int32
     q_start:     int32 — absolute position of q row 0
     ctx_len:     int32 — KV tokens visible in total (= q_start + C for prefill)
@@ -227,7 +599,7 @@ def paged_chunk_attention(q: jax.Array,
     ignores them); with ctx_len == 0 the output is zeros.
     """
     C, H, D = q.shape
-    NB, bs, Hkv, _ = k_pages.shape
+    NB, Hkv, bs, _ = k_pages.shape
     assert H % Hkv == 0
     G = H // Hkv
     MB = block_table.shape[0]
@@ -247,8 +619,8 @@ def paged_chunk_attention(q: jax.Array,
         grid=(nq, MB),
         in_specs=[
             pl.BlockSpec((bq, H, D), lambda iq, i, bt, m: (iq, 0, 0)),
-            pl.BlockSpec((1, bs, Hkv, D), lambda iq, i, bt, m: (bt[i], 0, 0, 0)),
-            pl.BlockSpec((1, bs, Hkv, D), lambda iq, i, bt, m: (bt[i], 0, 0, 0)),
+            pl.BlockSpec((1, Hkv, bs, D), lambda iq, i, bt, m: (bt[i], 0, 0, 0)),
+            pl.BlockSpec((1, Hkv, bs, D), lambda iq, i, bt, m: (bt[i], 0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((bq, H, D), lambda iq, i, bt, m: (iq, 0, 0)),
         scratch_shapes=[
@@ -271,12 +643,13 @@ def paged_chunk_attention_reference(q, k_pages, v_pages, block_table, q_start,
                                     ctx_len, softmax_scale: Optional[float] = None):
     """jnp reference for the chunk kernel (materialises the [C, MB*bs] scores)."""
     C, H, D = q.shape
-    NB, bs, Hkv, _ = k_pages.shape
+    NB, Hkv, bs, _ = k_pages.shape
     G = H // Hkv
     MB = block_table.shape[0]
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
-    k_seq = k_pages[block_table].reshape(MB * bs, Hkv, D)
-    v_seq = v_pages[block_table].reshape(MB * bs, Hkv, D)
+    # [MB, Hkv, bs, D] -> sequence-major [MB*bs, Hkv, D]
+    k_seq = jnp.moveaxis(k_pages[block_table], 1, 2).reshape(MB * bs, Hkv, D)
+    v_seq = jnp.moveaxis(v_pages[block_table], 1, 2).reshape(MB * bs, Hkv, D)
     k_seq = jnp.repeat(k_seq, G, axis=1)
     v_seq = jnp.repeat(v_seq, G, axis=1)
     sc = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
@@ -295,13 +668,14 @@ def paged_decode_attention_reference(q, k_pages, v_pages, block_tables, ctx_lens
                                      softmax_scale: Optional[float] = None):
     """jnp reference (gathers each sequence's pages — the copy the kernel avoids)."""
     S, H, D = q.shape
-    NB, bs, Hkv, _ = k_pages.shape
+    NB, Hkv, bs, _ = k_pages.shape
     G = H // Hkv
     MB = block_tables.shape[1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
 
-    k_seq = k_pages[block_tables].reshape(S, MB * bs, Hkv, D)
-    v_seq = v_pages[block_tables].reshape(S, MB * bs, Hkv, D)
+    # [S, MB, Hkv, bs, D] -> sequence-major [S, MB*bs, Hkv, D]
+    k_seq = jnp.moveaxis(k_pages[block_tables], 2, 3).reshape(S, MB * bs, Hkv, D)
+    v_seq = jnp.moveaxis(v_pages[block_tables], 2, 3).reshape(S, MB * bs, Hkv, D)
     k_seq = jnp.repeat(k_seq, G, axis=2)
     v_seq = jnp.repeat(v_seq, G, axis=2)
     sc = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),

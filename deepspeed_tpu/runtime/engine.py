@@ -330,8 +330,10 @@ class DeepSpeedTPUEngine:
                 st["params"] = tree_cast(master, self.compute_dtype)
             return st
 
+        donate = (0,) if self.config.donate_model_parameters else ()
         with topo.mesh:
-            self.state = jax.jit(build, out_shardings=shardings)(model_parameters)
+            self.state = jax.jit(build, out_shardings=shardings,
+                                 donate_argnums=donate)(model_parameters)
         self._state_shardings = shardings
         self._scaler_dynamic = bool(dynamic and fp16.loss_scale == 0)
 

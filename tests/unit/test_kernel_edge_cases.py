@@ -96,8 +96,8 @@ class TestPagedEdgeCases:
     def test_decode_ragged_contexts(self, bs, ctxs):
         NB, Hkv, H, D = 24, 2, 4, 32
         S = len(ctxs)
-        kp = _rand(20, NB, bs, Hkv, D)
-        vp = _rand(21, NB, bs, Hkv, D)
+        kp = _rand(20, NB, Hkv, bs, D)
+        vp = _rand(21, NB, Hkv, bs, D)
         q = _rand(22, S, H, D)
         mb = max(-(-max(max(ctxs), 1) // bs), 1)
         bts = jnp.asarray(
@@ -115,8 +115,8 @@ class TestPagedEdgeCases:
     @pytest.mark.parametrize("C,q_start", [(1, 0), (5, 3), (31, 1), (17, 40)])
     def test_chunk_odd_sizes_and_offsets(self, C, q_start):
         NB, bs, Hkv, H, D = 16, 8, 2, 4, 32
-        kp = _rand(23, NB, bs, Hkv, D)
-        vp = _rand(24, NB, bs, Hkv, D)
+        kp = _rand(23, NB, Hkv, bs, D)
+        vp = _rand(24, NB, Hkv, bs, D)
         q = _rand(25, C, H, D)
         ctx = q_start + C
         nb = -(-ctx // bs)
@@ -130,8 +130,8 @@ class TestPagedEdgeCases:
 
     def test_decode_single_token_context_bf16(self):
         NB, bs, Hkv, H, D = 8, 8, 1, 2, 64
-        kp = _rand(26, NB, bs, Hkv, D, dtype=jnp.bfloat16)
-        vp = _rand(27, NB, bs, Hkv, D, dtype=jnp.bfloat16)
+        kp = _rand(26, NB, Hkv, bs, D, dtype=jnp.bfloat16)
+        vp = _rand(27, NB, Hkv, bs, D, dtype=jnp.bfloat16)
         q = _rand(28, 1, H, D, dtype=jnp.bfloat16)
         bts = jnp.zeros((1, 1), jnp.int32)
         cls_ = jnp.asarray([1], jnp.int32)

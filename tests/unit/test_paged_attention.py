@@ -8,13 +8,14 @@ import pytest
 
 from deepspeed_tpu.ops.pallas.paged_attention import (
     paged_chunk_attention, paged_chunk_attention_reference,
-    paged_decode_attention, paged_decode_attention_reference)
+    paged_decode_attention, paged_decode_attention_reference,
+    paged_decode_attention_step, paged_decode_attention_step_reference)
 
 
 def _setup(rng, S, H, D, Hkv, NB, bs, MB):
     q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
-    k = jnp.asarray(rng.randn(NB, bs, Hkv, D), jnp.float32)
-    v = jnp.asarray(rng.randn(NB, bs, Hkv, D), jnp.float32)
+    k = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
+    v = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
     bt = jnp.asarray(rng.permutation(NB)[:S * MB].reshape(S, MB), jnp.int32)
     return q, k, v, bt
 
@@ -50,6 +51,43 @@ class TestPagedDecode:
                                    atol=2e-5, rtol=2e-5)
 
 
+class TestPagedDecodeStep:
+    """Fused decode step: prior-context flash + inline current token + page
+    write, pools aliased through. Edge cases: ctx 1 (no pages yet), page
+    boundary, ctx 0 (padding row: no write, zero output)."""
+
+    @pytest.mark.parametrize("Hkv,ctxs", [
+        (8, [9, 17, 30]),
+        (2, [1, 8, 32]),          # GQA; ctx=1; exact page boundary
+        (4, [0, 5]),              # padding row
+    ])
+    def test_matches_reference(self, Hkv, ctxs):
+        rng = np.random.RandomState(7)
+        S, H, D, bs = len(ctxs), 8, 64, 8
+        MB = 4
+        NB = S * MB + 2
+        k = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
+        v = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
+        q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
+        kn = jnp.asarray(rng.randn(S, Hkv, D), jnp.float32)
+        vn = jnp.asarray(rng.randn(S, Hkv, D), jnp.float32)
+        # disjoint per-sequence page tables (pages are exclusive in serving)
+        bt = jnp.asarray(rng.permutation(NB - 1)[:S * MB].reshape(S, MB) + 1,
+                         jnp.int32)
+        cl = jnp.asarray(ctxs, jnp.int32)
+        out, kf, vf = jax.jit(paged_decode_attention_step)(q, kn, vn, k, v,
+                                                           bt, cl)
+        orf, krf, vrf = paged_decode_attention_step_reference(q, kn, vn, k, v,
+                                                              bt, cl)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(orf),
+                                   atol=2e-5, rtol=2e-4)
+        np.testing.assert_array_equal(np.asarray(kf), np.asarray(krf))
+        np.testing.assert_array_equal(np.asarray(vf), np.asarray(vrf))
+        for i, c in enumerate(ctxs):
+            if c == 0:
+                assert np.all(np.asarray(out)[i] == 0)
+
+
 class TestPagedChunk:
 
     @pytest.mark.parametrize("q_start,ctx", [(0, 16), (13, 29), (40, 56)])
@@ -57,8 +95,8 @@ class TestPagedChunk:
         rng = np.random.RandomState(3)
         C, H, D, Hkv, NB, bs, MB = 16, 8, 64, 2, 32, 8, 8
         q = jnp.asarray(rng.randn(C, H, D), jnp.float32)
-        k = jnp.asarray(rng.randn(NB, bs, Hkv, D), jnp.float32)
-        v = jnp.asarray(rng.randn(NB, bs, Hkv, D), jnp.float32)
+        k = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
+        v = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
         bt = jnp.asarray(rng.permutation(NB)[:MB], jnp.int32)
         out = paged_chunk_attention(q, k, v, bt, q_start, ctx)
         ref = paged_chunk_attention_reference(q, k, v, bt, q_start, ctx)
@@ -68,8 +106,8 @@ class TestPagedChunk:
     def test_empty_ctx_zero(self):
         rng = np.random.RandomState(4)
         q = jnp.asarray(rng.randn(8, 4, 64), jnp.float32)
-        k = jnp.asarray(rng.randn(16, 8, 2, 64), jnp.float32)
-        v = jnp.asarray(rng.randn(16, 8, 2, 64), jnp.float32)
+        k = jnp.asarray(rng.randn(16, 2, 8, 64), jnp.float32)
+        v = jnp.asarray(rng.randn(16, 2, 8, 64), jnp.float32)
         bt = jnp.zeros((4,), jnp.int32)
         out = np.asarray(paged_chunk_attention(q, k, v, bt, 0, 0))
         assert np.all(out == 0)
@@ -84,10 +122,12 @@ class TestPagedChunk:
         kd = jnp.asarray(rng.randn(C, H, D), jnp.float32)
         vd = jnp.asarray(rng.randn(C, H, D), jnp.float32)
         bt = jnp.asarray([3, 5], jnp.int32)
-        k_pages = jnp.zeros((NB, bs, H, D), jnp.float32)
-        v_pages = jnp.zeros((NB, bs, H, D), jnp.float32)
-        k_pages = k_pages.at[bt].set(kd.reshape(MB, bs, H, D))
-        v_pages = v_pages.at[bt].set(vd.reshape(MB, bs, H, D))
+        k_pages = jnp.zeros((NB, H, bs, D), jnp.float32)
+        v_pages = jnp.zeros((NB, H, bs, D), jnp.float32)
+        k_pages = k_pages.at[bt].set(
+            jnp.moveaxis(kd.reshape(MB, bs, H, D), 1, 2))
+        v_pages = v_pages.at[bt].set(
+            jnp.moveaxis(vd.reshape(MB, bs, H, D), 1, 2))
         out = paged_chunk_attention(q, k_pages, v_pages, bt, 0, C)
         ref = reference_attention(q[None], kd[None], vd[None], causal=True)[0]
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
