@@ -351,7 +351,11 @@ def bench_decode(on_tpu: bool) -> dict:
             config={"state_manager": {
                 "max_tracked_sequences": n_seqs,
                 "max_ragged_sequence_count": n_seqs,
-                "max_ragged_batch_size": max(n_seqs * 2, prompt * 2),
+                # enough chunk slots to prefill the whole wave in one pass
+                # (multi-chunk SplitFuse: per-pass dispatch cost amortises
+                # over n_seqs prompts instead of paying it n_seqs times)
+                "max_ragged_batch_size": n_seqs * prompt + n_seqs,
+                "prefill_chunk_size": prompt,
                 "max_context": ctx,
             }})
         prompts = [rng.randint(0, vocab, size=(prompt,)).astype(np.int32)

@@ -19,12 +19,27 @@ class DSStateManagerConfig:
     """Parity: ``DSStateManagerConfig`` (manager_configs.py)."""
     max_tracked_sequences: int = 64          # sequences with live KV state
     max_ragged_sequence_count: int = 32      # decode rows per pass
-    max_ragged_batch_size: int = 768         # token budget per pass (chunk + decode)
+    max_ragged_batch_size: int = 768         # token budget per pass (chunks + decode)
     max_context: int = 8192                  # per-sequence KV capacity
+    prefill_chunk_size: int = 128            # tokens per prompt-chunk slot
 
     @property
     def chunk_budget(self) -> int:
         return self.max_ragged_batch_size - self.max_ragged_sequence_count
+
+    @property
+    def num_chunk_slots(self) -> int:
+        """Prompt-chunk slots per pass. Multi-slot is the prefill throughput
+        lever: one chunk per pass serialises N prompts on N pass dispatches
+        (host descriptor build + tunnel RTT each); with
+        chunk_budget // prefill_chunk_size slots they prefill together."""
+        return max(1, self.chunk_budget // max(1, self.prefill_chunk_size))
+
+    @property
+    def chunk_slot_size(self) -> int:
+        """Static tokens per slot (the last slot absorbs no remainder — the
+        pass shapes must be static across compiles)."""
+        return min(self.prefill_chunk_size, self.chunk_budget)
 
 
 @dataclass

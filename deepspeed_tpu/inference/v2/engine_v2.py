@@ -114,10 +114,10 @@ class InferenceEngineV2:
         self._rng = np.random.RandomState(cfg.seed)
         self._rng_key = jax.random.PRNGKey(cfg.seed)
         self._last_logits: Dict[int, np.ndarray] = {}
-        # device-resident logits refs: uid -> (device_array, row | None).
+        # device-resident logits refs: uid -> (device_array, row).
         # Materialised to numpy lazily (put()) or sampled on device without
         # ever shipping the [S, V] tensor to host (sample_next()).
-        self._last_ref: Dict[int, Tuple[Any, Optional[int]]] = {}
+        self._last_ref: Dict[int, Tuple[Any, int]] = {}
         # LRU-bounded compiled multistep programs: keyed by (n_steps, S,
         # do_sample, top_k); serving with many batch sizes must not accumulate
         # XLA executables without eviction (round S to buckets upstream when
@@ -192,7 +192,7 @@ class InferenceEngineV2:
         for arr, pairs in by_array.values():
             host = np.asarray(arr)
             for uid, row in pairs:
-                self._last_logits[uid] = host if row is None else host[row]
+                self._last_logits[uid] = host[row]
 
     def sample_next(self, uids: Sequence[int], do_sample: bool = False,
                     temperature: float = 1.0, top_k: int = 0) -> np.ndarray:
@@ -226,8 +226,6 @@ class InferenceEngineV2:
         n_done = 0
         for arr, pairs in by_array.values():
             rows = [r for _, r in pairs]
-            if rows[0] is None:
-                arr, rows = arr[None, :], [0]
             if do_sample:
                 self._rng_key, sub = jax.random.split(self._rng_key)
             else:
@@ -295,8 +293,11 @@ class InferenceEngineV2:
         self.kv.update(new_k, new_v)
         finished = self.scheduler.complete_pass(batch)
         for uid in finished:
-            if batch.chunk_uid == uid and batch.chunk_is_final:
-                self._last_ref[uid] = (chunk_logits, None)
+            if uid in batch.slot_uid:
+                # a prompt may span several slots; its next-token logits sit
+                # in the LAST slot it filled
+                row = len(batch.slot_uid) - 1 - batch.slot_uid[::-1].index(uid)
+                self._last_ref[uid] = (chunk_logits, row)
             else:
                 self._last_ref[uid] = (decode_logits,
                                        batch.decode_uids.index(uid))

@@ -8,9 +8,11 @@ feed ``jax.device_put`` / jit donation.
 
 Pass layout (static shapes; see ``ragged_model.py`` for how each section is used):
 
-  - **chunk section** (``chunk_budget`` rows): one sequence's prompt chunk —
-    Dynamic SplitFuse processes at most one prompt chunk per pass alongside all
-    ready decode tokens, so prefill never stalls token generation.
+  - **chunk section** (``num_slots`` slots of ``slot_size`` rows): several
+    sequences' prompt chunks prefill together in one pass — one chunk per pass
+    would serialise N prompts on N pass dispatches (host descriptor build +
+    transfer RTT each); Dynamic SplitFuse composes them with the ready decode
+    tokens so prefill never stalls token generation.
   - **decode section** (``max_sequences`` rows): one query token per sequence,
     served by the paged flash-decode kernel.
 """
@@ -18,7 +20,7 @@ Pass layout (static shapes; see ``ragged_model.py`` for how each section is used
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 import numpy as np
 
@@ -26,18 +28,24 @@ import numpy as np
 @dataclass
 class RaggedBatch:
     # static capacities
-    chunk_budget: int
+    num_slots: int                            # chunk slots per pass
+    slot_size: int                            # tokens per slot
     max_sequences: int
     max_blocks: int
 
-    # chunk section (one prompt chunk)
-    chunk_uid: Optional[int] = None
-    chunk_tokens: np.ndarray = None           # [C] int32
-    chunk_positions: np.ndarray = None        # [C] int32
-    chunk_num_tokens: int = 0
-    chunk_block_table: np.ndarray = None      # [MB] int32
-    chunk_ctx_len: int = 0                    # kv visible after this chunk
-    chunk_is_final: bool = False              # last chunk of prompt -> logits used
+    # chunk section (num_slots prompt chunks, slot-major rows). A sequence
+    # may span several consecutive slots in one pass: chunk_uids/_is_final
+    # are per SEQUENCE (scheduling order); slot_uid is per filled SLOT (the
+    # logits row for a finished prompt is its last slot).
+    chunk_uids: List[int] = field(default_factory=list)
+    slot_uid: List[int] = field(default_factory=list)
+    chunk_tokens: np.ndarray = None           # [NC * Cs] int32
+    chunk_positions: np.ndarray = None        # [NC * Cs] int32
+    chunk_ntok: np.ndarray = None             # [NC] int32 (0 = empty slot)
+    chunk_block_tables: np.ndarray = None     # [NC, MB] int32
+    chunk_q0: np.ndarray = None               # [NC] int32
+    chunk_ctx_lens: np.ndarray = None         # [NC] int32 (0 = empty slot)
+    chunk_is_final: List[bool] = field(default_factory=list)  # per filled slot
 
     # decode section
     decode_uids: List[int] = field(default_factory=list)
@@ -48,16 +56,23 @@ class RaggedBatch:
 
     # flat KV scatter destinations for every new token, chunk rows then decode
     # rows; padding rows hold the cache's OOB sentinel so the write drops them
-    kv_dest: np.ndarray = None                # [C + S] int32
+    kv_dest: np.ndarray = None                # [NC * Cs + S] int32
 
     def __post_init__(self):
-        C, S, MB = self.chunk_budget, self.max_sequences, self.max_blocks
+        NC, Cs = self.num_slots, self.slot_size
+        S, MB = self.max_sequences, self.max_blocks
         if self.chunk_tokens is None:
-            self.chunk_tokens = np.zeros((C,), np.int32)
+            self.chunk_tokens = np.zeros((NC * Cs,), np.int32)
         if self.chunk_positions is None:
-            self.chunk_positions = np.zeros((C,), np.int32)
-        if self.chunk_block_table is None:
-            self.chunk_block_table = np.zeros((MB,), np.int32)
+            self.chunk_positions = np.zeros((NC * Cs,), np.int32)
+        if self.chunk_ntok is None:
+            self.chunk_ntok = np.zeros((NC,), np.int32)
+        if self.chunk_block_tables is None:
+            self.chunk_block_tables = np.zeros((NC, MB), np.int32)
+        if self.chunk_q0 is None:
+            self.chunk_q0 = np.zeros((NC,), np.int32)
+        if self.chunk_ctx_lens is None:
+            self.chunk_ctx_lens = np.zeros((NC,), np.int32)
         if self.decode_tokens is None:
             self.decode_tokens = np.zeros((S,), np.int32)
         if self.decode_positions is None:
@@ -67,24 +82,25 @@ class RaggedBatch:
         if self.decode_ctx_lens is None:
             self.decode_ctx_lens = np.zeros((S,), np.int32)
         if self.kv_dest is None:
-            self.kv_dest = np.zeros((C + S,), np.int32)
+            self.kv_dest = np.zeros((NC * Cs + S,), np.int32)
 
     @property
     def current_tokens(self) -> int:
-        return self.chunk_num_tokens + len(self.decode_uids)
+        return int(self.chunk_ntok.sum()) + len(self.decode_uids)
 
     @property
     def current_sequences(self) -> int:
-        return (1 if self.chunk_uid is not None else 0) + len(self.decode_uids)
+        return len(self.chunk_uids) + len(self.decode_uids)
 
     def device_arrays(self) -> Dict[str, Any]:
         """The dict handed to the jitted pass (shapes static across passes)."""
         return {
             "chunk_tokens": self.chunk_tokens,
             "chunk_positions": self.chunk_positions,
-            "chunk_num_tokens": np.int32(self.chunk_num_tokens),
-            "chunk_block_table": self.chunk_block_table,
-            "chunk_ctx_len": np.int32(self.chunk_ctx_len),
+            "chunk_ntok": self.chunk_ntok,
+            "chunk_block_tables": self.chunk_block_tables,
+            "chunk_q0": self.chunk_q0,
+            "chunk_ctx_lens": self.chunk_ctx_lens,
             "decode_tokens": self.decode_tokens,
             "decode_positions": self.decode_positions,
             "decode_block_tables": self.decode_block_tables,

@@ -8,12 +8,14 @@ specialised per family by a :class:`RaggedModelSpec` (norm type, activation,
 rope/learned positions, parallel residual, MoE) and a weight *adapter* that
 re-keys the zoo model's param tree into the canonical stacked layout.
 
-Pass structure (see ``ragged/ragged_batch.py``): tokens = [prompt chunk | decode
-rows]. Each layer writes the pass's K/V into the paged cache (one flat scatter),
-then attends:
+Pass structure (see ``ragged/ragged_batch.py``): tokens = [NC prompt-chunk
+slots | decode rows]. Each layer writes the pass's K/V into the paged cache
+(one flat scatter), then attends:
 
-  - chunk rows  -> ``paged_chunk_attention`` (flash over pages, causal by position)
-  - decode rows -> ``paged_decode_attention`` (one token per sequence)
+  - chunk slots -> ``paged_chunk_attention_batched`` (flash over pages for all
+    slots in one kernel, causal by absolute position)
+  - decode rows -> ``paged_decode_attention`` (one token per sequence; the
+    fused multistep loop uses ``paged_decode_attention_step``)
 
 MoE layers use sort-based grouped GEMM (``jax.lax.ragged_dot`` when available) —
 the TPU analog of the reference's CUTLASS ``moe_gemm`` + moe_scatter/gather
@@ -30,7 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.ops.pallas.paged_attention import (
-    paged_chunk_attention, paged_decode_attention, paged_decode_attention_step)
+    paged_chunk_attention_batched, paged_decode_attention,
+    paged_decode_attention_step)
 
 
 @dataclass
@@ -485,7 +488,8 @@ def build_ragged_forward(spec: RaggedModelSpec,
                          mesh=None,
                          tp: int = 1) -> Callable:
     """Returns ``fwd(weights, k_pages, v_pages, batch) ->
-    (chunk_logits [V], decode_logits [S, V], new_k, new_v)``.
+    (chunk_logits [NC, V], decode_logits [S, V], new_k, new_v)`` where
+    ``chunk_logits[j]`` holds the logits after slot j's last token.
 
     k/v_pages: [L, NB, Hkv, bs, D] (head-major pages — see
     ragged/kv_cache.py). ``batch`` is RaggedBatch.device_arrays().
@@ -510,22 +514,25 @@ def build_ragged_forward(spec: RaggedModelSpec,
             return fn(q, k_l, v_l, bts, cls_)
         return paged_decode_attention(q, k_l, v_l, bts, cls_)
 
-    def _chunk_attn(q, k_l, v_l, bt, q0, ctx):
+    def _chunk_attn(q, k_l, v_l, bts, q0s, ctxs):
         if tp > 1:
             from jax import shard_map
             from jax.sharding import PartitionSpec as P
             from deepspeed_tpu.comm.mesh import TENSOR_AXIS
             fn = shard_map(
-                paged_chunk_attention, mesh=mesh,
-                in_specs=(P(None, TENSOR_AXIS, None),
+                paged_chunk_attention_batched, mesh=mesh,
+                in_specs=(P(None, None, TENSOR_AXIS, None),
                           P(None, TENSOR_AXIS, None, None),
-                          P(None, TENSOR_AXIS, None, None), P(None), P(), P()),
-                out_specs=P(None, TENSOR_AXIS, None), check_vma=False)
-            return fn(q, k_l, v_l, bt, q0, ctx)
-        return paged_chunk_attention(q, k_l, v_l, bt, q0, ctx)
+                          P(None, TENSOR_AXIS, None, None),
+                          P(None, None), P(None), P(None)),
+                out_specs=P(None, None, TENSOR_AXIS, None), check_vma=False)
+            return fn(q, k_l, v_l, bts, q0s, ctxs)
+        return paged_chunk_attention_batched(q, k_l, v_l, bts, q0s, ctxs)
 
     def fwd(weights, k_pages, v_pages, b):
-        C = b["chunk_tokens"].shape[0]
+        NC = b["chunk_ntok"].shape[0]
+        CT = b["chunk_tokens"].shape[0]
+        Cs = CT // NC
         S = b["decode_tokens"].shape[0]
         L, NB, bs = k_pages.shape[0], k_pages.shape[1], k_pages.shape[3]
         kp0 = k_pages.reshape(L * NB * Hkv * bs, D)  # flat rows (bitcast);
@@ -545,14 +552,14 @@ def build_ragged_forward(spec: RaggedModelSpec,
                     Hkv, bs)
                 k_l = kp_.reshape(L * NB, Hkv, bs, D)
                 v_l = vp_.reshape(L * NB, Hkv, bs, D)
-                q0 = b["chunk_positions"][0]
-                out_c = _chunk_attn(q[:C], k_l, v_l,
-                                    b["chunk_block_table"] + l * NB,
-                                    q0, b["chunk_ctx_len"])
-                out_d = _decode_attn(q[C:], k_l, v_l,
+                out_c = _chunk_attn(q[:CT].reshape(NC, Cs, H, D), k_l, v_l,
+                                    b["chunk_block_tables"] + l * NB,
+                                    b["chunk_q0"], b["chunk_ctx_lens"])
+                out_d = _decode_attn(q[CT:], k_l, v_l,
                                      b["decode_block_tables"] + l * NB,
                                      b["decode_ctx_lens"])
-                return jnp.concatenate([out_c, out_d], axis=0), kp_, vp_
+                return (jnp.concatenate([out_c.reshape(CT, H, D), out_d],
+                                        axis=0), kp_, vp_)
 
             x, (kp, vp) = _transformer_layer(spec, w, x, positions, attend)
             return (x, kp, vp), None
@@ -565,13 +572,13 @@ def build_ragged_forward(spec: RaggedModelSpec,
 
         x = _norm(x, weights["final_norm"], spec.norm, spec.eps, dtype,
                   spec.norm_plus_one)
-        # only 1 + S rows are ever read (parity: ragged_ops/logits_gather — the
-        # reference also gathers the needed rows before the unembed GEMM)
-        last = jnp.maximum(b["chunk_num_tokens"] - 1, 0)
-        chunk_row = jax.lax.dynamic_index_in_dim(x[:C], last, keepdims=True)
-        xs = jnp.concatenate([chunk_row, x[C:]], axis=0)       # [1 + S, hid]
+        # only NC + S rows are ever read (parity: ragged_ops/logits_gather —
+        # the reference also gathers the needed rows before the unembed GEMM)
+        last_rows = (jnp.arange(NC) * Cs
+                     + jnp.maximum(b["chunk_ntok"] - 1, 0))    # [NC]
+        xs = jnp.concatenate([x[last_rows], x[CT:]], axis=0)   # [NC + S, hid]
         logits = _unembed(spec, weights, xs)
-        return logits[0], logits[1:], new_k, new_v
+        return logits[:NC], logits[NC:], new_k, new_v
 
     return fwd
 

@@ -5,10 +5,12 @@ Parity: the FastGen scheduling policy (reference ``blogs/deepspeed-fastgen`` §
 ``inference/v2/engine_v2.py:153-227``): long prompts are decomposed into chunks
 processed across passes; short work is composed so every pass runs near the token
 budget. Each pass here = all ready decode tokens (one per active sequence, up to
-``max_ragged_sequence_count``) + at most one prompt chunk (up to ``chunk_budget``
-tokens) — the chunk's matmuls amortise the decode tokens' bandwidth, which is the
-SplitFuse win; attention splits per section (dense flash for the chunk, paged
-flash-decode for the rest) in ``ragged_model.py``.
+``max_ragged_sequence_count``) + up to ``num_chunk_slots`` prompt chunks of
+``chunk_slot_size`` tokens each — the chunks' matmuls amortise the decode tokens'
+bandwidth (the SplitFuse win), and multiple slots per pass keep prefill from
+serialising on per-pass dispatch costs; attention splits per section (batched
+chunked flash for the slots, paged flash-decode for the rest) in
+``ragged_model.py``.
 """
 
 from __future__ import annotations
@@ -121,10 +123,12 @@ class DynamicSplitFuseScheduler:
     def schedule_pass(self) -> Optional[RaggedBatch]:
         """Build the next pass, or None when no pending work exists."""
         cfg = self.config
-        C, S, MB = cfg.chunk_budget, cfg.max_ragged_sequence_count, self.max_blocks
+        NC, Cs = cfg.num_chunk_slots, cfg.chunk_slot_size
+        S, MB = cfg.max_ragged_sequence_count, self.max_blocks
         bs = self.cache.config.block_size
-        batch = RaggedBatch(chunk_budget=C, max_sequences=S, max_blocks=MB)
-        kv_dest = np.full((C + S,), self.cache.oob_sentinel, np.int32)
+        batch = RaggedBatch(num_slots=NC, slot_size=Cs, max_sequences=S,
+                            max_blocks=MB)
+        kv_dest = np.full((NC * Cs + S,), self.cache.oob_sentinel, np.int32)
 
         # decode rows: sequences holding exactly one pending token
         decode = [s for s in self.seqs.values()
@@ -138,32 +142,47 @@ class DynamicSplitFuseScheduler:
             batch.decode_positions[row] = pos
             batch.decode_block_tables[row] = seq.block_table(MB)
             batch.decode_ctx_lens[row] = pos + 1
-            kv_dest[C + row] = self.cache.flat_write_index(
+            kv_dest[NC * Cs + row] = self.cache.flat_write_index(
                 seq.blocks[pos // bs], pos % bs)
             seq.in_flight_tokens = 1
 
-        # one prompt chunk: longest pending first (prefer finishing prefills)
+        # prompt chunks, up to NC slots: longest pending first (prefer
+        # finishing prefills). A sequence may claim SEVERAL consecutive slots
+        # in one pass (its chunk KV is scattered before attention runs, so a
+        # later slot sees the earlier slots' tokens) — a lone long prompt
+        # then prefills at the full slot capacity per pass, not one slot.
         prompts = sorted((s for s in self.seqs.values()
                           if len(s.pending) > 1 or
                           (len(s.pending) == 1 and s.seen_tokens == 0
                            and s.uid not in batch.decode_uids)),
                          key=lambda s: -len(s.pending))
-        if prompts:
-            seq = prompts[0]
-            n = min(C, len(seq.pending))
-            self._ensure_blocks(seq, n)
-            positions = seq.seen_tokens + np.arange(n, dtype=np.int32)
-            batch.chunk_uid = seq.uid
-            batch.chunk_tokens[:n] = seq.pending[:n]
-            batch.chunk_positions[:n] = positions
-            batch.chunk_num_tokens = n
-            batch.chunk_block_table = seq.block_table(MB)
-            batch.chunk_ctx_len = seq.seen_tokens + n
-            batch.chunk_is_final = (n == len(seq.pending))
+        sl = 0
+        for seq in prompts:
+            if sl >= NC:
+                break
+            take = min(len(seq.pending), (NC - sl) * Cs)
+            self._ensure_blocks(seq, take)
             blocks = np.asarray(seq.blocks, np.int32)
-            kv_dest[:n] = self.cache.flat_write_index(
-                blocks[positions // bs], positions % bs)
-            seq.in_flight_tokens = n
+            batch.chunk_uids.append(seq.uid)
+            batch.chunk_is_final.append(take == len(seq.pending))
+            taken = 0
+            while taken < take:
+                n = min(Cs, take - taken)
+                q0 = seq.seen_tokens + taken
+                positions = q0 + np.arange(n, dtype=np.int32)
+                r0 = sl * Cs
+                batch.chunk_tokens[r0:r0 + n] = seq.pending[taken:taken + n]
+                batch.chunk_positions[r0:r0 + n] = positions
+                batch.chunk_ntok[sl] = n
+                batch.chunk_block_tables[sl] = seq.block_table(MB)
+                batch.chunk_q0[sl] = q0
+                batch.chunk_ctx_lens[sl] = q0 + n
+                kv_dest[r0:r0 + n] = self.cache.flat_write_index(
+                    blocks[positions // bs], positions % bs)
+                batch.slot_uid.append(seq.uid)
+                taken += n
+                sl += 1
+            seq.in_flight_tokens = take
 
         batch.kv_dest = kv_dest
         if batch.current_sequences == 0:
@@ -174,14 +193,14 @@ class DynamicSplitFuseScheduler:
         """Advance descriptors after the pass ran; returns uids whose *next-token
         logits* this pass produced (final prompt chunks + all decode rows)."""
         finished: List[int] = []
-        if batch.chunk_uid is not None:
-            seq = self.seqs[batch.chunk_uid]
+        for uid, is_final in zip(batch.chunk_uids, batch.chunk_is_final):
+            seq = self.seqs[uid]
             n = seq.in_flight_tokens
             seq.seen_tokens += n
             seq.pending = seq.pending[n:]
             seq.in_flight_tokens = 0
-            if batch.chunk_is_final:
-                finished.append(seq.uid)
+            if is_final:
+                finished.append(uid)
         for uid in batch.decode_uids:
             seq = self.seqs[uid]
             seq.seen_tokens += 1

@@ -519,12 +519,45 @@ def paged_decode_attention_step_reference(q, k_new, v_new, k_pages, v_pages,
     return out, kf, vf
 
 
-def _chunk_kernel(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
-                  acc_sc, m_sc, l_sc, *, scale, block_size, block_q,
-                  max_blocks, h_kv, groups):
-    iq, i = pl.program_id(0), pl.program_id(1)
-    q0 = meta_ref[0]
-    ctx = meta_ref[1]
+def paged_chunk_attention(q: jax.Array,
+                          k_pages: jax.Array,
+                          v_pages: jax.Array,
+                          block_table: jax.Array,
+                          q_start,
+                          ctx_len,
+                          softmax_scale: Optional[float] = None,
+                          block_q: int = 128) -> jax.Array:
+    """Prompt-chunk (prefill) flash attention over one sequence's paged KV.
+
+    The single-chunk convenience wrapper: one slot of
+    :func:`paged_chunk_attention_batched` (ONE kernel body — a masking or
+    softmax fix lands in both paths by construction).
+
+    q:           [C, H, D]
+    k/v_pages:   [NB, H_kv, bs, D] (head-major pages)
+    block_table: [MB] int32
+    q_start:     int32 — absolute position of q row 0
+    ctx_len:     int32 — KV tokens visible in total (= q_start + C for prefill)
+
+    Rows past the real chunk length are computed but meaningless (the caller
+    ignores them); with ctx_len == 0 the output is zeros.
+    """
+    return paged_chunk_attention_batched(
+        q[None], k_pages, v_pages, jnp.asarray(block_table)[None],
+        jnp.asarray(q_start, jnp.int32)[None],
+        jnp.asarray(ctx_len, jnp.int32)[None],
+        softmax_scale=softmax_scale, block_q=block_q)[0]
+
+
+def _chunk_kernel_batched(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
+                          acc_sc, m_sc, l_sc, *, scale, block_size, block_q,
+                          max_blocks, h_kv, groups):
+    """Multi-slot variant of ``_chunk_kernel``: grid (slot, q-block, page);
+    each slot is an independent prompt chunk with its own block table and
+    (q_start, ctx) row in ``meta_ref``. Slot padding (ctx 0) writes zeros."""
+    sl, iq, i = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    q0 = meta_ref[sl, 0]
+    ctx = meta_ref[sl, 1]
 
     @pl.when(i == 0)
     def _():
@@ -532,19 +565,18 @@ def _chunk_kernel(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
         l_sc[:] = jnp.zeros_like(l_sc)
         acc_sc[:] = jnp.zeros_like(acc_sc)
 
-    # causal skip: page starts past this q block's last visible position
-    run = (i * block_size <= q0 + iq * block_q + block_q - 1) & (i * block_size < ctx)
+    run = (i * block_size <= q0 + iq * block_q + block_q - 1) & \
+          (i * block_size < ctx)
 
     @pl.when(run)
     def _():
         bq, G, bs = block_q, groups, block_size
-        q = q_ref[:].astype(jnp.float32)                       # [bq, H, D]
+        q = q_ref[0].astype(jnp.float32)                       # [bq, H, D]
         q_pos = q0 + iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bs), 0)
         k_pos = i * bs + jax.lax.broadcasted_iota(jnp.int32, (bq, bs), 1)
-        mask = (k_pos <= q_pos) & (k_pos < ctx)                # [bq, bs]
+        mask = (k_pos <= q_pos) & (k_pos < ctx)
         mask = jnp.broadcast_to(mask[:, None, :], (bq, G, bs)).reshape(bq * G, bs)
 
-        # per kv head: the group's bq*G query rows share one page slice
         for h in range(h_kv):
             qh = q[:, h * G:(h + 1) * G, :].reshape(bq * G, -1)
             kh = k_ref[0, h].astype(jnp.float32)               # [bs, D]
@@ -557,10 +589,12 @@ def _chunk_kernel(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
             m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
             p = jnp.where(mask, jnp.exp(sc - m_new), 0.0)
             alpha = jnp.exp(m_prev - m_new)
-            l_sc[rows, 0:1] = l_sc[rows, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+            l_sc[rows, 0:1] = l_sc[rows, 0:1] * alpha + jnp.sum(p, axis=1,
+                                                               keepdims=True)
             m_sc[rows, 0:1] = m_new
             acc_sc[rows, :] = acc_sc[rows, :] * alpha + jax.lax.dot_general(
-                p, vh, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+                p, vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
 
     @pl.when(i == max_blocks - 1)
     def _():
@@ -569,60 +603,61 @@ def _chunk_kernel(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
         safe_l = jnp.where(l > 0.0, l, 1.0)
         o = acc_sc[:] / safe_l                                  # [Hkv*bq*G, D]
         o = o.reshape(h_kv, bq, G, -1)
-        o_ref[:] = jnp.moveaxis(o, 0, 1).reshape(bq, h_kv * G, -1).astype(o_ref.dtype)
+        o_ref[0] = jnp.moveaxis(o, 0, 1).reshape(bq, h_kv * G,
+                                                 -1).astype(o_ref.dtype)
 
 
-def paged_chunk_attention(q: jax.Array,
-                          k_pages: jax.Array,
-                          v_pages: jax.Array,
-                          block_table: jax.Array,
-                          q_start,
-                          ctx_len,
-                          softmax_scale: Optional[float] = None,
-                          block_q: int = 128) -> jax.Array:
-    """Prompt-chunk (prefill) flash attention over one sequence's paged KV.
+def paged_chunk_attention_batched(q: jax.Array,
+                                  k_pages: jax.Array,
+                                  v_pages: jax.Array,
+                                  block_tables: jax.Array,
+                                  q_starts: jax.Array,
+                                  ctx_lens: jax.Array,
+                                  softmax_scale: Optional[float] = None,
+                                  block_q: int = 128) -> jax.Array:
+    """Prefill flash attention for SEVERAL prompt chunks in one kernel.
 
-    The SplitFuse chunk side: ``q`` holds a contiguous chunk of one sequence's
-    prompt occupying absolute positions ``[q_start, q_start + C)``; its KV (and all
-    earlier context) is already written to the pages. Reads pages directly via the
-    scalar-prefetched block table — like the decode kernel, no per-sequence KV
-    gather copy — with flash online softmax across pages and causal masking by
-    absolute position.
+    Multi-chunk SplitFuse: a pass that carries one chunk per pallas call
+    serialises prefill on per-call fixed costs; with the slot in the grid,
+    N prompts' chunks prefill in one launch.
 
-    q:           [C, H, D]
-    k/v_pages:   [NB, H_kv, bs, D] (head-major pages)
-    block_table: [MB] int32
-    q_start:     int32 — absolute position of q row 0
-    ctx_len:     int32 — KV tokens visible in total (= q_start + C for prefill)
+    q:            [NC, Cs, H, D]  — slot-major chunk rows
+    k/v_pages:    [NB, H_kv, bs, D] (head-major pages)
+    block_tables: [NC, MB] int32
+    q_starts:     [NC] int32 — absolute position of each slot's row 0
+    ctx_lens:     [NC] int32 — KV tokens visible per slot (0 = empty slot)
 
-    Rows past the real chunk length are computed but meaningless (the caller
-    ignores them); with ctx_len == 0 the output is zeros.
+    Returns [NC, Cs, H, D]; empty slots return zeros.
     """
-    C, H, D = q.shape
+    NC, Cs, H, D = q.shape
     NB, Hkv, bs, _ = k_pages.shape
     assert H % Hkv == 0
     G = H // Hkv
-    MB = block_table.shape[0]
+    MB = block_tables.shape[1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
     bq = block_q
-    while C % bq != 0:
+    while Cs % bq != 0:
         bq //= 2
     bq = max(bq, 1)
-    nq = C // bq
+    nq = Cs // bq
 
-    meta = jnp.stack([jnp.asarray(q_start, jnp.int32),
-                      jnp.asarray(ctx_len, jnp.int32)])
-    kernel = functools.partial(_chunk_kernel, scale=scale, block_size=bs,
-                               block_q=bq, max_blocks=MB, h_kv=Hkv, groups=G)
+    meta = jnp.stack([jnp.asarray(q_starts, jnp.int32),
+                      jnp.asarray(ctx_lens, jnp.int32)], axis=1)   # [NC, 2]
+    kernel = functools.partial(_chunk_kernel_batched, scale=scale,
+                               block_size=bs, block_q=bq, max_blocks=MB,
+                               h_kv=Hkv, groups=G)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(nq, MB),
+        grid=(NC, nq, MB),
         in_specs=[
-            pl.BlockSpec((bq, H, D), lambda iq, i, bt, m: (iq, 0, 0)),
-            pl.BlockSpec((1, Hkv, bs, D), lambda iq, i, bt, m: (bt[i], 0, 0, 0)),
-            pl.BlockSpec((1, Hkv, bs, D), lambda iq, i, bt, m: (bt[i], 0, 0, 0)),
+            pl.BlockSpec((1, bq, H, D), lambda sl, iq, i, bt, m: (sl, iq, 0, 0)),
+            pl.BlockSpec((1, Hkv, bs, D),
+                         lambda sl, iq, i, bt, m: (bt[sl, i], 0, 0, 0)),
+            pl.BlockSpec((1, Hkv, bs, D),
+                         lambda sl, iq, i, bt, m: (bt[sl, i], 0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((bq, H, D), lambda iq, i, bt, m: (iq, 0, 0)),
+        out_specs=pl.BlockSpec((1, bq, H, D),
+                               lambda sl, iq, i, bt, m: (sl, iq, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((Hkv * bq * G, D), jnp.float32),
             pltpu.VMEM((Hkv * bq * G, 128), jnp.float32),
@@ -632,11 +667,23 @@ def paged_chunk_attention(q: jax.Array,
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((C, H, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((NC, Cs, H, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(block_table.astype(jnp.int32), meta, q, k_pages, v_pages)
+    )(block_tables.astype(jnp.int32), meta, q, k_pages, v_pages)
+
+
+def paged_chunk_attention_batched_reference(q, k_pages, v_pages, block_tables,
+                                            q_starts, ctx_lens,
+                                            softmax_scale: Optional[float] = None):
+    """jnp reference: per-slot single-chunk reference, stacked."""
+    outs = []
+    for sl in range(q.shape[0]):
+        outs.append(paged_chunk_attention_reference(
+            q[sl], k_pages, v_pages, block_tables[sl],
+            q_starts[sl], ctx_lens[sl], softmax_scale))
+    return jnp.stack(outs)
 
 
 def paged_chunk_attention_reference(q, k_pages, v_pages, block_table, q_start,

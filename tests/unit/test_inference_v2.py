@@ -67,7 +67,7 @@ class TestScheduler:
         sizes = []
         while sched.has_pending():
             b = sched.schedule_pass()
-            sizes.append(b.chunk_num_tokens)
+            sizes.append(int(b.chunk_ntok.sum()))
             done = sched.complete_pass(b)
         assert sizes == [8, 8, 4]
         assert done == [1]   # logits only after the final chunk
@@ -81,9 +81,52 @@ class TestScheduler:
         sched.add_tokens(2, np.arange(12, dtype=np.int32))    # prompt
         b = sched.schedule_pass()
         assert b.decode_uids == [1]
-        assert b.chunk_uid == 2 and b.chunk_num_tokens == 8
+        assert b.chunk_uids == [2] and int(b.chunk_ntok[0]) == 8
         done = sched.complete_pass(b)
         assert done == [1]
+
+    def test_multiple_prompts_prefill_in_one_pass(self):
+        # 3 prompts, chunk budget 16 with 8-token slots -> 2 slots per pass:
+        # pass 1 carries two prompts' chunks, pass 2 the third's
+        cfg = DSStateManagerConfig(
+            max_tracked_sequences=8, max_ragged_sequence_count=4,
+            max_ragged_batch_size=20, max_context=64, prefill_chunk_size=8)
+        assert cfg.num_chunk_slots == 2 and cfg.chunk_slot_size == 8
+        kv = BlockedKVCache(KVCacheConfig(num_layers=1, num_kv_heads=1,
+                                          head_dim=8, block_size=8,
+                                          num_blocks=16, dtype=jnp.float32))
+        sched = DynamicSplitFuseScheduler(cfg, kv, BlockedAllocator(16))
+        for uid in (1, 2, 3):
+            sched.add_tokens(uid, np.arange(8, dtype=np.int32))
+        b = sched.schedule_pass()
+        assert len(b.chunk_uids) == 2 and list(b.chunk_ntok[:2]) == [8, 8]
+        assert b.chunk_is_final == [True, True]
+        done = sched.complete_pass(b)
+        assert sorted(done) == sorted(b.chunk_uids)
+        b2 = sched.schedule_pass()
+        assert len(b2.chunk_uids) == 1
+        assert sched.complete_pass(b2) == b2.chunk_uids
+        assert not sched.has_pending()
+
+    def test_long_prompt_claims_multiple_slots(self):
+        # one 16-token prompt + 2 slots of 8 -> finishes in ONE pass (the
+        # single-slot-per-sequence rule would take two)
+        cfg = DSStateManagerConfig(
+            max_tracked_sequences=8, max_ragged_sequence_count=4,
+            max_ragged_batch_size=20, max_context=64, prefill_chunk_size=8)
+        kv = BlockedKVCache(KVCacheConfig(num_layers=1, num_kv_heads=1,
+                                          head_dim=8, block_size=8,
+                                          num_blocks=16, dtype=jnp.float32))
+        sched = DynamicSplitFuseScheduler(cfg, kv, BlockedAllocator(16))
+        sched.add_tokens(1, np.arange(16, dtype=np.int32))
+        b = sched.schedule_pass()
+        assert b.chunk_uids == [1] and b.slot_uid == [1, 1]
+        assert list(b.chunk_ntok) == [8, 8]
+        assert list(b.chunk_q0) == [0, 8]           # consecutive windows
+        assert list(b.chunk_ctx_lens) == [8, 16]    # later slot sees earlier
+        assert b.chunk_is_final == [True]
+        assert sched.complete_pass(b) == [1]
+        assert not sched.has_pending()
 
     def test_flush_recycles_blocks(self):
         sched, alloc = self._mk(block_size=8, num_blocks=16)

@@ -51,6 +51,28 @@ class TestPagedDecode:
                                    atol=2e-5, rtol=2e-5)
 
 
+class TestPagedChunkBatched:
+
+    def test_matches_per_slot_reference(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            paged_chunk_attention_batched, paged_chunk_attention_batched_reference)
+        rng = np.random.RandomState(11)
+        NC, Cs, H, Hkv, D, bs, MB = 4, 16, 8, 2, 64, 8, 6
+        NB = NC * MB + 2
+        k = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
+        v = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
+        q = jnp.asarray(rng.randn(NC, Cs, H, D), jnp.float32)
+        bt = jnp.asarray(rng.permutation(NB - 1)[:NC * MB].reshape(NC, MB) + 1,
+                         jnp.int32)
+        q0s = jnp.asarray([0, 13, 40, 0], jnp.int32)
+        ctxs = jnp.asarray([16, 29, 56, 0], jnp.int32)   # last slot empty
+        out = jax.jit(paged_chunk_attention_batched)(q, k, v, bt, q0s, ctxs)
+        ref = paged_chunk_attention_batched_reference(q, k, v, bt, q0s, ctxs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-4)
+        assert np.all(np.asarray(out)[3] == 0)
+
+
 class TestPagedDecodeStep:
     """Fused decode step: prior-context flash + inline current token + page
     write, pools aliased through. Edge cases: ctx 1 (no pages yet), page
