@@ -474,6 +474,64 @@ def bench_moe(on_tpu: bool) -> dict:
 
 
 # --------------------------------------------------------------------------- #
+# ZeRO-Offload overlap: delayed param update (DPU) vs synchronous host step
+# --------------------------------------------------------------------------- #
+
+def bench_offload(on_tpu: bool) -> dict:
+    """Step time with the host optimizer OVERLAPPED (delayed_param_update)
+    vs synchronous: sync ~= device + d2h + host, DPU ~= max(device,
+    d2h + host). Through the axon tunnel the host path is transfer-dominated,
+    so the observable saving is ~the device-compute time per step.
+    Parity: pipelined_optimizer_swapper.py:1 overlap + ZeRO-Offload DPU."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+    if on_tpu:
+        cfg = GPT2Config(vocab_size=50257, n_positions=512, n_embd=768,
+                         n_layer=12, n_head=12, dtype=jnp.bfloat16, remat=False)
+        bs, mb, seq, steps, warmup, ratio = 32, 8, 512, 4, 2, 0.05
+    else:
+        cfg = GPT2Config.tiny(dtype=jnp.float32)
+        bs, mb, seq, steps, warmup, ratio = 8, None, 32, 2, 1, 0.5
+    model = GPT2LMHead(cfg)
+
+    def make_batch(i):
+        rng = np.random.default_rng(3000 + i)
+        return {"input_ids": rng.integers(0, cfg.vocab_size,
+                                          size=(bs, seq)).astype(np.int32)}
+
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": make_batch(0)["input_ids"][:1]})["params"]
+
+    def run(delayed):
+        econf = _train_engine_cfg(bs, mb, bf16=bool(on_tpu), stage=1)
+        econf["zero_optimization"]["offload_optimizer"] = {
+            "device": "cpu", "ratio": ratio,
+            "delayed_param_update": delayed}
+        engine, *_ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params, config=econf)
+        for i in range(warmup):
+            float(engine.train_batch(make_batch(i)))
+        t0 = time.time()
+        for i in range(steps):
+            float(engine.train_batch(make_batch(warmup + i)))
+        engine._drain_offload()
+        dt = (time.time() - t0) / steps
+        engine.destroy()
+        return dt
+
+    sync_s = run(False)
+    import gc
+    gc.collect()
+    jax.clear_caches()
+    dpu_s = run(True)
+    log(f"offload: sync {sync_s:.2f}s/step vs overlapped {dpu_s:.2f}s/step "
+        f"({sync_s / dpu_s:.2f}x)")
+    return {"sync_step_s": round(sync_s, 3), "dpu_step_s": round(dpu_s, 3),
+            "overlap_speedup": round(sync_s / dpu_s, 3), "ratio": ratio}
+
+
+# --------------------------------------------------------------------------- #
 # Pallas kernel smoke grid (real-TPU lowering check vs jnp references)
 # --------------------------------------------------------------------------- #
 
@@ -689,7 +747,8 @@ def main():
     fast = os.environ.get("DSTPU_BENCH_FAST") == "1"
     for name, fn in (("llama_zero3", bench_llama_zero3),
                      ("kernels", bench_kernels), ("decode", bench_decode),
-                     ("moe", bench_moe), ("comm", bench_comm)):
+                     ("moe", bench_moe), ("offload", bench_offload),
+                     ("comm", bench_comm)):
         # Each phase builds its own model/engine; drop the previous phase's
         # device state (params, optimizer, KV pools) before the next one or
         # the 350M train state alone exhausts a v5e chip's HBM.

@@ -229,6 +229,12 @@ class OffloadOptimizerConfig(ConfigModel):
     pipeline_write: bool = False
     fast_init: bool = False
     ratio: float = 1.0
+    # Delayed Param Update (ZeRO-Offload paper §5, DeepSpeed's DPU): run the
+    # host optimizer for step N concurrently with device step N+1; host-flow
+    # params apply one step late. Trades exact SGD semantics (one-step
+    # staleness on the offloaded leaves) for step time ~= max(device, host)
+    # instead of device + transfer + host.
+    delayed_param_update: bool = False
 
 
 @dataclass

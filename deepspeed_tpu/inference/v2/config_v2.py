@@ -28,18 +28,23 @@ class DSStateManagerConfig:
         return self.max_ragged_batch_size - self.max_ragged_sequence_count
 
     @property
+    def chunk_slot_size(self) -> int:
+        """Static tokens per slot. Stays exactly ``prefill_chunk_size`` (a
+        user-aligned size, 128 by default): dividing the budget evenly
+        instead gives sizes like 147 whose q-block collapses to 1-row MXU
+        tiles in the batched prefill kernel."""
+        return min(self.prefill_chunk_size, max(1, self.chunk_budget))
+
+    @property
     def num_chunk_slots(self) -> int:
         """Prompt-chunk slots per pass. Multi-slot is the prefill throughput
         lever: one chunk per pass serialises N prompts on N pass dispatches
-        (host descriptor build + tunnel RTT each); with
-        chunk_budget // prefill_chunk_size slots they prefill together."""
-        return max(1, self.chunk_budget // max(1, self.prefill_chunk_size))
-
-    @property
-    def chunk_slot_size(self) -> int:
-        """Static tokens per slot (the last slot absorbs no remainder — the
-        pass shapes must be static across compiles)."""
-        return min(self.prefill_chunk_size, self.chunk_budget)
+        (host descriptor build + tunnel RTT each). The count rounds the
+        budget to the NEAREST slot multiple, so realized chunk capacity is
+        within half a slot of ``chunk_budget`` — flooring stranded up to a
+        slot's worth (96 of 736 tokens at the defaults)."""
+        cs = self.chunk_slot_size
+        return max(1, (self.chunk_budget + cs // 2) // cs)
 
 
 @dataclass

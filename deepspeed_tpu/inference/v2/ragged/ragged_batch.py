@@ -34,18 +34,18 @@ class RaggedBatch:
     max_blocks: int
 
     # chunk section (num_slots prompt chunks, slot-major rows). A sequence
-    # may span several consecutive slots in one pass: chunk_uids/_is_final
-    # are per SEQUENCE (scheduling order); slot_uid is per filled SLOT (the
-    # logits row for a finished prompt is its last slot).
-    chunk_uids: List[int] = field(default_factory=list)
-    slot_uid: List[int] = field(default_factory=list)
+    # may span several consecutive slots in one pass: chunk_uids and
+    # chunk_is_final are per SEQUENCE (scheduling order); slot_uid is per
+    # filled SLOT (the logits row for a finished prompt is its last slot).
+    chunk_uids: List[int] = field(default_factory=list)   # per sequence
+    slot_uid: List[int] = field(default_factory=list)     # per filled slot
     chunk_tokens: np.ndarray = None           # [NC * Cs] int32
     chunk_positions: np.ndarray = None        # [NC * Cs] int32
     chunk_ntok: np.ndarray = None             # [NC] int32 (0 = empty slot)
     chunk_block_tables: np.ndarray = None     # [NC, MB] int32
     chunk_q0: np.ndarray = None               # [NC] int32
     chunk_ctx_lens: np.ndarray = None         # [NC] int32 (0 = empty slot)
-    chunk_is_final: List[bool] = field(default_factory=list)  # per filled slot
+    chunk_is_final: List[bool] = field(default_factory=list)  # per sequence
 
     # decode section
     decode_uids: List[int] = field(default_factory=list)

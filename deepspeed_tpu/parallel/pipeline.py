@@ -71,6 +71,62 @@ def partition_uniform(n_layers: int, n_parts: int) -> List[int]:
     return [(i * n_layers) // n_parts for i in range(n_parts + 1)]
 
 
+def _pipeline_ticks(stage, compute, params, micros, carry0,
+                    n_micro: int, n_stages: int, axis_name: str,
+                    remat_ticks: bool):
+    """The shared GPipe fill/drain tick schedule (ONE implementation for the
+    homogeneous and heterogeneous pipelines — a schedule fix lands in both).
+
+    ``compute(params, x_mb, recv) -> out`` runs one stage on one microbatch:
+    stage 0 reads ``x_mb`` (its input-slice), later stages read ``recv``.
+    ``carry0`` fixes the inter-stage activation shape/dtype. Returns the
+    [n_micro, ...] buffer of last-stage outputs (garbage on other stages —
+    the caller masks + psums)."""
+    out_buf = jnp.zeros((n_micro,) + carry0.shape, carry0.dtype)
+    recv = carry0
+    fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+    total_ticks = n_micro + n_stages - 1
+
+    def tick(carry, t, params):
+        recv, out_buf = carry
+        mb_idx = t - stage
+        active = jnp.logical_and(mb_idx >= 0, mb_idx < n_micro)
+        safe_idx = jnp.clip(mb_idx, 0, n_micro - 1)
+        x_mb = lax.dynamic_index_in_dim(micros, safe_idx, 0, keepdims=False)
+        out = compute(params, x_mb, recv)
+        out = jnp.where(active, out, jnp.zeros_like(out))
+        # last stage stores its finished microbatch
+        store = jnp.logical_and(active, stage == n_stages - 1)
+        cur = lax.dynamic_slice_in_dim(out_buf, safe_idx, 1, 0)
+        out_buf = lax.dynamic_update_slice_in_dim(
+            out_buf, jnp.where(store, out[None], cur), safe_idx, 0)
+        # the final tick's send is never read (the carry's recv dies with
+        # the scan) — skip the inter-stage transfer on t == total_ticks-1
+        # instead of paying one dead ppermute per step. The predicate is
+        # the replicated tick index, so every stage takes the same branch.
+        if n_stages > 1:
+            recv = lax.cond(t == total_ticks - 1,
+                            lambda o: o,
+                            lambda o: lax.ppermute(o, axis_name, fwd_perm),
+                            out)
+        else:
+            recv = out
+        return (recv, out_buf)
+
+    if remat_ticks:
+        tick = jax.checkpoint(tick)
+
+    # lax.scan over ticks (not a Python loop): reverse-mode AD then runs
+    # one tick's backward — and, under remat_ticks, one tick's recompute —
+    # at a time, which is what actually bounds peak memory. An unrolled
+    # loop lets XLA overlap the recomputes and the bound is lost
+    # (measured on the v5e AOT topology; see test_pipeline_memory.py).
+    (recv, out_buf), _ = lax.scan(
+        lambda c, t: (tick(c, t, params), None),
+        (recv, out_buf), jnp.arange(total_ticks))
+    return out_buf
+
+
 def gpipe_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
                 stacked_params: Any,
                 x: jax.Array,
@@ -105,56 +161,20 @@ def gpipe_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
     def stage_body(local_params, x_full):
         stage = lax.axis_index(axis_name)
         micros = x_full.reshape((n_micro, mb) + x_full.shape[1:])
-        out_buf = jnp.zeros_like(micros)
-        recv = jnp.zeros((mb,) + x_full.shape[1:], x_full.dtype)
-        fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
-        total_ticks = n_micro + n_stages - 1
 
         # params are an EXPLICIT argument so jax.checkpoint can prune the tick
         # body's residuals (closure captures don't get residual-pruned)
-        def tick(carry, t, params):
-            recv, out_buf = carry
-            mb_idx = t - stage
-            active = jnp.logical_and(mb_idx >= 0, mb_idx < n_micro)
-            safe_idx = jnp.clip(mb_idx, 0, n_micro - 1)
-            inp = jnp.where(stage == 0,
-                            lax.dynamic_index_in_dim(micros, safe_idx, 0,
-                                                     keepdims=False),
-                            recv)
+        def compute(params, x_mb, recv):
+            inp = jnp.where(stage == 0, x_mb, recv)
 
             def scan_fn(h, lp):
                 return block_fn(lp, h), None
             out, _ = lax.scan(scan_fn, inp, params)
-            out = jnp.where(active, out, jnp.zeros_like(out))
-            # last stage stores its finished microbatch
-            store = jnp.logical_and(active, stage == n_stages - 1)
-            cur = lax.dynamic_slice_in_dim(out_buf, safe_idx, 1, 0)
-            out_buf = lax.dynamic_update_slice_in_dim(
-                out_buf, jnp.where(store, out[None], cur), safe_idx, 0)
-            # the final tick's send is never read (the carry's recv dies with
-            # the scan) — skip the inter-stage transfer on t == total_ticks-1
-            # instead of paying one dead ppermute per step. The predicate is
-            # the replicated tick index, so every stage takes the same branch.
-            if n_stages > 1:
-                recv = lax.cond(t == total_ticks - 1,
-                                lambda o: o,
-                                lambda o: lax.ppermute(o, axis_name, fwd_perm),
-                                out)
-            else:
-                recv = out
-            return (recv, out_buf)
+            return out
 
-        if remat_ticks:
-            tick = jax.checkpoint(tick)
-
-        # lax.scan over ticks (not a Python loop): reverse-mode AD then runs
-        # one tick's backward — and, under remat_ticks, one tick's recompute —
-        # at a time, which is what actually bounds peak memory. An unrolled
-        # loop lets XLA overlap the recomputes and the bound is lost
-        # (measured on the v5e AOT topology; see test_pipeline_memory.py).
-        (recv, out_buf), _ = lax.scan(
-            lambda c, t: (tick(c, t, local_params), None),
-            (recv, out_buf), jnp.arange(total_ticks))
+        carry0 = jnp.zeros((mb,) + x_full.shape[1:], x_full.dtype)
+        out_buf = _pipeline_ticks(stage, compute, local_params, micros, carry0,
+                                  n_micro, n_stages, axis_name, remat_ticks)
         # share final activations from the last stage with everyone (tiny psum —
         # keeps the output replicated so the loss/head runs outside the pipeline)
         out_full = out_buf.reshape(x_full.shape)
@@ -171,6 +191,145 @@ def gpipe_apply(block_fn: Callable[[Any, jax.Array], jax.Array],
     return f(stacked_params, x)
 
 
+def hetero_gpipe_apply(stage_fns: Sequence[Callable[[Any, jax.Array, jax.Array], jax.Array]],
+                       stage_params: Sequence[Any],
+                       x: jax.Array,
+                       n_micro: int,
+                       mesh=None,
+                       axis_name: str = PIPE_AXIS,
+                       remat_ticks: bool = False) -> jax.Array:
+    """GPipe over HETEROGENEOUS stages (arbitrary per-stage functions/params).
+
+    ``stage_fns[i](params_i, x_mb, recv)`` runs stage i on one microbatch:
+    stage 0 reads ``x_mb`` (its slice of the pipeline input — token ids or
+    embedded activations), later stages read ``recv`` (the previous stage's
+    output, a fixed [mb, ...] float carry). Every stage must emit the SAME
+    carry shape; the last stage's outputs are gathered (psum) and returned
+    stacked [B, ...].
+
+    TPU-native form of the reference's arbitrary ``LayerSpec`` lists
+    (runtime/pipe/module.py:86,130): stages with different structures can't
+    ride one stacked-and-sharded array, so each device selects its stage's
+    computation with ``lax.switch`` on ``axis_index('pipe')`` — the stage
+    params enter replicated across 'pipe' and stay shardable over fsdp /
+    tensor axes (at pipe x fsdp the entry gather is exactly ZeRO-3's
+    params-for-compute gather).
+    """
+    mesh = mesh or get_topology().mesh
+    n_stages = mesh.shape[axis_name]
+    assert len(stage_fns) == n_stages, \
+        f"{len(stage_fns)} stage fns for {n_stages} '{axis_name}' devices"
+    B = x.shape[0]
+    assert B % n_micro == 0, f"batch {B} not divisible by n_micro {n_micro}"
+    mb = B // n_micro
+
+    def stage_body(params, x_full, carry0):
+        stage = lax.axis_index(axis_name)
+        micros = x_full.reshape((n_micro, mb) + x_full.shape[1:])
+
+        def compute(params, x_mb, recv):
+            branches = [
+                (lambda p, xm, rc, i=i: stage_fns[i](p[i], xm, rc))
+                for i in range(n_stages)
+            ]
+            return lax.switch(stage, branches, params, x_mb, recv)
+
+        out_buf = _pipeline_ticks(stage, compute, params, micros, carry0,
+                                  n_micro, n_stages, axis_name, remat_ticks)
+        out_full = out_buf.reshape((B,) + carry0.shape[1:])
+        out_full = lax.psum(
+            jnp.where(stage == n_stages - 1, out_full, jnp.zeros_like(out_full)),
+            axis_name)
+        return out_full
+
+    f = shard_map(
+        stage_body, mesh=mesh,
+        in_specs=(P(), P(), P()),
+        out_specs=P(),
+        check_vma=False)
+    # the carry template fixes the inter-stage activation shape/dtype; run
+    # stage 0's fn once abstractly to derive it (stage 0 reads x_mb, so its
+    # recv argument may be abstractly None here)
+    carry_sds = jax.eval_shape(
+        lambda p, xm: stage_fns[0](p, xm, None),
+        stage_params[0],
+        jax.ShapeDtypeStruct((mb,) + x.shape[1:], x.dtype))
+    carry0 = jnp.zeros(carry_sds.shape, carry_sds.dtype)
+    return f(list(stage_params), x, carry0)
+
+
+class HeteroPipelineModule:
+    """Parity: ``PipelineModule`` with arbitrary ``LayerSpec`` lists
+    (runtime/pipe/module.py:86,130,370) — layers of DIFFERENT types
+    partitioned into pipeline stages by parameter count.
+
+    ``layers``: a list of flax modules (optionally with an embedding module
+    first — it lands on stage 0, the reference's embed-on-first-stage
+    layout). Stage boundaries come from :func:`partition_balanced` over each
+    layer's actual parameter count ('parameters') or layer index
+    ('uniform'). The head typically stays outside (tied to the embedding);
+    run the result through the engine like any model.
+    """
+
+    def __init__(self, layers: Sequence[Any], n_stages: int, n_micro: int = 1,
+                 partition_method: str = "parameters",
+                 remat_ticks: bool = False):
+        if partition_method not in ("uniform", "parameters"):
+            raise NotImplementedError(
+                f"partition_method='{partition_method}' not supported "
+                "(have: 'uniform', 'parameters')")
+        self.layers = list(layers)
+        self.n_stages = n_stages
+        self.n_micro = n_micro
+        self.partition_method = partition_method
+        self.remat_ticks = remat_ticks
+        self.bounds: Optional[List[int]] = None   # set at init()
+
+    def init(self, rng, sample_x):
+        """Init every layer, then cut stage bounds by parameter weight.
+        ``sample_x`` feeds layer 0; later layers see the previous output."""
+        params = []
+        x = sample_x
+        for i, layer in enumerate(self.layers):
+            rng, sub = jax.random.split(rng)
+            p = layer.init(sub, x)["params"]
+            params.append(p)
+            x = layer.apply({"params": p}, x)
+        if self.partition_method == "parameters":
+            weights = [sum(int(np.prod(np.shape(leaf)))
+                           for leaf in jax.tree_util.tree_leaves(p))
+                       for p in params]
+            self.bounds = partition_balanced(weights, self.n_stages)
+        else:
+            self.bounds = partition_uniform(len(self.layers), self.n_stages)
+        # per-stage param LISTS (ragged python structure — fine: stages are
+        # separate pytrees, not one stacked array; lists, not tuples, because
+        # the optimizer's tree-unzip helper treats tuples as leaves)
+        return {"params": [
+            list(params[self.bounds[i]:self.bounds[i + 1]])
+            for i in range(self.n_stages)]}
+
+    def _stage_fns(self):
+        bounds = self.bounds
+        assert bounds is not None, "call init() (or set .bounds) first"
+
+        def make(i):
+            layers = self.layers[bounds[i]:bounds[i + 1]]
+
+            def fn(stage_params, x_mb, recv):
+                h = x_mb if i == 0 else recv
+                for layer, p in zip(layers, stage_params):
+                    h = layer.apply({"params": p}, h)
+                return h
+            return fn
+        return [make(i) for i in range(self.n_stages)]
+
+    def __call__(self, stage_params, x, mesh=None):
+        p = stage_params["params"] if "params" in stage_params else stage_params
+        return hetero_gpipe_apply(self._stage_fns(), p, x, self.n_micro,
+                                  mesh=mesh, remat_ticks=self.remat_ticks)
+
+
 class PipelineModule:
     """Parity: ``PipelineModule`` (runtime/pipe/module.py:86) for homogeneous
     transformer stacks: embed/head run outside the pipeline region (replicated or
@@ -185,12 +344,13 @@ class PipelineModule:
                  remat_ticks: bool = False):
         # For a homogeneous block stack, 'uniform' and 'parameters' coincide
         # (equal per-layer weight): the stacked leading dim shards evenly over
-        # 'pipe'. Heterogeneous weighting needs per-stage layer lists — use
-        # partition_balanced() + explicit stage functions for that.
+        # 'pipe'. Heterogeneous layer lists go through HeteroPipelineModule,
+        # which consumes partition_balanced() over real param counts.
         if partition_method not in ("uniform", "parameters"):
             raise NotImplementedError(
                 f"partition_method='{partition_method}' not supported; homogeneous "
-                "stacks use 'uniform'/'parameters' (identical here)")
+                "stacks use 'uniform'/'parameters' (identical here); heterogeneous "
+                "layer lists use HeteroPipelineModule")
         self.block = block
         self.n_layers = n_layers
         self.n_micro = n_micro
@@ -210,6 +370,56 @@ class PipelineModule:
             lambda p, h: self.block.apply({"params": p}, h),
             stacked_params, x, self.n_micro, mesh=mesh,
             remat_ticks=self.remat_ticks)
+
+
+class HeteroPipelineLM:
+    """A causal LM over a HETEROGENEOUS layer list, engine-compatible.
+
+    ``layers[0]`` must map token ids -> hidden (the embedding lands on stage
+    0 with everything partition_balanced assigns there — the reference's
+    ``EmbeddingPipe``-on-first-stage layout, module.py:86); the untied LM
+    head stays outside the pipeline (replicated / TP-shardable). Train it
+    through ``deepspeed_tpu.initialize`` like any model::
+
+        lm = HeteroPipelineLM(vocab_size=V, layers=[Embed(), Big(), Small()],
+                              n_stages=2, n_micro=M)
+        params = lm.init(rng, batch)["params"]
+        engine, *_ = deepspeed_tpu.initialize(model=lm, model_parameters=params,
+                                              config={..., "mesh": {"pipe": P}})
+    """
+
+    def __init__(self, vocab_size: int, d_model: int, layers: Sequence[Any],
+                 n_stages: int, n_micro: int = 1,
+                 partition_method: str = "parameters",
+                 init_scale: float = 0.02, remat_ticks: bool = False):
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.pipe = HeteroPipelineModule(layers, n_stages, n_micro,
+                                         partition_method=partition_method,
+                                         remat_ticks=remat_ticks)
+        self.init_scale = init_scale
+
+    def init(self, rng, batch):
+        ids = jnp.asarray(batch["input_ids"] if isinstance(batch, dict) else batch)
+        k_head, k_stages = jax.random.split(rng)
+        stages = self.pipe.init(k_stages, ids[:1])["params"]
+        head = self.init_scale * jax.random.normal(
+            k_head, (self.vocab_size, self.d_model), jnp.float32)
+        return {"params": {"stages": stages, "head": head}}
+
+    def apply(self, variables, batch, rngs=None, mesh=None):
+        p = variables["params"] if "params" in variables else variables
+        ids = jnp.asarray(batch["input_ids"] if isinstance(batch, dict) else batch)
+        labels = batch.get("labels", ids) if isinstance(batch, dict) else ids
+        h = self.pipe(p["stages"], ids, mesh=mesh)
+        from deepspeed_tpu.models.llama import chunked_causal_lm_loss
+        return chunked_causal_lm_loss(h, p["head"], labels)
+
+    def param_specs(self, params):
+        """Replicated over 'pipe' (heterogeneous stage trees can't ride one
+        sharded axis); leaves remain shardable over fsdp by the engine."""
+        p = params["params"] if "params" in params else params
+        return jax.tree_util.tree_map(lambda _: P(), p)
 
 
 class PipelineLM:

@@ -130,6 +130,29 @@ def test_nvme_offload_trains_and_swaps(tmp_path):
     eng.destroy()
 
 
+def test_delayed_param_update_trains_and_drains():
+    """ZeRO-Offload DPU: host step N overlaps device step N+1 (host-flow
+    leaves one step stale). Training still converges; after the final drain
+    every pending update has landed (checkpoint state == sync-mode layout)."""
+    model, batches = _model_and_batches(steps=8)
+    _, base_losses = _run(model, batches, _config(offload={"device": "cpu"}))
+    eng, dpu_losses = _run(model, batches, _config(offload={
+        "device": "cpu", "delayed_param_update": True}))
+    assert eng._offload_pending is not None     # overlap actually in flight
+    # close to the sync trajectory (one-step staleness, not divergence) and
+    # clearly training
+    assert dpu_losses[-1] < dpu_losses[0]
+    np.testing.assert_allclose(dpu_losses[-1], base_losses[-1], rtol=0.05)
+    # drain + checkpoint view must include the delayed update
+    st = eng._offload_ckpt_state()
+    assert eng._offload_pending is None
+    host_master, _ = eng._offload.state_leaves()
+    for k, v in host_master.items():
+        np.testing.assert_array_equal(st["master"][k], v)
+    eng.destroy()
+    assert eng._offload_executor is None
+
+
 def test_twin_flow_ratio_splits_leaves():
     from deepspeed_tpu.runtime.zero.offload import partition_leaves
     leaves = {"a": np.zeros(100), "b": np.zeros(1000), "c": np.zeros(10)}
