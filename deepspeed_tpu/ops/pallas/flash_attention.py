@@ -334,6 +334,20 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+    # Ragged T LARGER than the block: the divisor-halving block picker
+    # degrades hard there (e.g. T=1032 at block 1024 halves all the way to
+    # 8-row q-tiles — MXU-starved; T <= block_q always gets one full-length
+    # tile and needs nothing). For causal SELF-attention, pad T to the next
+    # 128-multiple instead (<= 12% extra rows, >= 128-row tiles): padded KEYS
+    # sit at k_idx >= T > q_idx of every real row, so the existing causal
+    # mask drops them with no kernel change, and padded QUERY rows are
+    # sliced off. (pad/slice are differentiable, so the custom-vjp backward
+    # sees the padded shapes too.)
+    T_out = T
+    if causal and T == k.shape[1] and T > block_q and T % 128 != 0:
+        T2 = -(-T // 128) * 128
+        pad = [(0, 0), (0, T2 - T), (0, 0), (0, 0)]
+        q, k, v = (jnp.pad(t, pad) for t in (q, k, v))
     q, k, v = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))  # -> [B, H, T, D]
     out = _flash(q, k, v, scale, causal, block_q, block_k)
-    return jnp.swapaxes(out, 1, 2)
+    return jnp.swapaxes(out, 1, 2)[:, :T_out]

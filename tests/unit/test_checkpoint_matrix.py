@@ -51,7 +51,8 @@ def _dense_engine(stage, mesh, *, offload=None, dtype=jnp.float32, gas=1, bs=8):
 def _moe_engine(stage, mesh_cfg):
     topo = set_topology(build_topology(MeshConfig(**mesh_cfg)))
     model = MixtralForCausalLM(MixtralConfig.tiny(vocab_size=VOCAB,
-                                                  num_local_experts=2))
+                                                  num_local_experts=2,
+                                                  num_hidden_layers=1))
     params = model.init(jax.random.PRNGKey(1), _batch(2))["params"]
     engine, *_ = deepspeed_tpu.initialize(
         model=model, model_parameters=params, mesh_topology=topo,

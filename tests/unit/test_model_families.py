@@ -30,11 +30,23 @@ def _make(family):
     return cfg, model, params
 
 
-def _dense_greedy(model, params, prompt, n):
+def _forward_fn(model):
+    """ONE jitted fixed-width forward per model (hoisted out of the greedy
+    loop so its jit cache survives across prompts)."""
+    return jax.jit(lambda p, x: model.apply({"params": p}, x,
+                                            method=DecoderLM.forward_logits))
+
+
+def _dense_greedy(fl, params, prompt, n, width=16):
+    """Greedy reference at a FIXED padded width: growing the sequence by one
+    token per step would recompile forward_logits at every length (8 XLA
+    compiles per family, the old cost of this file); causal attention makes
+    the logits at position len-1 independent of the zero-padding after it."""
     ids = list(prompt)
     for _ in range(n):
-        lg = model.apply({"params": params}, jnp.asarray([ids], jnp.int32),
-                         method=DecoderLM.forward_logits)
+        x = np.zeros((1, width), np.int32)
+        x[0, :len(ids)] = ids
+        lg = fl(params, jnp.asarray(x))
         ids.append(int(jnp.argmax(lg[0, len(ids) - 1])))
     return ids
 
@@ -78,7 +90,8 @@ class TestDecoderFamilies:
     def test_v2_ragged_matches_dense(self, family):
         cfg, model, params = _make(family)
         prompts = [[5, 7, 11, 13, 2, 9], [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]]
-        ref = [_dense_greedy(model, params, p, 4) for p in prompts]
+        fl = _forward_fn(model)
+        ref = [_dense_greedy(fl, params, p, 4) for p in prompts]
         eng = InferenceEngineV2(model=model,
                                 config=RaggedInferenceEngineConfig.load(dict(V2_CONFIG)),
                                 model_parameters=params)

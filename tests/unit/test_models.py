@@ -184,7 +184,7 @@ class TestMixtral:
         from deepspeed_tpu.comm.mesh import build_topology, set_topology
         from deepspeed_tpu.config import MeshConfig
 
-        cfg = MixtralConfig.tiny()
+        cfg = MixtralConfig.tiny(num_hidden_layers=1)
         model = MixtralForCausalLM(cfg)
         ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 16))
         topo = set_topology(build_topology(MeshConfig(expert=2, fsdp=2, data=2),

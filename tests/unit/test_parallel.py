@@ -505,7 +505,8 @@ def test_sequence_parallel_llama_training_matches_serial(eight_devices):
 
     def run(seq_parallel):
         mesh = {"seq": 2, "data": 4} if seq_parallel else {"data": 8}
-        cfg = LlamaConfig.tiny(sequence_parallel=seq_parallel)
+        cfg = LlamaConfig.tiny(sequence_parallel=seq_parallel,
+                               num_hidden_layers=1)
         model = LlamaForCausalLM(cfg)
         params = model.init(jax.random.PRNGKey(0), batches[0])["params"]
         engine, *_ = deepspeed_tpu.initialize(
@@ -637,7 +638,8 @@ def test_sequence_parallel_decoder_matches_serial(eight_devices, family):
 
     def run(sp):
         mesh = {"seq": 2, "data": 4} if sp else {"data": 8}
-        cfg = DecoderConfig.tiny(family, sequence_parallel=sp)
+        cfg = DecoderConfig.tiny(family, sequence_parallel=sp,
+                                 num_hidden_layers=1)
         model = DecoderLM(cfg)
         params = model.init(jax.random.PRNGKey(2), batches[0])["params"]
         engine, *_ = deepspeed_tpu.initialize(
@@ -699,7 +701,8 @@ def test_sequence_parallel_composes_with_expert_parallel(eight_devices):
     def run(sp):
         mesh = ({"seq": 2, "expert": 2, "data": 2} if sp
                 else {"expert": 2, "data": 4})
-        cfg = MixtralConfig.tiny(num_local_experts=2, sequence_parallel=sp)
+        cfg = MixtralConfig.tiny(num_local_experts=2, sequence_parallel=sp,
+                                 num_hidden_layers=1)
         model = MixtralForCausalLM(cfg)
         params = model.init(jax.random.PRNGKey(5), batches[0])["params"]
         engine, *_ = deepspeed_tpu.initialize(

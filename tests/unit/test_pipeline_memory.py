@@ -50,7 +50,7 @@ def _tpu_pipe_mesh():
 
 
 def _compiled_temp_bytes(n_micro: int, remat_ticks: bool, mesh,
-                         width=512, n_layers=8, B=64, S=128) -> int:
+                         width=256, n_layers=6, B=32, S=128) -> int:
     """Temp bytes of loss+grad through gpipe_apply alone (no LM head — the
     residual store of the block stack is the quantity under test)."""
     from jax.sharding import NamedSharding
@@ -83,8 +83,9 @@ def test_remat_ticks_bounds_memory_in_n_micro():
     real TPU compiler: remat_ticks + scan-over-ticks holds <= one tick's
     residuals (the 1F1B residency bound — stored bytes DROP as n_micro grows,
     like P*B/M), while plain GPipe-through-AD keeps every microbatch's stack
-    residuals. Measured v5e AOT (width 512, L=8, B=64, S=128):
-    plain {4: 1110, 16: 748} MB vs remat {4: 245, 16: 52} MB."""
+    residuals. Measured v5e AOT at the original width-512/L8/B64 shapes:
+    plain {4: 1110, 16: 748} MB vs remat {4: 245, 16: 52} MB; the test runs
+    half-size shapes (same relative bounds, cheaper remote-AOT compiles)."""
     mesh = _tpu_pipe_mesh()
     # 3 AOT compiles (not 4): plain@16 anchors the full-residual cost; the
     # remat pair pins both claims. (These compile via the remote AOT path,
@@ -142,14 +143,29 @@ def test_tied_embedding_grads_sum_across_stages(eight_devices):
         return chunked_causal_lm_loss(h, wte_head, ids)
 
     wte, stack = params["wte"], params["stack"]
-    g_tied = jax.grad(lambda w: loss_split(w, w, stack))(wte)
-    g_embed = jax.grad(lambda w: loss_split(w, wte, stack))(wte)
-    g_head = jax.grad(lambda w: loss_split(wte, w, stack))(wte)
+    # ONE pipeline backward compile for both use-site grads (the old three
+    # separate jax.grad closures each paid a shard_map+scan compile, ~35 s
+    # of suite time); the tied grad to compare against comes from a SERIAL
+    # model — cheap to compile and a stronger oracle than re-running AD on
+    # the same pipeline.
+    g_embed, g_head = jax.jit(jax.grad(loss_split, argnums=(0, 1)))(
+        wte, wte, stack)
+
+    def loss_serial(w):
+        ids = jnp.asarray(batch["input_ids"])
+        h = w[ids]
+        for i in range(lm.pipe.n_layers):
+            p_i = jax.tree_util.tree_map(lambda t: t[i], stack)
+            h = lm.pipe.block.apply({"params": p_i}, h)
+        from deepspeed_tpu.models.llama import chunked_causal_lm_loss
+        return chunked_causal_lm_loss(h, w, ids)
+
+    g_tied_serial = jax.jit(jax.grad(loss_serial))(wte)
 
     # both tie points contribute a real (nonzero) gradient...
     assert float(jnp.abs(g_embed).max()) > 0
     assert float(jnp.abs(g_head).max()) > 0
-    # ...and the tied grad is exactly their sum
-    np.testing.assert_allclose(np.asarray(g_tied),
-                               np.asarray(g_embed) + np.asarray(g_head),
-                               rtol=1e-5, atol=1e-6)
+    # ...and their sum equals the serial tied-weight gradient
+    np.testing.assert_allclose(np.asarray(g_embed) + np.asarray(g_head),
+                               np.asarray(g_tied_serial),
+                               rtol=1e-4, atol=1e-5)

@@ -136,10 +136,10 @@ def test_qwz_training_tracks_fp(eight_devices):
     # larger embd so weight leaves clear QWZ_MIN_SIZE and actually quantize
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
     model = GPT2LMHead(GPT2Config(vocab_size=64, n_positions=16, n_embd=64,
-                                  n_layer=2, n_head=2, dtype=jnp.bfloat16))
+                                  n_layer=1, n_head=2, dtype=jnp.bfloat16))
     rng = np.random.default_rng(0)
     batches = [{"input_ids": rng.integers(0, 64, (8, 16)).astype(np.int32)}
-               for _ in range(8)]
+               for _ in range(5)]
     base_cfg = {
         "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
         "bf16": {"enabled": True},
@@ -161,7 +161,7 @@ def test_qwz_training_tracks_fp(eight_devices):
 def test_qwz_checkpoint_roundtrip(eight_devices, tmp_path):
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
     model = GPT2LMHead(GPT2Config(vocab_size=64, n_positions=16, n_embd=64,
-                                  n_layer=2, n_head=2, dtype=jnp.bfloat16))
+                                  n_layer=1, n_head=2, dtype=jnp.bfloat16))
     rng = np.random.default_rng(0)
     batches = [{"input_ids": rng.integers(0, 64, (8, 16)).astype(np.int32)}
                for _ in range(4)]
