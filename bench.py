@@ -633,6 +633,29 @@ def bench_kernels(on_tpu: bool) -> dict:
     assert err < 2e-2, f"paged chunk mismatch {err:.4f}"
     results["paged_chunk"] = round(err, 5)
 
+    # fused Evoformer pair-bias attention (triangle-attention shape) incl.
+    # the pair-bias gradient the dedicated accumulation kernel produces
+    from deepspeed_tpu.ops.evoformer import evoformer_attention
+    from deepspeed_tpu.ops.pallas.evoformer_attention import (
+        evoformer_flash_attention)
+    G, R, Se, He, De = 1, 64, 64, 4, 32
+    Le = G * R
+    qe = mk(Le, Se, He, De, k=120)
+    ke = mk(Le, Se, He, De, k=121)
+    ve = mk(Le, Se, He, De, k=122)
+    pe = mk(G, He, Se, Se, k=123)
+    oe = evoformer_flash_attention(qe, ke, ve, pe, rows_per_group=R)
+    oe_ref = evoformer_attention(
+        qe.reshape(G, R, Se, He, De), ke.reshape(G, R, Se, He, De),
+        ve.reshape(G, R, Se, He, De), [pe[:, None]]).reshape(Le, Se, He, De)
+    err = float(jnp.max(jnp.abs(oe.astype(jnp.float32)
+                                - oe_ref.astype(jnp.float32))))
+    gp = jax.grad(lambda p: jnp.sum(evoformer_flash_attention(
+        qe, ke, ve, p, rows_per_group=R).astype(jnp.float32) ** 2))(pe)
+    assert err < 2e-2, f"evoformer fwd mismatch {err:.4f}"
+    assert bool(jnp.isfinite(gp).all()), "evoformer d(pair_bias) non-finite"
+    results["evoformer_pair_bias"] = round(err, 5)
+
     # block-sparse attention (bigbird-style mixed layout) vs dense masked ref
     T, blk = 512, 64
     nb = T // blk

@@ -41,10 +41,47 @@ def evoformer_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return jnp.einsum("...hqk,...khd->...qhd", probs.astype(q.dtype), v)
 
 
-def DS4Sci_EvoformerAttention(Q, K, V, biases: List[Optional[jax.Array]]):
-    """Reference-shaped entry point (evoformer_attn.py DS4Sci_EvoformerAttention)."""
+def DS4Sci_EvoformerAttention(Q, K, V, biases: List[Optional[jax.Array]],
+                              fused: Optional[bool] = None):
+    """Reference-shaped entry point (evoformer_attn.py DS4Sci_EvoformerAttention).
+
+    Routes to the fused Pallas flash kernel
+    (``ops/pallas/evoformer_attention``) when the shapes match the published
+    layouts — Q/K/V ``[B, N, S, H, D]``, bias1 ``[B, N, 1, 1, S]`` (per-row
+    additive key mask), bias2 ``[B, 1, H, S, S]`` (pair bias) — and falls
+    back to the jnp reference for anything more exotic.
+
+    ``fused``: the fused kernel treats bias1 as a NON-trainable constant
+    (zero cotangent — it is a padding mask in every published use). So the
+    default (None) auto-fuses only when that cannot matter (bias1 absent);
+    pass ``fused=True`` to accept the mask-is-constant contract with bias1
+    present, or ``fused=False`` to force the jnp reference (full autodiff
+    for both biases).
+    """
     if len(biases) > 2:
         raise ValueError("DS4Sci_EvoformerAttention takes at most 2 biases")
+    bias1 = biases[0] if len(biases) >= 1 else None
+    bias2 = biases[1] if len(biases) >= 2 else None
+    fusable = Q.ndim == 5 and K.shape == Q.shape and V.shape == Q.shape
+    if fusable:
+        B, N, S, H, D = Q.shape
+        fusable = (bias2 is not None and bias2.shape == (B, 1, H, S, S)
+                   and (bias1 is None or bias1.shape == (B, N, 1, 1, S)))
+    if fused is None:
+        fused = fusable and bias1 is None
+    if fused:
+        if not fusable:
+            raise ValueError(
+                "fused=True but the shapes don't match the fused kernel's "
+                f"layouts: Q {Q.shape}, biases "
+                f"{[None if b is None else b.shape for b in biases]}")
+        from deepspeed_tpu.ops.pallas.evoformer_attention import (
+            evoformer_flash_attention)
+        fold = lambda t: t.reshape(B * N, S, H, D)
+        mask = None if bias1 is None else bias1.reshape(B * N, S)
+        out = evoformer_flash_attention(fold(Q), fold(K), fold(V),
+                                        bias2[:, 0], mask, rows_per_group=N)
+        return out.reshape(B, N, S, H, D)
     return evoformer_attention(Q, K, V, biases)
 
 
