@@ -353,8 +353,12 @@ class TestEngineV2:
                                 config=RaggedInferenceEngineConfig.load(dict(V2_CONFIG)),
                                 model_parameters=params)
         out = eng.generate([PROMPTS[0]], max_new_tokens=4)
+        # fixed-width greedy reference: one compile instead of one per length
+        fl = jax.jit(lambda p, x: model.apply({"params": p}, x))
         ids = list(PROMPTS[0])
         for _ in range(4):
-            lg = model.apply({"params": params}, jnp.asarray([ids], jnp.int32))
+            x = np.zeros((1, 16), np.int32)
+            x[0, :len(ids)] = ids
+            lg = fl(params, jnp.asarray(x))
             ids.append(int(jnp.argmax(lg[0, len(ids) - 1])))
         assert out[0] == ids
