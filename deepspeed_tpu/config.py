@@ -627,6 +627,12 @@ class DeepSpeedTPUConfig(ConfigModel):
     # raw dict, parsed by deepspeed_tpu.compression (dict-schema like the reference)
     compression_training: Optional[Dict[str, Any]] = None
 
+    # Extra XLA compile options for the jitted train step (merged OVER the
+    # ZeRO-bucket-derived combiner thresholds; TPU backend only). The config-
+    # driven analog of the reference's env-var XLA/NCCL tuning surface — lets
+    # a user pin e.g. {"xla_tpu_scoped_vmem_limit_kib": 65536} per run.
+    xla_compile_options: Dict[str, Any] = field(default_factory=dict)
+
     _migrations = {"fp16_enabled": ("fp16", lambda v: {"enabled": bool(v)})}
 
     # ------------------------------------------------------------------ #

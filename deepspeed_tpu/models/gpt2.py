@@ -46,6 +46,10 @@ class GPT2Config:
     # two all-to-alls on the 'seq' mesh axis; no-op when the mesh has no seq
     # axis. Requires n_head and T divisible by the seq axis size.
     sequence_parallel: bool = False
+    # rows per chunk in the fused projection+CE loss (llama.py
+    # chunked_causal_lm_loss). The head GEMM's M dim is chunk*(T-1): larger
+    # chunks raise MXU efficiency, smaller bound the [chunk, T, V] transient.
+    lm_loss_chunk: int = 4
 
     @classmethod
     def small(cls, **kw):
@@ -156,4 +160,5 @@ class GPT2LMHead(nn.Module):
         # fused chunked projection+CE: the [B, T, V] logits never materialise
         # (see models/llama.py chunked_causal_lm_loss)
         from deepspeed_tpu.models.llama import chunked_causal_lm_loss
-        return chunked_causal_lm_loss(x, self.wte.embedding, labels)
+        return chunked_causal_lm_loss(x, self.wte.embedding, labels,
+                                      batch_chunk=cfg.lm_loss_chunk)

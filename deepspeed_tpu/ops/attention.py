@@ -52,13 +52,27 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                           softmax_scale: Optional[float] = None) -> jax.Array:
     """[B, T, H, D] attention. Routes to the Pallas flash kernel on TPU."""
     if _use_pallas() and bias is None and q.shape[1] >= FLASH_MIN_SEQ:
-        try:
-            from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
-            return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids,
-                                   softmax_scale=softmax_scale)
-        except Exception as e:  # pragma: no cover - fall back if kernel unavailable
-            from deepspeed_tpu.utils.logging import warning_once
-            warning_once(f"pallas flash attention unavailable, using jnp fallback: {e}")
+        for attempt in range(3):
+            try:
+                from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+                return flash_attention(q, k, v, causal=causal,
+                                       segment_ids=segment_ids,
+                                       softmax_scale=softmax_scale)
+            except Exception as e:  # pragma: no cover - kernel unavailable
+                # Transient tunnel/compile-service errors (axon remote-compile
+                # flakes) must not silently bake the slow dense path into a
+                # traced step — retry those before falling back. Deterministic
+                # failures (ImportError, Mosaic compile errors) fall back
+                # immediately, preserving the dense-path escape hatch.
+                from deepspeed_tpu.utils.errors import is_transient_error
+                if is_transient_error(e) and attempt < 2:
+                    import time
+                    time.sleep(1.0 + attempt)
+                    continue
+                from deepspeed_tpu.utils.logging import warning_once
+                warning_once(
+                    f"pallas flash attention unavailable, using jnp fallback: {e}")
+                break
     return reference_attention(q, k, v, causal=causal, bias=bias,
                                segment_ids=segment_ids, softmax_scale=softmax_scale)
 

@@ -826,10 +826,15 @@ class DeepSpeedTPUEngine:
         if backend != "tpu":
             return None
         z = self.config.zero_optimization
-        if z.stage < 1:
-            return None
-        from deepspeed_tpu.runtime.zero.partition import xla_bucket_flags
-        return xla_bucket_flags(z.reduce_bucket_size, z.allgather_bucket_size)
+        opts = {}
+        if z.stage >= 1:
+            from deepspeed_tpu.runtime.zero.partition import xla_bucket_flags
+            opts.update(xla_bucket_flags(z.reduce_bucket_size,
+                                         z.allgather_bucket_size))
+        # user-pinned compile options win over the derived ones
+        opts.update({k: str(v) for k, v in
+                     self.config.xla_compile_options.items()})
+        return opts or None
 
     def train_batch(self, batch=None, data_iter=None):
         """One full training step over a global batch (parity:

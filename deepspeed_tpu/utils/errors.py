@@ -1,0 +1,31 @@
+"""Error classification shared by retry paths.
+
+The axon remote-compile tunnel surfaces transient transport failures as
+runtime errors (observed: "INTERNAL: http://127.0.0.1:.../remote_compile:
+read body: response body closed before all bytes were read"). Retrying those
+is correct; retrying deterministic compiler errors (which are ALSO spelled
+"INTERNAL: Mosaic failed ...") just adds sleep latency to every trace — so
+the match is on the tunnel-specific signatures, not the generic status prefix.
+"""
+
+from __future__ import annotations
+
+_TRANSIENT_SIGNATURES = (
+    # matched case-insensitively: OS errors capitalize ("Connection reset by
+    # peer", "Broken pipe") while grpc statuses upcase ("UNAVAILABLE")
+    "remote_compile",
+    "read body",
+    "response body closed",
+    "connection reset",
+    "connection refused",
+    "broken pipe",
+    "unavailable",
+    "deadline_exceeded",
+)
+
+
+def is_transient_error(exc: BaseException) -> bool:
+    """True when ``exc`` looks like a transient tunnel/transport flake worth
+    retrying (vs a deterministic compile/runtime error that never will)."""
+    msg = str(exc).lower()
+    return any(s in msg for s in _TRANSIENT_SIGNATURES)

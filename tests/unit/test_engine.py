@@ -259,3 +259,20 @@ def test_bucket_sizes_reach_compiler_options(eight_devices):
     assert engine._compiler_options(backend="cpu") is None
     # and the real (CPU) path still compiles + runs with options gated off
     assert np.isfinite(float(engine.train_batch(make_batch(8))))
+
+
+def test_user_xla_compile_options_merge_over_bucket_flags(eight_devices):
+    """``xla_compile_options`` reaches the step's compile options (stringified)
+    and wins over the bucket-derived thresholds; works at stage 0 too."""
+    engine = make_engine(stage=2, extra={
+        "zero_optimization": {"stage": 2, "allgather_bucket_size": 33_000_000},
+        "xla_compile_options": {
+            "xla_tpu_scoped_vmem_limit_kib": 65536,
+            "xla_gpu_all_gather_combine_threshold_bytes": 11}})
+    opts = engine._compiler_options(backend="tpu")
+    assert opts["xla_tpu_scoped_vmem_limit_kib"] == "65536"
+    assert opts["xla_gpu_all_gather_combine_threshold_bytes"] == "11"
+    s0 = make_engine(stage=0, extra={
+        "xla_compile_options": {"xla_tpu_scoped_vmem_limit_kib": 1024}})
+    assert s0._compiler_options(backend="tpu") == {
+        "xla_tpu_scoped_vmem_limit_kib": "1024"}
