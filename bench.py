@@ -438,7 +438,14 @@ def bench_decode(on_tpu: bool) -> dict:
         #     modern-serving number (decode is KV-read bound).
         import gc
         for key, kvh, nseq in (("mha64_decode_tokens_per_sec", heads, 64),
-                               ("gqa_decode_tokens_per_sec", 4, 64)):
+                               ("gqa_decode_tokens_per_sec", 4, 64),
+                               # decode is weight-read bound at these batch
+                               # sizes, so throughput scales with seqs until
+                               # KV reads take over: measured GQA 10.5k @ 64
+                               # -> 18.3k @ 128 (v5e-1). The 128-seq leg is
+                               # the FastGen-style "big continuous batch"
+                               # operating point.
+                               ("gqa128_decode_tokens_per_sec", 4, 128)):
             gc.collect()
             try:
                 tput, _, _ = measure(kvh, nseq, False)

@@ -33,7 +33,7 @@ jax.config.update("jax_threefry_partitionable", True)
 from deepspeed_tpu.utils.compile_cache import setup_compile_cache  # noqa: E402
 
 setup_compile_cache(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                    min_compile_time_secs=1.0)
+                    min_compile_time_secs=0.5)
 
 
 @pytest.fixture(autouse=True)
