@@ -157,6 +157,13 @@ class DynamicSplitFuseScheduler:
                            and s.uid not in batch.decode_uids)),
                          key=lambda s: -len(s.pending))
         sl = 0
+        from_zero = True   # every chunk sequence starts at position 0?
+        # page-granular write plan (pure-prefill fast path; see RaggedBatch)
+        PW = NC * Cs // bs + NC
+        batch.page_ids = np.full((PW,), self.cache.config.num_blocks, np.int32)
+        batch.page_rows = np.zeros((PW,), np.int32)
+        batch.page_fill = np.zeros((PW,), np.int32)
+        pw = 0
         for seq in prompts:
             if sl >= NC:
                 break
@@ -165,6 +172,17 @@ class DynamicSplitFuseScheduler:
             blocks = np.asarray(seq.blocks, np.int32)
             batch.chunk_uids.append(seq.uid)
             batch.chunk_is_final.append(take == len(seq.pending))
+            if seq.seen_tokens > 0:
+                from_zero = False
+            else:
+                # from position 0, tokens fill pages in order: one plan entry
+                # per touched page, rows contiguous from this seq's first row
+                r0_seq = sl * Cs
+                for p in range(-(-take // bs)):
+                    batch.page_ids[pw] = blocks[p]
+                    batch.page_rows[pw] = r0_seq + p * bs
+                    batch.page_fill[pw] = min(bs, take - p * bs)
+                    pw += 1
             taken = 0
             while taken < take:
                 n = min(Cs, take - taken)
@@ -177,6 +195,7 @@ class DynamicSplitFuseScheduler:
                 batch.chunk_block_tables[sl] = seq.block_table(MB)
                 batch.chunk_q0[sl] = q0
                 batch.chunk_ctx_lens[sl] = q0 + n
+                batch.row_seg[r0:r0 + n] = len(batch.chunk_uids) - 1
                 kv_dest[r0:r0 + n] = self.cache.flat_write_index(
                     blocks[positions // bs], positions % bs)
                 batch.slot_uid.append(seq.uid)
@@ -185,6 +204,8 @@ class DynamicSplitFuseScheduler:
             seq.in_flight_tokens = take
 
         batch.kv_dest = kv_dest
+        batch.pure_prefill = (not batch.decode_uids and bool(batch.chunk_uids)
+                              and from_zero)
         if batch.current_sequences == 0:
             return None
         return batch

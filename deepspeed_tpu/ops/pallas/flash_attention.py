@@ -136,6 +136,139 @@ def _fwd(q, k, v, scale: float, causal: bool,
 
 
 # --------------------------------------------------------------------------- #
+# packed (ragged prefill) forward: rows from MANY sequences concatenated
+# --------------------------------------------------------------------------- #
+
+
+def _fwd_kernel_packed(segq_ref, segk_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                       acc_sc, m_sc, l_sc, *, scale, block_q, block_k, nk):
+    """Flash forward over PACKED rows: causal by global row index AND masked to
+    same-segment pairs. Row order within a segment must be position order
+    (true for ragged prefill batches: the scheduler fills slots in position
+    order, multi-slot prompts take consecutive slots), so row-index causality
+    equals position causality and cross-segment pairs are masked out."""
+    iq, ik = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _():
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    # packed rows are globally causal by row index (see docstring)
+    should_run = ik * block_k <= iq * block_q + block_q - 1
+
+    @pl.when(should_run)
+    def _():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_idx = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_idx = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        seg_q = segq_ref[0, :].reshape(-1, 1)          # [bq, 1]
+        seg_k = segk_ref[0, :].reshape(1, -1)          # [1, bk]
+        mask = (q_idx >= k_idx) & (seg_q == seg_k)
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_sc[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_sc[:, 0:1] = l_sc[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_sc[:, 0:1] = m_new
+        acc_sc[:] = acc_sc[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _():
+        l = l_sc[:, 0:1]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0, 0, :, :] = (acc_sc[:] / safe_l).astype(o_ref.dtype)
+        lse = m_sc[:, 0:1] + jnp.log(safe_l)
+        lse_ref[0, 0, :, :] = jnp.where(l > 0.0, lse, NEG_INF)
+
+
+def flash_attention_packed(q: jax.Array, k: jax.Array, v: jax.Array,
+                           segment_ids: jax.Array,
+                           softmax_scale: Optional[float] = None,
+                           block_q: int = 512, block_k: int = 512,
+                           with_lse: bool = False):
+    """Packed ragged-prefill flash attention (inference fast path; fwd only).
+
+    q [R, H, D]; k/v [R, Hkv, D] (GQA kv repeated in here); segment_ids [R]
+    int32 — rows attend only same-segment rows at <= their own row index.
+    Padding rows should carry segment -1 (they then attend only other padding,
+    and their output is never read). Returns [R, H, D] (plus lse [R, H] fp32
+    when ``with_lse`` — the hook for merging with paged prior-context
+    attention).
+
+    Parity role: the reference's ragged blocked_flash prefill kernels
+    (``inference/v2/kernels/ragged_ops/blocked_flash``) — here the in-pass
+    tokens attend each other DENSELY on the MXU instead of through per-slot
+    paged reads (measured 13 ms/layer paged-chunk vs ~1 ms packed at
+    32x128 rows, v5e-1).
+    """
+    R, H, D = q.shape
+    Hkv = k.shape[1]
+    assert H % Hkv == 0
+    rep = H // Hkv
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+    if R % 128 != 0:
+        # Mosaic wants tile-aligned row blocks regardless of R's magnitude
+        R2 = -(-R // 128) * 128
+        q = jnp.pad(q, ((0, R2 - R), (0, 0), (0, 0)))
+        k = jnp.pad(k, ((0, R2 - R), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, R2 - R), (0, 0), (0, 0)))
+        segment_ids = jnp.pad(segment_ids, ((0, R2 - R),), constant_values=-1)
+    Rp = q.shape[0]
+    bq = _pick_block(Rp, block_q)
+    bk = _pick_block(Rp, block_k)
+    nq, nk = Rp // bq, Rp // bk
+
+    qT = jnp.swapaxes(q, 0, 1)[None]   # [1, H, Rp, D]
+    kT = jnp.swapaxes(k, 0, 1)[None]   # [1, Hkv, Rp, D] — GQA via index map
+    vT = jnp.swapaxes(v, 0, 1)[None]
+    seg = segment_ids.astype(jnp.int32)[None]   # [1, Rp]
+
+    kernel = functools.partial(_fwd_kernel_packed, scale=scale,
+                               block_q=bq, block_k=bk, nk=nk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq), lambda h, iq, ik: (0, iq)),   # seg (q side)
+            pl.BlockSpec((1, bk), lambda h, iq, ik: (0, ik)),   # seg (k side)
+            pl.BlockSpec((1, 1, bq, D), lambda h, iq, ik: (0, h, iq, 0)),
+            # GQA: kv head = q head // rep, no materialised repeat
+            pl.BlockSpec((1, 1, bk, D), lambda h, iq, ik: (0, h // rep, ik, 0)),
+            pl.BlockSpec((1, 1, bk, D), lambda h, iq, ik: (0, h // rep, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda h, iq, ik: (0, h, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda h, iq, ik: (0, h, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, H, Rp, D), q.dtype),
+            jax.ShapeDtypeStruct((1, H, Rp, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(seg, seg, qT, kT, vT)
+    out = jnp.swapaxes(o[0], 0, 1)[:R]
+    if with_lse:
+        return out, jnp.swapaxes(lse[0, :, :, 0], 0, 1)[:R]
+    return out
+
+
+# --------------------------------------------------------------------------- #
 # backward
 # --------------------------------------------------------------------------- #
 

@@ -154,3 +154,69 @@ class TestPagedChunk:
         ref = reference_attention(q[None], kd[None], vd[None], causal=True)[0]
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
+
+
+class TestPackedFlash:
+    """flash_attention_packed: the prefill-from-zero fast path's kernel
+    (segment-masked packed flash; ragged_model.build_prefill_forward)."""
+
+    @pytest.mark.parametrize("Hkv", [4, 2])
+    def test_matches_per_segment_reference(self, Hkv):
+        from deepspeed_tpu.ops.attention import reference_attention
+        from deepspeed_tpu.ops.pallas.flash_attention import (
+            flash_attention_packed)
+        rng = np.random.RandomState(3)
+        H, D = 4, 32
+        lens = [7, 19, 3, 33]
+        R = sum(lens)
+        seg = np.concatenate([np.full(n, i, np.int32)
+                              for i, n in enumerate(lens)])
+        q = jnp.asarray(rng.randn(R, H, D), jnp.float32)
+        k = jnp.asarray(rng.randn(R, Hkv, D), jnp.float32)
+        v = jnp.asarray(rng.randn(R, Hkv, D), jnp.float32)
+        out, lse = flash_attention_packed(q, k, v, jnp.asarray(seg),
+                                          with_lse=True)
+        rep = H // Hkv
+        r0 = 0
+        for n in lens:
+            sl = slice(r0, r0 + n)
+            ref = reference_attention(
+                q[None, sl], jnp.repeat(k[None, sl], rep, 2),
+                jnp.repeat(v[None, sl], rep, 2), causal=True)[0]
+            np.testing.assert_allclose(np.asarray(out[sl]), np.asarray(ref),
+                                       atol=2e-5)
+            r0 += n
+        assert bool(jnp.isfinite(lse).all())
+
+    def test_padding_rows_are_isolated(self):
+        """Rows with segment -1 (slot padding) must not leak into real rows."""
+        from deepspeed_tpu.ops.attention import reference_attention
+        from deepspeed_tpu.ops.pallas.flash_attention import (
+            flash_attention_packed)
+        rng = np.random.RandomState(4)
+        H, D = 2, 16
+        # real rows 0..9 (segment 0), pad rows 10..15 (segment -1) with huge
+        # values that would visibly corrupt the output if attended
+        seg = np.asarray([0] * 10 + [-1] * 6, np.int32)
+        q = jnp.asarray(rng.randn(16, H, D), jnp.float32)
+        k = jnp.asarray(rng.randn(16, H, D), jnp.float32).at[10:].set(100.0)
+        v = jnp.asarray(rng.randn(16, H, D), jnp.float32).at[10:].set(1e6)
+        out = flash_attention_packed(q, k, v, jnp.asarray(seg))
+        ref = reference_attention(q[None, :10], k[None, :10], v[None, :10],
+                                  causal=True)[0]
+        np.testing.assert_allclose(np.asarray(out[:10]), np.asarray(ref),
+                                   atol=2e-5)
+        assert bool(jnp.isfinite(out).all())
+
+    def test_jit_and_nondivisible_rows(self):
+        from deepspeed_tpu.ops.pallas.flash_attention import (
+            flash_attention_packed)
+        rng = np.random.RandomState(5)
+        R, H, D = 200, 2, 32   # R > 128 and not a multiple of 128 -> pads
+        seg = np.repeat([0, 1], 100).astype(np.int32)
+        q = jnp.asarray(rng.randn(R, H, D), jnp.float32)
+        k = jnp.asarray(rng.randn(R, H, D), jnp.float32)
+        o1 = flash_attention_packed(q, k, k, jnp.asarray(seg))
+        o2 = jax.jit(flash_attention_packed)(q, k, k, jnp.asarray(seg))
+        assert o1.shape == (R, H, D)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-6)
