@@ -202,13 +202,32 @@ class InferenceEngineV2:
         fetching only the token ids (4 bytes/seq instead of the [S, V] logits
         tensor — through a remote tunnel or PCIe this is the difference between
         transfer-bound and compute-bound decode)."""
-        return np.asarray(self._sample_device([int(u) for u in uids],
-                                              do_sample, temperature, top_k))
+        padded, n = self._sample_device_padded([int(u) for u in uids],
+                                               do_sample, temperature, top_k)
+        # slice AFTER the host fetch: a device-side [:n] would compile a new
+        # tiny executable for every distinct live-sequence count
+        return np.asarray(padded)[:n]
 
     def _sample_device(self, uids: Sequence[int], do_sample: bool,
                        temperature: float, top_k: int):
         """Sample next tokens on device, returning a device array aligned with
-        ``uids`` (no host fetch)."""
+        ``uids`` (no host fetch). Prefer :meth:`_sample_device_padded` where a
+        padded result is acceptable — the exact-length slice here compiles one
+        tiny program per distinct ``len(uids)``."""
+        padded, n = self._sample_device_padded(uids, do_sample, temperature,
+                                               top_k)
+        return padded[:n]
+
+    def _sample_device_padded(self, uids: Sequence[int], do_sample: bool,
+                              temperature: float, top_k: int):
+        """Like :meth:`_sample_device` but returns ``(padded_ids, n)`` where
+        ``padded_ids`` has a power-of-two length >= n: every device program in
+        here is then keyed by the BUCKET size, so a serving loop whose live
+        set shrinks by one each retirement reuses cached executables instead
+        of recompiling per count (~seconds each through a remote-compile
+        tunnel; measured 5 s/iteration in benchmarks/serving_bench.py)."""
+        if not uids:
+            return jnp.zeros((1,), jnp.int32), 0
         order = np.empty(len(uids), np.int64)
         parts = []
         by_array: Dict[int, Tuple[Any, list]] = {}
@@ -232,14 +251,28 @@ class InferenceEngineV2:
                 self._rng_key, sub = jax.random.split(self._rng_key)
             else:
                 sub = self._rng_key
-            parts.append(_dev_sample(arr, np.asarray(rows, np.int32), sub,
-                                     bool(do_sample), int(top_k),
-                                     float(temperature)))
+            # pad the row set to the next power of two: a serving loop calls
+            # this with a DIFFERENT number of live sequences every time a
+            # sequence retires, and each distinct length would recompile
+            # _dev_sample (~seconds through a remote-compile tunnel; measured
+            # 5 s/iteration in benchmarks/serving_bench.py). Extra rows
+            # resample row 0 and are sliced off.
+            n_real = len(rows)
+            n_pad = 1 << (n_real - 1).bit_length() if n_real > 1 else 1
+            rows = rows + [rows[0]] * (n_pad - n_real)
+            out = _dev_sample(arr, np.asarray(rows, np.int32), sub,
+                              bool(do_sample), int(top_k),
+                              float(temperature))
+            parts.append(out)                 # padded; real rows are [:n_real]
             for j, (i, _) in enumerate(pairs):
                 order[i] = n_done + j
-            n_done += len(pairs)
+            n_done += len(out)                # padded offsets
         flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-        return flat[jnp.asarray(order, jnp.int32)].astype(jnp.int32)
+        # pad the reorder gather to the bucket size too (same reasoning)
+        n = len(uids)
+        n_pad = 1 << (n - 1).bit_length() if n > 1 else 1
+        order_pad = np.concatenate([order, np.zeros(n_pad - n, np.int64)])
+        return flat[jnp.asarray(order_pad, jnp.int32)].astype(jnp.int32), n
 
     def decode_steps(self, uids: Sequence[int], n_steps: int,
                      do_sample: bool = False, temperature: float = 1.0,
