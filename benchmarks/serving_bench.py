@@ -36,7 +36,8 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_engine(on_tpu: bool, seqs: int, prompt: int, gen: int):
+def build_engine(on_tpu: bool, seqs: int, prompt: int, gen: int,
+                 burst: int = 8):
     import jax
     import jax.numpy as jnp
     from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
@@ -46,7 +47,8 @@ def build_engine(on_tpu: bool, seqs: int, prompt: int, gen: int):
         layers, hidden, heads, vocab = 12, 1536, 12, 32000
     else:
         layers, hidden, heads, vocab = 2, 64, 4, 256
-    ctx = prompt + gen + 64
+    # slack covers the waste margin (4*burst) + one burst overshoot
+    ctx = prompt + gen + 6 * burst
     cfg = LlamaConfig(vocab_size=vocab, hidden_size=hidden,
                       intermediate_size=hidden * 4, num_hidden_layers=layers,
                       num_attention_heads=heads, num_key_value_heads=heads,
@@ -224,6 +226,9 @@ def main():
     ap.add_argument("--gen", type=int, default=64)
     ap.add_argument("--rates", default="2,6")
     ap.add_argument("--duration", type=float, default=20.0)
+    ap.add_argument("--burst", type=int, default=8,
+                    help="fused decode tokens per host round trip (raise "
+                         "over high-RTT links; must divide the ctx slack)")
     args = ap.parse_args()
 
     import jax
@@ -231,15 +236,16 @@ def main():
     from deepspeed_tpu.utils.compile_cache import setup_compile_cache
     setup_compile_cache(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    engine, vocab = build_engine(on_tpu, args.seqs, args.prompt, args.gen)
+    engine, vocab = build_engine(on_tpu, args.seqs, args.prompt, args.gen,
+                                 burst=args.burst)
     rng = np.random.RandomState(0)
     # warm run compiles every pass shape (prefill, mixed, fused burst)
     run_load_point(engine, vocab, rate=50.0, seqs=args.seqs,
                    prompt=args.prompt, gen=max(8, args.gen // 4),
-                   duration=8.0, rng=rng)
+                   duration=8.0, rng=rng, burst=args.burst)
     for rate in [float(r) for r in args.rates.split(",")]:
         out = run_load_point(engine, vocab, rate, args.seqs, args.prompt,
-                             args.gen, args.duration, rng)
+                             args.gen, args.duration, rng, burst=args.burst)
         print(json.dumps(out), flush=True)
 
 
