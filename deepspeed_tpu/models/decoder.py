@@ -83,6 +83,8 @@ class DecoderConfig:
     # local-window layers (both need a bias the SP path doesn't carry).
     sequence_parallel: bool = False
     eps: float = 1e-5
+    # fused projection+CE chunk rows (llama.py chunked_causal_lm_loss)
+    lm_loss_chunk: int = 4
     dtype: Any = jnp.float32
     remat: bool = False
     remat_policy: Optional[str] = None
@@ -486,9 +488,11 @@ class DecoderLM(nn.Module):
         hb = self.lm_head_bias if cfg.head_bias else None
         if cfg.tied_lm_head:
             return chunked_causal_lm_loss(x, self.embed.embedding, labels,
-                                          head_bias=hb)
+                                          head_bias=hb,
+                                          batch_chunk=cfg.lm_loss_chunk)
         return chunked_causal_lm_loss(x, self.lm_head, labels, transpose=True,
-                                      head_bias=hb)
+                                      head_bias=hb,
+                                      batch_chunk=cfg.lm_loss_chunk)
 
     def decode(self, input_ids, cache, cache_index, positions=None):
         cfg = self.config

@@ -62,6 +62,10 @@ class LlamaConfig:
     # when head counts can't divide the seq axis. Mutually exclusive with
     # sequence_parallel.
     context_parallel: bool = False
+    # rows per chunk in the fused projection+CE loss (chunked_causal_lm_loss):
+    # larger chunks raise head-GEMM MXU efficiency, smaller bound the
+    # [chunk, T, V] fp32 transient
+    lm_loss_chunk: int = 4
     dtype: Any = jnp.float32
     remat: bool = False
     remat_policy: Optional[str] = None
@@ -456,7 +460,8 @@ class LlamaForCausalLM(nn.Module):
         # materialise (chunked_causal_lm_loss)
         _ = self.lm_head(x[:, :1])
         kernel = self.lm_head.variables["params"]["kernel"]
-        return chunked_causal_lm_loss(x, kernel, labels, transpose=True)
+        return chunked_causal_lm_loss(x, kernel, labels, transpose=True,
+                                      batch_chunk=self.config.lm_loss_chunk)
 
     def decode(self, input_ids, cache, cache_index, positions=None):
         """One incremental step (prefill or single-token decode).

@@ -185,7 +185,8 @@ class MixtralForCausalLM(nn.Module):
         _ = self.lm_head(x[:, :1])
         kernel = self.lm_head.variables["params"]["kernel"]
         from deepspeed_tpu.models.llama import chunked_causal_lm_loss
-        loss = chunked_causal_lm_loss(x, kernel, labels, transpose=True)
+        loss = chunked_causal_lm_loss(x, kernel, labels, transpose=True,
+                                      batch_chunk=self.config.lm_loss_chunk)
         cfg = self.config
         return loss + cfg.router_aux_loss_coef * aux_total / cfg.num_hidden_layers
 
