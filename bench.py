@@ -454,10 +454,11 @@ def bench_decode(on_tpu: bool) -> dict:
                                # decode is weight-read bound at these batch
                                # sizes, so throughput scales with seqs until
                                # KV reads take over: measured GQA 10.5k @ 64
-                               # -> 18.3k @ 128 (v5e-1). The 128-seq leg is
-                               # the FastGen-style "big continuous batch"
-                               # operating point.
-                               ("gqa128_decode_tokens_per_sec", 4, 128)):
+                               # -> 18.3k @ 128 -> 20.5k @ 256 (v5e-1). The
+                               # big-batch legs are the FastGen-style
+                               # continuous-batch operating points.
+                               ("gqa128_decode_tokens_per_sec", 4, 128),
+                               ("gqa256_decode_tokens_per_sec", 4, 256)):
             gc.collect()
             try:
                 tput, _, _ = measure(kvh, nseq, False)
