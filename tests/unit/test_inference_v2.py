@@ -128,6 +128,59 @@ class TestScheduler:
         assert sched.complete_pass(b) == [1]
         assert not sched.has_pending()
 
+
+    def test_page_plan_consistent_with_kv_dest(self):
+        """The page-granular write plan (pure-prefill fast path) must cover
+        exactly the same (page, slot) destinations as the row-level kv_dest,
+        with contiguous chunk rows per plan entry."""
+        cfg = DSStateManagerConfig(
+            max_tracked_sequences=8, max_ragged_sequence_count=4,
+            max_ragged_batch_size=40, max_context=64, prefill_chunk_size=8)
+        bs, nb = 8, 16
+        kv = BlockedKVCache(KVCacheConfig(num_layers=1, num_kv_heads=1,
+                                          head_dim=8, block_size=bs,
+                                          num_blocks=nb, dtype=jnp.float32))
+        sched = DynamicSplitFuseScheduler(cfg, kv, BlockedAllocator(nb))
+        # 11- and 5-token fresh prompts: one full + one partial page each
+        sched.add_tokens(1, np.arange(11, dtype=np.int32))
+        sched.add_tokens(2, np.arange(5, dtype=np.int32))
+        b = sched.schedule_pass()
+        assert b.pure_prefill
+        # reconstruct per-row destinations from the plan and compare
+        from_plan = {}
+        for pid, row0, fill in zip(b.page_ids, b.page_rows, b.page_fill):
+            if pid >= nb:
+                continue
+            for j in range(int(fill)):
+                from_plan[int(row0) + j] = (int(pid), j)
+        for r, dest in enumerate(b.kv_dest[: len(b.row_seg)]):
+            if b.row_seg[r] < 0:
+                assert r not in from_plan
+                continue
+            page, slot = divmod(int(dest), bs)
+            assert from_plan.get(r) == (page, slot), (r, from_plan.get(r),
+                                                      (page, slot))
+        # every non-pad row is covered exactly once
+        n_rows = int((b.row_seg >= 0).sum())
+        assert len(from_plan) == n_rows == 16
+        sched.complete_pass(b)
+
+    def test_continuation_pass_is_not_pure_prefill(self):
+        cfg = DSStateManagerConfig(
+            max_tracked_sequences=8, max_ragged_sequence_count=4,
+            max_ragged_batch_size=12, max_context=64, prefill_chunk_size=8)
+        kv = BlockedKVCache(KVCacheConfig(num_layers=1, num_kv_heads=1,
+                                          head_dim=8, block_size=8,
+                                          num_blocks=16, dtype=jnp.float32))
+        sched = DynamicSplitFuseScheduler(cfg, kv, BlockedAllocator(16))
+        sched.add_tokens(1, np.arange(12, dtype=np.int32))  # > one pass
+        b1 = sched.schedule_pass()
+        assert b1.pure_prefill
+        sched.complete_pass(b1)
+        b2 = sched.schedule_pass()                 # continuation from pos 8
+        assert not b2.pure_prefill
+        sched.complete_pass(b2)
+
     def test_flush_recycles_blocks(self):
         sched, alloc = self._mk(block_size=8, num_blocks=16)
         free0 = alloc.free_blocks
