@@ -1,6 +1,12 @@
 """Benchmark entry point (driver-run, real TPU).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+Prints ONE compact JSON line to stdout:
+  {"metric", "value", "unit", "vs_baseline", "summary"}
+kept under ~1,500 chars so the driver's 2,000-char stdout tail always parses
+(round-3 verdict: the old single giant line overflowed the tail and the
+artifact of record lost the headline). The FULL payload — per-phase dicts
+with every diagnostic — is written to ``bench_full.json`` at the repo root
+and echoed to stderr with a ``FULL:`` prefix.
 
 Headline metric: training tokens/sec/chip for a GPT-2-350M-class LM (bf16,
 fused-Adam, full train step through deepspeed_tpu.initialize). ``vs_baseline``
@@ -736,6 +742,42 @@ def bench_kernels(on_tpu: bool) -> dict:
 
 
 # --------------------------------------------------------------------------- #
+# serving: continuous-batching saturation point (FastGen system-level analog;
+# the full rate sweep lives in benchmarks/serving_bench.py — the artifact
+# records the saturation operating point so round-over-round serving progress
+# is driver-verifiable, not docs-only)
+# --------------------------------------------------------------------------- #
+
+def bench_serving(on_tpu: bool) -> dict:
+    import subprocess
+    repo = os.path.dirname(os.path.abspath(__file__))
+    args = ["--rates", "50", "--duration", "15", "--burst", "16"]
+    if not on_tpu:
+        args = ["--rates", "50", "--duration", "3", "--burst", "4",
+                "--seqs", "4", "--prompt", "16", "--gen", "8"]
+    env = dict(os.environ)
+    if not on_tpu:  # mirror the parent's forced-CPU platform in the child
+        env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "benchmarks", "serving_bench.py"),
+         *args], cwd=repo, env=env, capture_output=True, text=True,
+        timeout=1200)
+    sys.stderr.write(proc.stderr[-2000:])
+    row = None
+    for line in proc.stdout.splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            pass
+    if proc.returncode != 0 or row is None:
+        raise RuntimeError(f"serving bench rc={proc.returncode}: "
+                           f"{proc.stderr[-300:]}")
+    log(f"serving: {row['total_tokens_per_sec']:,.0f} total tok/s, "
+        f"p95 TBT {row['p95_tbt_ms']} ms")
+    return row
+
+
+# --------------------------------------------------------------------------- #
 # comm: tunnel transfer bandwidth + collective sweep (parity: the reference
 # treats comm benchmarking as a first-class deliverable — calc_bw_log,
 # deepspeed/utils/comms_logging.py:34; suite in DeepSpeedExamples)
@@ -744,6 +786,30 @@ def bench_kernels(on_tpu: bool) -> dict:
 def bench_comm(on_tpu: bool) -> dict:
     import subprocess
     out = {}
+
+    # Measured single-chip HBM bandwidth: time an on-device bf16 add (read +
+    # write = 2x bytes; an add, not a multiply-by-~1, so XLA cannot
+    # algebraically elide the body into a parameter-root copy). This is the
+    # measured peak the decode/serving rooflines are computed against —
+    # nominal v5e HBM is ~819 GB/s, but the achievable streaming rate is what
+    # a weight-reading decode step can actually reach.
+    n = (256 if on_tpu else 4) * 1024 * 1024  # 512 MB bf16 (8 MB on CPU CI)
+    xd = jax.block_until_ready(
+        jax.device_put(jnp.ones((n,), jnp.bfloat16)))
+    stream = jax.jit(lambda a: a + jnp.bfloat16(1.0))
+    jax.block_until_ready(stream(xd))  # compile + warm
+    trials = 5
+    t0 = time.time()
+    for _ in range(trials):
+        y = stream(xd)
+    jax.block_until_ready(y)
+    hbm = trials * 2 * xd.nbytes / (time.time() - t0) / 1e9
+    out["hbm_copy_GBps"] = round(hbm, 1)
+    out["hbm_note"] = (
+        "on-device bf16 stream (read+write); the measured peak used for "
+        "decode roofline fractions" if on_tpu else
+        "CPU-backend CI path — host memcpy rate, NOT TPU HBM")
+    log(f"comm: HBM stream {hbm:.0f} GB/s")
 
     # host <-> device bandwidth on the real link (through the tunnel this is
     # the serving-path constraint that motivates on-device sampling etc.);
@@ -764,9 +830,12 @@ def bench_comm(on_tpu: bool) -> dict:
     for f in fresh:
         _ = np.asarray(f)
     d2h = trials * x.nbytes / (time.time() - t0) / 1e9
-    out["h2d_GBps"] = round(h2d, 3)
-    out["d2h_GBps"] = round(d2h, 3)
-    log(f"comm: h2d {h2d:.2f} GB/s, d2h {d2h:.2f} GB/s")
+    out["tunnel_h2d_GBps"] = round(h2d, 3)
+    out["tunnel_d2h_GBps"] = round(d2h, 3)
+    out["tunnel_note"] = ("host<->device through the remote axon tunnel — "
+                          "NOT PCIe-class; bounds the serving host loop, not "
+                          "the on-chip paths")
+    log(f"comm: h2d {h2d:.2f} GB/s, d2h {d2h:.2f} GB/s (tunnel)")
 
     # collective sweep over an 8-device virtual CPU mesh (single real chip
     # has no ICI; this polices the collectives plumbing + busbw accounting
@@ -791,7 +860,11 @@ def bench_comm(on_tpu: bool) -> dict:
     if proc.returncode != 0 or not rows:
         raise RuntimeError(f"comm sweep rc={proc.returncode}: "
                            f"{proc.stderr[-300:]}")
-    out["mesh_sweep"] = rows
+    out["virtual_cpu_mesh_sweep"] = rows
+    out["virtual_cpu_mesh_note"] = (
+        "8-device FORCED-HOST CPU mesh (v5e-1 has no ICI): polices the "
+        "collectives plumbing and busbw accounting end to end, does NOT "
+        "measure TPU interconnect — absolute GB/s here are CPU-mesh numbers")
     log(f"comm: sweep {len(rows)} rows over the virtual mesh")
     return out
 
@@ -820,6 +893,7 @@ def main():
     fast = os.environ.get("DSTPU_BENCH_FAST") == "1"
     for name, fn in (("llama_zero3", bench_llama_zero3),
                      ("kernels", bench_kernels), ("decode", bench_decode),
+                     ("serving", bench_serving),
                      ("moe", bench_moe), ("offload", bench_offload),
                      ("comm", bench_comm)):
         # Each phase builds its own model/engine; drop the previous phase's
@@ -848,14 +922,67 @@ def main():
                 jax.clear_caches()
 
     mfu = extra.pop("mfu")
-    out = {
+    full = {
         "metric": "gpt2_350m_train_tokens_per_sec_per_chip",
         "value": round(train["tokens_per_sec"], 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.50, 4),
         "extra": {"mfu": round(mfu, 4), **extra},
     }
-    print(json.dumps(out))
+    # Artifact discipline (round-3 verdict): the driver's record keeps only
+    # the LAST ~2000 chars of stdout, so the full payload goes to a file +
+    # stderr and stdout ends with ONE compact line that always fits the tail.
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(repo, "bench_full.json"), "w") as f:
+        json.dump(full, f, indent=1)
+    print("FULL:" + json.dumps(full), file=sys.stderr, flush=True)
+    print(json.dumps(_compact(full)), flush=True)
+
+
+def _pick(d, keys):
+    """Scalar subset of phase dict ``d`` (error string -> short status)."""
+    if not isinstance(d, dict):
+        return {"status": str(d)[:70]}
+    return {k: d[k] for k in keys if k in d and not isinstance(d[k], (dict, list))}
+
+
+def _compact(full: dict) -> dict:
+    """One-level summary that must fit the driver's 2000-char stdout tail:
+    headline + per-phase scalars; the full payload lives in bench_full.json."""
+    e = full["extra"]
+    summary = {
+        "mfu": e.get("mfu"),
+        "llama_zero3": _pick(e.get("llama_zero3"),
+                             ("tokens_per_sec", "mfu", "n_params")),
+        "decode": _pick(e.get("decode"),
+                        ("decode_tokens_per_sec", "prefill_tokens_per_sec",
+                         "mha64_decode_tokens_per_sec",
+                         "gqa_decode_tokens_per_sec",
+                         "gqa256_decode_tokens_per_sec",
+                         "hbm_frac_mha32", "hbm_frac_gqa256")),
+        "serving": _pick(e.get("serving"),
+                         ("total_tokens_per_sec", "gen_tokens_per_sec",
+                          "mean_tbt_ms", "p95_tbt_ms")),
+        "moe": _pick(e.get("moe"), ("moe_train_tokens_per_sec", "mfu")),
+        "offload": _pick(e.get("offload"),
+                         ("sync_step_s", "dpu_step_s", "overlap_speedup",
+                          "host_kernel")),
+        "comm": _pick(e.get("comm"), ("hbm_copy_GBps", "tunnel_h2d_GBps",
+                                      "tunnel_d2h_GBps")),
+        "kernels": ("pass(%d)" % len(e["kernels"])
+                    if isinstance(e.get("kernels"), dict)
+                    else str(e.get("kernels"))[:70]),
+        "full_payload": "bench_full.json",
+    }
+    out = {k: full[k] for k in ("metric", "value", "unit", "vs_baseline")}
+    out["summary"] = summary
+    # hard guarantee: stay inside the driver's tail window even if a phase
+    # status string balloons — drop whole phases (least-headline first)
+    for drop in ("kernels", "comm", "offload", "moe", "serving", "decode"):
+        if len(json.dumps(out)) <= 1500:
+            break
+        summary.pop(drop, None)
+    return out
 
 
 if __name__ == "__main__":

@@ -244,7 +244,7 @@ def main():
     # warm run compiles every pass shape (prefill, mixed, fused burst)
     run_load_point(engine, vocab, rate=50.0, seqs=args.seqs,
                    prompt=args.prompt, gen=max(8, args.gen // 4),
-                   duration=8.0, rng=rng, burst=args.burst)
+                   duration=8.0 if on_tpu else 2.0, rng=rng, burst=args.burst)
     for rate in [float(r) for r in args.rates.split(",")]:
         out = run_load_point(engine, vocab, rate, args.seqs, args.prompt,
                              args.gen, args.duration, rng, burst=args.burst)
