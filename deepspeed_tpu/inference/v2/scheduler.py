@@ -208,6 +208,21 @@ class DynamicSplitFuseScheduler:
                               and from_zero)
         if batch.current_sequences == 0:
             return None
+        # flash_attention_packed's correctness contract (see its docstring:
+        # per-sequence rows contiguous-in-order, padding rows seg -1) is
+        # PRODUCED here, so it is asserted here: non-padding row_seg values
+        # must be non-decreasing and positions within a segment must advance
+        # by exactly 1. O(rows) numpy — negligible next to the pass itself.
+        live = batch.row_seg >= 0
+        segs = batch.row_seg[live]
+        if segs.size > 1:
+            dseg = np.diff(segs)
+            dpos = np.diff(batch.chunk_positions[live])
+            if not (np.all(dseg >= 0) and np.all(dpos[dseg == 0] == 1)):
+                raise AssertionError(
+                    "scheduler produced an interleaved/unordered packed "
+                    "batch; flash_attention_packed requires per-sequence "
+                    "rows contiguous and position-ordered")
         return batch
 
     def complete_pass(self, batch: RaggedBatch) -> List[int]:

@@ -90,12 +90,18 @@ def quantized_matmul(a: jax.Array, w8: jax.Array, scale: jax.Array,
             b //= 2
         return max(b, 1)
 
+    bk = pick(K, block_k)
+    bn = pick(N, block_n)
+    # layout contract: int8 sublane tile 32 (bk), lane tile 128 (bn). A
+    # non-multiple K/N degrades the picker to tiny blocks (e.g. K=600 ->
+    # bk=8) that Mosaic may reject or crawl through — such shapes are not
+    # the serving hot path, so take the XLA reference instead.
+    if bk % 32 or bn % 128:
+        return quantized_matmul_reference(a, w8, scale).astype(out_dtype)
     # pad M to the fp32-accumulator sublane tile
     Mp = -(-M // 8) * 8
     if Mp != M:
         a = jnp.pad(a, ((0, Mp - M), (0, 0)))
-    bk = pick(K, block_k)
-    bn = pick(N, block_n)
     nk, nn = K // bk, N // bn
 
     out = pl.pallas_call(

@@ -831,9 +831,11 @@ class DeepSpeedTPUEngine:
             from deepspeed_tpu.runtime.zero.partition import xla_bucket_flags
             opts.update(xla_bucket_flags(z.reduce_bucket_size,
                                          z.allgather_bucket_size))
-        # user-pinned compile options win over the derived ones
-        opts.update({k: str(v) for k, v in
-                     self.config.xla_compile_options.items()})
+        # user-pinned compile options win over the derived ones. Python bools
+        # must become XLA's lowercase 'true'/'false' — str(True) is 'True',
+        # which XLA flag parsing rejects or ignores.
+        opts.update({k: (str(v).lower() if isinstance(v, bool) else str(v))
+                     for k, v in self.config.xla_compile_options.items()})
         return opts or None
 
     def train_batch(self, batch=None, data_iter=None):

@@ -19,13 +19,27 @@ _TRANSIENT_SIGNATURES = (
     "connection reset",
     "connection refused",
     "broken pipe",
-    "unavailable",
+    # all three gRPC deadline spellings: snake_case status code, the
+    # spaced human message, and the camel-case enum name
     "deadline_exceeded",
+    "deadline exceeded",
+    "deadlineexceeded",
+)
+
+# "unavailable" alone matches deterministic messages too (e.g. "feature
+# unavailable on this backend"), so anchor it to the gRPC status-token forms.
+_TRANSIENT_REGEXES = (
+    r"\bunavailable:",             # "UNAVAILABLE: connection ..."
+    r"statuscode\.unavailable",    # python grpc repr: "StatusCode.UNAVAILABLE"
+    r"status[^a-z]{0,3}unavailable",
+    r"(?s)\bunavailable\b.*(socket|connect|channel|endpoint|tunnel)",
 )
 
 
 def is_transient_error(exc: BaseException) -> bool:
     """True when ``exc`` looks like a transient tunnel/transport flake worth
     retrying (vs a deterministic compile/runtime error that never will)."""
+    import re
     msg = str(exc).lower()
-    return any(s in msg for s in _TRANSIENT_SIGNATURES)
+    return (any(s in msg for s in _TRANSIENT_SIGNATURES)
+            or any(re.search(p, msg) for p in _TRANSIENT_REGEXES))

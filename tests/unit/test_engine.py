@@ -276,3 +276,16 @@ def test_user_xla_compile_options_merge_over_bucket_flags(eight_devices):
         "xla_compile_options": {"xla_tpu_scoped_vmem_limit_kib": 1024}})
     assert s0._compiler_options(backend="tpu") == {
         "xla_tpu_scoped_vmem_limit_kib": "1024"}
+
+
+def test_user_xla_compile_options_bool_lowercased(eight_devices):
+    """Python bools must reach XLA as 'true'/'false' — str(True) is 'True',
+    which XLA flag parsing rejects (advisor round-3 finding)."""
+    engine = make_engine(stage=0, extra={
+        "xla_compile_options": {"xla_tpu_enable_flash_attention": True,
+                                "xla_some_off_switch": False,
+                                "xla_tpu_scoped_vmem_limit_kib": 1024}})
+    opts = engine._compiler_options(backend="tpu")
+    assert opts["xla_tpu_enable_flash_attention"] == "true"
+    assert opts["xla_some_off_switch"] == "false"
+    assert opts["xla_tpu_scoped_vmem_limit_kib"] == "1024"
