@@ -355,25 +355,77 @@ def bench_llama_zero3(on_tpu: bool) -> dict:
 # blogs/deepspeed-fastgen/README.md throughput evaluation)
 # --------------------------------------------------------------------------- #
 
+def measure_hbm_stream() -> float:
+    """Measured single-chip HBM streaming rate (GB/s) via an IN-PROGRAM
+    ``lax.scan`` of bf16 adds, timed by differencing two iteration counts so
+    dispatch/fetch overhead cancels. (block_until_ready is effectively a
+    no-op through the axon tunnel and single boundary fetches carry ~100 ms
+    of service time, so naive timings measure the tunnel, not the chip —
+    both failure modes were observed and drove this design.)"""
+    from jax import lax
+    on_tpu = jax.default_backend() not in ("cpu",)
+    n = (256 if on_tpu else 4) * 1024 * 1024
+    xd = jax.device_put(jnp.ones((n,), jnp.bfloat16))
+    probe = jax.jit(lambda a: jnp.sum(a[:8], dtype=jnp.float32))
+
+    def mk(iters):
+        @jax.jit
+        def f(a):
+            return lax.scan(lambda c, _: (c + jnp.bfloat16(1), None),
+                            a, None, length=iters)[0]
+        return f
+
+    i1, i2 = 10, 60
+    f1, f2 = mk(i1), mk(i2)
+    float(probe(f1(xd))), float(probe(f2(xd)))     # compile both
+
+    def run(f):
+        t0 = time.time()
+        float(probe(f(xd)))
+        return time.time() - t0
+
+    reps = 3
+    for attempt in range(2):
+        t1 = sorted(run(f1) for _ in range(reps))[reps // 2]
+        t2 = sorted(run(f2) for _ in range(reps))[reps // 2]
+        if t2 > t1:
+            return (i2 - i1) * 2 * xd.nbytes / (t2 - t1) / 1e9
+    raise RuntimeError(
+        f"HBM stream measurement incoherent (t1={t1:.3f}s >= t2={t2:.3f}s "
+        f"twice): tunnel noise swamped the differencing window")
+
+
 def bench_decode(on_tpu: bool) -> dict:
     from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
     from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 
     if on_tpu:
         layers, hidden, heads, vocab = 12, 1536, 12, 32000
-        seqs, prompt, gen, chunk = 32, 128, 64, 32
+        seqs, prompt = 32, 128
+        C1, C2, reps = 16, 96, 5
     else:
         layers, hidden, heads, vocab = 2, 64, 4, 256
-        seqs, prompt, gen, chunk = 4, 16, 8, 8
+        seqs, prompt = 4, 16
+        C1, C2, reps = 2, 8, 2
 
-    # context budget: prompt + warmup decode chunks (2x) + gen + reserve slack
-    ctx = prompt + gen + 3 * chunk + 64
+    # context budget: prompt + the LONG timing program + slack. Pool sizing
+    # follows max_context, so this budget is what keeps the S=256 leg's KV
+    # pool inside HBM (an oversized pool silently degrades into allocator
+    # thrash — observed 10x step inflation at ctx 608, S=256).
+    ctx = prompt + C1 + C2 + 64
     rng = np.random.RandomState(0)
+    hbm_peak = measure_hbm_stream()
+    log(f"decode: measured HBM stream peak {hbm_peak:,.0f} GB/s")
 
-    def measure(kv_heads, n_seqs, measure_prefill):
-        """One engine at (kv_heads, n_seqs): optional prefill tput + the timed
-        fused-multistep decode window. ONE implementation so the MHA and GQA
-        numbers stay comparable (same warmup, ctx budget, timing)."""
+    def measure(kv_heads, n_seqs, measure_prefill, weight_bits=None):
+        """One engine at (kv_heads, n_seqs): optional prefill tput + the
+        device-rate decode step. Decode timing: run the C1-step and C2-step
+        fused programs (single dispatch + single ids fetch each, state reset
+        between runs by flush + re-prefill), median over ``reps``; the
+        (C2 - C1)-step time difference cancels the tunnel's dispatch/fetch
+        service time, which at ~100 ms per interaction otherwise doubles the
+        apparent step time (round-3 artifact numbers carried exactly that
+        bias). ONE implementation for all legs so they stay comparable."""
         cfg = LlamaConfig(vocab_size=vocab, hidden_size=hidden,
                           intermediate_size=hidden * 4,
                           num_hidden_layers=layers,
@@ -384,96 +436,133 @@ def bench_decode(on_tpu: bool) -> dict:
         model = LlamaForCausalLM(cfg)
         params = _init_params(model, {"input_ids": jnp.zeros((1, 8), jnp.int32)})
         n_par = sum(x.size for x in jax.tree_util.tree_leaves(params))
-        engine = InferenceEngineV2(
-            model=model, model_parameters=params,
-            config={"state_manager": {
-                "max_tracked_sequences": n_seqs,
-                "max_ragged_sequence_count": n_seqs,
-                # enough chunk slots to prefill the whole wave in one pass
-                # (multi-chunk SplitFuse: per-pass dispatch cost amortises
-                # over n_seqs prompts instead of paying it n_seqs times)
-                "max_ragged_batch_size": n_seqs * prompt + n_seqs,
-                "prefill_chunk_size": prompt,
-                "max_context": ctx,
-            }})
+        econf = {"state_manager": {
+            "max_tracked_sequences": n_seqs,
+            "max_ragged_sequence_count": n_seqs,
+            # enough chunk slots to prefill the whole wave in one pass
+            # (multi-chunk SplitFuse: per-pass dispatch cost amortises
+            # over n_seqs prompts instead of paying it n_seqs times)
+            "max_ragged_batch_size": n_seqs * prompt + n_seqs,
+            "prefill_chunk_size": prompt,
+            "max_context": ctx,
+        }}
+        if weight_bits:
+            econf["quantization"] = {"weight_bits": weight_bits}
+        engine = InferenceEngineV2(model=model, model_parameters=params,
+                                   config=econf)
         prompts = [rng.randint(0, vocab, size=(prompt,)).astype(np.int32)
                    for _ in range(n_seqs)]
         uids = list(range(n_seqs))
 
+        def prefill_wave():
+            """Serving-realistic prefill: logits stay on device, only the
+            sampled ids come back (4 B/seq; put()'s [S, V] logits fetch is an
+            API-parity path, not the serving loop)."""
+            engine._put_nofetch(uids, prompts)
+            engine.sample_next(uids)
+
         prefill_tput = None
+        t = time.time()
+        prefill_wave()                       # cold: compiles chunk shapes
+        log(f"decode: prefill compile {time.time()-t:.1f}s")
         if measure_prefill:
-            t = time.time()
-            engine._put_nofetch(uids, prompts)   # cold: compiles chunk shapes
-            engine.sample_next(uids)             # + the device sampler
-            engine.flush(uids)
-            log(f"decode: prefill compile {time.time()-t:.1f}s")
-            # serving-realistic prefill: logits stay on device, only the
-            # sampled token ids come back (4 B/seq). put() — which fetches the
-            # full [S, V] logits — costs ~200 ms extra PER WAVE through the
-            # tunnel's ~30 MB/s d2h and is an API-parity path, not the
-            # serving loop. Median of 3 waves.
             times = []
             for _ in range(3):
-                t0 = time.time()
-                engine._put_nofetch(uids, prompts)
-                engine.sample_next(uids)         # device sample + tiny fetch
-                times.append(time.time() - t0)
                 engine.flush(uids)
+                t0 = time.time()
+                prefill_wave()
+                times.append(time.time() - t0)
             prefill_tput = n_seqs * prompt / sorted(times)[1]
-            engine.put(uids, prompts)            # leave state as before
-        else:
-            engine.put(uids, prompts)
+
+        # per-step streamed HBM bytes: every weight except the gathered
+        # embedding tables, plus the mid-window KV read
+        emb_bytes = sum(
+            np.prod(v.shape) * v.dtype.itemsize
+            for k, v in engine.weights.items()
+            if k in ("embed", "pos_embed"))
+        w_bytes = sum(x.size * x.dtype.itemsize
+                      for x in jax.tree_util.tree_leaves(engine.weights)
+                      ) - emb_bytes
+        # mean context over the DIFFERENCED window (steps C1..C2)
+        kv_bytes = 2 * n_seqs * (prompt + (C1 + C2) // 2) * kv_heads * \
+            (hidden // heads) * 2
 
         t = time.time()
-        engine.decode_steps(uids, chunk)   # cold: compiles the fused loop
+        for C in (C1, C2):                   # cold: compiles both programs
+            np.asarray(engine.decode_steps(uids, C, fetch=False))
         log(f"decode: multistep compile {time.time()-t:.1f}s")
-        engine.decode_steps(uids, chunk)   # warm once more
-        t0 = time.time()
-        done = 0
-        while done < gen:
-            engine.decode_steps(uids, chunk)
-            done += chunk
-        decode_tput = n_seqs * done / (time.time() - t0)
+        ts = {C1: [], C2: []}
+        for _ in range(reps):
+            for C in (C1, C2):
+                engine.flush(uids)
+                prefill_wave()               # reset to a fixed-ctx start
+                t0 = time.time()
+                np.asarray(engine.decode_steps(uids, C, fetch=False))
+                ts[C].append(time.time() - t0)
+        step_s = (sorted(ts[C2])[reps // 2] - sorted(ts[C1])[reps // 2]) \
+            / (C2 - C1)
         engine.flush(uids)
-        return decode_tput, prefill_tput, n_par
+        if step_s <= 0:
+            raise RuntimeError(
+                f"decode timing incoherent (median t[C2] <= t[C1], "
+                f"ts={ts}): tunnel noise swamped the differencing window")
+        gbps = (w_bytes + kv_bytes) / step_s / 1e9
+        leg = {
+            "tokens_per_sec": round(n_seqs / step_s, 1),
+            "step_ms": round(step_s * 1e3, 3),
+            "streamed_GB_per_step": round((w_bytes + kv_bytes) / 1e9, 3),
+            "achieved_GBps": round(gbps, 1),
+            "hbm_frac": round(gbps / hbm_peak, 3),
+        }
+        return leg, prefill_tput, n_par
 
-    decode_tput, prefill_tput, n_params = measure(heads, seqs, True)
-    log(f"decode: {decode_tput:,.0f} tok/s decode, {prefill_tput:,.0f} tok/s prefill")
+    leg, prefill_tput, n_params = measure(heads, seqs, True)
+    log(f"decode: mha32 {leg['tokens_per_sec']:,.0f} tok/s "
+        f"({leg['hbm_frac']:.0%} of {hbm_peak:,.0f} GB/s), "
+        f"prefill {prefill_tput:,.0f} tok/s")
     out = {
-        "decode_tokens_per_sec": round(decode_tput, 1),
+        "decode_tokens_per_sec": leg["tokens_per_sec"],
+        "hbm_frac_mha32": leg["hbm_frac"],
         "prefill_tokens_per_sec": round(prefill_tput, 1),
-        "n_params": int(n_params), "seqs": seqs,
-        "prompt": prompt, "gen": gen,
+        "n_params": int(n_params), "seqs": seqs, "prompt": prompt,
+        "hbm_peak_GBps": round(hbm_peak, 1),
+        "mha32": leg,
+        "timing_note": ("device-rate: C2-C1 program-length differencing "
+                        "cancels the tunnel's ~100 ms/interaction service "
+                        "time; the serving phase reports the through-tunnel "
+                        "system number"),
     }
 
     if on_tpu:
         # Scaling legs (each engine freed before the next — see gc below;
         # a late-leg failure must not discard earlier results):
-        #   - MHA at 64 seqs: the round-2 kernel COLLAPSED past 32 seqs
-        #     (2.35k@32 -> 1.58k@32x2); the batched chunk-DMA kernel must
-        #     show 64-seq throughput >= the 32-seq number.
-        #   - GQA (4 kv heads, 64 seqs): grouped KV is the representative
-        #     modern-serving number (decode is KV-read bound).
+        #   - int8 at 32 seqs: weight-only quantized serving (VERDICT r3
+        #     item 2) — decode is weight-read bound, int8 halves the stream.
+        #   - MHA at 64 seqs: the round-2 kernel COLLAPSED past 32 seqs;
+        #     64-seq throughput must stay >= the 32-seq number.
+        #   - GQA legs at 64/128/256 seqs: grouped KV is the representative
+        #     modern-serving operating point (FastGen-style batches).
         import gc
-        for key, kvh, nseq in (("mha64_decode_tokens_per_sec", heads, 64),
-                               ("gqa_decode_tokens_per_sec", 4, 64),
-                               # decode is weight-read bound at these batch
-                               # sizes, so throughput scales with seqs until
-                               # KV reads take over: measured GQA 10.5k @ 64
-                               # -> 18.3k @ 128 -> 20.5k @ 256 (v5e-1). The
-                               # big-batch legs are the FastGen-style
-                               # continuous-batch operating points.
-                               ("gqa128_decode_tokens_per_sec", 4, 128),
-                               ("gqa256_decode_tokens_per_sec", 4, 256)):
+        for key, kvh, nseq, wb in (
+                ("mha32_int8", heads, 32, 8),
+                ("mha64", heads, 64, None),
+                ("gqa64", 4, 64, None),
+                ("gqa128", 4, 128, None),
+                ("gqa256", 4, 256, None),
+                ("gqa256_int8", 4, 256, 8)):
             gc.collect()
             try:
-                tput, _, _ = measure(kvh, nseq, False)
-                out[key] = round(tput, 1)
-                log(f"decode: {tput:,.0f} tok/s (kv={kvh}, {nseq} seqs)")
+                leg, _, _ = measure(kvh, nseq, False, weight_bits=wb)
+                out[key] = leg
+                log(f"decode: {key} {leg['tokens_per_sec']:,.0f} tok/s "
+                    f"({leg['hbm_frac']:.0%} of peak)")
             except Exception as e:
                 traceback.print_exc(file=sys.stderr)
                 out[key] = f"FAILED: {type(e).__name__}: {e}"
-        out["gqa"] = {"kv_heads": 4, "seqs": 64}
+        if isinstance(out.get("gqa256"), dict):
+            out["gqa256_decode_tokens_per_sec"] = \
+                out["gqa256"]["tokens_per_sec"]
+            out["hbm_frac_gqa256"] = out["gqa256"]["hbm_frac"]
     return out
 
 
@@ -787,23 +876,11 @@ def bench_comm(on_tpu: bool) -> dict:
     import subprocess
     out = {}
 
-    # Measured single-chip HBM bandwidth: time an on-device bf16 add (read +
-    # write = 2x bytes; an add, not a multiply-by-~1, so XLA cannot
-    # algebraically elide the body into a parameter-root copy). This is the
-    # measured peak the decode/serving rooflines are computed against —
-    # nominal v5e HBM is ~819 GB/s, but the achievable streaming rate is what
-    # a weight-reading decode step can actually reach.
-    n = (256 if on_tpu else 4) * 1024 * 1024  # 512 MB bf16 (8 MB on CPU CI)
-    xd = jax.block_until_ready(
-        jax.device_put(jnp.ones((n,), jnp.bfloat16)))
-    stream = jax.jit(lambda a: a + jnp.bfloat16(1.0))
-    jax.block_until_ready(stream(xd))  # compile + warm
-    trials = 5
-    t0 = time.time()
-    for _ in range(trials):
-        y = stream(xd)
-    jax.block_until_ready(y)
-    hbm = trials * 2 * xd.nbytes / (time.time() - t0) / 1e9
+    # Measured single-chip HBM streaming bandwidth (in-program scan with
+    # iteration-count differencing — see measure_hbm_stream for why naive
+    # timings measure the tunnel instead). Nominal v5e HBM is ~819 GB/s; the
+    # achievable streaming rate here is what the decode rooflines use.
+    hbm = measure_hbm_stream()
     out["hbm_copy_GBps"] = round(hbm, 1)
     out["hbm_note"] = (
         "on-device bf16 stream (read+write); the measured peak used for "
@@ -956,9 +1033,7 @@ def _compact(full: dict) -> dict:
                              ("tokens_per_sec", "mfu", "n_params")),
         "decode": _pick(e.get("decode"),
                         ("decode_tokens_per_sec", "prefill_tokens_per_sec",
-                         "mha64_decode_tokens_per_sec",
-                         "gqa_decode_tokens_per_sec",
-                         "gqa256_decode_tokens_per_sec",
+                         "gqa256_decode_tokens_per_sec", "hbm_peak_GBps",
                          "hbm_frac_mha32", "hbm_frac_gqa256")),
         "serving": _pick(e.get("serving"),
                          ("total_tokens_per_sec", "gen_tokens_per_sec",

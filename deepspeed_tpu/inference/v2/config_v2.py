@@ -55,9 +55,25 @@ class KVCacheSizingConfig:
 
 
 @dataclass
+class QuantizationConfig:
+    """Weight-only quantization for the serving path (parity: the reference's
+    v2 quantization config, ``inference/v2/config_v2.py`` QuantizationConfig,
+    backing the CUTLASS fp16 x int8 mixed GEMM). ``weight_bits=8`` stores the
+    streamed weight matrices int8 in HBM with per-output-column scales and
+    dequantizes inside the dot (see ``ragged_model._mm``). None = off."""
+    weight_bits: Optional[int] = None
+
+    def __post_init__(self):
+        if self.weight_bits not in (None, 8):
+            raise ValueError("quantization.weight_bits must be None or 8, "
+                             f"got {self.weight_bits!r}")
+
+
+@dataclass
 class RaggedInferenceEngineConfig:
     state_manager: DSStateManagerConfig = field(default_factory=DSStateManagerConfig)
     kv_cache: KVCacheSizingConfig = field(default_factory=KVCacheSizingConfig)
+    quantization: QuantizationConfig = field(default_factory=QuantizationConfig)
     tensor_parallel: int = 1
     dtype: Any = jnp.bfloat16
     seed: int = 0
@@ -77,7 +93,9 @@ class RaggedInferenceEngineConfig:
                 else d.pop("state_manager")
             kv = d.pop("kv_cache", {})
             kv = KVCacheSizingConfig(**kv) if isinstance(kv, dict) else kv
-            cfg = cls(state_manager=sm, kv_cache=kv, **d)
+            qz = d.pop("quantization", {})
+            qz = QuantizationConfig(**qz) if isinstance(qz, dict) else qz
+            cfg = cls(state_manager=sm, kv_cache=kv, quantization=qz, **d)
         if cfg.state_manager.chunk_budget <= 0:
             raise ValueError("max_ragged_batch_size must exceed max_ragged_sequence_count")
         return cfg

@@ -86,6 +86,15 @@ class InferenceEngineV2:
         self.spec, weights = adapt_model(family, params, model_config,
                                          max_context=cfg.state_manager.max_context)
         self.spec.dtype = cfg.dtype
+        if cfg.quantization.weight_bits == 8:
+            if tp > 1:
+                raise NotImplementedError(
+                    "weight-only int8 with tensor_parallel > 1 is not wired "
+                    "yet (the AutoTP rule walker shards plain arrays); run "
+                    "int8 at tp=1 or bf16 under tp")
+            from deepspeed_tpu.inference.v2.ragged_model import (
+                quantize_weights_int8)
+            weights = quantize_weights_int8(weights)
         self.weights = self._shard_weights(weights)
 
         # KV cache + allocator + scheduler
@@ -276,12 +285,19 @@ class InferenceEngineV2:
 
     def decode_steps(self, uids: Sequence[int], n_steps: int,
                      do_sample: bool = False, temperature: float = 1.0,
-                     top_k: int = 0) -> np.ndarray:
+                     top_k: int = 0, fetch: bool = True
+                     ) -> "np.ndarray | jax.Array":
         """Generate ``n_steps`` tokens for every uid with ONE device program
         (fused sample->forward->sample loop; see build_multistep_decode).
         All uids must be in steady decode state (no pending tokens).  Returns
         the generated ids [len(uids), n_steps]; the engine's last-logits refs
-        advance so normal put()/sample_next() calls can continue after."""
+        advance so normal put()/sample_next() calls can continue after.
+
+        ``fetch=False`` returns the DEVICE array of shape [n_steps, S]
+        instead (transpose after ``np.asarray`` to match): the call then
+        costs only a dispatch, so back-to-back bursts chain on device —
+        through a remote runtime the synchronous ids fetch is ~an RTT per
+        burst, which would otherwise serialise host RTT into every burst."""
         uids = [int(u) for u in uids]
         S = len(uids)
         assert not self.scheduler.has_pending(), \
@@ -316,6 +332,8 @@ class InferenceEngineV2:
             self.scheduler.advance(u, n_steps)
             self._last_ref[u] = (final_logits, i)
             self._last_logits.pop(u, None)
+        if not fetch:
+            return out_ids              # device [n_steps, S]
         return np.asarray(out_ids).T    # [S, n_steps]
 
     def _run_pass(self) -> None:

@@ -372,6 +372,61 @@ def _moe_ffn(x: jax.Array, w: Dict, top_k: int, dtype) -> jax.Array:
 
 
 
+def _mm(x, w):
+    """``x @ w`` where ``w`` is a plain array OR a weight-only-int8 dict
+    ``{"w8" [K, N] int8, "scale" [1, N] f32}``.
+
+    TPU-native mixed GEMM (parity role: the reference's fp16 x int8 CUTLASS
+    mixed_gemm, ``inference/v2/kernels/cutlass_ops/mixed_gemm``): at decode
+    shapes the GEMM is weight-READ bound, so int8 storage halves the HBM
+    stream. XLA fuses the int8->bf16 convert into the dot's tile pipeline
+    (measured v5e-1, M=32: int8 weight stream runs at ~700 GB/s wire rate =
+    ~1.4 TB/s bf16-equivalent vs ~750 GB/s for bf16 weights — a true ~1.9x).
+    int8 values up to +-127 are exact in bf16; accumulation is fp32 via
+    preferred_element_type; the per-output-column scale is applied to the
+    fp32 accumulator (valid: scale is constant along K)."""
+    if isinstance(w, dict) and "w8" in w:
+        o = jax.lax.dot_general(x, w["w8"].astype(x.dtype),
+                                (((x.ndim - 1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        return (o * w["scale"]).astype(x.dtype)
+    return x @ w
+
+
+_QUANT_KEYS = ("wq", "wk", "wv", "wo")
+_QUANT_MLP_KEYS = ("w_gate", "w_up", "w_down")
+
+
+def quantize_weights_int8(weights: Dict) -> Dict:
+    """Weight-only int8 for the serving weight tree (in place, returns it).
+
+    Symmetric per-output-column int8 over the stacked per-layer matrices
+    ``[L, K, N] -> {"w8" int8 [L, K, N], "scale" f32 [L, 1, N]}`` plus the
+    untied ``lm_head``; embeddings, norms, and biases stay in the model
+    dtype (embeds are row-gathers, not streamed matmuls). Scheme parity:
+    the reference quantizer's symmetric mode (``csrc/quantization``)."""
+    def q(w):
+        absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2,
+                         keepdims=True)
+        scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+        w8 = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                      -127, 127).astype(jnp.int8)
+        return {"w8": w8, "scale": scale.astype(jnp.float32)}
+
+    layers = weights["layers"]
+    for key in _QUANT_KEYS:
+        if key in layers and not isinstance(layers[key], dict):
+            layers[key] = q(layers[key])
+    mlp = layers.get("mlp")
+    if isinstance(mlp, dict):
+        for key in _QUANT_MLP_KEYS:
+            if key in mlp and not isinstance(mlp[key], dict):
+                mlp[key] = q(mlp[key])
+    if "lm_head" in weights and not isinstance(weights["lm_head"], dict):
+        weights["lm_head"] = q(weights["lm_head"])
+    return weights
+
+
 def _transformer_layer(spec: "RaggedModelSpec", w, x, positions, attend):
     """Shared per-layer transformer body for BOTH the ragged forward (put
     passes) and the fused multistep decode — one implementation so the two
@@ -384,9 +439,9 @@ def _transformer_layer(spec: "RaggedModelSpec", w, x, positions, attend):
     dtype = spec.dtype
     k_l, v_l = None, None  # provided via attend closure state
     h1 = _norm(x, w["ln1"], spec.norm, spec.eps, dtype, spec.norm_plus_one)
-    q = (h1 @ w["wq"]).reshape(-1, H, D)
-    k = (h1 @ w["wk"]).reshape(-1, Hkv, D)
-    v = (h1 @ w["wv"]).reshape(-1, Hkv, D)
+    q = _mm(h1, w["wq"]).reshape(-1, H, D)
+    k = _mm(h1, w["wk"]).reshape(-1, Hkv, D)
+    v = _mm(h1, w["wv"]).reshape(-1, Hkv, D)
     if "bq" in w:
         q = q + w["bq"].reshape(H, D)
         k = k + w["bk"].reshape(Hkv, D)
@@ -396,7 +451,7 @@ def _transformer_layer(spec: "RaggedModelSpec", w, x, positions, attend):
         k = _rope_flat(k, positions, spec.rope_theta, spec.rotary_dim)
 
     attn_raw, k_l, v_l = attend(q, k, v)
-    attn_out = attn_raw.reshape(-1, H * D) @ w["wo"]
+    attn_out = _mm(attn_raw.reshape(-1, H * D), w["wo"])
     if "bo" in w:
         attn_out = attn_out + w["bo"]
 
@@ -415,14 +470,14 @@ def _transformer_layer(spec: "RaggedModelSpec", w, x, positions, attend):
         m = w["mlp"]
         if spec.activation in ("swiglu", "geglu"):
             gate_act = jax.nn.silu if spec.activation == "swiglu" else jax.nn.gelu
-            hmid = gate_act(mlp_in @ m["w_gate"]) * (mlp_in @ m["w_up"])
+            hmid = gate_act(_mm(mlp_in, m["w_gate"])) * _mm(mlp_in, m["w_up"])
         else:
             act = _plain_act(spec.activation)
-            hmid = mlp_in @ m["w_up"]
+            hmid = _mm(mlp_in, m["w_up"])
             if "b_up" in m:
                 hmid = hmid + m["b_up"]
             hmid = act(hmid)
-        mlp_out = hmid @ m["w_down"]
+        mlp_out = _mm(hmid, m["w_down"])
         if "b_down" in m:
             mlp_out = mlp_out + m["b_down"]
 
@@ -449,7 +504,7 @@ def _unembed(spec: "RaggedModelSpec", weights, xs):
     if spec.tied_lm_head:
         logits = xs.astype(jnp.float32) @ weights["embed"].astype(jnp.float32).T
     else:
-        logits = (xs @ weights["lm_head"]).astype(jnp.float32)
+        logits = _mm(xs, weights["lm_head"]).astype(jnp.float32)
     if spec.head_bias:
         logits = logits + weights["lm_head_bias"].astype(jnp.float32)
     return logits

@@ -17,14 +17,16 @@ Quantisation scheme: symmetric per-output-channel (per-N-column) int8 —
 Layout contract: ``w8 [K, N] int8``, ``scale [N] f32``; ``a [M, K]``
 bf16/f32. M is padded to the sublane tile in the wrapper.
 
-Status: building block, NOT wired into the v2 serving engine. Measured on
-v5e-1 (standalone 12-layer stacked scan, M=64): this kernel streams int8 at
-25-36 GB/s vs XLA's bf16 dot at 32-80 GB/s in the same pattern — the fused
-engine step reaches ~230 GB/s effective only through XLA's latency-hiding
-scheduler overlapping weight streams with other work, which a standalone
-custom call cannot join. Integration waits until the kernel pipelines at
-parity (manual double-buffered DMA over the weight stream is the next step);
-v1's int4/int8 weight-only path remains the supported quantized serving mode.
+Status: building block, deliberately NOT on the v2 serving path. The v2
+engine's weight-only int8 (``inference/v2/ragged_model._mm``) uses XLA's own
+``convert(int8) -> dot`` INSIDE the fused layer scan instead: measured
+v5e-1 at decode shapes (M=32), XLA fuses the convert into the dot's tile
+pipeline and streams int8 weights at ~700 GB/s wire rate (~1.4 TB/s
+bf16-equivalent), which a standalone custom call cannot match because it
+cannot join the step program's latency-hiding schedule (this kernel
+standalone: 25-36 GB/s). Keep the two numerically in sync via
+tests/unit/test_quantized_matmul.py; scale layout here is ``[N]`` vs
+``[1, N]`` there (``_mm`` broadcasts over the fp32 accumulator).
 """
 
 from __future__ import annotations
