@@ -417,7 +417,8 @@ def bench_decode(on_tpu: bool) -> dict:
     hbm_peak = measure_hbm_stream()
     log(f"decode: measured HBM stream peak {hbm_peak:,.0f} GB/s")
 
-    def measure(kv_heads, n_seqs, measure_prefill, weight_bits=None):
+    def measure(kv_heads, n_seqs, measure_prefill, weight_bits=None,
+                window=None):
         """One engine at (kv_heads, n_seqs): optional prefill tput + the
         device-rate decode step. Decode timing: run the C1-step and C2-step
         fused programs (single dispatch + single ids fetch each, state reset
@@ -432,6 +433,7 @@ def bench_decode(on_tpu: bool) -> dict:
                           num_attention_heads=heads,
                           num_key_value_heads=kv_heads,
                           max_position_embeddings=ctx,
+                          sliding_window=window,
                           dtype=jnp.bfloat16 if on_tpu else jnp.float32)
         model = LlamaForCausalLM(cfg)
         params = _init_params(model, {"input_ids": jnp.zeros((1, 8), jnp.int32)})
@@ -483,9 +485,15 @@ def bench_decode(on_tpu: bool) -> dict:
         w_bytes = sum(x.size * x.dtype.itemsize
                       for x in jax.tree_util.tree_leaves(engine.weights)
                       ) - emb_bytes
-        # mean context over the DIFFERENCED window (steps C1..C2)
-        kv_bytes = 2 * n_seqs * (prompt + (C1 + C2) // 2) * kv_heads * \
-            (hidden // heads) * 2
+        # mean context over the DIFFERENCED window (steps C1..C2); a sliding
+        # window caps the attended span at PAGE granularity (the kernel DMAs
+        # whole pages overlapping [ctx-window, ctx))
+        eff_ctx = prompt + (C1 + C2) // 2
+        if window is not None and eff_ctx > window:
+            bs_pg = 128  # kv_cache block_size default used by these engines
+            eff_ctx = ((eff_ctx - 1) // bs_pg
+                       - (eff_ctx - window) // bs_pg + 1) * bs_pg
+        kv_bytes = 2 * n_seqs * eff_ctx * kv_heads * (hidden // heads) * 2
 
         t = time.time()
         for C in (C1, C2):                   # cold: compiles both programs
@@ -543,16 +551,20 @@ def bench_decode(on_tpu: bool) -> dict:
         #   - GQA legs at 64/128/256 seqs: grouped KV is the representative
         #     modern-serving operating point (FastGen-style batches).
         import gc
-        for key, kvh, nseq, wb in (
-                ("mha32_int8", heads, 32, 8),
-                ("mha64", heads, 64, None),
-                ("gqa64", 4, 64, None),
-                ("gqa128", 4, 128, None),
-                ("gqa256", 4, 256, None),
-                ("gqa256_int8", 4, 256, 8)):
+        #   - gqa256_win128: sliding-window serving leg (Mistral/Qwen2
+        #     analog): window mask + page-ring reuse in the paged kernels.
+        for key, kvh, nseq, wb, win in (
+                ("mha32_int8", heads, 32, 8, None),
+                ("mha64", heads, 64, None, None),
+                ("gqa64", 4, 64, None, None),
+                ("gqa128", 4, 128, None, None),
+                ("gqa256", 4, 256, None, None),
+                ("gqa256_int8", 4, 256, 8, None),
+                ("gqa256_win128", 4, 256, None, 128)):
             gc.collect()
             try:
-                leg, _, _ = measure(kvh, nseq, False, weight_bits=wb)
+                leg, _, _ = measure(kvh, nseq, False, weight_bits=wb,
+                                    window=win)
                 out[key] = leg
                 log(f"decode: {key} {leg['tokens_per_sec']:,.0f} tok/s "
                     f"({leg['hbm_frac']:.0%} of peak)")

@@ -115,6 +115,9 @@ class InferenceEngineV2:
         self.kv = BlockedKVCache(kv_cfg, self.topology)
         self.allocator = BlockedAllocator(nb)
         self.scheduler = DynamicSplitFuseScheduler(sm, self.kv, self.allocator)
+        # sliding-window serving (Mistral/Qwen2): the scheduler ring-reuses
+        # each sequence's pages beyond the window so KV stays bounded
+        self.scheduler.window = self.spec.window
 
         eff_tp = tp if (tp > 1 and self.spec.num_kv_heads % tp == 0
                         and self.spec.num_heads % tp == 0) else 1

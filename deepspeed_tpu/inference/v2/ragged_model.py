@@ -27,6 +27,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -62,6 +64,9 @@ class RaggedModelSpec:
     norm_plus_one: bool = False    # gemma: RMSNorm scales by (1 + weight)
     eps: float = 1e-5
     moe: Optional[Dict[str, int]] = None    # {"num_experts": E, "top_k": k}
+    # mistral/qwen2 sliding-window span (tokens); None = full attention.
+    # Reference parity: inference/v2/model_implementations/mistral.
+    window: Optional[int] = None
     dtype: Any = jnp.bfloat16
 
 
@@ -90,19 +95,12 @@ def adapt_llama(params: Dict, config,
         raise ValueError(f"llama-lineage mlp_act '{mlp_act}' has no ragged "
                          "gated-MLP mapping (expected 'silu' or 'gelu')")
     window = getattr(config, "sliding_window", None)
-    if window is not None and (max_context is None or max_context > window):
-        # mistral/qwen2 window attention: the paged kernels attend the full
-        # context. When the engine's max_context <= window no position can
-        # ever see past the window, so full attention is exactly equivalent
-        # and serving proceeds; beyond that, silently dropping the window
-        # would diverge from v1.
-        raise ValueError(
-            f"sliding_window={window} attention is not supported by the "
-            "ragged (paged) path when contexts can exceed the window "
-            f"(engine max_context={max_context}) — cap state_manager."
-            f"max_context at {window} (exact equivalence), serve through "
-            "deepspeed_tpu.init_inference (v1 dense engine), or unset "
-            "sliding_window if the model tolerates full attention")
+    if window is not None and (max_context is not None
+                               and max_context <= window):
+        # no position can ever see past the window: full attention is
+        # exactly equivalent, so skip the window masks (and their small
+        # kernel cost) entirely
+        window = None
     spec = RaggedModelSpec(
         family="mixtral" if moe else "llama",
         num_layers=config.num_hidden_layers,
@@ -116,7 +114,7 @@ def adapt_llama(params: Dict, config,
         rope_theta=config.rope_theta,
         embed_scale_by_sqrt_dim=getattr(config, "embed_scale_by_sqrt_dim", False),
         norm_plus_one=getattr(config, "norm_plus_one", False),
-        eps=config.rms_norm_eps, moe=moe, dtype=config.dtype)
+        eps=config.rms_norm_eps, moe=moe, window=window, dtype=config.dtype)
 
     layers = []
     for i in range(config.num_hidden_layers):
@@ -611,32 +609,37 @@ def build_ragged_forward(spec: RaggedModelSpec,
     hid = spec.hidden_size
     dtype = spec.dtype
 
+    decode_win = functools.partial(paged_decode_attention,
+                                   window=spec.window)
+    chunk_win = functools.partial(paged_chunk_attention_batched,
+                                  window=spec.window)
+
     def _decode_attn(q, k_l, v_l, bts, cls_):
         if tp > 1:
             from jax.sharding import PartitionSpec as P
             from deepspeed_tpu.comm.mesh import TENSOR_AXIS
             fn = _tp_wrap(
-                paged_decode_attention, mesh,
+                decode_win, mesh,
                 in_specs=(P(None, TENSOR_AXIS, None),
                           P(None, TENSOR_AXIS, None, None),
                           P(None, TENSOR_AXIS, None, None), P(None, None), P(None)),
                 out_specs=P(None, TENSOR_AXIS, None))
             return fn(q, k_l, v_l, bts, cls_)
-        return paged_decode_attention(q, k_l, v_l, bts, cls_)
+        return decode_win(q, k_l, v_l, bts, cls_)
 
     def _chunk_attn(q, k_l, v_l, bts, q0s, ctxs):
         if tp > 1:
             from jax.sharding import PartitionSpec as P
             from deepspeed_tpu.comm.mesh import TENSOR_AXIS
             fn = _tp_wrap(
-                paged_chunk_attention_batched, mesh,
+                chunk_win, mesh,
                 in_specs=(P(None, None, TENSOR_AXIS, None),
                           P(None, TENSOR_AXIS, None, None),
                           P(None, TENSOR_AXIS, None, None),
                           P(None, None), P(None), P(None)),
                 out_specs=P(None, None, TENSOR_AXIS, None))
             return fn(q, k_l, v_l, bts, q0s, ctxs)
-        return paged_chunk_attention_batched(q, k_l, v_l, bts, q0s, ctxs)
+        return chunk_win(q, k_l, v_l, bts, q0s, ctxs)
 
     def fwd(weights, k_pages, v_pages, b):
         NC = b["chunk_ntok"].shape[0]
@@ -710,18 +713,21 @@ def build_prefill_forward(spec: RaggedModelSpec,
     H, Hkv, D = spec.num_heads, spec.num_kv_heads, spec.head_dim
     dtype = spec.dtype
 
+    packed_win = functools.partial(flash_attention_packed,
+                                   window=spec.window)
+
     def _packed_attn(q, k, v, seg):
         if tp > 1:
             from jax.sharding import PartitionSpec as P
             from deepspeed_tpu.comm.mesh import TENSOR_AXIS
             fn = _tp_wrap(
-                flash_attention_packed, mesh,
+                packed_win, mesh,
                 in_specs=(P(None, TENSOR_AXIS, None),
                           P(None, TENSOR_AXIS, None),
                           P(None, TENSOR_AXIS, None), P(None)),
                 out_specs=P(None, TENSOR_AXIS, None))
             return fn(q, k, v, seg)
-        return flash_attention_packed(q, k, v, seg)
+        return packed_win(q, k, v, seg)
 
     def fwd(weights, k_pages, v_pages, b):
         NC = b["chunk_ntok"].shape[0]
@@ -792,13 +798,16 @@ def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
     H, Hkv, D = spec.num_heads, spec.num_kv_heads, spec.head_dim
     dtype = spec.dtype
 
+    step_win = functools.partial(paged_decode_attention_step,
+                                 window=spec.window)
+
     def _decode_step(q, k_new, v_new, k_l, v_l, bts, cls_):
         if tp > 1:
             from jax import shard_map
             from jax.sharding import PartitionSpec as P
             from deepspeed_tpu.comm.mesh import TENSOR_AXIS
             fn = shard_map(
-                paged_decode_attention_step, mesh=mesh,
+                step_win, mesh=mesh,
                 in_specs=(P(None, TENSOR_AXIS, None),
                           P(None, TENSOR_AXIS, None),
                           P(None, TENSOR_AXIS, None),
@@ -808,7 +817,7 @@ def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
                            P(None, TENSOR_AXIS, None, None),
                            P(None, TENSOR_AXIS, None, None)), check_vma=False)
             return fn(q, k_new, v_new, k_l, v_l, bts, cls_)
-        return paged_decode_attention_step(q, k_new, v_new, k_l, v_l, bts, cls_)
+        return step_win(q, k_new, v_new, k_l, v_l, bts, cls_)
 
     def fwd(weights, k_pages, v_pages, ids0, positions0, block_tables, ctx0,
             key, temperature=1.0):

@@ -36,6 +36,35 @@ class DynamicSplitFuseScheduler:
         self.seqs: Dict[int, DSSequenceDescriptor] = {}
         bs = cache.config.block_size
         self.max_blocks = -(-config.max_context // bs)
+        # sliding-window span (set by the engine from the model spec). With a
+        # window, per-sequence physical KV is a PAGE RING of ring_pages
+        # blocks: logical page i beyond the ring reuses blocks[i - ring];
+        # dead tokens are overwritten in place, so a sequence's KV footprint
+        # is bounded by the window however long it runs (the ZeRO-Inference
+        # long-context analog of the reference's sliding cache).
+        self.window: Optional[int] = None
+
+    @property
+    def _pass_take_cap(self) -> int:
+        """Max prompt tokens one sequence may take in one pass under a
+        window (also bounds the live span the ring must cover)."""
+        cfg = self.config
+        return min(self.window + self.cache.config.block_size,
+                   cfg.num_chunk_slots * cfg.chunk_slot_size)
+
+    @property
+    def ring_pages(self) -> Optional[int]:
+        """Physical pages per sequence under a window. The live span during
+        a pass is [earliest_query - window + 1, write_head]: a chunked
+        continuation pass of T tokens still needs ``window`` tokens behind
+        its FIRST query row while writing T ahead, so the ring covers
+        window + T (+1 page of slack) — not just the window. Aliased logical
+        pages are then >= ring*bs > window + T tokens apart: no pass can
+        read or scatter-collide with a page it is overwriting."""
+        if self.window is None:
+            return None
+        bs = self.cache.config.block_size
+        return -(-(self.window + self._pass_take_cap) // bs) + 1
 
     # ------------------------------------------------------------------ #
     # sequence admission (parity: engine_v2.put token intake)
@@ -60,27 +89,41 @@ class DynamicSplitFuseScheduler:
         """Release a sequence's KV blocks (parity: ``engine_v2.flush``)."""
         seq = self.seqs.pop(uid, None)
         if seq is not None and seq.blocks:
-            self.allocator.free(seq.blocks)
+            # ring reuse repeats physical ids in the logical list — free each once
+            self.allocator.free(dict.fromkeys(seq.blocks))
 
     # ------------------------------------------------------------------ #
     # capacity queries (parity: engine_v2.query/can_schedule :153-227)
     # ------------------------------------------------------------------ #
+
+    def _new_blocks_needed(self, seq: DSSequenceDescriptor,
+                           new_tokens: int) -> int:
+        """Fresh allocator blocks required for ``new_tokens`` more tokens —
+        under a window, capped by the ring (pages beyond it are reuses)."""
+        bs = self.cache.config.block_size
+        need = seq.kv_blocks_needed(new_tokens, bs)
+        ring = self.ring_pages
+        if ring is not None:
+            need = min(need, max(0, ring - len(seq.blocks)))
+        return need
 
     def query(self, uid: int, max_request_tokens: int) -> Tuple[int, int]:
         """(max new tokens fundable by free blocks, free blocks). Accounts for
         queued-but-unprocessed pending tokens, which will consume the same pool."""
         seq = self.seqs.get(uid, DSSequenceDescriptor(uid=uid))
         bs = self.cache.config.block_size
+        if self.ring_pages is not None and len(seq.blocks) >= self.ring_pages:
+            # ring complete: any request fits in place (up to max_context)
+            return max_request_tokens, self.allocator.free_blocks
         slack = len(seq.blocks) * bs - seq.seen_tokens - len(seq.pending)
         fundable = max(0, slack + self.allocator.free_blocks * bs)
         return min(max_request_tokens, fundable), self.allocator.free_blocks
 
     def can_schedule(self, uids: List[int], lengths: List[int]) -> bool:
-        bs = self.cache.config.block_size
         needed = 0
         for uid, n in zip(uids, lengths):
             seq = self.seqs.get(uid, DSSequenceDescriptor(uid=uid))
-            needed += seq.kv_blocks_needed(len(seq.pending) + n, bs)
+            needed += self._new_blocks_needed(seq, len(seq.pending) + n)
         if needed > self.allocator.free_blocks:
             return False
         new = sum(1 for u in uids if u not in self.seqs)
@@ -116,9 +159,20 @@ class DynamicSplitFuseScheduler:
     # ------------------------------------------------------------------ #
 
     def _ensure_blocks(self, seq: DSSequenceDescriptor, new_tokens: int) -> None:
-        need = seq.kv_blocks_needed(new_tokens, self.cache.config.block_size)
-        if need:
-            seq.blocks.extend(int(b) for b in self.allocator.allocate(need))
+        bs = self.cache.config.block_size
+        ring = self.ring_pages
+        if ring is None:
+            need = seq.kv_blocks_needed(new_tokens, bs)
+            if need:
+                seq.blocks.extend(int(b) for b in self.allocator.allocate(need))
+            return
+        target = -(-(seq.seen_tokens + new_tokens) // bs)   # logical pages
+        fresh = min(max(0, target - len(seq.blocks)),
+                    max(0, ring - len(seq.blocks)))
+        if fresh:
+            seq.blocks.extend(int(b) for b in self.allocator.allocate(fresh))
+        while len(seq.blocks) < target:                      # ring reuse
+            seq.blocks.append(seq.blocks[len(seq.blocks) - ring])
 
     def schedule_pass(self) -> Optional[RaggedBatch]:
         """Build the next pass, or None when no pending work exists."""
@@ -168,6 +222,12 @@ class DynamicSplitFuseScheduler:
             if sl >= NC:
                 break
             take = min(len(seq.pending), (NC - sl) * Cs)
+            if self.window is not None:
+                # the ring covers window + _pass_take_cap tokens of live
+                # span; taking more in one pass would overwrite pages the
+                # pass's own queries still need (the remainder prefills on
+                # the next pass)
+                take = min(take, self._pass_take_cap)
             self._ensure_blocks(seq, take)
             blocks = np.asarray(seq.blocks, np.int32)
             batch.chunk_uids.append(seq.uid)
@@ -176,9 +236,16 @@ class DynamicSplitFuseScheduler:
                 from_zero = False
             else:
                 # from position 0, tokens fill pages in order: one plan entry
-                # per touched page, rows contiguous from this seq's first row
+                # per touched page, rows contiguous from this seq's first row.
+                # Under a window, pages wholly dead by the end of the take are
+                # skipped — their tokens are never attended again, and writing
+                # them could collide with a ring-reused live page in the same
+                # scatter.
                 r0_seq = sl * Cs
                 for p in range(-(-take // bs)):
+                    if (self.window is not None
+                            and (p + 1) * bs <= take - self.window):
+                        continue
                     batch.page_ids[pw] = blocks[p]
                     batch.page_rows[pw] = r0_seq + p * bs
                     batch.page_fill[pw] = min(bs, take - p * bs)

@@ -141,12 +141,16 @@ def _fwd(q, k, v, scale: float, causal: bool,
 
 
 def _fwd_kernel_packed(segq_ref, segk_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                       acc_sc, m_sc, l_sc, *, scale, block_q, block_k, nk):
+                       acc_sc, m_sc, l_sc, *, scale, block_q, block_k, nk,
+                       window=None):
     """Flash forward over PACKED rows: causal by global row index AND masked to
     same-segment pairs. Row order within a segment must be position order
     (true for ragged prefill batches: the scheduler fills slots in position
-    order, multi-slot prompts take consecutive slots), so row-index causality
-    equals position causality and cross-segment pairs are masked out."""
+    order, multi-slot prompts take consecutive slots — asserted where the
+    batch is built, scheduler.schedule_pass), so row-index causality equals
+    position causality and cross-segment pairs are masked out. ``window``
+    additionally hides same-segment pairs more than window-1 rows apart
+    (row distance == position distance under the same invariant)."""
     iq, ik = pl.program_id(1), pl.program_id(2)
 
     @pl.when(ik == 0)
@@ -157,6 +161,9 @@ def _fwd_kernel_packed(segq_ref, segk_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     # packed rows are globally causal by row index (see docstring)
     should_run = ik * block_k <= iq * block_q + block_q - 1
+    if window is not None:
+        should_run = should_run & \
+            ((ik + 1) * block_k > iq * block_q - window + 1)
 
     @pl.when(should_run)
     def _():
@@ -170,6 +177,8 @@ def _fwd_kernel_packed(segq_ref, segk_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         seg_q = segq_ref[0, :].reshape(-1, 1)          # [bq, 1]
         seg_k = segk_ref[0, :].reshape(1, -1)          # [1, bk]
         mask = (q_idx >= k_idx) & (seg_q == seg_k)
+        if window is not None:
+            mask = mask & (q_idx - k_idx < window)
         s = jnp.where(mask, s, NEG_INF)
         m_prev = m_sc[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -194,7 +203,8 @@ def flash_attention_packed(q: jax.Array, k: jax.Array, v: jax.Array,
                            segment_ids: jax.Array,
                            softmax_scale: Optional[float] = None,
                            block_q: int = 512, block_k: int = 512,
-                           with_lse: bool = False):
+                           with_lse: bool = False,
+                           window: Optional[int] = None):
     """Packed ragged-prefill flash attention (inference fast path; fwd only).
 
     q [R, H, D]; k/v [R, Hkv, D] (GQA kv repeated in here); segment_ids [R]
@@ -233,7 +243,7 @@ def flash_attention_packed(q: jax.Array, k: jax.Array, v: jax.Array,
     seg = segment_ids.astype(jnp.int32)[None]   # [1, Rp]
 
     kernel = functools.partial(_fwd_kernel_packed, scale=scale,
-                               block_q=bq, block_k=bk, nk=nk)
+                               block_q=bq, block_k=bk, nk=nk, window=window)
     o, lse = pl.pallas_call(
         kernel,
         grid=(H, nq, nk),

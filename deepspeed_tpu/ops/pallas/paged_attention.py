@@ -65,19 +65,23 @@ def _pick_pages_per_chunk(bs: int, h_kv: int, d: int, esize: int,
     return max(1, min(max_blocks, budget // per_page))
 
 
-def _chunk_mask(c, ctx_limit, T, h_kv, bs, H):
+def _chunk_mask(c, ctx_limit, T, h_kv, bs, H, tok_lo=None):
     """[H, P*Hkv*bs] block-diagonal + context mask for a head-major chunk
     slab: column j <-> (page p = j // (Hkv*bs), kv head (j // bs) % Hkv,
     token p*bs + j % bs); row i's kv head is i // G. Built directly in 2D —
     merging a (sublane, lane) pair via reshape is a relayout Mosaic
-    rejects."""
+    rejects. ``tok_lo`` (sliding window) additionally hides tokens below
+    the window start."""
     W = (T // bs) * h_kv * bs  # == P * Hkv * bs
     col = jax.lax.broadcasted_iota(jnp.int32, (H, W), 1)
     groups = H // h_kv
     row_kv = jax.lax.broadcasted_iota(jnp.int32, (H, W), 0) // groups
     tok = c * T + (col // (h_kv * bs)) * bs + jax.lax.rem(col, bs)
     col_kv = jax.lax.rem(col // bs, h_kv)
-    return jnp.logical_and(col_kv == row_kv, tok < ctx_limit)
+    mask = jnp.logical_and(col_kv == row_kv, tok < ctx_limit)
+    if tok_lo is not None:
+        mask = jnp.logical_and(mask, tok >= tok_lo)
+    return mask
 
 
 def _flash_update(sc, mask, vv, m_sc, l_sc, acc_sc):
@@ -102,11 +106,18 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
                  k_hbm, v_hbm, o_ref,
                  k_buf, v_buf, sems, acc_sc, m_sc, l_sc, *,
                  scale, block_size, pages_per_chunk, n_chunks, max_blocks,
-                 n_seqs, h_kv, groups):
+                 n_seqs, h_kv, groups, window=None):
     """Shared batched-decode body (see module docstring). With
     ``knew_ref/vnew_ref`` (step mode) the pages hold tokens [0, ctx-1) and
     the current token's attention term folds in from registers at finalize;
-    without them the pages hold everything (ctx tokens)."""
+    without them the pages hold everything (ctx tokens).
+
+    ``window`` (static, sliding-window serving — Mistral/Qwen2 parity,
+    reference ``inference/v2/model_implementations/mistral``): the query at
+    position ctx-1 attends only tokens >= ctx - window. Chunks wholly below
+    the window start are skipped (grid range) and pages outside
+    [window_lo, ctx) are neither DMA'd nor computed — the window bounds the
+    per-step KV read the way the reference's sliding cache does."""
     inline_current = knew_ref is not None
     ctx_off = 1 if inline_current else 0
     P, bs, T = pages_per_chunk, block_size, pages_per_chunk * block_size
@@ -114,49 +125,100 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
     g = s * n_chunks + c                   # global step: the pipeline clock
     H = h_kv * groups
 
+    def tok_lo_of(s_):
+        # first visible token (window start); 0 without a window
+        if window is None:
+            return jnp.int32(0)
+        return jnp.maximum(cl_ref[s_] - window, 0)
+
+    def c0_of(s_):
+        # first REAL chunk index (chunks wholly below the window skip).
+        # Clamped to the last chunk: window=1 in step mode has tok_lo ==
+        # ctx-1, which on a chunk boundary would otherwise give c0 == nc and
+        # an empty chunk range — finalize must always run once.
+        if window is None:
+            return jnp.int32(0)
+        return jnp.minimum(jax.lax.div(tok_lo_of(s_), T),
+                           n_chunks_of(s_) - 1)
+
     def n_chunks_of(s_):
         # every sequence runs >= 1 chunk (ctx 0 rows mask to zeros)
         return jax.lax.div(jnp.maximum(cl_ref[s_] - ctx_off, 1) + (T - 1), T)
 
+    def page_needed(s_, c_, j):
+        """Page j of chunk c_ overlaps [tok_lo, ctx - ctx_off)? Skipped
+        pages are neither started nor waited (identical predicate on both
+        sides keeps the semaphore counts consistent)."""
+        t0 = (c_ * P + j) * bs
+        need = t0 < jnp.maximum(cl_ref[s_] - ctx_off, 1)
+        if window is not None:
+            need = jnp.logical_and(need, t0 + bs > tok_lo_of(s_))
+        return need
+
     def chunk_copies(s_, c_, slot):
-        """The 2P page-copy descriptors for chunk c_ of sequence s_ (built
-        identically at start and wait — same (src, dst, sem) triples)."""
+        """The per-page copy descriptors for chunk c_ of sequence s_ (built
+        identically at start and wait — same (src, dst, sem) triples and
+        the same ``page_needed`` predicates)."""
         cps = []
         for j in range(P):
             page = bt_ref[s_, jnp.minimum(c_ * P + j, max_blocks - 1)]
-            cps.append(pltpu.make_async_copy(
-                k_hbm.at[page], k_buf.at[slot, j], sems.at[slot]))
-            cps.append(pltpu.make_async_copy(
-                v_hbm.at[page], v_buf.at[slot, j], sems.at[slot]))
+            cps.append((page_needed(s_, c_, j), pltpu.make_async_copy(
+                k_hbm.at[page], k_buf.at[slot, j], sems.at[slot])))
+            cps.append((page_needed(s_, c_, j), pltpu.make_async_copy(
+                v_hbm.at[page], v_buf.at[slot, j], sems.at[slot])))
         return cps
 
-    @pl.when(g == 0)
-    def _():                               # prime the pipeline
-        for cp in chunk_copies(0, 0, 0):
-            cp.start()
+    def start_copies(s_, c_, slot):
+        for need, cp in chunk_copies(s_, c_, slot):
+            @pl.when(need)
+            def _():
+                cp.start()
+
+    def wait_copies(s_, c_, slot):
+        for j2, (need, cp) in enumerate(chunk_copies(s_, c_, slot)):
+            @pl.when(need)
+            def _():
+                cp.wait()
+            if j2 % 2 == 1:  # V copy of page j2 // 2
+                # a skipped page's V buffer holds garbage; the online-softmax
+                # p rows are exactly 0 there, but 0 * NaN = NaN, so the V slab
+                # must be finite — zero it (K needs nothing: masked scores are
+                # replaced before use)
+                @pl.when(jnp.logical_not(need))
+                def _():
+                    v_buf[slot, j2 // 2] = jnp.zeros_like(v_buf[slot, j2 // 2])
+
+    # prime the pipeline — only when chunk (0, 0) is real (with a window,
+    # sequence 0 may start at a later chunk, whose copy is issued by the
+    # preceding grid step's next-real block below; priming chunk 0 anyway
+    # would put stale completions on the slot-0 semaphore)
+    @pl.when(jnp.logical_and(g == 0, c0_of(0) == 0))
+    def _():
+        start_copies(0, 0, 0)
 
     # issue the next REAL chunk's DMA before this chunk's compute; unreal
-    # steps (c beyond this sequence's chunk count) still run this control so
+    # steps (c outside this sequence's chunk range) still run this control so
     # the two-slot protocol stays consistent across skipped steps
     s_n = jax.lax.div(g + 1, n_chunks)
     c_n = jax.lax.rem(g + 1, n_chunks)
-    next_real = jnp.logical_and(g + 1 < n_seqs * n_chunks, c_n < n_chunks_of(s_n))
+    next_real = jnp.logical_and(
+        g + 1 < n_seqs * n_chunks,
+        jnp.logical_and(c_n < n_chunks_of(s_n), c_n >= c0_of(s_n)))
 
     @pl.when(next_real)
     def _():
-        for cp in chunk_copies(s_n, c_n, jax.lax.rem(g + 1, 2)):
-            cp.start()
+        start_copies(s_n, c_n, jax.lax.rem(g + 1, 2))
 
     ctx = cl_ref[s]
     nc_s = n_chunks_of(s)
+    c0_s = c0_of(s)
 
-    @pl.when(c < nc_s)
+    @pl.when(jnp.logical_and(c < nc_s, c >= c0_s))
     def _():
         slot = jax.lax.rem(g, 2)
-        for cp in chunk_copies(s, c, slot):
-            cp.wait()
+        wait_copies(s, c, slot)
 
-        @pl.when(c == 0)
+        @pl.when(c == c0_s)
         def _():
             m_sc[:] = jnp.full_like(m_sc, NEG_INF)
             l_sc[:] = jnp.zeros_like(l_sc)
@@ -165,7 +227,8 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
         q = q_ref[0]                                           # [H, D]
         kk = k_buf[slot].reshape(P * h_kv * bs, -1)            # leading-dim
         vv = v_buf[slot].reshape(P * h_kv * bs, -1)            # collapse only
-        mask = _chunk_mask(c, ctx - ctx_off, T, h_kv, bs, H)
+        mask = _chunk_mask(c, ctx - ctx_off, T, h_kv, bs, H,
+                           tok_lo=None if window is None else tok_lo_of(s))
         # dots run in the page dtype (bf16 MXU path for serving caches) with
         # f32 accumulation; identical math to before for f32 pools
         sc = jax.lax.dot_general(q.astype(kk.dtype), kk,
@@ -217,7 +280,7 @@ def _decode_kernel(bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref,
 
 def _decode_kernel_smalld(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
                           acc_sc, m_sc, l_sc, *, scale, block_size,
-                          max_blocks, h_kv, groups):
+                          max_blocks, h_kv, groups, window=None):
     """BlockSpec-pipelined fallback for head dims the manual-DMA path can't
     carry (Mosaic requires DMA lane extents aligned to 128; D=64-class
     models land here). One grid step = (sequence, page), pages pulled by the
@@ -234,12 +297,13 @@ def _decode_kernel_smalld(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
         acc_sc[:] = jnp.zeros_like(acc_sc)
 
     ctx = cl_ref[s]
+    lo = jnp.int32(0) if window is None else jnp.maximum(ctx - window, 0)
 
-    @pl.when(i * bs < ctx)
+    @pl.when(jnp.logical_and(i * bs < ctx, (i + 1) * bs > lo))
     def _():
         q = q_ref[0].astype(jnp.float32)                       # [H, D]
         tok = i * bs + jax.lax.broadcasted_iota(jnp.int32, (H, bs), 1)
-        mask = tok < ctx
+        mask = jnp.logical_and(tok < ctx, tok >= lo)
         for h in range(h_kv):
             rows = slice(h * groups, (h + 1) * groups)
             qh = q[rows, :]                                    # [G, D]
@@ -267,14 +331,15 @@ def _decode_kernel_smalld(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_sc[:] / safe_l).astype(o_ref.dtype)
 
 
-def _paged_decode_smalld(q, k_pages, v_pages, block_tables, ctx_lens, scale):
+def _paged_decode_smalld(q, k_pages, v_pages, block_tables, ctx_lens, scale,
+                         window=None):
     S, H, D = q.shape
     NB, Hkv, bs, _ = k_pages.shape
     G = H // Hkv
     MB = block_tables.shape[1]
     kernel = functools.partial(_decode_kernel_smalld, scale=scale,
                                block_size=bs, max_blocks=MB, h_kv=Hkv,
-                               groups=G)
+                               groups=G, window=window)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S, MB),
@@ -306,7 +371,8 @@ def paged_decode_attention(q: jax.Array,
                            v_pages: jax.Array,
                            block_tables: jax.Array,
                            ctx_lens: jax.Array,
-                           softmax_scale: Optional[float] = None) -> jax.Array:
+                           softmax_scale: Optional[float] = None,
+                           window: Optional[int] = None) -> jax.Array:
     """Single-token-per-sequence attention over a paged KV cache.
 
     q:            [S, H, D]        one query token per sequence
@@ -314,6 +380,8 @@ def paged_decode_attention(q: jax.Array,
     v_pages:      [NB, H_kv, bs, D]
     block_tables: [S, MB] int32    physical page ids per sequence (0-padded)
     ctx_lens:     [S] int32        tokens visible per sequence (incl. current)
+    window:       optional static sliding-window span (Mistral-style): only
+                  tokens >= ctx - window are attended or read.
 
     Returns [S, H, D]. Rows whose ctx_len is 0 return zeros.
     """
@@ -326,13 +394,14 @@ def paged_decode_attention(q: jax.Array,
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
     if D % 128 != 0:   # manual-DMA lane-alignment limit — see _paged_decode_smalld
         return _paged_decode_smalld(q, k_pages, v_pages, block_tables,
-                                    ctx_lens, scale)
+                                    ctx_lens, scale, window=window)
     P = _pick_pages_per_chunk(bs, Hkv, D, jnp.dtype(k_pages.dtype).itemsize, MB)
     NC = -(-MB // P)
 
     kernel = functools.partial(
         _decode_kernel, scale=scale, block_size=bs, pages_per_chunk=P,
-        n_chunks=NC, max_blocks=MB, n_seqs=S, h_kv=Hkv, groups=G)
+        n_chunks=NC, max_blocks=MB, n_seqs=S, h_kv=Hkv, groups=G,
+        window=window)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S, NC),
@@ -401,10 +470,12 @@ def paged_decode_attention_step(q: jax.Array,
                                 v_pages: jax.Array,
                                 block_tables: jax.Array,
                                 ctx_lens: jax.Array,
-                                softmax_scale: Optional[float] = None):
+                                softmax_scale: Optional[float] = None,
+                                window: Optional[int] = None):
     """One fused decode step per sequence: write ``k_new/v_new`` (the current
     token's K/V, position ``ctx_lens - 1``) into the paged cache AND return
-    attention over the full context including the current token.
+    attention over the full context including the current token (with
+    ``window``, over the trailing ``window`` tokens only).
 
     q:            [S, H, D]       k_new/v_new: [S, H_kv, D]
     k/v_pages:    [NB, H_kv, bs, D] — ALIASED: the returned pools reuse the
@@ -435,7 +506,8 @@ def paged_decode_attention_step(q: jax.Array,
             v_new.reshape(S * Hkv, D).astype(v_pages.dtype), mode="drop")
         kf = kf.reshape(NB, Hkv, bs, D)
         vf = vf.reshape(NB, Hkv, bs, D)
-        out = _paged_decode_smalld(q, kf, vf, block_tables, ctx_lens, scale)
+        out = _paged_decode_smalld(q, kf, vf, block_tables, ctx_lens, scale,
+                                   window=window)
         return out, kf, vf
     P = _pick_pages_per_chunk(bs, Hkv, D, jnp.dtype(k_pages.dtype).itemsize, MB)
     NC = -(-MB // P)
@@ -443,7 +515,8 @@ def paged_decode_attention_step(q: jax.Array,
 
     kernel = functools.partial(
         _decode_step_kernel, scale=scale, block_size=bs, pages_per_chunk=P,
-        n_chunks=NC, max_blocks=MB, n_seqs=S, h_kv=Hkv, groups=G)
+        n_chunks=NC, max_blocks=MB, n_seqs=S, h_kv=Hkv, groups=G,
+        window=window)
     flat = (NB, Hkv * bs, D)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
@@ -499,7 +572,8 @@ def paged_decode_attention_step(q: jax.Array,
 
 def paged_decode_attention_step_reference(q, k_new, v_new, k_pages, v_pages,
                                           block_tables, ctx_lens,
-                                          softmax_scale: Optional[float] = None):
+                                          softmax_scale: Optional[float] = None,
+                                          window: Optional[int] = None):
     """jnp reference: scatter the new rows, then dense paged-decode reference."""
     S, H, D = q.shape
     NB, Hkv, bs, _ = k_pages.shape
@@ -515,7 +589,7 @@ def paged_decode_attention_step_reference(q, k_new, v_new, k_pages, v_pages,
         v_new.reshape(S * Hkv, D).astype(v_pages.dtype),
         mode="drop").reshape(NB, Hkv, bs, D)
     out = paged_decode_attention_reference(q, kf, vf, block_tables, ctx_lens,
-                                           softmax_scale)
+                                           softmax_scale, window=window)
     return out, kf, vf
 
 
@@ -526,7 +600,8 @@ def paged_chunk_attention(q: jax.Array,
                           q_start,
                           ctx_len,
                           softmax_scale: Optional[float] = None,
-                          block_q: int = 128) -> jax.Array:
+                          block_q: int = 128,
+                          window: Optional[int] = None) -> jax.Array:
     """Prompt-chunk (prefill) flash attention over one sequence's paged KV.
 
     The single-chunk convenience wrapper: one slot of
@@ -546,15 +621,17 @@ def paged_chunk_attention(q: jax.Array,
         q[None], k_pages, v_pages, jnp.asarray(block_table)[None],
         jnp.asarray(q_start, jnp.int32)[None],
         jnp.asarray(ctx_len, jnp.int32)[None],
-        softmax_scale=softmax_scale, block_q=block_q)[0]
+        softmax_scale=softmax_scale, block_q=block_q, window=window)[0]
 
 
 def _chunk_kernel_batched(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
                           acc_sc, m_sc, l_sc, *, scale, block_size, block_q,
-                          max_blocks, h_kv, groups):
+                          max_blocks, h_kv, groups, window=None):
     """Multi-slot variant of ``_chunk_kernel``: grid (slot, q-block, page);
     each slot is an independent prompt chunk with its own block table and
-    (q_start, ctx) row in ``meta_ref``. Slot padding (ctx 0) writes zeros."""
+    (q_start, ctx) row in ``meta_ref``. Slot padding (ctx 0) writes zeros.
+    With ``window``, row q_pos attends only k_pos > q_pos - window (and
+    pages wholly below the q-block's window skip)."""
     sl, iq, i = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     q0 = meta_ref[sl, 0]
     ctx = meta_ref[sl, 1]
@@ -567,6 +644,9 @@ def _chunk_kernel_batched(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
 
     run = (i * block_size <= q0 + iq * block_q + block_q - 1) & \
           (i * block_size < ctx)
+    if window is not None:
+        # lowest visible k for this q block: min q_pos - window + 1
+        run = run & ((i + 1) * block_size > q0 + iq * block_q - window + 1)
 
     @pl.when(run)
     def _():
@@ -575,6 +655,8 @@ def _chunk_kernel_batched(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
         q_pos = q0 + iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bs), 0)
         k_pos = i * bs + jax.lax.broadcasted_iota(jnp.int32, (bq, bs), 1)
         mask = (k_pos <= q_pos) & (k_pos < ctx)
+        if window is not None:
+            mask = mask & (k_pos > q_pos - window)
         mask = jnp.broadcast_to(mask[:, None, :], (bq, G, bs)).reshape(bq * G, bs)
 
         for h in range(h_kv):
@@ -614,7 +696,8 @@ def paged_chunk_attention_batched(q: jax.Array,
                                   q_starts: jax.Array,
                                   ctx_lens: jax.Array,
                                   softmax_scale: Optional[float] = None,
-                                  block_q: int = 128) -> jax.Array:
+                                  block_q: int = 128,
+                                  window: Optional[int] = None) -> jax.Array:
     """Prefill flash attention for SEVERAL prompt chunks in one kernel.
 
     Multi-chunk SplitFuse: a pass that carries one chunk per pallas call
@@ -645,7 +728,7 @@ def paged_chunk_attention_batched(q: jax.Array,
                       jnp.asarray(ctx_lens, jnp.int32)], axis=1)   # [NC, 2]
     kernel = functools.partial(_chunk_kernel_batched, scale=scale,
                                block_size=bs, block_q=bq, max_blocks=MB,
-                               h_kv=Hkv, groups=G)
+                               h_kv=Hkv, groups=G, window=window)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(NC, nq, MB),
@@ -676,18 +759,20 @@ def paged_chunk_attention_batched(q: jax.Array,
 
 def paged_chunk_attention_batched_reference(q, k_pages, v_pages, block_tables,
                                             q_starts, ctx_lens,
-                                            softmax_scale: Optional[float] = None):
+                                            softmax_scale: Optional[float] = None,
+                                            window: Optional[int] = None):
     """jnp reference: per-slot single-chunk reference, stacked."""
     outs = []
     for sl in range(q.shape[0]):
         outs.append(paged_chunk_attention_reference(
             q[sl], k_pages, v_pages, block_tables[sl],
-            q_starts[sl], ctx_lens[sl], softmax_scale))
+            q_starts[sl], ctx_lens[sl], softmax_scale, window=window))
     return jnp.stack(outs)
 
 
 def paged_chunk_attention_reference(q, k_pages, v_pages, block_table, q_start,
-                                    ctx_len, softmax_scale: Optional[float] = None):
+                                    ctx_len, softmax_scale: Optional[float] = None,
+                                    window: Optional[int] = None):
     """jnp reference for the chunk kernel (materialises the [C, MB*bs] scores)."""
     C, H, D = q.shape
     NB, Hkv, bs, _ = k_pages.shape
@@ -704,6 +789,8 @@ def paged_chunk_attention_reference(q, k_pages, v_pages, block_table, q_start,
     q_pos = q_start + jnp.arange(C)
     k_pos = jnp.arange(MB * bs)
     mask = (k_pos[None, :] <= q_pos[:, None]) & (k_pos[None, :] < ctx_len)
+    if window is not None:
+        mask = mask & (k_pos[None, :] > q_pos[:, None] - window)
     sc = jnp.where(mask[None], sc, NEG_INF)
     p = jax.nn.softmax(sc, axis=-1)
     p = jnp.where(jnp.any(mask, axis=-1)[None, :, None], p, 0.0)
@@ -712,7 +799,8 @@ def paged_chunk_attention_reference(q, k_pages, v_pages, block_table, q_start,
 
 
 def paged_decode_attention_reference(q, k_pages, v_pages, block_tables, ctx_lens,
-                                     softmax_scale: Optional[float] = None):
+                                     softmax_scale: Optional[float] = None,
+                                     window: Optional[int] = None):
     """jnp reference (gathers each sequence's pages — the copy the kernel avoids)."""
     S, H, D = q.shape
     NB, Hkv, bs, _ = k_pages.shape
@@ -728,6 +816,9 @@ def paged_decode_attention_reference(q, k_pages, v_pages, block_tables, ctx_lens
     sc = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),
                     k_seq.astype(jnp.float32)) * scale
     mask = jnp.arange(MB * bs)[None, None, :] < ctx_lens[:, None, None]
+    if window is not None:
+        mask = mask & (jnp.arange(MB * bs)[None, None, :]
+                       >= jnp.maximum(ctx_lens - window, 0)[:, None, None])
     sc = jnp.where(mask, sc, NEG_INF)
     p = jax.nn.softmax(sc, axis=-1)
     p = jnp.where(ctx_lens[:, None, None] > 0, p, 0.0)

@@ -1,0 +1,188 @@
+"""Collective-mix and sharding-contract assertions on the COMPILED step.
+
+Round-3 verdict item 5: nothing previously compiled the fused step and
+asserted what the partitioner emitted, so a lowering regression (e.g. a
+sharding annotation silently dropped) would pass the numeric suite. These
+tests pin two layers:
+
+1. The ENGINE's contract — ZeRO stages as sharding specs (the analog of the
+   reference's hand-scheduled collectives, ``runtime/zero/stage_1_and_2.py:1004``
+   / ``stage3.py:1183``): state sharding specs per stage, asserted directly
+   on the engine state's NamedShardings.
+2. The PARTITIONER's output — collective ops counted in the optimized HLO of
+   the fused step on the 8-device CPU mesh.
+
+Backend caveat (measured, documents the limits of layer 2): the CPU SPMD
+partitioner lowers stage>=2 grad reduction as all-reduce + slice rather
+than reduce-scatter, and pipeline ppermute as masked all-reduce — the op
+CHOICE is XLA's per backend. The reduce-scatter assertion therefore only
+activates on a real multi-device TPU mesh (skipped on CPU); what the CPU
+mesh CAN pin — Ulysses all-to-all counts, ring collective-permute, grad
+all-reduce at stage 0, param all-gathers at stage>=1, and every sharding
+annotation — is asserted unconditionally.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.mesh import build_topology, set_topology
+from deepspeed_tpu.config import MeshConfig
+
+
+COLLECTIVES = ("all-reduce", "reduce-scatter", "all-gather", "all-to-all",
+               "collective-permute")
+
+
+def collective_counts(txt: str, min_elems: int = 1):
+    """Per-op counts of collective defs in optimized HLO text whose result
+    carries >= min_elems elements (sum over tuple members). A size floor of
+    ~2048 filters the scalar loss/metric all-reduces out of grad-path
+    assertions."""
+    counts = {op: 0 for op in COLLECTIVES}
+    pat = re.compile(r"\s*%(" + "|".join(COLLECTIVES) + r")[-.\d]* = (.*)")
+    for line in txt.splitlines():
+        m = pat.match(line)
+        if not m:
+            continue
+        op, rest = m.group(1), m.group(2)
+        rest = rest.split(f" {op}(")[0].split(f" {op}-start(")[0]
+        elems = 0
+        for dims in re.findall(r"\[([0-9,]*)\]", rest):
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            elems += n
+        if elems >= min_elems:
+            counts[op] += 1
+    return counts
+
+
+def _engine(stage, mesh_cfg, model_kind="gpt2", model_kw=None, bs=8):
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    topo = set_topology(build_topology(mesh_cfg, devices=jax.devices()[:8]))
+    if model_kind == "gpt2":
+        model = GPT2LMHead(GPT2Config.tiny())
+    else:
+        model = LlamaForCausalLM(
+            LlamaConfig.tiny(dtype=jnp.float32, **(model_kw or {})))
+    batch = {"input_ids": np.zeros((bs, 16), np.int32)}
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    zcfg = {"stage": stage}
+    if stage >= 3:
+        zcfg["stage3_param_persistence_threshold"] = 0
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, mesh_topology=topo,
+        config={"train_batch_size": bs, "steps_per_print": 0,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "bf16": {"enabled": True},   # mixed precision: state carries
+                                             # a compute-params tree to assert
+                "zero_optimization": zcfg})
+    return engine, batch
+
+
+def _lower(engine, batch) -> str:
+    """Optimized HLO text of the fused step, compiled (not run)."""
+    engine._ensure_state(batch)
+    sharded = engine._shard_global_batch(batch)
+    return jax.jit(engine._build_fused_step()).lower(
+        engine.state, sharded).compile().as_text()
+
+
+def _specs(tree):
+    return {s.spec for s in jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda x: x.sharding, tree))}
+
+
+# --------------------------------------------------------------------------- #
+# layer 1: stages as sharding specs — the engine's contract
+# --------------------------------------------------------------------------- #
+
+def test_stage_sharding_contract(eight_devices):
+    from jax.sharding import PartitionSpec as P
+    # stage 1: params replicated, fp32 master + opt states fsdp-sharded
+    e1, b1 = _engine(1, MeshConfig(fsdp=8))
+    e1._ensure_state(b1)
+    assert _specs(e1.state["params"]) == {P()}
+    assert any(s != P() for s in _specs(e1.state["master"]))
+    assert any(s != P() for s in _specs(e1.state["opt"]))
+    # stage 3: parameters themselves sharded (threshold 0)
+    e3, b3 = _engine(3, MeshConfig(fsdp=8))
+    e3._ensure_state(b3)
+    assert any(s != P() for s in _specs(e3.state["params"]))
+    # stage 0: everything replicated
+    e0, b0 = _engine(0, MeshConfig(data=8))
+    e0._ensure_state(b0)
+    assert _specs(e0.state["params"]) == {P()}
+
+
+def test_grad_spec_policy_per_stage(eight_devices):
+    """stage>=2 constrains grads to the master sharding (the reduce-scatter
+    CONTRACT — the backend chooses the op); stage<2 leaves them replicated."""
+    from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu.runtime.zero.partition import ZeroPartitioner
+    from deepspeed_tpu.comm.mesh import build_topology
+    topo = set_topology(build_topology(MeshConfig(fsdp=8),
+                                       devices=jax.devices()[:8]))
+    params = {"w": jnp.zeros((64, 64)), "b": jnp.zeros((64,))}
+    for stage, expect_sharded in ((0, False), (1, False), (2, True), (3, True)):
+        part = ZeroPartitioner(stage, topo)
+        specs = set(jax.tree_util.tree_leaves(
+            part.grad_spec(params), is_leaf=lambda s: isinstance(s, P)))
+        assert (any(s != P() for s in specs)) == expect_sharded, \
+            (stage, specs)
+
+
+# --------------------------------------------------------------------------- #
+# layer 2: collective mix in the compiled step (CPU-mesh-stable subset)
+# --------------------------------------------------------------------------- #
+
+def test_stage0_grads_all_reduce_no_gather(eight_devices):
+    engine, batch = _engine(0, MeshConfig(data=8))
+    c = collective_counts(_lower(engine, batch), min_elems=2048)
+    assert c["all-reduce"] >= 1, c       # DP grad averaging
+    assert c["all-gather"] == 0, c       # params replicated: nothing to gather
+
+
+def test_stage1_and_3_param_all_gathers(eight_devices):
+    for stage, mesh in ((1, MeshConfig(fsdp=8)),
+                        (3, MeshConfig(fsdp=8)),
+                        (3, MeshConfig(fsdp=4, data=2))):
+        engine, batch = _engine(stage, mesh)
+        c = collective_counts(_lower(engine, batch), min_elems=2048)
+        assert c["all-gather"] >= 1, (stage, c)
+
+
+def test_ulysses_all_to_all_count(eight_devices):
+    """Ulysses SP: 2 all-to-alls around each attention (head-scatter /
+    seq-gather), doubled by the backward transposes and by the separate
+    q and kv streams -> 8 per layer; the tiny model has 2 layers."""
+    engine, batch = _engine(
+        1, MeshConfig(seq=4, data=2), model_kind="llama",
+        model_kw=dict(sequence_parallel=True, num_attention_heads=4,
+                      num_key_value_heads=4))
+    c = collective_counts(_lower(engine, batch))
+    assert c["all-to-all"] == 16, c
+
+
+def test_ring_attention_collective_permute(eight_devices):
+    engine, batch = _engine(1, MeshConfig(seq=4, data=2), model_kind="llama",
+                            model_kw=dict(context_parallel=True))
+    c = collective_counts(_lower(engine, batch))
+    assert c["collective-permute"] >= 1, c  # the KV ring rotation (in-scan)
+
+
+@pytest.mark.skipif(
+    jax.default_backend() != "tpu" or len(jax.devices()) < 2,
+    reason="reduce-scatter emission is a TPU-partitioner choice; the CPU "
+           "partitioner lowers stage>=2 grads as all-reduce+slice (measured)")
+def test_stage2_grads_reduce_scatter_on_tpu():
+    engine, batch = _engine(2, MeshConfig(fsdp=len(jax.devices())))
+    c = collective_counts(_lower(engine, batch), min_elems=2048)
+    assert c["reduce-scatter"] >= 1, c
