@@ -575,6 +575,20 @@ def bench_decode(on_tpu: bool) -> dict:
             out["gqa256_decode_tokens_per_sec"] = \
                 out["gqa256"]["tokens_per_sec"]
             out["hbm_frac_gqa256"] = out["gqa256"]["hbm_frac"]
+    # KV tier capacity framing (ZeRO-Inference analog, reference README.md:23):
+    # persistent bytes per cached token across ALL layers at the GQA serving
+    # shape — the int8 tier (v1 kv_quant, per-token-per-head f32 scales)
+    # multiplies servable context x batch at fixed HBM by ~2x
+    hd = hidden // heads
+    kvh = 4 if on_tpu else heads            # the gqa serving legs' kv heads
+    bf16_tok = layers * 2 * kvh * hd * 2
+    int8_tok = layers * 2 * kvh * (hd + 4)
+    out["kv_tier"] = {
+        "bytes_per_token_bf16": bf16_tok,
+        "bytes_per_token_int8": int8_tok,
+        "kv_heads": kvh, "layers": layers,
+        "capacity_multiplier": round(bf16_tok / int8_tok, 3),
+    }
     return out
 
 

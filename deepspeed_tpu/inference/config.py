@@ -46,6 +46,21 @@ class WeightQuantConfig(ConfigModel):
 
 
 @dataclass
+class KVQuantConfig(ConfigModel):
+    """int8 KV cache (ZeRO-Inference long-context tier — the reference pairs
+    weight quantization with KV-cache offload/quantization for its 20x claim,
+    README.md:23). Per-token-per-head symmetric int8 with f32 scales: the
+    persistent cache halves, so max servable context x batch at fixed HBM
+    ~doubles. Supported by the llama-lineage v1 path."""
+    enabled: bool = False
+    bits: int = 8
+
+    def __post_init__(self):
+        if self.enabled and self.bits != 8:
+            raise ConfigError(f"kv_quant.bits must be 8, got {self.bits!r}")
+
+
+@dataclass
 class InferenceCheckpointConfig(ConfigModel):
     """Parity: checkpoint loading args of ``DeepSpeedInferenceConfig``."""
     checkpoint_dir: Optional[str] = None
@@ -59,6 +74,7 @@ class InferenceConfig(ConfigModel):
     tensor_parallel: TPConfig = field(default_factory=TPConfig)
     moe: InferenceMoEConfig = field(default_factory=InferenceMoEConfig)
     quant: WeightQuantConfig = field(default_factory=WeightQuantConfig)
+    kv_quant: KVQuantConfig = field(default_factory=KVQuantConfig)
     checkpoint: InferenceCheckpointConfig = field(default_factory=InferenceCheckpointConfig)
     max_out_tokens: int = 1024
     min_out_tokens: int = 1

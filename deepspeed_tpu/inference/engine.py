@@ -203,8 +203,10 @@ class InferenceEngine:
         tp = topo.tp_world_size
 
         def sh(x):
+            # rank >= 5: KV arrays [L, B, S, H_kv, D]; rank 4: the int8
+            # tier's scale arrays [L, B, S, H_kv] — same batch/head layout
             spec = [None] * x.ndim
-            if x.ndim >= 5:
+            if x.ndim >= 4:
                 if x.shape[1] % max(topo.sizes[DATA_AXIS], 1) == 0:
                     spec[1] = DATA_AXIS
                 if tp > 1 and x.shape[3] % tp == 0:
@@ -215,12 +217,24 @@ class InferenceEngine:
 
     def _make_cache(self, batch_size: int, max_len: int):
         fn = self._init_cache_fn
+        from deepspeed_tpu.models.decoder import DecoderLM, init_decoder_cache
+        from deepspeed_tpu.models.llama import init_cache
         if fn is None:
-            from deepspeed_tpu.models.decoder import DecoderLM, init_decoder_cache
-            from deepspeed_tpu.models.llama import init_cache
             fn = (init_decoder_cache if isinstance(self.module, DecoderLM)
                   else init_cache)
-        cache = fn(self.model_config, batch_size, max_len, dtype=self._dtype)
+        if self.config.kv_quant.enabled:
+            # int8 KV tier (ZeRO-Inference analog): llama-lineage dense cache
+            # only — other families' decode paths read plain {k, v} caches
+            if fn is not init_cache:
+                raise NotImplementedError(
+                    "kv_quant is supported for the llama-lineage v1 cache "
+                    "(models/llama.py init_cache); this model family's cache "
+                    "has no int8 tier yet")
+            cache = fn(self.model_config, batch_size, max_len,
+                       dtype=self._dtype, kv_bits=self.config.kv_quant.bits)
+        else:
+            cache = fn(self.model_config, batch_size, max_len,
+                       dtype=self._dtype)
         return jax.device_put(cache, self._cache_sharding(cache))
 
     # ------------------------------------------------------------------ #

@@ -274,14 +274,37 @@ class LlamaAttention(nn.Module):
 
     def decode(self, x, positions, layer_cache, cache_index):
         """Incremental step: append this step's K/V at ``cache_index`` and attend
-        over the filled prefix. layer_cache: {"k","v"}: [B, S_max, H_kv, D]."""
+        over the filled prefix. layer_cache: {"k","v"}: [B, S_max, H_kv, D] —
+        or the int8 tier with "k_scale"/"v_scale" [B, S_max, H_kv] f32
+        (quantize on append, dequant fused into the attention read)."""
         cfg = self.config
         B, T, _ = x.shape
         q, k, v = self._qkv(x, positions)
-        ck = jax.lax.dynamic_update_slice(layer_cache["k"], k.astype(layer_cache["k"].dtype),
-                                          (0, cache_index, 0, 0))
-        cv = jax.lax.dynamic_update_slice(layer_cache["v"], v.astype(layer_cache["v"].dtype),
-                                          (0, cache_index, 0, 0))
+        new_cache = {}
+        if "k_scale" in layer_cache:
+            for name, rows in (("k", k), ("v", v)):
+                scale = jnp.max(jnp.abs(rows.astype(jnp.float32)),
+                                axis=-1) / 127.0                    # [B,T,Hkv]
+                scale = jnp.maximum(scale, 1e-8)
+                q8 = jnp.clip(jnp.round(rows.astype(jnp.float32)
+                                        / scale[..., None]),
+                              -127, 127).astype(jnp.int8)
+                new_cache[name] = jax.lax.dynamic_update_slice(
+                    layer_cache[name], q8, (0, cache_index, 0, 0))
+                new_cache[f"{name}_scale"] = jax.lax.dynamic_update_slice(
+                    layer_cache[f"{name}_scale"], scale, (0, cache_index, 0))
+            ck = (new_cache["k"].astype(x.dtype)
+                  * new_cache["k_scale"].astype(x.dtype)[..., None])
+            cv = (new_cache["v"].astype(x.dtype)
+                  * new_cache["v_scale"].astype(x.dtype)[..., None])
+        else:
+            ck = jax.lax.dynamic_update_slice(
+                layer_cache["k"], k.astype(layer_cache["k"].dtype),
+                (0, cache_index, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                layer_cache["v"], v.astype(layer_cache["v"].dtype),
+                (0, cache_index, 0, 0))
+            new_cache = {"k": ck, "v": cv}
         S = ck.shape[1]
         n_rep = cfg.num_attention_heads // cfg.num_key_value_heads
         kk, vv = repeat_kv(ck, n_rep), repeat_kv(cv, n_rep)
@@ -291,7 +314,7 @@ class LlamaAttention(nn.Module):
         bias = _window_bias(positions, k_pos, cfg.sliding_window)
         out = reference_attention(q, kk, vv, bias=bias)
         out = self.o_proj(out.reshape(B, T, cfg.num_attention_heads * cfg.head_dim))
-        return out, {"k": ck, "v": cv}
+        return out, new_cache
 
 
 class LlamaMLP(nn.Module):
@@ -400,15 +423,15 @@ def decode_layers(model, input_ids, cache, cache_index, positions):
     if getattr(model.config, "embed_scale_by_sqrt_dim", False):
         x = (x.astype(jnp.float32)
              * (model.config.hidden_size ** 0.5)).astype(x.dtype)
-    new_k, new_v = [], []
+    new_cols = {key: [] for key in cache}
     for i, layer in enumerate(model.layers):
-        layer_cache = {"k": cache["k"][i], "v": cache["v"][i]}
+        layer_cache = {key: cache[key][i] for key in cache}
         x, nc = layer.decode(x, positions, layer_cache, cache_index)
-        new_k.append(nc["k"])
-        new_v.append(nc["v"])
+        for key in new_cols:
+            new_cols[key].append(nc[key])
     x = model.norm(x)
     logits = model.lm_head(x).astype(jnp.float32)
-    return logits, {"k": jnp.stack(new_k), "v": jnp.stack(new_v)}
+    return logits, {key: jnp.stack(cols) for key, cols in new_cols.items()}
 
 
 class LlamaForCausalLM(nn.Module):
@@ -473,10 +496,22 @@ class LlamaForCausalLM(nn.Module):
 
 
 def init_cache(config: LlamaConfig, batch_size: int, max_len: int,
-               dtype: Any = None) -> Dict[str, jax.Array]:
+               dtype: Any = None, kv_bits: Any = None) -> Dict[str, jax.Array]:
     """Dense per-sequence KV cache (inference v1 path; the v2 engine uses the
-    blocked/paged cache in deepspeed_tpu.inference.ragged instead)."""
+    blocked/paged cache in deepspeed_tpu.inference.ragged instead).
+
+    ``kv_bits=8``: int8 storage with per-token-per-head f32 scales
+    (ZeRO-Inference KV tier — the persistent cache halves, so servable
+    context x batch at fixed HBM ~doubles; reference README.md:23)."""
     dtype = dtype or config.dtype
     shape = (config.num_hidden_layers, batch_size, max_len,
              config.num_key_value_heads, config.head_dim)
+    if kv_bits == 8:
+        sshape = shape[:-1]
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(sshape, jnp.float32),
+                "v_scale": jnp.zeros(sshape, jnp.float32)}
+    if kv_bits is not None:
+        raise ValueError(f"kv_bits must be None or 8, got {kv_bits!r}")
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}

@@ -140,3 +140,58 @@ class TestInferenceEngine:
         prompt = np.asarray([[5, 7, 11, 13]])
         out = engine.generate(prompt, max_new_tokens=3)
         assert out.shape == (1, 7)
+
+
+# --------------------------------------------------------------------------- #
+# int8 KV cache tier (ZeRO-Inference analog — reference README.md:23 pairs
+# weight quantization with a KV tier for its long-context serving claim)
+# --------------------------------------------------------------------------- #
+
+def _tiny_llama_v1(kv_quant):
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=128,
+                      dtype=jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 {"input_ids": jnp.zeros((1, 8), jnp.int32)}
+                                 )["params"]
+    kw = {"kv_quant": {"enabled": True}} if kv_quant else {}
+    return deepspeed_tpu.init_inference(model=model, model_parameters=params,
+                                        dtype="float32", **kw), cfg
+
+
+def test_kv_quant_greedy_parity(eight_devices):
+    rng = np.random.RandomState(0)
+    prompts = np.stack([rng.randint(0, 128, size=(24,)).astype(np.int32)
+                        for _ in range(2)])
+    e_bf, _ = _tiny_llama_v1(False)
+    e_q8, _ = _tiny_llama_v1(True)
+    ids_bf = np.asarray(e_bf.generate(prompts, max_new_tokens=12))
+    ids_q8 = np.asarray(e_q8.generate(prompts, max_new_tokens=12))
+    assert (ids_bf == ids_q8).mean() >= 0.9
+
+
+def test_kv_quant_cache_bytes_halve(eight_devices):
+    from deepspeed_tpu.models.llama import LlamaConfig, init_cache
+    # real-model head_dim (128): scale overhead is 4/256 of the bf16 bytes
+    cfg = LlamaConfig(vocab_size=128, hidden_size=512, intermediate_size=1024,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=4, max_position_embeddings=256,
+                      dtype=jnp.bfloat16)
+    b16 = sum(np.prod(v.shape) * v.dtype.itemsize
+              for v in init_cache(cfg, 4, 256).values())
+    b8 = sum(np.prod(v.shape) * v.dtype.itemsize
+             for v in init_cache(cfg, 4, 256, kv_bits=8).values())
+    assert b8 / b16 < 0.53, b8 / b16
+
+
+def test_kv_quant_rejects_non_llama_cache(eight_devices):
+    # a custom (non-llama) cache builder has no int8 tier: the engine must
+    # refuse loudly instead of handing the family a cache it cannot read
+    from deepspeed_tpu.models.decoder import init_decoder_cache
+    eng, _ = _tiny_llama_v1(True)
+    eng._init_cache_fn = init_decoder_cache
+    with pytest.raises(NotImplementedError):
+        eng._make_cache(1, 8)
