@@ -35,7 +35,16 @@ def _check(name: str, *arrays: np.ndarray) -> None:
                 "params/grads/states must be the same flat length")
 
 
-class HostAdam:
+class _HostKernelBase:
+    @property
+    def backend(self) -> str:
+        """Which implementation actually runs: 'openmp' (C++ ds_native) or
+        'numpy' (fallback) — recorded in the bench artifact so offload
+        numbers are attributable."""
+        return "openmp" if self._lib is not None else "numpy"
+
+
+class HostAdam(_HostKernelBase):
     """In-place Adam/AdamW step on host buffers: p, m, v mutated; g read-only."""
 
     def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
@@ -80,7 +89,7 @@ class HostAdam:
         params -= np.float32(lr) * upd
 
 
-class HostAdagrad:
+class HostAdagrad(_HostKernelBase):
     def __init__(self, lr: float = 1e-2, eps: float = 1e-10,
                  weight_decay: float = 0.0):
         self.lr = lr
@@ -104,7 +113,7 @@ class HostAdagrad:
         params -= np.float32(lr) * g / (np.sqrt(exp_avg_sq) + self.eps)
 
 
-class HostLion:
+class HostLion(_HostKernelBase):
     def __init__(self, lr: float = 1e-4, betas=(0.9, 0.99),
                  weight_decay: float = 0.0):
         self.lr = lr

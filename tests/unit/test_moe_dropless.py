@@ -119,3 +119,32 @@ def test_mixtral_dropless_matches_hf():
     got = np.asarray(drop_module.apply(variables, jnp.asarray(ids),
                                        method=type(module).forward_logits))
     np.testing.assert_allclose(got, ref, atol=2e-3, rtol=1e-3)
+
+
+def test_moe_ep_x_tp_composition(eight_devices):
+    """EP x TP x DP on one mesh (round-3 verdict item 6): expert=2 x
+    tensor=2 x data=2 over 8 devices, capacity dispatch (the mode that
+    shards experts over the 'expert' axis), full engine step — loss finite
+    and decreasing."""
+    import deepspeed_tpu
+    from deepspeed_tpu.comm.mesh import build_topology, set_topology
+    from deepspeed_tpu.config import MeshConfig
+    from deepspeed_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+    topo = set_topology(build_topology(
+        MeshConfig(expert=2, tensor=2, data=2), devices=jax.devices()[:8]))
+    cfg = MixtralConfig.tiny(num_local_experts=2, dispatch_mode="capacity",
+                             dtype=jnp.float32)
+    model = MixtralForCausalLM(cfg)
+    batch = {"input_ids": np.zeros((4, 16), np.int32)}
+    params = model.init(jax.random.PRNGKey(0), batch)["params"]
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, mesh_topology=topo,
+        config={"train_batch_size": 4, "steps_per_print": 0,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 1}})
+    rng = np.random.RandomState(0)
+    b = {"input_ids": rng.randint(0, cfg.vocab_size,
+                                  size=(4, 16)).astype(np.int32)}
+    losses = [float(engine.train_batch(b)) for _ in range(5)]
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses
