@@ -37,7 +37,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def build_engine(on_tpu: bool, seqs: int, prompt: int, gen: int,
-                 burst: int = 8):
+                 burst: int = 8, int8: bool = False):
     import jax
     import jax.numpy as jnp
     from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
@@ -73,15 +73,19 @@ def build_engine(on_tpu: bool, seqs: int, prompt: int, gen: int,
         params = jax.jit(model.init)(
             jax.random.PRNGKey(0),
             {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
-    engine = InferenceEngineV2(
-        model=model, model_parameters=params,
-        config={"state_manager": {
-            "max_tracked_sequences": seqs,
-            "max_ragged_sequence_count": seqs,
-            # chunk capacity for a handful of concurrent prefills per pass
-            "max_ragged_batch_size": 4 * prompt + seqs,
-            "prefill_chunk_size": prompt,
-            "max_context": ctx}})
+    econf = {"state_manager": {
+        "max_tracked_sequences": seqs,
+        "max_ragged_sequence_count": seqs,
+        # chunk capacity for a handful of concurrent prefills per pass
+        "max_ragged_batch_size": 4 * prompt + seqs,
+        "prefill_chunk_size": prompt,
+        "max_context": ctx}}
+    if int8:
+        # weight-only int8 serving (the v2 mixed-GEMM analog): decode is
+        # weight-read bound, int8 halves the stream (bench.py mha32 legs)
+        econf["quantization"] = {"weight_bits": 8}
+    engine = InferenceEngineV2(model=model, model_parameters=params,
+                               config=econf)
     return engine, vocab
 
 
@@ -226,6 +230,8 @@ def main():
     ap.add_argument("--gen", type=int, default=64)
     ap.add_argument("--rates", default="2,6")
     ap.add_argument("--duration", type=float, default=20.0)
+    ap.add_argument("--int8", action="store_true",
+                    help="weight-only int8 serving (quantization.weight_bits=8)")
     ap.add_argument("--burst", type=int, default=16,
                     help="fused decode tokens per host round trip (measured "
                          "v5e-1 tunnel saturation: burst 8 -> 3.6k total "
@@ -239,7 +245,7 @@ def main():
     setup_compile_cache(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     engine, vocab = build_engine(on_tpu, args.seqs, args.prompt, args.gen,
-                                 burst=args.burst)
+                                 burst=args.burst, int8=args.int8)
     rng = np.random.RandomState(0)
     # warm run compiles every pass shape (prefill, mixed, fused burst)
     run_load_point(engine, vocab, rate=50.0, seqs=args.seqs,
