@@ -106,7 +106,7 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
                  k_hbm, v_hbm, o_ref,
                  k_buf, v_buf, sems, acc_sc, m_sc, l_sc, *,
                  scale, block_size, pages_per_chunk, n_chunks, max_blocks,
-                 n_seqs, h_kv, groups, window=None):
+                 n_seqs, h_kv, groups, window=None, lse_ref=None):
     """Shared batched-decode body (see module docstring). With
     ``knew_ref/vnew_ref`` (step mode) the pages hold tokens [0, ctx-1) and
     the current token's attention term folds in from registers at finalize;
@@ -242,6 +242,13 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
                 l = l_sc[:, 0:1]
                 safe_l = jnp.where(l > 0.0, l, 1.0)
                 o_ref[0] = (acc_sc[:] / safe_l).astype(o_ref.dtype)
+                if lse_ref is not None:
+                    # lse = m + log(l) per head; NEG_INF when nothing was
+                    # attended (the merge hook for a second attention piece —
+                    # same contract as flash_attention_packed's lse output)
+                    lse = m_sc[:, 0:1] + jnp.log(safe_l)
+                    lse_ref[0] = jnp.broadcast_to(
+                        jnp.where(l > 0.0, lse, NEG_INF), lse_ref[0].shape)
                 return
             # fold in the current token from registers (one extra softmax
             # column per head group), then normalise
@@ -276,6 +283,12 @@ def _decode_kernel(bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref,
                    k_buf, v_buf, sems, acc_sc, m_sc, l_sc, **kw):
     _decode_body(bt_ref, cl_ref, q_ref, None, None, k_hbm, v_hbm, o_ref,
                  k_buf, v_buf, sems, acc_sc, m_sc, l_sc, **kw)
+
+
+def _decode_kernel_lse(bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
+                       k_buf, v_buf, sems, acc_sc, m_sc, l_sc, **kw):
+    _decode_body(bt_ref, cl_ref, q_ref, None, None, k_hbm, v_hbm, o_ref,
+                 k_buf, v_buf, sems, acc_sc, m_sc, l_sc, lse_ref=lse_ref, **kw)
 
 
 def _decode_kernel_smalld(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
@@ -372,7 +385,8 @@ def paged_decode_attention(q: jax.Array,
                            block_tables: jax.Array,
                            ctx_lens: jax.Array,
                            softmax_scale: Optional[float] = None,
-                           window: Optional[int] = None) -> jax.Array:
+                           window: Optional[int] = None,
+                           with_lse: bool = False):
     """Single-token-per-sequence attention over a paged KV cache.
 
     q:            [S, H, D]        one query token per sequence
@@ -382,8 +396,12 @@ def paged_decode_attention(q: jax.Array,
     ctx_lens:     [S] int32        tokens visible per sequence (incl. current)
     window:       optional static sliding-window span (Mistral-style): only
                   tokens >= ctx - window are attended or read.
+    with_lse:     also return lse [S, H] f32 (m + log l; NEG_INF for empty
+                  rows) — the hook for merging with a second attention piece
+                  (the fused multistep side-buffer path).
 
-    Returns [S, H, D]. Rows whose ctx_len is 0 return zeros.
+    Returns [S, H, D] (plus lse when requested). Rows whose ctx_len is 0
+    return zeros.
     """
     S, H, D = q.shape
     NB, Hkv, bs, Dk = k_pages.shape
@@ -393,15 +411,25 @@ def paged_decode_attention(q: jax.Array,
     MB = block_tables.shape[1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
     if D % 128 != 0:   # manual-DMA lane-alignment limit — see _paged_decode_smalld
+        assert not with_lse, "with_lse needs the manual-DMA path (D % 128 == 0)"
         return _paged_decode_smalld(q, k_pages, v_pages, block_tables,
                                     ctx_lens, scale, window=window)
     P = _pick_pages_per_chunk(bs, Hkv, D, jnp.dtype(k_pages.dtype).itemsize, MB)
     NC = -(-MB // P)
 
     kernel = functools.partial(
-        _decode_kernel, scale=scale, block_size=bs, pages_per_chunk=P,
+        _decode_kernel_lse if with_lse else _decode_kernel,
+        scale=scale, block_size=bs, pages_per_chunk=P,
         n_chunks=NC, max_blocks=MB, n_seqs=S, h_kv=Hkv, groups=G,
         window=window)
+    out_spec = pl.BlockSpec((1, H, D), lambda s, c, bt, cl: (s, 0, 0))
+    out_shape = jax.ShapeDtypeStruct((S, H, D), q.dtype)
+    if with_lse:
+        # lse rides as a [1, H, 128] f32 block (broadcast along the lane dim:
+        # a bare [1, H] output would hand Mosaic a sub-lane tile)
+        out_spec = [out_spec,
+                    pl.BlockSpec((1, H, 128), lambda s, c, bt, cl: (s, 0, 0))]
+        out_shape = [out_shape, jax.ShapeDtypeStruct((S, H, 128), jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S, NC),
@@ -410,7 +438,7 @@ def paged_decode_attention(q: jax.Array,
             pl.BlockSpec(memory_space=pl.ANY),     # K pages stay in HBM;
             pl.BlockSpec(memory_space=pl.ANY),     # chunks stream via DMA
         ],
-        out_specs=pl.BlockSpec((1, H, D), lambda s, c, bt, cl: (s, 0, 0)),
+        out_specs=out_spec,
         scratch_shapes=[
             # pages flattened to [Hkv*bs, D] rows — (bs, D) trailing tiles,
             # aligned for any head count
@@ -424,10 +452,10 @@ def paged_decode_attention(q: jax.Array,
     )
     assert (bs * Hkv) % 8 == 0, \
         f"page rows {Hkv}*{bs} must align to the 8-sublane tile"
-    return pl.pallas_call(
+    res = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
+        out_shape=out_shape,
         compiler_params=pltpu.CompilerParams(
             # the 2-slot DMA pipeline hands buffers across grid steps (and
             # across sequences), so iteration order must stay sequential
@@ -435,6 +463,9 @@ def paged_decode_attention(q: jax.Array,
         interpret=_interpret(),
     )(block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32), q,
       k_pages.reshape(NB, Hkv * bs, D), v_pages.reshape(NB, Hkv * bs, D))
+    if with_lse:
+        return res[0], res[1][:, :, 0]
+    return res
 
 
 def _decode_step_kernel(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
@@ -800,7 +831,8 @@ def paged_chunk_attention_reference(q, k_pages, v_pages, block_table, q_start,
 
 def paged_decode_attention_reference(q, k_pages, v_pages, block_tables, ctx_lens,
                                      softmax_scale: Optional[float] = None,
-                                     window: Optional[int] = None):
+                                     window: Optional[int] = None,
+                                     with_lse: bool = False):
     """jnp reference (gathers each sequence's pages — the copy the kernel avoids)."""
     S, H, D = q.shape
     NB, Hkv, bs, _ = k_pages.shape
@@ -823,4 +855,8 @@ def paged_decode_attention_reference(q, k_pages, v_pages, block_tables, ctx_lens
     p = jax.nn.softmax(sc, axis=-1)
     p = jnp.where(ctx_lens[:, None, None] > 0, p, 0.0)
     out = jnp.einsum("sht,sthd->shd", p, v_seq.astype(jnp.float32))
+    if with_lse:
+        lse = jax.scipy.special.logsumexp(sc, axis=-1)
+        lse = jnp.where(ctx_lens[:, None] > 0, lse, NEG_INF)
+        return out.astype(q.dtype), lse
     return out.astype(q.dtype)
