@@ -296,11 +296,13 @@ class InferenceEngineV2:
         the generated ids [len(uids), n_steps]; the engine's last-logits refs
         advance so normal put()/sample_next() calls can continue after.
 
-        ``fetch=False`` returns the DEVICE array of shape [n_steps, S]
-        instead (transpose after ``np.asarray`` to match): the call then
-        costs only a dispatch, so back-to-back bursts chain on device —
-        through a remote runtime the synchronous ids fetch is ~an RTT per
-        burst, which would otherwise serialise host RTT into every burst."""
+        ``fetch=False`` returns the DEVICE array, already shaped [S,
+        n_steps] like the fetched result (the transpose is a free layout op
+        on device — ADVICE r4: the old [n_steps, S] return was a silent-
+        corruption footgun when S == n_steps): the call then costs only a
+        dispatch, so back-to-back bursts chain on device — through a remote
+        runtime the synchronous ids fetch is ~an RTT per burst, which would
+        otherwise serialise host RTT into every burst."""
         uids = [int(u) for u in uids]
         S = len(uids)
         assert not self.scheduler.has_pending(), \
@@ -317,10 +319,15 @@ class InferenceEngineV2:
             from deepspeed_tpu.inference.v2.ragged_model import (
                 build_multistep_decode)
             tp = self.topology.tp_world_size
+            # windowed side-buffer chunks freeze page reads while writing
+            # n_steps (+1 reserved) tokens at the flush — safe only when the
+            # scheduler's page ring covers the frozen span
+            win_ok = self.scheduler.ring_covers(n_steps + 1)
             fwd = build_multistep_decode(self.spec, n_steps,
                                          mesh=self.topology.mesh,
                                          tp=tp if tp > 1 else 1,
-                                         do_sample=do_sample, top_k=top_k)
+                                         do_sample=do_sample, top_k=top_k,
+                                         window_ring_ok=win_ok)
             return jax.jit(fwd, donate_argnums=(1, 2))
 
         fn = self._multistep.get_or_create(
@@ -336,7 +343,7 @@ class InferenceEngineV2:
             self._last_ref[u] = (final_logits, i)
             self._last_logits.pop(u, None)
         if not fetch:
-            return out_ids              # device [n_steps, S]
+            return out_ids.T            # device [S, n_steps]
         return np.asarray(out_ids).T    # [S, n_steps]
 
     def _run_pass(self) -> None:

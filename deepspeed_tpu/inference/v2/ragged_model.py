@@ -36,7 +36,7 @@ import numpy as np
 from deepspeed_tpu.ops.pallas.flash_attention import flash_attention_packed
 from deepspeed_tpu.ops.pallas.paged_attention import (
     paged_chunk_attention_batched, paged_decode_attention,
-    paged_decode_attention_step)
+    paged_decode_attention_sidebuf, paged_decode_attention_step)
 
 
 @dataclass
@@ -785,22 +785,35 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
     dense+scatter 4.3 ms, full 7.0 ms). Here the pools stay FROZEN for the
     whole chunk:
 
-      - each layer's new K/V rows accumulate in a step-major side buffer
-        [C, S, Hkv, D] (one contiguous dynamic_update_slice per step);
-      - attention per step = paged kernel over the frozen prefix
-        (with_lse) MERGED with dense masked attention over the side buffer
-        (both pieces carry (m, l); standard logsumexp merge);
+      - each layer's new K/V rows accumulate in a sequence-major side buffer
+        [L, S, C, Hkv, D] (one contiguous dynamic_update_slice per step);
+      - attention per step = ONE fused kernel over the frozen prefix pages
+        plus the side slab (``paged_decode_attention_sidebuf``): the side
+        rows fold into the same online-softmax state, so the kernel reads
+        one sequence's [C, Hkv, D] slab into VMEM instead of the round-4
+        schedule's per-layer-per-step jnp re-read of the whole [C, S, Hkv,
+        D] buffer + lse merge;
       - ONE page-granular read-modify-write flushes the side buffers into
         the pools at chunk end (~n_span pages per sequence per layer,
         amortized over the C steps).
 
-    Used when window is None, tp == 1, and head_dim % 128 == 0 (the paged
-    kernel's lse path); other configs take the general loop below.
+    Used when tp == 1 and head_dim % 128 == 0 (the fused kernel's
+    alignment); other configs take the general loop below. ``window`` is
+    admitted (the kernel windows both pieces by the moving query position);
+    the page-ring flush stays correct because the flush only touches pages
+    holding positions >= prefix, which the ring never recycles mid-chunk.
     """
     H, Hkv, D = spec.num_heads, spec.num_kv_heads, spec.head_dim
     G = H // Hkv
     dtype = spec.dtype
+    # side-slab CAPACITY is n_steps padded so Cb*Hkv aligns to the 8-sublane
+    # tile (MQA Hkv=1 with arbitrary n_steps stays on the fast path; padded
+    # rows are never visible: the kernel masks cc > j and j < n_steps, and
+    # the flush only writes rows < n_steps)
     C = n_steps
+    Cb = n_steps
+    while (Cb * Hkv) % 8 != 0:
+        Cb += 1
     scale = 1.0 / (D ** 0.5)
 
     def fwd(weights, k_pages, v_pages, ids0, positions0, block_tables, ctx0,
@@ -814,8 +827,8 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
         # token; the pages hold only the frozen prefix [0, ctx0 - 1) — the
         # current token (and everything after) lives in the side buffers
         prefix = jnp.maximum(ctx0 - 1, 0)
-        side_k0 = jnp.zeros((L, C, S, Hkv, D), dtype)
-        side_v0 = jnp.zeros((L, C, S, Hkv, D), dtype)
+        side_k0 = jnp.zeros((L, S, Cb, Hkv, D), dtype)
+        side_v0 = jnp.zeros((L, S, Cb, Hkv, D), dtype)
 
         def one_pass(x_ids, pos, j, sk_all, sv_all):
             x = _embed_in(spec, weights, x_ids, pos)
@@ -829,40 +842,19 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
 
                 def attend(q, k, v):
                     sk_new = jax.lax.dynamic_update_slice(
-                        sk_all, k[None, None].astype(sk_all.dtype),
-                        (l, j, 0, 0, 0))
+                        sk_all, k[None, :, None].astype(sk_all.dtype),
+                        (l, 0, j, 0, 0))
                     sv_new = jax.lax.dynamic_update_slice(
-                        sv_all, v[None, None].astype(sv_all.dtype),
-                        (l, j, 0, 0, 0))
+                        sv_all, v[None, :, None].astype(sv_all.dtype),
+                        (l, 0, j, 0, 0))
                     sk = jax.lax.dynamic_slice(
-                        sk_new, (l, 0, 0, 0, 0), (1, C, S, Hkv, D))[0]
+                        sk_new, (l, 0, 0, 0, 0), (1, S, Cb, Hkv, D))[0]
                     sv = jax.lax.dynamic_slice(
-                        sv_new, (l, 0, 0, 0, 0), (1, C, S, Hkv, D))[0]
-                    # frozen-prefix piece (tokens [0, ctx0))
-                    out_p, lse_p = paged_decode_attention(
+                        sv_new, (l, 0, 0, 0, 0), (1, S, Cb, Hkv, D))[0]
+                    out = paged_decode_attention_sidebuf(
                         q, kp4, vp4, block_tables + l * NB, prefix,
-                        with_lse=True)
-                    # side piece (tokens ctx0 .. ctx0+j, current included)
-                    qg = q.reshape(S, Hkv, G, D).astype(jnp.float32)
-                    sc = jnp.einsum("shgd,cshd->shgc", qg,
-                                    sk.astype(jnp.float32)) * scale
-                    col_ok = (jnp.arange(C) <= j)[None, None, None, :]
-                    sc = jnp.where(col_ok, sc, -1e30)
-                    m_s = jnp.max(sc, axis=-1, keepdims=True)
-                    p = jnp.where(col_ok, jnp.exp(sc - m_s), 0.0)
-                    l_s = jnp.sum(p, axis=-1, keepdims=True)   # >= 1: col j
-                    out_s = jnp.einsum("shgc,cshd->shgd", p,
-                                       sv.astype(jnp.float32)) / l_s
-                    lse_s = (m_s + jnp.log(l_s))[..., 0]       # [S, Hkv, G]
-                    # merge the two normalized pieces by their lse weights
-                    lse_pg = lse_p.reshape(S, Hkv, G)
-                    m_tot = jnp.maximum(lse_pg, lse_s)
-                    w_p = jnp.exp(lse_pg - m_tot)[..., None]
-                    w_s = jnp.exp(lse_s - m_tot)[..., None]
-                    out = (w_p * out_p.reshape(S, Hkv, G, D).astype(jnp.float32)
-                           + w_s * out_s) / (w_p + w_s)
-                    return (out.reshape(S, H, D).astype(q.dtype),
-                            sk_new, sv_new)
+                        sk, sv, j, window=spec.window)
+                    return out, sk_new, sv_new
 
                 x, (sk_all, sv_all) = _transformer_layer(spec, w, x, pos,
                                                          attend)
@@ -918,8 +910,8 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
         s_idx = jnp.arange(S)[:, None, None]
 
         def flush(pool4, side):                                 # per k/v
-            # side [L, C, S, Hkv, D] -> new values [L, S, n_span, bs, Hkv, D]
-            newv = side[:, j_clamp, s_idx]                      # [L,S,n_span,bs,Hkv,D]
+            # side [L, S, C, Hkv, D] -> new values [L, S, n_span, bs, Hkv, D]
+            newv = side[:, s_idx, j_clamp]                      # [L,S,n_span,bs,Hkv,D]
             newv = jnp.moveaxis(newv, 4, 3)                     # [...,Hkv,bs,D]
             phys_l = (phys[None] + (jnp.arange(L) * NB)[:, None, None])
             phys_l = jnp.where(page_valid[None], phys_l, L * NB)  # OOB -> drop
@@ -940,7 +932,9 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
 def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
                            mesh=None, tp: int = 1,
                            do_sample: bool = False,
-                           top_k: int = 0) -> Callable:
+                           top_k: int = 0,
+                           window_ring_ok: bool = False,
+                           max_side_bytes: Optional[int] = None) -> Callable:
     """Fused N-step greedy/sampled decode: the sample->embed->forward->sample
     feedback loop runs entirely on device for ``n_steps`` tokens per sequence.
 
@@ -952,16 +946,57 @@ def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
     CUDA-graph capture of the decode step, ``InferenceEngine._create_cuda_graph``
     engine.py:524, taken further: the whole token loop is one XLA program.)
 
+    ``window_ring_ok``: with a sliding window, the side-buffer schedule
+    freezes page reads for the whole chunk while writing ``n_steps`` tokens
+    at the flush, so the scheduler's page ring must cover window + n_steps.
+    The UNSAFE-to-assume case defaults off: windowed specs take the general
+    (per-step write) loop unless the caller has checked
+    ``scheduler.ring_covers(n_steps + 1)`` and passes True.
+
+    ``max_side_bytes``: the side-buffer schedule carries two
+    [L, S, C, Hkv, D] buffers through the scan (transient HBM the per-step
+    schedule does not need); above this budget the general loop is used
+    (default from DSTPU_SIDEBUF_MAX_MB, 2048 MB — ADVICE r4).
+
     Returns ``fwd(weights, k_pages, v_pages, ids0 [S], positions0 [S],
     block_tables [S, MB], ctx0 [S], key) -> (out_ids [n_steps, S],
     final_logits [S, V], new_k, new_v)`` where ``out_ids[j]`` is the token
     *consumed* by step j (ids0 first), and ``final_logits`` predict the token
     after the last generated one (so the serving loop can continue seamlessly).
     """
-    if tp == 1 and spec.window is None and spec.head_dim % 128 == 0:
-        # scatter-free side-buffer schedule (see _build_multistep_sidebuf);
-        # windowed / TP / small-D configs take the general loop below
-        return _build_multistep_sidebuf(spec, n_steps, do_sample, top_k)
+    general = _build_multistep_general(spec, n_steps, mesh=mesh, tp=tp,
+                                       do_sample=do_sample, top_k=top_k)
+    fits = (tp == 1 and spec.head_dim % 128 == 0
+            and (spec.window is None or window_ring_ok))
+    if not fits:
+        return general
+    sidebuf = _build_multistep_sidebuf(spec, n_steps, do_sample, top_k)
+    if max_side_bytes is None:
+        import os
+        max_side_bytes = int(float(os.environ.get(
+            "DSTPU_SIDEBUF_MAX_MB", "2048")) * 1e6)
+    esize = jnp.dtype(spec.dtype).itemsize
+    budget = max_side_bytes
+
+    def fwd(weights, k_pages, v_pages, ids0, *rest, **kw):
+        S = ids0.shape[0]
+        L = k_pages.shape[0]
+        side_bytes = (2 * L * S * n_steps * spec.num_kv_heads
+                      * spec.head_dim * esize)
+        impl = sidebuf if side_bytes <= budget else general
+        return impl(weights, k_pages, v_pages, ids0, *rest, **kw)
+
+    return fwd
+
+
+def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
+                             mesh=None, tp: int = 1,
+                             do_sample: bool = False,
+                             top_k: int = 0) -> Callable:
+    """The per-step-write multistep loop (fused attention+page-write kernel
+    per layer per step): the fallback when the side-buffer schedule's gates
+    fail (TP sharding, small head_dim, window-ring capacity, side-buffer HBM
+    budget)."""
     H, Hkv, D = spec.num_heads, spec.num_kv_heads, spec.head_dim
     dtype = spec.dtype
 

@@ -66,6 +66,17 @@ class DynamicSplitFuseScheduler:
         bs = self.cache.config.block_size
         return -(-(self.window + self._pass_take_cap) // bs) + 1
 
+    def ring_covers(self, n_tokens: int) -> bool:
+        """True iff a consumer may freeze page reads while writing
+        ``n_tokens`` ahead (the side-buffer multistep schedule's flush
+        pattern): the ring spans window + _pass_take_cap live tokens, so a
+        frozen chunk is safe only when its whole write fits in the take the
+        ring was sized for. Without a window there is no ring — always
+        True."""
+        if self.window is None:
+            return True
+        return n_tokens <= self._pass_take_cap
+
     # ------------------------------------------------------------------ #
     # sequence admission (parity: engine_v2.put token intake)
     # ------------------------------------------------------------------ #

@@ -54,13 +54,15 @@ def _interpret() -> bool:
 
 
 def _pick_pages_per_chunk(bs: int, h_kv: int, d: int, esize: int,
-                          max_blocks: int) -> int:
+                          max_blocks: int, reserve_bytes: int = 0) -> int:
     """Largest P with the 2-slot K+V slabs within ~8 MB of VMEM (~16 MB on
     v5e; q/o blocks, score tiles and accumulators are small). Fatter chunks
-    amortise the per-grid-step fixed cost, the dominant decode overhead."""
+    amortise the per-grid-step fixed cost, the dominant decode overhead.
+    ``reserve_bytes``: VMEM the caller holds besides the page slabs (the
+    sidebuf kernel's side slabs) — subtracted from the budget."""
     import os
     budget = int(os.environ.get("DSTPU_PAGED_VMEM_BUDGET",
-                                8 * 1024 * 1024))
+                                8 * 1024 * 1024)) - reserve_bytes
     per_page = 2 * 2 * bs * h_kv * d * esize        # 2 slots x (K + V)
     return max(1, min(max_blocks, budget // per_page))
 
@@ -106,19 +108,32 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
                  k_hbm, v_hbm, o_ref,
                  k_buf, v_buf, sems, acc_sc, m_sc, l_sc, *,
                  scale, block_size, pages_per_chunk, n_chunks, max_blocks,
-                 n_seqs, h_kv, groups, window=None, lse_ref=None):
+                 n_seqs, h_kv, groups, window=None, lse_ref=None,
+                 j_ref=None, sidek_ref=None, sidev_ref=None, n_side=0):
     """Shared batched-decode body (see module docstring). With
     ``knew_ref/vnew_ref`` (step mode) the pages hold tokens [0, ctx-1) and
     the current token's attention term folds in from registers at finalize;
     without them the pages hold everything (ctx tokens).
+
+    ``sidek_ref/sidev_ref`` (side-slab mode — the fused multistep schedule):
+    the pages hold the FROZEN prefix [0, cl) and the per-sequence side slab
+    ``[n_side*Hkv, D]`` holds the chunk's freshly decoded K/V rows (row
+    cc*Hkv + h = step cc's kv head h, token position cl + cc); at finalize
+    rows cc <= ``j_ref[0]`` fold into the same (m, l, acc) state — one flash
+    stream over pages + side, no separate dense piece, no lse merge (the
+    round-4 schedule computed the side piece in jnp and merged by lse, which
+    re-read the [C, S, Hkv, D] slab from HBM per layer per step; folding it
+    here reads one sequence's [C, Hkv, D] slab into VMEM instead).
 
     ``window`` (static, sliding-window serving — Mistral/Qwen2 parity,
     reference ``inference/v2/model_implementations/mistral``): the query at
     position ctx-1 attends only tokens >= ctx - window. Chunks wholly below
     the window start are skipped (grid range) and pages outside
     [window_lo, ctx) are neither DMA'd nor computed — the window bounds the
-    per-step KV read the way the reference's sliding cache does."""
+    per-step KV read the way the reference's sliding cache does. In side-slab
+    mode the query position is cl + j, so the window start moves with j."""
     inline_current = knew_ref is not None
+    side = sidek_ref is not None
     ctx_off = 1 if inline_current else 0
     P, bs, T = pages_per_chunk, block_size, pages_per_chunk * block_size
     s, c = pl.program_id(0), pl.program_id(1)
@@ -129,6 +144,9 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
         # first visible token (window start); 0 without a window
         if window is None:
             return jnp.int32(0)
+        if side:
+            # query position = prefix + j (cl holds the prefix length)
+            return jnp.maximum(cl_ref[s_] + j_ref[0] + 1 - window, 0)
         return jnp.maximum(cl_ref[s_] - window, 0)
 
     def c0_of(s_):
@@ -238,6 +256,34 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
 
         @pl.when(c == nc_s - 1)
         def _():
+            if side:
+                # fold the side slab: one [H, D] x [D, n_side*Hkv] dot with
+                # the block-diagonal + step mask, same flash update as a page
+                # chunk. Rows past j hold zeros/garbage — masked. Column j is
+                # always visible, so l > 0 even at prefix 0 (no empty-row
+                # special case).
+                jcur = j_ref[0]
+                sk = sidek_ref[0]                              # [Cs*Hkv, D]
+                sv = sidev_ref[0]
+                Ws = n_side * h_kv
+                col = jax.lax.broadcasted_iota(jnp.int32, (H, Ws), 1)
+                row_kv = jax.lax.broadcasted_iota(jnp.int32, (H, Ws), 0) \
+                    // groups
+                cc = col // h_kv
+                col_kv = jax.lax.rem(col, h_kv)
+                smask = jnp.logical_and(col_kv == row_kv, cc <= jcur)
+                if window is not None:
+                    smask = jnp.logical_and(smask, cc >= jcur + 1 - window)
+                sc_s = jax.lax.dot_general(
+                    q_ref[0].astype(sk.dtype), sk,
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+                # rows > j may hold reused garbage; p is 0 there but
+                # 0 * inf = NaN through the pv dot, so zero sv's dead rows
+                # (same reasoning as the skipped-page V zeroing above)
+                row1 = jax.lax.broadcasted_iota(jnp.int32, (Ws, 1), 0)
+                sv = jnp.where(row1 // h_kv <= jcur, sv, 0.0)
+                _flash_update(sc_s, smask, sv, m_sc, l_sc, acc_sc)
             if not inline_current:
                 l = l_sc[:, 0:1]
                 safe_l = jnp.where(l > 0.0, l, 1.0)
@@ -289,6 +335,164 @@ def _decode_kernel_lse(bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
                        k_buf, v_buf, sems, acc_sc, m_sc, l_sc, **kw):
     _decode_body(bt_ref, cl_ref, q_ref, None, None, k_hbm, v_hbm, o_ref,
                  k_buf, v_buf, sems, acc_sc, m_sc, l_sc, lse_ref=lse_ref, **kw)
+
+
+def _decode_kernel_sidebuf(bt_ref, cl_ref, j_ref, q_ref, sidek_ref, sidev_ref,
+                           k_hbm, v_hbm, o_ref,
+                           k_buf, v_buf, sems, acc_sc, m_sc, l_sc, **kw):
+    _decode_body(bt_ref, cl_ref, q_ref, None, None, k_hbm, v_hbm, o_ref,
+                 k_buf, v_buf, sems, acc_sc, m_sc, l_sc,
+                 j_ref=j_ref, sidek_ref=sidek_ref, sidev_ref=sidev_ref, **kw)
+
+
+def paged_decode_attention_sidebuf(q: jax.Array,
+                                   k_pages: jax.Array,
+                                   v_pages: jax.Array,
+                                   block_tables: jax.Array,
+                                   prefix_lens: jax.Array,
+                                   side_k: jax.Array,
+                                   side_v: jax.Array,
+                                   j,
+                                   softmax_scale: Optional[float] = None,
+                                   window: Optional[int] = None) -> jax.Array:
+    """Decode attention over a FROZEN paged prefix plus a per-sequence side
+    slab of freshly decoded K/V — the kernel of the scatter-free multistep
+    schedule (``inference/v2/ragged_model._build_multistep_sidebuf``).
+
+    q:            [S, H, D]         one query per sequence (step j's token)
+    k/v_pages:    [NB, H_kv, bs, D] frozen prefix pages
+    block_tables: [S, MB] int32
+    prefix_lens:  [S] int32         tokens in the pages (EXCLUDING the chunk)
+    side_k/v:     [S, C, H_kv, D]   side slab; rows 0..j are real (row j is
+                                    the current token), rows > j are ignored
+    j:            int32 scalar      current step within the chunk
+    window:       optional static sliding window over position prefix + j
+
+    Returns [S, H, D]. Reference role: the blocked-flash KV stream fused with
+    the in-flight tokens (``inference/v2/kernels/ragged_ops/blocked_flash``) —
+    the round-4 two-piece lse merge collapsed into one flash stream.
+    """
+    S, H, D = q.shape
+    NB, Hkv, bs, Dk = k_pages.shape
+    S2, Cs, Hkv2, D2 = side_k.shape
+    assert Dk == D and D2 == D and S2 == S and Hkv2 == Hkv
+    assert H % Hkv == 0
+    assert D % 128 == 0 and (Cs * Hkv) % 8 == 0, \
+        "side-slab kernel needs lane-aligned D and 8-sublane-aligned C*Hkv"
+    G = H // Hkv
+    MB = block_tables.shape[1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+    side_vmem = 2 * Cs * Hkv * D * jnp.dtype(side_k.dtype).itemsize
+    P = _pick_pages_per_chunk(bs, Hkv, D, jnp.dtype(k_pages.dtype).itemsize,
+                              MB, reserve_bytes=side_vmem)
+    NC = -(-MB // P)
+    assert (bs * Hkv) % 8 == 0
+
+    kernel = functools.partial(
+        _decode_kernel_sidebuf, scale=scale, block_size=bs,
+        pages_per_chunk=P, n_chunks=NC, max_blocks=MB, n_seqs=S, h_kv=Hkv,
+        groups=G, window=window, n_side=Cs)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(S, NC),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda s, c, bt, cl, jj: (s, 0, 0)),
+            pl.BlockSpec((1, Cs * Hkv, D), lambda s, c, bt, cl, jj: (s, 0, 0)),
+            pl.BlockSpec((1, Cs * Hkv, D), lambda s, c, bt, cl, jj: (s, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda s, c, bt, cl, jj: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, P, Hkv * bs, D), k_pages.dtype),
+            pltpu.VMEM((2, P, Hkv * bs, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((H, D), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=_interpret(),
+    )(block_tables.astype(jnp.int32), prefix_lens.astype(jnp.int32),
+      jnp.asarray(j, jnp.int32).reshape(1), q,
+      side_k.reshape(S, Cs * Hkv, D), side_v.reshape(S, Cs * Hkv, D),
+      k_pages.reshape(NB, Hkv * bs, D), v_pages.reshape(NB, Hkv * bs, D))
+
+
+def paged_decode_attention_sidebuf_reference(q, k_pages, v_pages, block_tables,
+                                             prefix_lens, side_k, side_v, j,
+                                             softmax_scale=None, window=None):
+    """jnp reference: paged prefix piece (with lse) merged with dense masked
+    attention over the side slab — the exact round-4 two-piece computation
+    the fused kernel replaces."""
+    S, H, D = q.shape
+    _, Cs, Hkv, _ = side_k.shape
+    G = H // Hkv
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+    if window is not None:
+        # page piece window start moves with the in-chunk step j
+        eff_ctx = prefix_lens + j + 1
+        out_p, lse_p = _paged_reference_lse_lo(
+            q, k_pages, v_pages, block_tables, prefix_lens,
+            jnp.maximum(eff_ctx - window, 0), scale)
+    else:
+        out_p, lse_p = paged_decode_attention_reference(
+            q, k_pages, v_pages, block_tables, prefix_lens, scale,
+            with_lse=True)
+    qg = q.reshape(S, Hkv, G, D).astype(jnp.float32)
+    sc = jnp.einsum("shgd,schd->shgc", qg,
+                    side_k.astype(jnp.float32)) * scale
+    col_ok = (jnp.arange(Cs) <= j)[None, None, None, :]
+    if window is not None:
+        col_ok = jnp.logical_and(col_ok,
+                                 (jnp.arange(Cs) >= j + 1 - window)
+                                 [None, None, None, :])
+    sc = jnp.where(col_ok, sc, NEG_INF)
+    m_s = jnp.max(sc, axis=-1, keepdims=True)
+    p = jnp.where(col_ok, jnp.exp(sc - m_s), 0.0)
+    l_s = jnp.sum(p, axis=-1, keepdims=True)
+    out_s = jnp.einsum("shgc,schd->shgd", p,
+                       side_v.astype(jnp.float32)) / jnp.maximum(l_s, 1e-30)
+    lse_s = (m_s + jnp.log(jnp.maximum(l_s, 1e-30)))[..., 0]
+    lse_pg = lse_p.reshape(S, Hkv, G)
+    m_tot = jnp.maximum(lse_pg, lse_s)
+    w_p = jnp.exp(lse_pg - m_tot)[..., None]
+    w_s = jnp.exp(lse_s - m_tot)[..., None]
+    out = (w_p * out_p.reshape(S, Hkv, G, D).astype(jnp.float32)
+           + w_s * out_s) / (w_p + w_s)
+    return out.reshape(S, H, D).astype(q.dtype)
+
+
+def _paged_reference_lse_lo(q, k_pages, v_pages, block_tables, ctx_lens,
+                            tok_lo, scale):
+    """Dense paged reference with a per-sequence lower bound on visible
+    tokens (side-slab window reference support)."""
+    S, H, D = q.shape
+    NB, Hkv, bs, _ = k_pages.shape
+    G = H // Hkv
+    MB = block_tables.shape[1]
+    k_seq = jnp.moveaxis(k_pages[block_tables], 2, 3).reshape(S, MB * bs, Hkv, D)
+    v_seq = jnp.moveaxis(v_pages[block_tables], 2, 3).reshape(S, MB * bs, Hkv, D)
+    k_seq = jnp.repeat(k_seq, G, axis=2)
+    v_seq = jnp.repeat(v_seq, G, axis=2)
+    sc = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),
+                    k_seq.astype(jnp.float32)) * scale
+    pos = jnp.arange(MB * bs)[None, None, :]
+    mask = (pos < ctx_lens[:, None, None]) & (pos >= tok_lo[:, None, None])
+    sc = jnp.where(mask, sc, NEG_INF)
+    any_row = jnp.any(mask, axis=-1)
+    p = jax.nn.softmax(sc, axis=-1)
+    p = jnp.where(any_row[:, :, None], p, 0.0)
+    out = jnp.einsum("sht,sthd->shd", p, v_seq.astype(jnp.float32))
+    lse = jax.scipy.special.logsumexp(sc, axis=-1)
+    lse = jnp.where(any_row, lse, NEG_INF)
+    return out.astype(q.dtype), lse
 
 
 def _decode_kernel_smalld(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,

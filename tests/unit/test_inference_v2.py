@@ -524,7 +524,9 @@ def test_int8_weights_decode_and_fetch_false(eight_devices):
     ids_sync = eng.decode_steps([7, 8], 4)
     assert ids_sync.shape == (2, 4)
     dev = eng.decode_steps([7, 8], 4, fetch=False)
-    ids2 = np.asarray(dev).T
+    # fetch=False returns the device array already shaped [S, n_steps]
+    # (ADVICE r4: matching the fetched shape removes the transpose footgun)
+    ids2 = np.asarray(dev)
     assert ids2.shape == (2, 4)
     # scheduler advanced for both calls
     assert eng.scheduler.seqs[7].seen_tokens == 20 + 8

@@ -220,3 +220,59 @@ class TestPackedFlash:
         o2 = jax.jit(flash_attention_packed)(q, k, k, jnp.asarray(seg))
         assert o1.shape == (R, H, D)
         np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-6)
+
+
+class TestPagedDecodeSidebuf:
+    """Fused frozen-prefix + side-slab decode kernel (the side-buffer
+    multistep schedule's attention body). Reference = the round-4 two-piece
+    computation: paged prefix with lse, dense side piece, lse merge."""
+
+    @pytest.mark.parametrize("Hkv,j", [(2, 0), (2, 3), (4, 5), (8, 7)])
+    def test_matches_reference(self, Hkv, j):
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_sidebuf,
+            paged_decode_attention_sidebuf_reference)
+        rng = np.random.RandomState(3)
+        S, H, D, bs, MB, C = 4, 8, 128, 8, 3, 8
+        NB = S * MB + 1
+        q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
+        k = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
+        v = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
+        bt = jnp.asarray(rng.permutation(NB - 1)[:S * MB].reshape(S, MB) + 1,
+                         jnp.int32)
+        # prefix 0 (fresh sequence: all context in the side slab), mid-page,
+        # page boundary, full
+        prefix = jnp.asarray([0, 5, bs, MB * bs], jnp.int32)
+        sk = jnp.asarray(rng.randn(S, C, Hkv, D), jnp.float32)
+        sv = jnp.asarray(rng.randn(S, C, Hkv, D), jnp.float32)
+        out = jax.jit(paged_decode_attention_sidebuf,
+                      static_argnames=())(q, k, v, bt, prefix, sk, sv, j)
+        ref = paged_decode_attention_sidebuf_reference(q, k, v, bt, prefix,
+                                                       sk, sv, j)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-4)
+
+    @pytest.mark.parametrize("window,j", [(12, 0), (12, 6), (4, 7)])
+    def test_windowed_matches_reference(self, window, j):
+        """Sliding window over position prefix + j: the page-side window
+        start moves with j; side columns below j+1-window hide."""
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_sidebuf,
+            paged_decode_attention_sidebuf_reference)
+        rng = np.random.RandomState(9)
+        S, H, Hkv, D, bs, MB, C = 3, 4, 2, 128, 8, 3, 8
+        NB = S * MB + 1
+        q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
+        k = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
+        v = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
+        bt = jnp.asarray(rng.permutation(NB - 1)[:S * MB].reshape(S, MB) + 1,
+                         jnp.int32)
+        prefix = jnp.asarray([0, 7, 2 * bs + 3], jnp.int32)
+        sk = jnp.asarray(rng.randn(S, C, Hkv, D), jnp.float32)
+        sv = jnp.asarray(rng.randn(S, C, Hkv, D), jnp.float32)
+        out = paged_decode_attention_sidebuf(q, k, v, bt, prefix, sk, sv, j,
+                                             window=window)
+        ref = paged_decode_attention_sidebuf_reference(
+            q, k, v, bt, prefix, sk, sv, j, window=window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-4)
