@@ -105,13 +105,27 @@ class InferenceEngineV2:
             # on TPU prefer an explicit num_blocks or memory-fraction sizing
             per_seq = -(-sm.max_context // cfg.kv_cache.block_size)
             nb = per_seq * sm.max_tracked_sequences
+        if cfg.kv_quant.enabled:
+            if tp > 1:
+                raise NotImplementedError(
+                    "kv_quant with tensor_parallel > 1 is not wired")
+            if (self.spec.head_dim % 128 != 0
+                    or (self.spec.num_kv_heads
+                        * cfg.kv_cache.block_size) % 128 != 0):
+                raise ValueError(
+                    "kv_quant needs head_dim % 128 == 0 and "
+                    "kv_heads * block_size % 128 == 0 (got head_dim="
+                    f"{self.spec.head_dim}, kv_heads="
+                    f"{self.spec.num_kv_heads}, block_size="
+                    f"{cfg.kv_cache.block_size})")
         kv_cfg = KVCacheConfig(
             num_layers=self.spec.num_layers,
             num_kv_heads=self.spec.num_kv_heads,
             head_dim=self.spec.head_dim,
             block_size=cfg.kv_cache.block_size,
             num_blocks=nb,
-            dtype=cfg.dtype)
+            dtype=cfg.dtype,
+            quantized=cfg.kv_quant.enabled)
         self.kv = BlockedKVCache(kv_cfg, self.topology)
         self.allocator = BlockedAllocator(nb)
         self.scheduler = DynamicSplitFuseScheduler(sm, self.kv, self.allocator)

@@ -44,15 +44,19 @@ class KVCacheConfig:
     block_size: int = 128
     num_blocks: int = 256
     dtype: Any = jnp.bfloat16
+    # int8 pages with per-token-head f32 scales (see config_v2.KVQuantConfig):
+    # the pools become (int8 values, f32 scales) pytrees; every consumer
+    # dequantizes in-kernel
+    quantized: bool = False
 
     @property
     def max_tokens(self) -> int:
         return self.num_blocks * self.block_size
 
     def bytes_per_block(self) -> int:
-        itemsize = jnp.dtype(self.dtype).itemsize
-        return 2 * self.num_layers * self.block_size * self.num_kv_heads * \
-            self.head_dim * itemsize
+        itemsize = 1 if self.quantized else jnp.dtype(self.dtype).itemsize
+        per = self.head_dim * itemsize + (4 if self.quantized else 0)
+        return 2 * self.num_layers * self.block_size * self.num_kv_heads * per
 
     @classmethod
     def from_memory_budget(cls, num_layers: int, num_kv_heads: int, head_dim: int,
@@ -80,8 +84,18 @@ class BlockedKVCache:
             if tp > 1 and config.num_kv_heads % tp == 0:
                 spec[2] = TENSOR_AXIS
             sharding = NamedSharding(topology.mesh, P(*spec))
-        self.k = _zeros(shape, config.dtype, sharding)
-        self.v = _zeros(shape, config.dtype, sharding)
+        if config.quantized:
+            if sharding is not None and topology.tp_world_size > 1:
+                raise NotImplementedError(
+                    "int8 KV pages with tensor_parallel > 1 are not wired")
+            sshape = shape[:-1]                   # per-token-head scales
+            self.k = (_zeros(shape, jnp.int8, None),
+                      _zeros(sshape, jnp.float32, None))
+            self.v = (_zeros(shape, jnp.int8, None),
+                      _zeros(sshape, jnp.float32, None))
+        else:
+            self.k = _zeros(shape, config.dtype, sharding)
+            self.v = _zeros(shape, config.dtype, sharding)
         self.sharding = sharding
 
     def update(self, k: jax.Array, v: jax.Array) -> None:

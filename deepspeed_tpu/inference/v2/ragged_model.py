@@ -35,8 +35,17 @@ import numpy as np
 
 from deepspeed_tpu.ops.pallas.flash_attention import flash_attention_packed
 from deepspeed_tpu.ops.pallas.paged_attention import (
-    paged_chunk_attention_batched, paged_decode_attention,
+    kv_quantize_rows, paged_chunk_attention_batched, paged_decode_attention,
     paged_decode_attention_sidebuf, paged_decode_attention_step)
+
+
+def _kv_unpack(kp):
+    """KV pool argument -> (pages, scales-or-None). int8 KV pages travel as
+    a (values int8, per-token-head f32 scales) tuple through every jit
+    boundary so the engine's (k, v) plumbing is dtype-agnostic."""
+    if isinstance(kp, tuple):
+        return kp
+    return kp, None
 
 
 @dataclass
@@ -428,14 +437,13 @@ def quantize_weights_int8(weights: Dict) -> Dict:
 def _transformer_layer(spec: "RaggedModelSpec", w, x, positions, attend):
     """Shared per-layer transformer body for BOTH the ragged forward (put
     passes) and the fused multistep decode — one implementation so the two
-    paths cannot diverge.  ``attend(q, k, v, k_l, v_l) -> (attn_raw [N, H, D],
-    k_l, v_l)`` performs the KV page write + attention for its pass shape.
-    Returns ``(x_out, (k_l, v_l))``; call under lax.scan with
-    ``scanned = (w, k_l, v_l)``.
+    paths cannot diverge.  ``attend(q, k, v) -> (attn_raw [N, H, D],
+    *state)`` performs the KV page write + attention for its pass shape;
+    ``state`` is the caller's carried cache state (pools, or pools + scale
+    pools for int8 KV). Returns ``(x_out, state_tuple)``.
     """
     H, Hkv, D = spec.num_heads, spec.num_kv_heads, spec.head_dim
     dtype = spec.dtype
-    k_l, v_l = None, None  # provided via attend closure state
     h1 = _norm(x, w["ln1"], spec.norm, spec.eps, dtype, spec.norm_plus_one)
     q = _mm(h1, w["wq"]).reshape(-1, H, D)
     k = _mm(h1, w["wk"]).reshape(-1, Hkv, D)
@@ -448,7 +456,7 @@ def _transformer_layer(spec: "RaggedModelSpec", w, x, positions, attend):
         q = _rope_flat(q, positions, spec.rope_theta, spec.rotary_dim)
         k = _rope_flat(k, positions, spec.rope_theta, spec.rotary_dim)
 
-    attn_raw, k_l, v_l = attend(q, k, v)
+    attn_raw, *state = attend(q, k, v)
     attn_out = _mm(attn_raw.reshape(-1, H * D), w["wo"])
     if "bo" in w:
         attn_out = attn_out + w["bo"]
@@ -483,7 +491,7 @@ def _transformer_layer(spec: "RaggedModelSpec", w, x, positions, attend):
         x = x + attn_out + mlp_out
     else:
         x = x + mlp_out
-    return x.astype(dtype), (k_l, v_l)
+    return x.astype(dtype), tuple(state)
 
 
 def _embed_in(spec: "RaggedModelSpec", weights, tokens, positions):
@@ -531,6 +539,23 @@ def _kv_page_write(kp, vp, k, v, dest_tok, Hkv, bs):
     return kf, vf
 
 
+def _kv_page_write_quant(kp, vp, ks, vs, k, v, dest_tok, Hkv, bs):
+    """int8 variant of :func:`_kv_page_write`: quantize the new rows on
+    append (per token-head) and scatter values + scales with the same row
+    index (the flat scale pool [L*NB*Hkv*bs] shares the row addressing)."""
+    T = dest_tok.shape[0]
+    page_g = dest_tok // bs
+    rows = ((page_g[:, None] * Hkv + jnp.arange(Hkv)[None, :]) * bs
+            + (dest_tok % bs)[:, None]).reshape(-1)            # [T*Hkv]
+    kq, ksc = kv_quantize_rows(k)                              # [T,Hkv,D]/[T,Hkv]
+    vq, vsc = kv_quantize_rows(v)
+    kf = kp.at[rows].set(kq.reshape(T * Hkv, -1), mode="drop")
+    vf = vp.at[rows].set(vq.reshape(T * Hkv, -1), mode="drop")
+    ksf = ks.at[rows].set(ksc.reshape(-1), mode="drop")
+    vsf = vs.at[rows].set(vsc.reshape(-1), mode="drop")
+    return kf, vf, ksf, vsf
+
+
 def _kv_page_write_pages(kp, vp, k, v, l, page_ids, page_rows, page_fill,
                          NB, bs, L, Hkv):
     """Page-granular pool update for prefill-from-zero passes.
@@ -563,6 +588,32 @@ def _kv_page_write_pages(kp, vp, k, v, l, page_ids, page_rows, page_fill,
     vp3 = vp3.at[tgt].set(vg.reshape(PW * Hkv, bs, D).astype(vp.dtype),
                           mode="drop")
     return kp3.reshape(-1, D), vp3.reshape(-1, D)
+
+
+def _kv_page_write_pages_quant(kp, vp, ks, vs, k, v, l, page_ids, page_rows,
+                               page_fill, NB, bs, L, Hkv):
+    """int8 variant of :func:`_kv_page_write_pages`: the gathered page
+    windows quantize per token-head row; scale pools [L*NB*Hkv, bs] get the
+    same page-granular scatter at the same target index."""
+    PW = page_ids.shape[0]
+    D = k.shape[-1]
+    CT = k.shape[0]
+    j = jnp.arange(bs, dtype=jnp.int32)
+    rows = jnp.minimum(page_rows[:, None] + j[None, :], CT - 1)     # [PW, bs]
+    valid = j[None, :] < page_fill[:, None]                         # [PW, bs]
+    kg = jnp.where(valid[..., None, None], k[rows], 0)              # [PW,bs,Hkv,D]
+    vg = jnp.where(valid[..., None, None], v[rows], 0)
+    kgq, kgs = kv_quantize_rows(jnp.moveaxis(kg, 2, 1))             # [PW,Hkv,bs,D]
+    vgq, vgs = kv_quantize_rows(jnp.moveaxis(vg, 2, 1))
+    kp3 = kp.reshape(L * NB * Hkv, bs, D)
+    vp3 = vp.reshape(L * NB * Hkv, bs, D)
+    page_g = jnp.where(page_ids < NB, l * NB + page_ids, L * NB)
+    tgt = (page_g[:, None] * Hkv + jnp.arange(Hkv)[None, :]).reshape(-1)
+    kp3 = kp3.at[tgt].set(kgq.reshape(PW * Hkv, bs, D), mode="drop")
+    vp3 = vp3.at[tgt].set(vgq.reshape(PW * Hkv, bs, D), mode="drop")
+    ksf = ks.at[tgt].set(kgs.reshape(PW * Hkv, bs), mode="drop")
+    vsf = vs.at[tgt].set(vgs.reshape(PW * Hkv, bs), mode="drop")
+    return kp3.reshape(-1, D), vp3.reshape(-1, D), ksf, vsf
 
 
 def _layer_dest(dest, l, NB, bs, L):
@@ -614,8 +665,9 @@ def build_ragged_forward(spec: RaggedModelSpec,
     chunk_win = functools.partial(paged_chunk_attention_batched,
                                   window=spec.window)
 
-    def _decode_attn(q, k_l, v_l, bts, cls_):
+    def _decode_attn(q, k_l, v_l, bts, cls_, **sc_kw):
         if tp > 1:
+            assert not sc_kw, "int8 KV pages + TP not wired"
             from jax.sharding import PartitionSpec as P
             from deepspeed_tpu.comm.mesh import TENSOR_AXIS
             fn = _tp_wrap(
@@ -625,10 +677,11 @@ def build_ragged_forward(spec: RaggedModelSpec,
                           P(None, TENSOR_AXIS, None, None), P(None, None), P(None)),
                 out_specs=P(None, TENSOR_AXIS, None))
             return fn(q, k_l, v_l, bts, cls_)
-        return decode_win(q, k_l, v_l, bts, cls_)
+        return decode_win(q, k_l, v_l, bts, cls_, **sc_kw)
 
-    def _chunk_attn(q, k_l, v_l, bts, q0s, ctxs):
+    def _chunk_attn(q, k_l, v_l, bts, q0s, ctxs, **sc_kw):
         if tp > 1:
+            assert not sc_kw, "int8 KV pages + TP not wired"
             from jax.sharding import PartitionSpec as P
             from deepspeed_tpu.comm.mesh import TENSOR_AXIS
             fn = _tp_wrap(
@@ -639,9 +692,12 @@ def build_ragged_forward(spec: RaggedModelSpec,
                           P(None, None), P(None), P(None)),
                 out_specs=P(None, None, TENSOR_AXIS, None))
             return fn(q, k_l, v_l, bts, q0s, ctxs)
-        return chunk_win(q, k_l, v_l, bts, q0s, ctxs)
+        return chunk_win(q, k_l, v_l, bts, q0s, ctxs, **sc_kw)
 
     def fwd(weights, k_pages, v_pages, b):
+        k_pages, k_sc = _kv_unpack(k_pages)
+        v_pages, v_sc = _kv_unpack(v_pages)
+        kvq = k_sc is not None
         NC = b["chunk_ntok"].shape[0]
         CT = b["chunk_tokens"].shape[0]
         Cs = CT // NC
@@ -649,38 +705,52 @@ def build_ragged_forward(spec: RaggedModelSpec,
         L, NB, bs = k_pages.shape[0], k_pages.shape[1], k_pages.shape[3]
         kp0 = k_pages.reshape(L * NB * Hkv * bs, D)  # flat rows (bitcast);
         vp0 = v_pages.reshape(L * NB * Hkv * bs, D)  # see _kv_page_write
+        ks0 = k_sc.reshape(L * NB * Hkv * bs) if kvq else None
+        vs0 = v_sc.reshape(L * NB * Hkv * bs) if kvq else None
         tokens = jnp.concatenate([b["chunk_tokens"], b["decode_tokens"]])
         positions = jnp.concatenate([b["chunk_positions"], b["decode_positions"]])
 
         x = _embed_in(spec, weights, tokens, positions)
 
         def layer_fn(carry, scanned):
-            x, kp, vp = carry
+            x, kp, vp, ks, vs = carry
             w, l = scanned
 
             def attend(q, k, v):
-                kp_, vp_ = _kv_page_write(
-                    kp, vp, k, v, _layer_dest(b["kv_dest"], l, NB, bs, L),
-                    Hkv, bs)
+                dest = _layer_dest(b["kv_dest"], l, NB, bs, L)
+                if kvq:
+                    kp_, vp_, ks_, vs_ = _kv_page_write_quant(
+                        kp, vp, ks, vs, k, v, dest, Hkv, bs)
+                    sc_kw = dict(
+                        k_scales=ks_.reshape(L * NB, Hkv, bs),
+                        v_scales=vs_.reshape(L * NB, Hkv, bs))
+                else:
+                    kp_, vp_ = _kv_page_write(kp, vp, k, v, dest, Hkv, bs)
+                    ks_, vs_, sc_kw = ks, vs, {}
                 k_l = kp_.reshape(L * NB, Hkv, bs, D)
                 v_l = vp_.reshape(L * NB, Hkv, bs, D)
                 out_c = _chunk_attn(q[:CT].reshape(NC, Cs, H, D), k_l, v_l,
                                     b["chunk_block_tables"] + l * NB,
-                                    b["chunk_q0"], b["chunk_ctx_lens"])
+                                    b["chunk_q0"], b["chunk_ctx_lens"],
+                                    **sc_kw)
                 out_d = _decode_attn(q[CT:], k_l, v_l,
                                      b["decode_block_tables"] + l * NB,
-                                     b["decode_ctx_lens"])
+                                     b["decode_ctx_lens"], **sc_kw)
                 return (jnp.concatenate([out_c.reshape(CT, H, D), out_d],
-                                        axis=0), kp_, vp_)
+                                        axis=0), kp_, vp_, ks_, vs_)
 
-            x, (kp, vp) = _transformer_layer(spec, w, x, positions, attend)
-            return (x, kp, vp), None
+            x, (kp, vp, ks, vs) = _transformer_layer(spec, w, x, positions,
+                                                     attend)
+            return (x, kp, vp, ks, vs), None
 
-        (x, kp, vp), _ = jax.lax.scan(
-            layer_fn, (x, kp0, vp0),
+        (x, kp, vp, ks, vs), _ = jax.lax.scan(
+            layer_fn, (x, kp0, vp0, ks0, vs0),
             (weights["layers"], jnp.arange(L, dtype=jnp.int32)))
         new_k = kp.reshape(L, NB, Hkv, bs, D)
         new_v = vp.reshape(L, NB, Hkv, bs, D)
+        if kvq:
+            new_k = (new_k, ks.reshape(L, NB, Hkv, bs))
+            new_v = (new_v, vs.reshape(L, NB, Hkv, bs))
 
         x = _norm(x, weights["final_norm"], spec.norm, spec.eps, dtype,
                   spec.norm_plus_one)
@@ -734,9 +804,14 @@ def build_prefill_forward(spec: RaggedModelSpec,
         CT = b["chunk_tokens"].shape[0]
         Cs = CT // NC
         S = b["decode_tokens"].shape[0]
+        k_pages, k_sc = _kv_unpack(k_pages)
+        v_pages, v_sc = _kv_unpack(v_pages)
+        kvq = k_sc is not None
         L, NB, bs = k_pages.shape[0], k_pages.shape[1], k_pages.shape[3]
         kp0 = k_pages.reshape(L * NB * Hkv * bs, D)
         vp0 = v_pages.reshape(L * NB * Hkv * bs, D)
+        ks0 = k_sc.reshape(L * NB * Hkv, bs) if kvq else None
+        vs0 = v_sc.reshape(L * NB * Hkv, bs) if kvq else None
         tokens = b["chunk_tokens"]
         positions = b["chunk_positions"]
         seg = b["row_seg"]
@@ -744,24 +819,36 @@ def build_prefill_forward(spec: RaggedModelSpec,
         x = _embed_in(spec, weights, tokens, positions)
 
         def layer_fn(carry, scanned):
-            x, kp, vp = carry
+            x, kp, vp, ks, vs = carry
             w, l = scanned
 
             def attend(q, k, v):
+                # attention reads the PACKED in-flight rows (full precision);
+                # only the page write quantizes
                 out = _packed_attn(q, k, v, seg)
-                kp_, vp_ = _kv_page_write_pages(
-                    kp, vp, k, v, l, b["page_ids"], b["page_rows"],
-                    b["page_fill"], NB, bs, L, Hkv)
-                return out, kp_, vp_
+                if kvq:
+                    kp_, vp_, ks_, vs_ = _kv_page_write_pages_quant(
+                        kp, vp, ks, vs, k, v, l, b["page_ids"],
+                        b["page_rows"], b["page_fill"], NB, bs, L, Hkv)
+                else:
+                    kp_, vp_ = _kv_page_write_pages(
+                        kp, vp, k, v, l, b["page_ids"], b["page_rows"],
+                        b["page_fill"], NB, bs, L, Hkv)
+                    ks_, vs_ = ks, vs
+                return out, kp_, vp_, ks_, vs_
 
-            x, (kp, vp) = _transformer_layer(spec, w, x, positions, attend)
-            return (x, kp, vp), None
+            x, (kp, vp, ks, vs) = _transformer_layer(spec, w, x, positions,
+                                                     attend)
+            return (x, kp, vp, ks, vs), None
 
-        (x, kp, vp), _ = jax.lax.scan(
-            layer_fn, (x, kp0, vp0),
+        (x, kp, vp, ks, vs), _ = jax.lax.scan(
+            layer_fn, (x, kp0, vp0, ks0, vs0),
             (weights["layers"], jnp.arange(L, dtype=jnp.int32)))
         new_k = kp.reshape(L, NB, Hkv, bs, D)
         new_v = vp.reshape(L, NB, Hkv, bs, D)
+        if kvq:
+            new_k = (new_k, ks.reshape(L, NB, Hkv, bs))
+            new_v = (new_v, vs.reshape(L, NB, Hkv, bs))
 
         x = _norm(x, weights["final_norm"], spec.norm, spec.eps, dtype,
                   spec.norm_plus_one)
@@ -818,11 +905,16 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
 
     def fwd(weights, k_pages, v_pages, ids0, positions0, block_tables, ctx0,
             key, temperature=1.0):
+        k_pages, k_sc = _kv_unpack(k_pages)
+        v_pages, v_sc = _kv_unpack(v_pages)
+        kvq = k_sc is not None
         S = ids0.shape[0]
         L, NB, bs = k_pages.shape[0], k_pages.shape[1], k_pages.shape[3]
         MB = block_tables.shape[1]
         kp4 = k_pages.reshape(L * NB, Hkv, bs, D)
         vp4 = v_pages.reshape(L * NB, Hkv, bs, D)
+        ks4 = k_sc.reshape(L * NB, Hkv, bs) if kvq else None
+        vs4 = v_sc.reshape(L * NB, Hkv, bs) if kvq else None
         # engine contract: ctx0 counts tokens INCLUDING the first current
         # token; the pages hold only the frozen prefix [0, ctx0 - 1) — the
         # current token (and everything after) lives in the side buffers
@@ -851,9 +943,14 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
                         sk_new, (l, 0, 0, 0, 0), (1, S, Cb, Hkv, D))[0]
                     sv = jax.lax.dynamic_slice(
                         sv_new, (l, 0, 0, 0, 0), (1, S, Cb, Hkv, D))[0]
+                    sc_kw = {}
+                    if kvq:
+                        # the frozen prefix streams int8 (the dominant read);
+                        # the in-chunk side slab stays full precision
+                        sc_kw = dict(k_scales=ks4, v_scales=vs4)
                     out = paged_decode_attention_sidebuf(
                         q, kp4, vp4, block_tables + l * NB, prefix,
-                        sk, sv, j, window=spec.window)
+                        sk, sv, j, window=spec.window, **sc_kw)
                     return out, sk_new, sv_new
 
                 x, (sk_all, sv_all) = _transformer_layer(spec, w, x, pos,
@@ -893,7 +990,8 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
         # the kernels READ the pools inside the scan; the barrier ties the
         # flush's pool operand to the scan result so XLA orders the in-place
         # scatter after the reads instead of cloning the (GB-scale) pools
-        kp4b, vp4b, _ = jax.lax.optimization_barrier((kp4, vp4, final_logits))
+        kp4b, vp4b, ks4b, vs4b, _ = jax.lax.optimization_barrier(
+            (kp4, vp4, ks4, vs4, final_logits))
         n_span = -(-C // bs) + 1
         t_idx = jnp.arange(n_span)
         lp = prefix[:, None] // bs + t_idx[None, :]             # [S, n_span]
@@ -908,23 +1006,39 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
         tok_valid = (j_rel >= 0) & (j_rel < C)
         j_clamp = jnp.clip(j_rel, 0, C - 1)
         s_idx = jnp.arange(S)[:, None, None]
+        phys_l = (phys[None] + (jnp.arange(L) * NB)[:, None, None])
+        phys_l = jnp.where(page_valid[None], phys_l, L * NB)    # OOB -> drop
 
-        def flush(pool4, side):                                 # per k/v
+        def flush(pool4, side, spool=None):                     # per k/v
             # side [L, S, C, Hkv, D] -> new values [L, S, n_span, bs, Hkv, D]
             newv = side[:, s_idx, j_clamp]                      # [L,S,n_span,bs,Hkv,D]
             newv = jnp.moveaxis(newv, 4, 3)                     # [...,Hkv,bs,D]
-            phys_l = (phys[None] + (jnp.arange(L) * NB)[:, None, None])
-            phys_l = jnp.where(page_valid[None], phys_l, L * NB)  # OOB -> drop
             old = pool4[jnp.minimum(phys_l, L * NB - 1)]
-            comb = jnp.where(tok_valid[None, :, :, None, :, None],
-                             newv.astype(pool4.dtype), old)
-            return pool4.at[phys_l.reshape(-1)].set(
-                comb.reshape(-1, Hkv, bs, D), mode="drop")
+            tv = tok_valid[None, :, :, None, :, None]
+            if spool is None:
+                comb = jnp.where(tv, newv.astype(pool4.dtype), old)
+                return pool4.at[phys_l.reshape(-1)].set(
+                    comb.reshape(-1, Hkv, bs, D), mode="drop"), None
+            # int8 pools: quantize the flushed rows; the RMW keeps the old
+            # page values AND old scales where the span page's slots predate
+            # the chunk
+            newq, news = kv_quantize_rows(newv)    # [...,Hkv,bs,D]/[...,Hkv,bs]
+            comb = jnp.where(tv, newq, old)
+            olds = spool[jnp.minimum(phys_l, L * NB - 1)]
+            combs = jnp.where(tok_valid[None, :, :, None, :], news, olds)
+            return (pool4.at[phys_l.reshape(-1)].set(
+                        comb.reshape(-1, Hkv, bs, D), mode="drop"),
+                    spool.at[phys_l.reshape(-1)].set(
+                        combs.reshape(-1, Hkv, bs), mode="drop"))
 
-        kf = flush(kp4b, sk_all)
-        vf = flush(vp4b, sv_all)
-        return (out_ids, final_logits,
-                kf.reshape(L, NB, Hkv, bs, D), vf.reshape(L, NB, Hkv, bs, D))
+        kf, ksf = flush(kp4b, sk_all, ks4b)
+        vf, vsf = flush(vp4b, sv_all, vs4b)
+        new_k = kf.reshape(L, NB, Hkv, bs, D)
+        new_v = vf.reshape(L, NB, Hkv, bs, D)
+        if kvq:
+            new_k = (new_k, ksf.reshape(L, NB, Hkv, bs))
+            new_v = (new_v, vsf.reshape(L, NB, Hkv, bs))
+        return (out_ids, final_logits, new_k, new_v)
 
     return fwd
 
@@ -980,7 +1094,7 @@ def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
 
     def fwd(weights, k_pages, v_pages, ids0, *rest, **kw):
         S = ids0.shape[0]
-        L = k_pages.shape[0]
+        L = _kv_unpack(k_pages)[0].shape[0]
         side_bytes = (2 * L * S * n_steps * spec.num_kv_heads
                       * spec.head_dim * esize)
         impl = sidebuf if side_bytes <= budget else general
@@ -1023,10 +1137,14 @@ def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
 
     def fwd(weights, k_pages, v_pages, ids0, positions0, block_tables, ctx0,
             key, temperature=1.0):
+        k_pages, k_sc = _kv_unpack(k_pages)
+        v_pages, v_sc = _kv_unpack(v_pages)
+        kvq = k_sc is not None
+        assert not (kvq and tp > 1), "int8 KV pages + TP not wired"
         S = ids0.shape[0]
         L, NB, bs = k_pages.shape[0], k_pages.shape[1], k_pages.shape[3]
 
-        def one_pass(x_ids, pos, ctx, kp, vp):
+        def one_pass(x_ids, pos, ctx, kp, vp, ks, vs):
             # kp/vp flat [L*NB*Hkv*bs, D]. The attention + page-write is one
             # fused unit (paged_decode_attention_step): pool aliased through
             # the kernel, new rows scattered in place after — the pools flow
@@ -1035,27 +1153,39 @@ def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
             x = _embed_in(spec, weights, x_ids, pos)
 
             def layer_fn(carry, scanned):
-                x, kp, vp = carry
+                x, kp, vp, ks, vs = carry
                 w, l = scanned
 
                 def attend(q, k, v):
+                    if kvq:
+                        out, kp4, vp4, ks4, vs4 = step_win(
+                            q, k, v, kp.reshape(L * NB, Hkv, bs, D),
+                            vp.reshape(L * NB, Hkv, bs, D),
+                            block_tables + l * NB, ctx,
+                            k_scales=ks.reshape(L * NB, Hkv, bs),
+                            v_scales=vs.reshape(L * NB, Hkv, bs))
+                        return (out, kp4.reshape(L * NB * Hkv * bs, D),
+                                vp4.reshape(L * NB * Hkv * bs, D),
+                                ks4.reshape(L * NB * Hkv * bs),
+                                vs4.reshape(L * NB * Hkv * bs))
                     out, kp4, vp4 = _decode_step(
                         q, k, v, kp.reshape(L * NB, Hkv, bs, D),
                         vp.reshape(L * NB, Hkv, bs, D),
                         block_tables + l * NB, ctx)
                     return (out, kp4.reshape(L * NB * Hkv * bs, D),
-                            vp4.reshape(L * NB * Hkv * bs, D))
+                            vp4.reshape(L * NB * Hkv * bs, D), ks, vs)
 
-                x, (kp, vp) = _transformer_layer(spec, w, x, pos, attend)
-                return (x, kp, vp), None
+                x, (kp, vp, ks, vs) = _transformer_layer(spec, w, x, pos,
+                                                         attend)
+                return (x, kp, vp, ks, vs), None
 
-            (x, kp, vp), _ = jax.lax.scan(
-                layer_fn, (x, kp, vp),
+            (x, kp, vp, ks, vs), _ = jax.lax.scan(
+                layer_fn, (x, kp, vp, ks, vs),
                 (weights["layers"], jnp.arange(L, dtype=jnp.int32)))
             x = _norm(x, weights["final_norm"], spec.norm, spec.eps, dtype,
                       spec.norm_plus_one)
             logits = _unembed(spec, weights, x)
-            return logits, kp, vp
+            return logits, kp, vp, ks, vs
 
         def sample(logits, step_key):
             if not do_sample:
@@ -1067,19 +1197,25 @@ def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
             return jax.random.categorical(step_key, z, axis=-1).astype(jnp.int32)
 
         def step(carry, j):
-            ids, pos, ctx, kp, vp, _ = carry
-            logits, kp, vp = one_pass(ids, pos, ctx, kp, vp)
+            ids, pos, ctx, kp, vp, ks, vs, _ = carry
+            logits, kp, vp, ks, vs = one_pass(ids, pos, ctx, kp, vp, ks, vs)
             nxt = sample(logits, jax.random.fold_in(key, j))
-            return (nxt, pos + 1, ctx + 1, kp, vp, logits), ids
+            return (nxt, pos + 1, ctx + 1, kp, vp, ks, vs, logits), ids
 
         V = weights["embed"].shape[0]
         init_logits = jnp.zeros((ids0.shape[0], V), jnp.float32)
         kp0 = k_pages.reshape(L * NB * Hkv * bs, D)
         vp0 = v_pages.reshape(L * NB * Hkv * bs, D)
-        (_, _, _, kp, vp, final_logits), out_ids = jax.lax.scan(
-            step, (ids0, positions0, ctx0, kp0, vp0, init_logits),
+        ks0 = k_sc.reshape(L * NB * Hkv * bs) if kvq else None
+        vs0 = v_sc.reshape(L * NB * Hkv * bs) if kvq else None
+        (_, _, _, kp, vp, ks, vs, final_logits), out_ids = jax.lax.scan(
+            step, (ids0, positions0, ctx0, kp0, vp0, ks0, vs0, init_logits),
             jnp.arange(n_steps))
-        return (out_ids, final_logits,
-                kp.reshape(L, NB, Hkv, bs, D), vp.reshape(L, NB, Hkv, bs, D))
+        new_k = kp.reshape(L, NB, Hkv, bs, D)
+        new_v = vp.reshape(L, NB, Hkv, bs, D)
+        if kvq:
+            new_k = (new_k, ks.reshape(L, NB, Hkv, bs))
+            new_v = (new_v, vs.reshape(L, NB, Hkv, bs))
+        return (out_ids, final_logits, new_k, new_v)
 
     return fwd

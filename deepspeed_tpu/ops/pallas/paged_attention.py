@@ -53,6 +53,40 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def kv_quantize_rows(x: jax.Array):
+    """Symmetric per-row int8 quantization for KV pages: ``[..., D]`` ->
+    (int8 values ``[..., D]``, f32 scale ``[...]``). One scale per
+    token-head row — the granularity the paged kernels dequantize at
+    (reference role: the int8 KV strategy of ZeRO-Inference, README.md:23;
+    the v1 dense tier uses the same scheme)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    s = amax / 127.0
+    q = jnp.round(xf / jnp.maximum(s, 1e-20)[..., None])
+    return q.astype(jnp.int8), s
+
+
+def _scale_tile_rows(h_kv: int, bs: int) -> int:
+    """Sublane rows of one page's scale tile, padded to the (8, 128) f32
+    tile: a page's Hkv*bs scales occupy Hkv*bs/128 lane rows; Mosaic DMA
+    slices must be whole tiles, so the row count rounds up to 8 (~6% of the
+    int8 page body — the price of an aligned one-tile-per-page stream)."""
+    r = (h_kv * bs) // 128
+    return -(-r // 8) * 8
+
+
+def _scales_to_tiles(s: jax.Array, NB: int, h_kv: int, bs: int) -> jax.Array:
+    """[NB, Hkv, bs] f32 logical scales -> [NB, R8, 128] DMA-aligned tiles
+    (flat scale index h*bs + t at (idx // 128, idx % 128)). XLA hoists this
+    out of the decode scan when the pools are frozen (the sidebuf path)."""
+    r8 = _scale_tile_rows(h_kv, bs)
+    flat = s.reshape(NB, h_kv * bs).astype(jnp.float32)
+    pad = r8 * 128 - h_kv * bs
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    return flat.reshape(NB, r8, 128)
+
+
 def _pick_pages_per_chunk(bs: int, h_kv: int, d: int, esize: int,
                           max_blocks: int, reserve_bytes: int = 0) -> int:
     """Largest P with the 2-slot K+V slabs within ~8 MB of VMEM (~16 MB on
@@ -86,8 +120,15 @@ def _chunk_mask(c, ctx_limit, T, h_kv, bs, H, tok_lo=None):
     return mask
 
 
-def _flash_update(sc, mask, vv, m_sc, l_sc, acc_sc):
-    """One online-softmax update of the running (m, l, acc) scratch."""
+def _flash_update(sc, mask, vv, m_sc, l_sc, acc_sc, v_scale_fn=None,
+                  compute_dtype=jnp.bfloat16):
+    """One online-softmax update of the running (m, l, acc) scratch.
+
+    ``v_scale_fn`` (int8 KV pages): applies the per-column V dequant scales
+    to p before the pv dot, so the int8 V slab never materialises a
+    dequantized copy (p @ (s * v) == (p * s) @ v, column-wise).
+    ``compute_dtype``: dot dtype for an int8 ``vv`` (bf16 on the serving
+    path — MXU; f32 when the caller's q is f32, keeping tests exact)."""
     sc = jnp.where(mask, sc, NEG_INF)
     m_prev = m_sc[:, 0:1]
     m_new = jnp.maximum(m_prev, jnp.max(sc, axis=1, keepdims=True))
@@ -98,7 +139,10 @@ def _flash_update(sc, mask, vv, m_sc, l_sc, acc_sc):
     alpha = jnp.exp(m_prev - m_new)
     l_sc[:, 0:1] = l_sc[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
     m_sc[:, 0:1] = m_new
-    pv_dot = jax.lax.dot_general(p.astype(vv.dtype), vv,
+    pv = p if v_scale_fn is None else v_scale_fn(p)
+    if vv.dtype == jnp.int8:
+        vv = vv.astype(compute_dtype)
+    pv_dot = jax.lax.dot_general(pv.astype(vv.dtype), vv,
                                  (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
     acc_sc[:] = acc_sc[:] * alpha + pv_dot
@@ -109,7 +153,8 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
                  k_buf, v_buf, sems, acc_sc, m_sc, l_sc, *,
                  scale, block_size, pages_per_chunk, n_chunks, max_blocks,
                  n_seqs, h_kv, groups, window=None, lse_ref=None,
-                 j_ref=None, sidek_ref=None, sidev_ref=None, n_side=0):
+                 j_ref=None, sidek_ref=None, sidev_ref=None, n_side=0,
+                 ks_hbm=None, vs_hbm=None, ks_buf=None, vs_buf=None):
     """Shared batched-decode body (see module docstring). With
     ``knew_ref/vnew_ref`` (step mode) the pages hold tokens [0, ctx-1) and
     the current token's attention term folds in from registers at finalize;
@@ -173,10 +218,14 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
             need = jnp.logical_and(need, t0 + bs > tok_lo_of(s_))
         return need
 
+    quant = ks_hbm is not None
+
     def chunk_copies(s_, c_, slot):
         """The per-page copy descriptors for chunk c_ of sequence s_ (built
         identically at start and wait — same (src, dst, sem) triples and
-        the same ``page_needed`` predicates)."""
+        the same ``page_needed`` predicates). int8 pages add a per-page
+        [Hkv*bs] f32 scale-row copy for K and V (2 KB each — noise next to
+        the page body, which the int8 dtype just halved)."""
         cps = []
         for j in range(P):
             page = bt_ref[s_, jnp.minimum(c_ * P + j, max_blocks - 1)]
@@ -184,7 +233,14 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
                 k_hbm.at[page], k_buf.at[slot, j], sems.at[slot])))
             cps.append((page_needed(s_, c_, j), pltpu.make_async_copy(
                 v_hbm.at[page], v_buf.at[slot, j], sems.at[slot])))
+            if quant:
+                cps.append((page_needed(s_, c_, j), pltpu.make_async_copy(
+                    ks_hbm.at[page], ks_buf.at[slot, j], sems.at[slot])))
+                cps.append((page_needed(s_, c_, j), pltpu.make_async_copy(
+                    vs_hbm.at[page], vs_buf.at[slot, j], sems.at[slot])))
         return cps
+
+    per_page = 4 if quant else 2
 
     def start_copies(s_, c_, slot):
         for need, cp in chunk_copies(s_, c_, slot):
@@ -197,14 +253,21 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
             @pl.when(need)
             def _():
                 cp.wait()
-            if j2 % 2 == 1:  # V copy of page j2 // 2
+            if j2 % per_page == 1:  # V copy of page j2 // per_page
                 # a skipped page's V buffer holds garbage; the online-softmax
                 # p rows are exactly 0 there, but 0 * NaN = NaN, so the V slab
                 # must be finite — zero it (K needs nothing: masked scores are
                 # replaced before use)
                 @pl.when(jnp.logical_not(need))
                 def _():
-                    v_buf[slot, j2 // 2] = jnp.zeros_like(v_buf[slot, j2 // 2])
+                    v_buf[slot, j2 // per_page] = jnp.zeros_like(
+                        v_buf[slot, j2 // per_page])
+            if quant and j2 % per_page == 3:  # V-scale copy
+                # same reasoning: the V scale folds into p (0 * NaN = NaN)
+                @pl.when(jnp.logical_not(need))
+                def _():
+                    vs_buf[slot, j2 // per_page] = jnp.zeros_like(
+                        vs_buf[slot, j2 // per_page])
 
     # prime the pipeline — only when chunk (0, 0) is real (with a window,
     # sequence 0 may start at a later chunk, whose copy is issued by the
@@ -247,12 +310,40 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
         vv = v_buf[slot].reshape(P * h_kv * bs, -1)            # collapse only
         mask = _chunk_mask(c, ctx - ctx_off, T, h_kv, bs, H,
                            tok_lo=None if window is None else tok_lo_of(s))
+        v_scale_fn = None
+        if quant:
+            # int8 pages: convert to q's dtype for the dots (bf16 MXU path
+            # in serving; f32 when q is f32 so tests stay exact) — a VPU
+            # cast over a VMEM-resident slab, cheap next to the HBM read
+            # this halves. Per-row dequant scales fold in as score-column
+            # (K) and p-column (V) multipliers applied per 128-lane
+            # sub-block (the scale tile's lane rows map 1:1 onto score
+            # column blocks — no cross-tile relayout), never materialising
+            # a dequantized slab.
+            kk = kk.astype(q.dtype)
+            nsub = (h_kv * bs) // 128
+            kst = ks_buf[slot]                      # [P, R8, 128]
+            vst = vs_buf[slot]
+
+            def colscale(mat, st):
+                cols = []
+                for jp in range(P):
+                    for t in range(nsub):
+                        c0 = (jp * nsub + t) * 128
+                        cols.append(mat[:, c0:c0 + 128]
+                                    * st[jp, t, :][None, :])
+                return jnp.concatenate(cols, axis=1)
+
+            v_scale_fn = functools.partial(colscale, st=vst)
         # dots run in the page dtype (bf16 MXU path for serving caches) with
         # f32 accumulation; identical math to before for f32 pools
         sc = jax.lax.dot_general(q.astype(kk.dtype), kk,
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32) * scale
-        _flash_update(sc, mask, vv, m_sc, l_sc, acc_sc)
+        if quant:
+            sc = colscale(sc, kst)
+        _flash_update(sc, mask, vv, m_sc, l_sc, acc_sc,
+                      v_scale_fn=v_scale_fn, compute_dtype=q.dtype)
 
         @pl.when(c == nc_s - 1)
         def _():
@@ -337,12 +428,32 @@ def _decode_kernel_lse(bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
                  k_buf, v_buf, sems, acc_sc, m_sc, l_sc, lse_ref=lse_ref, **kw)
 
 
+def _decode_kernel_quant(bt_ref, cl_ref, q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm,
+                         o_ref, k_buf, v_buf, ks_buf, vs_buf, sems,
+                         acc_sc, m_sc, l_sc, **kw):
+    _decode_body(bt_ref, cl_ref, q_ref, None, None, k_hbm, v_hbm, o_ref,
+                 k_buf, v_buf, sems, acc_sc, m_sc, l_sc,
+                 ks_hbm=ks_hbm, vs_hbm=vs_hbm, ks_buf=ks_buf, vs_buf=vs_buf,
+                 **kw)
+
+
 def _decode_kernel_sidebuf(bt_ref, cl_ref, j_ref, q_ref, sidek_ref, sidev_ref,
                            k_hbm, v_hbm, o_ref,
                            k_buf, v_buf, sems, acc_sc, m_sc, l_sc, **kw):
     _decode_body(bt_ref, cl_ref, q_ref, None, None, k_hbm, v_hbm, o_ref,
                  k_buf, v_buf, sems, acc_sc, m_sc, l_sc,
                  j_ref=j_ref, sidek_ref=sidek_ref, sidev_ref=sidev_ref, **kw)
+
+
+def _decode_kernel_sidebuf_quant(bt_ref, cl_ref, j_ref, q_ref, sidek_ref,
+                                 sidev_ref, k_hbm, v_hbm, ks_hbm, vs_hbm,
+                                 o_ref, k_buf, v_buf, ks_buf, vs_buf, sems,
+                                 acc_sc, m_sc, l_sc, **kw):
+    _decode_body(bt_ref, cl_ref, q_ref, None, None, k_hbm, v_hbm, o_ref,
+                 k_buf, v_buf, sems, acc_sc, m_sc, l_sc,
+                 j_ref=j_ref, sidek_ref=sidek_ref, sidev_ref=sidev_ref,
+                 ks_hbm=ks_hbm, vs_hbm=vs_hbm, ks_buf=ks_buf, vs_buf=vs_buf,
+                 **kw)
 
 
 def paged_decode_attention_sidebuf(q: jax.Array,
@@ -354,7 +465,10 @@ def paged_decode_attention_sidebuf(q: jax.Array,
                                    side_v: jax.Array,
                                    j,
                                    softmax_scale: Optional[float] = None,
-                                   window: Optional[int] = None) -> jax.Array:
+                                   window: Optional[int] = None,
+                                   k_scales: Optional[jax.Array] = None,
+                                   v_scales: Optional[jax.Array] = None
+                                   ) -> jax.Array:
     """Decode attention over a FROZEN paged prefix plus a per-sequence side
     slab of freshly decoded K/V — the kernel of the scatter-free multistep
     schedule (``inference/v2/ragged_model._build_multistep_sidebuf``).
@@ -367,6 +481,9 @@ def paged_decode_attention_sidebuf(q: jax.Array,
                                     the current token), rows > j are ignored
     j:            int32 scalar      current step within the chunk
     window:       optional static sliding window over position prefix + j
+    k/v_scales:   [NB, H_kv, bs] f32 — int8 pages: per-token-head dequant
+                  scales (the side slab stays bf16; only the prefix pages,
+                  the dominant stream, are quantized)
 
     Returns [S, H, D]. Reference role: the blocked-flash KV stream fused with
     the in-flight tokens (``inference/v2/kernels/ragged_ops/blocked_flash``) —
@@ -382,35 +499,56 @@ def paged_decode_attention_sidebuf(q: jax.Array,
     G = H // Hkv
     MB = block_tables.shape[1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+    quant = k_scales is not None
     side_vmem = 2 * Cs * Hkv * D * jnp.dtype(side_k.dtype).itemsize
     P = _pick_pages_per_chunk(bs, Hkv, D, jnp.dtype(k_pages.dtype).itemsize,
                               MB, reserve_bytes=side_vmem)
     NC = -(-MB // P)
     assert (bs * Hkv) % 8 == 0
+    if quant:
+        assert (Hkv * bs) % 128 == 0, "scale-row DMA needs lane alignment"
 
     kernel = functools.partial(
-        _decode_kernel_sidebuf, scale=scale, block_size=bs,
+        _decode_kernel_sidebuf_quant if quant else _decode_kernel_sidebuf,
+        scale=scale, block_size=bs,
         pages_per_chunk=P, n_chunks=NC, max_blocks=MB, n_seqs=S, h_kv=Hkv,
         groups=G, window=window, n_side=Cs)
+    in_specs = [
+        pl.BlockSpec((1, H, D), lambda s, c, bt, cl, jj: (s, 0, 0)),
+        pl.BlockSpec((1, Cs * Hkv, D), lambda s, c, bt, cl, jj: (s, 0, 0)),
+        pl.BlockSpec((1, Cs * Hkv, D), lambda s, c, bt, cl, jj: (s, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    scratch = [
+        pltpu.VMEM((2, P, Hkv * bs, D), k_pages.dtype),
+        pltpu.VMEM((2, P, Hkv * bs, D), v_pages.dtype),
+    ]
+    operands = [block_tables.astype(jnp.int32), prefix_lens.astype(jnp.int32),
+                jnp.asarray(j, jnp.int32).reshape(1), q,
+                side_k.reshape(S, Cs * Hkv, D), side_v.reshape(S, Cs * Hkv, D),
+                k_pages.reshape(NB, Hkv * bs, D),
+                v_pages.reshape(NB, Hkv * bs, D)]
+    if quant:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        r8 = _scale_tile_rows(Hkv, bs)
+        scratch += [pltpu.VMEM((2, P, r8, 128), jnp.float32),
+                    pltpu.VMEM((2, P, r8, 128), jnp.float32)]
+        operands += [_scales_to_tiles(k_scales, NB, Hkv, bs),
+                     _scales_to_tiles(v_scales, NB, Hkv, bs)]
+    scratch += [
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.VMEM((H, D), jnp.float32),
+        pltpu.VMEM((H, 128), jnp.float32),
+        pltpu.VMEM((H, 128), jnp.float32),
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(S, NC),
-        in_specs=[
-            pl.BlockSpec((1, H, D), lambda s, c, bt, cl, jj: (s, 0, 0)),
-            pl.BlockSpec((1, Cs * Hkv, D), lambda s, c, bt, cl, jj: (s, 0, 0)),
-            pl.BlockSpec((1, Cs * Hkv, D), lambda s, c, bt, cl, jj: (s, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, H, D), lambda s, c, bt, cl, jj: (s, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, P, Hkv * bs, D), k_pages.dtype),
-            pltpu.VMEM((2, P, Hkv * bs, D), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.VMEM((H, D), jnp.float32),
-            pltpu.VMEM((H, 128), jnp.float32),
-            pltpu.VMEM((H, 128), jnp.float32),
-        ],
+        scratch_shapes=scratch,
     )
     return pl.pallas_call(
         kernel,
@@ -419,10 +557,7 @@ def paged_decode_attention_sidebuf(q: jax.Array,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=_interpret(),
-    )(block_tables.astype(jnp.int32), prefix_lens.astype(jnp.int32),
-      jnp.asarray(j, jnp.int32).reshape(1), q,
-      side_k.reshape(S, Cs * Hkv, D), side_v.reshape(S, Cs * Hkv, D),
-      k_pages.reshape(NB, Hkv * bs, D), v_pages.reshape(NB, Hkv * bs, D))
+    )(*operands)
 
 
 def paged_decode_attention_sidebuf_reference(q, k_pages, v_pages, block_tables,
@@ -590,7 +725,9 @@ def paged_decode_attention(q: jax.Array,
                            ctx_lens: jax.Array,
                            softmax_scale: Optional[float] = None,
                            window: Optional[int] = None,
-                           with_lse: bool = False):
+                           with_lse: bool = False,
+                           k_scales: Optional[jax.Array] = None,
+                           v_scales: Optional[jax.Array] = None):
     """Single-token-per-sequence attention over a paged KV cache.
 
     q:            [S, H, D]        one query token per sequence
@@ -603,6 +740,10 @@ def paged_decode_attention(q: jax.Array,
     with_lse:     also return lse [S, H] f32 (m + log l; NEG_INF for empty
                   rows) — the hook for merging with a second attention piece
                   (the fused multistep side-buffer path).
+    k/v_scales:   [NB, H_kv, bs] f32 — int8 pages: per-token-head dequant
+                  scales, streamed per page and folded into the dots
+                  in-kernel (reference role: the int8 KV tier of
+                  ZeRO-Inference, README.md:23, on the blocked-flash path).
 
     Returns [S, H, D] (plus lse when requested). Rows whose ctx_len is 0
     return zeros.
@@ -614,15 +755,21 @@ def paged_decode_attention(q: jax.Array,
     G = H // Hkv
     MB = block_tables.shape[1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+    quant = k_scales is not None
     if D % 128 != 0:   # manual-DMA lane-alignment limit — see _paged_decode_smalld
         assert not with_lse, "with_lse needs the manual-DMA path (D % 128 == 0)"
+        assert not quant, "int8 pages need the manual-DMA path (D % 128 == 0)"
         return _paged_decode_smalld(q, k_pages, v_pages, block_tables,
                                     ctx_lens, scale, window=window)
     P = _pick_pages_per_chunk(bs, Hkv, D, jnp.dtype(k_pages.dtype).itemsize, MB)
     NC = -(-MB // P)
+    if quant:
+        assert not with_lse, "with_lse + int8 pages not needed by any caller"
+        assert (Hkv * bs) % 128 == 0, "scale-row DMA needs lane alignment"
 
     kernel = functools.partial(
-        _decode_kernel_lse if with_lse else _decode_kernel,
+        _decode_kernel_quant if quant
+        else (_decode_kernel_lse if with_lse else _decode_kernel),
         scale=scale, block_size=bs, pages_per_chunk=P,
         n_chunks=NC, max_blocks=MB, n_seqs=S, h_kv=Hkv, groups=G,
         window=window)
@@ -634,25 +781,40 @@ def paged_decode_attention(q: jax.Array,
         out_spec = [out_spec,
                     pl.BlockSpec((1, H, 128), lambda s, c, bt, cl: (s, 0, 0))]
         out_shape = [out_shape, jax.ShapeDtypeStruct((S, H, 128), jnp.float32)]
+    in_specs = [
+        pl.BlockSpec((1, H, D), lambda s, c, bt, cl: (s, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),     # K pages stay in HBM;
+        pl.BlockSpec(memory_space=pl.ANY),     # chunks stream via DMA
+    ]
+    scratch = [
+        # pages flattened to [Hkv*bs, D] rows — (bs, D) trailing tiles,
+        # aligned for any head count
+        pltpu.VMEM((2, P, Hkv * bs, D), k_pages.dtype),
+        pltpu.VMEM((2, P, Hkv * bs, D), v_pages.dtype),
+    ]
+    operands = [block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32), q,
+                k_pages.reshape(NB, Hkv * bs, D),
+                v_pages.reshape(NB, Hkv * bs, D)]
+    if quant:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        r8 = _scale_tile_rows(Hkv, bs)
+        scratch += [pltpu.VMEM((2, P, r8, 128), jnp.float32),
+                    pltpu.VMEM((2, P, r8, 128), jnp.float32)]
+        operands += [_scales_to_tiles(k_scales, NB, Hkv, bs),
+                     _scales_to_tiles(v_scales, NB, Hkv, bs)]
+    scratch += [
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.VMEM((H, D), jnp.float32),
+        pltpu.VMEM((H, 128), jnp.float32),
+        pltpu.VMEM((H, 128), jnp.float32),
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S, NC),
-        in_specs=[
-            pl.BlockSpec((1, H, D), lambda s, c, bt, cl: (s, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),     # K pages stay in HBM;
-            pl.BlockSpec(memory_space=pl.ANY),     # chunks stream via DMA
-        ],
+        in_specs=in_specs,
         out_specs=out_spec,
-        scratch_shapes=[
-            # pages flattened to [Hkv*bs, D] rows — (bs, D) trailing tiles,
-            # aligned for any head count
-            pltpu.VMEM((2, P, Hkv * bs, D), k_pages.dtype),
-            pltpu.VMEM((2, P, Hkv * bs, D), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.VMEM((H, D), jnp.float32),
-            pltpu.VMEM((H, 128), jnp.float32),
-            pltpu.VMEM((H, 128), jnp.float32),
-        ],
+        scratch_shapes=scratch,
     )
     assert (bs * Hkv) % 8 == 0, \
         f"page rows {Hkv}*{bs} must align to the 8-sublane tile"
@@ -665,8 +827,7 @@ def paged_decode_attention(q: jax.Array,
             # across sequences), so iteration order must stay sequential
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=_interpret(),
-    )(block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32), q,
-      k_pages.reshape(NB, Hkv * bs, D), v_pages.reshape(NB, Hkv * bs, D))
+    )(*operands)
     if with_lse:
         return res[0], res[1][:, :, 0]
     return res
@@ -698,6 +859,22 @@ def _decode_step_kernel(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
                  o_ref, k_buf, v_buf, sems, acc_sc, m_sc, l_sc, **kw)
 
 
+def _decode_step_kernel_quant(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
+                              k_hbm, v_hbm, ks_hbm, vs_hbm,
+                              o_ref, kout_ref, vout_ref,
+                              k_buf, v_buf, ks_buf, vs_buf, sems,
+                              acc_sc, m_sc, l_sc, **kw):
+    # value pools alias through (caller-side scatter); scale TILES are
+    # read-only inputs — they are a fresh pad/reshape copy of the at-rest
+    # scale pools, so the caller's scale scatter needs no aliasing or
+    # ordering against this kernel
+    del kout_ref, vout_ref
+    _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref, k_hbm, v_hbm,
+                 o_ref, k_buf, v_buf, sems, acc_sc, m_sc, l_sc,
+                 ks_hbm=ks_hbm, vs_hbm=vs_hbm, ks_buf=ks_buf, vs_buf=vs_buf,
+                 **kw)
+
+
 def paged_decode_attention_step(q: jax.Array,
                                 k_new: jax.Array,
                                 v_new: jax.Array,
@@ -706,7 +883,9 @@ def paged_decode_attention_step(q: jax.Array,
                                 block_tables: jax.Array,
                                 ctx_lens: jax.Array,
                                 softmax_scale: Optional[float] = None,
-                                window: Optional[int] = None):
+                                window: Optional[int] = None,
+                                k_scales: Optional[jax.Array] = None,
+                                v_scales: Optional[jax.Array] = None):
     """One fused decode step per sequence: write ``k_new/v_new`` (the current
     token's K/V, position ``ctx_lens - 1``) into the paged cache AND return
     attention over the full context including the current token (with
@@ -716,9 +895,13 @@ def paged_decode_attention_step(q: jax.Array,
     k/v_pages:    [NB, H_kv, bs, D] — ALIASED: the returned pools reuse the
                   input buffers (donate them at the jit boundary)
     block_tables: [S, MB] int32   ctx_lens: [S] int32 (INCLUDING current)
+    k/v_scales:   [NB, H_kv, bs] f32 — int8 pages: per-token-head dequant
+                  scales; ALIASED through like the pools, the new token's
+                  rows quantized and scattered by the same post-kernel path.
 
-    Returns ``(out [S, H, D], k_pages, v_pages)``. ctx_lens == 0 rows write
-    nothing and return zeros.
+    Returns ``(out [S, H, D], k_pages, v_pages)`` — with scales,
+    ``(out, k_pages, v_pages, k_scales, v_scales)``. ctx_lens == 0 rows
+    write nothing and return zeros.
     """
     S, H, D = q.shape
     NB, Hkv, bs, Dk = k_pages.shape
@@ -726,6 +909,9 @@ def paged_decode_attention_step(q: jax.Array,
     G = H // Hkv
     MB = block_tables.shape[1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+    quant = k_scales is not None
+    if quant:
+        assert D % 128 == 0 and (Hkv * bs) % 128 == 0
     if D % 128 != 0:
         # small-D fallback: scatter first (pools here are small), then the
         # BlockSpec-pipelined kernel over the full context
@@ -749,47 +935,66 @@ def paged_decode_attention_step(q: jax.Array,
     assert (bs * Hkv) % 8 == 0
 
     kernel = functools.partial(
-        _decode_step_kernel, scale=scale, block_size=bs, pages_per_chunk=P,
+        _decode_step_kernel_quant if quant else _decode_step_kernel,
+        scale=scale, block_size=bs, pages_per_chunk=P,
         n_chunks=NC, max_blocks=MB, n_seqs=S, h_kv=Hkv, groups=G,
         window=window)
     flat = (NB, Hkv * bs, D)
+    in_specs = [
+        pl.BlockSpec((1, H, D), lambda s, c, bt, cl: (s, 0, 0)),
+        pl.BlockSpec((1, Hkv, D), lambda s, c, bt, cl: (s, 0, 0)),
+        pl.BlockSpec((1, Hkv, D), lambda s, c, bt, cl: (s, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    out_specs = [
+        pl.BlockSpec((1, H, D), lambda s, c, bt, cl: (s, 0, 0)),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    out_shape = [jax.ShapeDtypeStruct((S, H, D), q.dtype),
+                 jax.ShapeDtypeStruct(flat, k_pages.dtype),
+                 jax.ShapeDtypeStruct(flat, v_pages.dtype)]
+    scratch = [
+        pltpu.VMEM((2, P, Hkv * bs, D), k_pages.dtype),
+        pltpu.VMEM((2, P, Hkv * bs, D), v_pages.dtype),
+    ]
+    operands = [block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32),
+                q, k_new, v_new, k_pages.reshape(flat), v_pages.reshape(flat)]
+    # call args: (bt, cl, q, k_new, v_new, k_pool, v_pool[, ks, vs]) ->
+    # value pools alias input -> output; scale tiles are read-only copies
+    aliases = {5: 1, 6: 2}
+    if quant:
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
+                     pl.BlockSpec(memory_space=pl.ANY)]
+        r8 = _scale_tile_rows(Hkv, bs)
+        scratch += [pltpu.VMEM((2, P, r8, 128), jnp.float32),
+                    pltpu.VMEM((2, P, r8, 128), jnp.float32)]
+        operands += [_scales_to_tiles(k_scales, NB, Hkv, bs),
+                     _scales_to_tiles(v_scales, NB, Hkv, bs)]
+    scratch += [
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.VMEM((H, D), jnp.float32),
+        pltpu.VMEM((H, 128), jnp.float32),
+        pltpu.VMEM((H, 128), jnp.float32),
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S, NC),
-        in_specs=[
-            pl.BlockSpec((1, H, D), lambda s, c, bt, cl: (s, 0, 0)),
-            pl.BlockSpec((1, Hkv, D), lambda s, c, bt, cl: (s, 0, 0)),
-            pl.BlockSpec((1, Hkv, D), lambda s, c, bt, cl: (s, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, H, D), lambda s, c, bt, cl: (s, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((2, P, Hkv * bs, D), k_pages.dtype),
-            pltpu.VMEM((2, P, Hkv * bs, D), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.VMEM((H, D), jnp.float32),
-            pltpu.VMEM((H, 128), jnp.float32),
-            pltpu.VMEM((H, 128), jnp.float32),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
     )
-    out, kf, vf = pl.pallas_call(
+    res = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct((S, H, D), q.dtype),
-                   jax.ShapeDtypeStruct(flat, k_pages.dtype),
-                   jax.ShapeDtypeStruct(flat, v_pages.dtype)],
-        # call args: (bt, cl, q, k_new, v_new, k_pool, v_pool) -> pools alias
-        input_output_aliases={5: 1, 6: 2},
+        out_shape=out_shape,
+        input_output_aliases=aliases,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=_interpret(),
-    )(block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32),
-      q, k_new, v_new, k_pages.reshape(flat), v_pages.reshape(flat))
+    )(*operands)
+    out, kf, vf = res[0], res[1], res[2]
     # the write happens HERE, after the kernel: a canonical in-place scatter
     # on the aliased-through pool (see _decode_step_kernel docstring).
     # Head-major flat rows: row of (page, head, slot) = (page*Hkv + h)*bs + slot.
@@ -798,6 +1003,21 @@ def paged_decode_attention_step(q: jax.Array,
     dest = ((page_w[:, None] * Hkv + jnp.arange(Hkv)[None, :]) * bs
             + (pv % bs)[:, None])                              # [S, Hkv]
     dest = jnp.where(ctx_lens[:, None] > 0, dest, NB * Hkv * bs).reshape(-1)
+    if quant:
+        kq, ks_new = kv_quantize_rows(k_new)                   # [S, Hkv, D]/[S, Hkv]
+        vq, vs_new = kv_quantize_rows(v_new)
+        kf = kf.reshape(NB * Hkv * bs, D).at[dest].set(
+            kq.reshape(S * Hkv, D), mode="drop")
+        vf = vf.reshape(NB * Hkv * bs, D).at[dest].set(
+            vq.reshape(S * Hkv, D), mode="drop")
+        # scale scatter targets the AT-REST pools (the kernel read a tile
+        # copy, so this is an ordinary in-place scatter)
+        ksf = k_scales.reshape(NB * Hkv * bs).at[dest].set(
+            ks_new.reshape(-1), mode="drop")
+        vsf = v_scales.reshape(NB * Hkv * bs).at[dest].set(
+            vs_new.reshape(-1), mode="drop")
+        return (out, kf.reshape(NB, Hkv, bs, D), vf.reshape(NB, Hkv, bs, D),
+                ksf.reshape(NB, Hkv, bs), vsf.reshape(NB, Hkv, bs))
     kf = kf.reshape(NB * Hkv * bs, D).at[dest].set(
         k_new.reshape(S * Hkv, D).astype(kf.dtype), mode="drop")
     vf = vf.reshape(NB * Hkv * bs, D).at[dest].set(
@@ -859,14 +1079,29 @@ def paged_chunk_attention(q: jax.Array,
         softmax_scale=softmax_scale, block_q=block_q, window=window)[0]
 
 
+def _apply_scale_rows(mat, s_ref, h, bs):
+    """Multiply ``mat`` [rows, bs] by head h's per-token dequant scales read
+    from a page scale tile ref [1, R8, 128] — one aligned 128-lane piece at
+    a time (the tile's lane rows map 1:1 onto token sub-blocks)."""
+    pieces = []
+    for t0 in range(bs // 128):
+        row = (h * bs) // 128 + t0
+        pieces.append(mat[:, t0 * 128:(t0 + 1) * 128]
+                      * s_ref[0, row, :][None, :])
+    return jnp.concatenate(pieces, axis=1) if len(pieces) > 1 else pieces[0]
+
+
 def _chunk_kernel_batched(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
                           acc_sc, m_sc, l_sc, *, scale, block_size, block_q,
-                          max_blocks, h_kv, groups, window=None):
+                          max_blocks, h_kv, groups, window=None,
+                          ks_ref=None, vs_ref=None):
     """Multi-slot variant of ``_chunk_kernel``: grid (slot, q-block, page);
     each slot is an independent prompt chunk with its own block table and
     (q_start, ctx) row in ``meta_ref``. Slot padding (ctx 0) writes zeros.
     With ``window``, row q_pos attends only k_pos > q_pos - window (and
-    pages wholly below the q-block's window skip)."""
+    pages wholly below the q-block's window skip). ``ks_ref/vs_ref``
+    (int8 pages): the page's per-token-head dequant scales, applied as
+    score-column (K) and p-column (V) multipliers."""
     sl, iq, i = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     q0 = meta_ref[sl, 0]
     ctx = meta_ref[sl, 1]
@@ -900,6 +1135,10 @@ def _chunk_kernel_batched(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
             vh = v_ref[0, h].astype(jnp.float32)
             sc = jax.lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32) * scale
+            if ks_ref is not None:
+                # scale tiles [1, R8, 128]: head h's bs scales live in lane
+                # rows h*bs/128 .. — multiply per 128-lane piece (aligned)
+                sc = _apply_scale_rows(sc, ks_ref, h, bs)
             sc = jnp.where(mask, sc, NEG_INF)
             rows = slice(h * bq * G, (h + 1) * bq * G)
             m_prev = m_sc[rows, 0:1]
@@ -909,8 +1148,10 @@ def _chunk_kernel_batched(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
             l_sc[rows, 0:1] = l_sc[rows, 0:1] * alpha + jnp.sum(p, axis=1,
                                                                keepdims=True)
             m_sc[rows, 0:1] = m_new
+            pv = p if vs_ref is None \
+                else _apply_scale_rows(p, vs_ref, h, bs)
             acc_sc[rows, :] = acc_sc[rows, :] * alpha + jax.lax.dot_general(
-                p, vh, (((1,), (0,)), ((), ())),
+                pv, vh, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
     @pl.when(i == max_blocks - 1)
@@ -932,7 +1173,10 @@ def paged_chunk_attention_batched(q: jax.Array,
                                   ctx_lens: jax.Array,
                                   softmax_scale: Optional[float] = None,
                                   block_q: int = 128,
-                                  window: Optional[int] = None) -> jax.Array:
+                                  window: Optional[int] = None,
+                                  k_scales: Optional[jax.Array] = None,
+                                  v_scales: Optional[jax.Array] = None
+                                  ) -> jax.Array:
     """Prefill flash attention for SEVERAL prompt chunks in one kernel.
 
     Multi-chunk SplitFuse: a pass that carries one chunk per pallas call
@@ -944,6 +1188,7 @@ def paged_chunk_attention_batched(q: jax.Array,
     block_tables: [NC, MB] int32
     q_starts:     [NC] int32 — absolute position of each slot's row 0
     ctx_lens:     [NC] int32 — KV tokens visible per slot (0 = empty slot)
+    k/v_scales:   [NB, H_kv, bs] f32 — int8 pages (dequant in-kernel)
 
     Returns [NC, Cs, H, D]; empty slots return zeros.
     """
@@ -953,6 +1198,7 @@ def paged_chunk_attention_batched(q: jax.Array,
     G = H // Hkv
     MB = block_tables.shape[1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+    quant = k_scales is not None
     bq = block_q
     while Cs % bq != 0:
         bq //= 2
@@ -961,19 +1207,32 @@ def paged_chunk_attention_batched(q: jax.Array,
 
     meta = jnp.stack([jnp.asarray(q_starts, jnp.int32),
                       jnp.asarray(ctx_lens, jnp.int32)], axis=1)   # [NC, 2]
-    kernel = functools.partial(_chunk_kernel_batched, scale=scale,
-                               block_size=bs, block_q=bq, max_blocks=MB,
-                               h_kv=Hkv, groups=G, window=window)
+    kernel = functools.partial(
+        _chunk_kernel_batched_quant if quant else _chunk_kernel_batched,
+        scale=scale, block_size=bs, block_q=bq, max_blocks=MB,
+        h_kv=Hkv, groups=G, window=window)
+    in_specs = [
+        pl.BlockSpec((1, bq, H, D), lambda sl, iq, i, bt, m: (sl, iq, 0, 0)),
+        pl.BlockSpec((1, Hkv, bs, D),
+                     lambda sl, iq, i, bt, m: (bt[sl, i], 0, 0, 0)),
+        pl.BlockSpec((1, Hkv, bs, D),
+                     lambda sl, iq, i, bt, m: (bt[sl, i], 0, 0, 0)),
+    ]
+    operands = [block_tables.astype(jnp.int32), meta, q, k_pages, v_pages]
+    if quant:
+        r8 = _scale_tile_rows(Hkv, bs)
+        in_specs += [
+            pl.BlockSpec((1, r8, 128),
+                         lambda sl, iq, i, bt, m: (bt[sl, i], 0, 0)),
+            pl.BlockSpec((1, r8, 128),
+                         lambda sl, iq, i, bt, m: (bt[sl, i], 0, 0)),
+        ]
+        operands += [_scales_to_tiles(k_scales, NB, Hkv, bs),
+                     _scales_to_tiles(v_scales, NB, Hkv, bs)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(NC, nq, MB),
-        in_specs=[
-            pl.BlockSpec((1, bq, H, D), lambda sl, iq, i, bt, m: (sl, iq, 0, 0)),
-            pl.BlockSpec((1, Hkv, bs, D),
-                         lambda sl, iq, i, bt, m: (bt[sl, i], 0, 0, 0)),
-            pl.BlockSpec((1, Hkv, bs, D),
-                         lambda sl, iq, i, bt, m: (bt[sl, i], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, bq, H, D),
                                lambda sl, iq, i, bt, m: (sl, iq, 0, 0)),
         scratch_shapes=[
@@ -989,7 +1248,15 @@ def paged_chunk_attention_batched(q: jax.Array,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(block_tables.astype(jnp.int32), meta, q, k_pages, v_pages)
+    )(*operands)
+
+
+def _chunk_kernel_batched_quant(bt_ref, meta_ref, q_ref, k_ref, v_ref,
+                                ks_ref, vs_ref, o_ref, acc_sc, m_sc, l_sc,
+                                **kw):
+    _chunk_kernel_batched(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
+                          acc_sc, m_sc, l_sc, ks_ref=ks_ref, vs_ref=vs_ref,
+                          **kw)
 
 
 def paged_chunk_attention_batched_reference(q, k_pages, v_pages, block_tables,

@@ -276,3 +276,115 @@ class TestPagedDecodeSidebuf:
             q, k, v, bt, prefix, sk, sv, j, window=window)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-4)
+
+
+class TestInt8Pages:
+    """int8 KV pages: kernels with (int8 values, per-token-head scales) must
+    match the bf16/f32 reference run on the dequantized pages exactly (the
+    dequant is algebraically folded, not approximated — scale commutes
+    through the dots)."""
+
+    def _qpages(self, rng, NB, Hkv, bs, D):
+        from deepspeed_tpu.ops.pallas.paged_attention import kv_quantize_rows
+        k = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
+        v = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
+        kq, ks = kv_quantize_rows(k)
+        vq, vs = kv_quantize_rows(v)
+        kd = kq.astype(jnp.float32) * ks[..., None]
+        vd = vq.astype(jnp.float32) * vs[..., None]
+        return kq, ks, kd, vq, vs, vd
+
+    def test_decode_matches_dequant_reference(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention, paged_decode_attention_reference)
+        rng = np.random.RandomState(21)
+        S, H, Hkv, D, bs, MB = 3, 8, 2, 128, 128, 2
+        NB = S * MB + 1
+        kq, ks, kd, vq, vs, vd = self._qpages(rng, NB, Hkv, bs, D)
+        q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
+        bt = jnp.asarray(rng.permutation(NB - 1)[:S * MB].reshape(S, MB) + 1,
+                         jnp.int32)
+        cl = jnp.asarray([5, 130, 256], jnp.int32)
+        out = paged_decode_attention(q, kq, vq, bt, cl,
+                                     k_scales=ks, v_scales=vs)
+        ref = paged_decode_attention_reference(q, kd, vd, bt, cl)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5, rtol=3e-4)
+
+    def test_sidebuf_matches_dequant_reference(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_sidebuf,
+            paged_decode_attention_sidebuf_reference)
+        rng = np.random.RandomState(22)
+        S, H, Hkv, D, bs, MB, C = 3, 4, 2, 128, 128, 2, 8
+        NB = S * MB + 1
+        kq, ks, kd, vq, vs, vd = self._qpages(rng, NB, Hkv, bs, D)
+        q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
+        bt = jnp.asarray(rng.permutation(NB - 1)[:S * MB].reshape(S, MB) + 1,
+                         jnp.int32)
+        prefix = jnp.asarray([0, 70, 200], jnp.int32)
+        sk = jnp.asarray(rng.randn(S, C, Hkv, D), jnp.float32)
+        sv = jnp.asarray(rng.randn(S, C, Hkv, D), jnp.float32)
+        out = paged_decode_attention_sidebuf(q, kq, vq, bt, prefix, sk, sv, 5,
+                                             k_scales=ks, v_scales=vs)
+        ref = paged_decode_attention_sidebuf_reference(q, kd, vd, bt, prefix,
+                                                       sk, sv, 5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5, rtol=3e-4)
+
+    def test_step_quantizes_new_rows(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            kv_quantize_rows, paged_decode_attention_step,
+            paged_decode_attention_step_reference)
+        rng = np.random.RandomState(23)
+        S, H, Hkv, D, bs, MB = 2, 4, 2, 128, 128, 2
+        NB = S * MB + 1
+        kq, ks, kd, vq, vs, vd = self._qpages(rng, NB, Hkv, bs, D)
+        q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
+        kn = jnp.asarray(rng.randn(S, Hkv, D), jnp.float32)
+        vn = jnp.asarray(rng.randn(S, Hkv, D), jnp.float32)
+        bt = jnp.asarray(rng.permutation(NB - 1)[:S * MB].reshape(S, MB) + 1,
+                         jnp.int32)
+        cl = jnp.asarray([6, 140], jnp.int32)
+        out, kf, vf, ksf, vsf = paged_decode_attention_step(
+            q, kn, vn, kq, vq, bt, cl, k_scales=ks, v_scales=vs)
+        # the kernel attends the CURRENT token at full precision from
+        # registers (quantization happens at the page write, for future
+        # reads) — so the attention reference uses unquantized kn/vn
+        orf, _, _ = paged_decode_attention_step_reference(
+            q, kn, vn, kd, vd, bt, cl)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(orf),
+                                   atol=3e-5, rtol=3e-4)
+        # the returned pools hold the QUANTIZED new rows: they must
+        # dequantize to the reference pool built from dequantized new rows
+        knq, kns = kv_quantize_rows(kn)
+        vnq, vns = kv_quantize_rows(vn)
+        knd = knq.astype(jnp.float32) * kns[..., None]
+        vnd = vnq.astype(jnp.float32) * vns[..., None]
+        _, krf, vrf = paged_decode_attention_step_reference(
+            q, knd, vnd, kd, vd, bt, cl)
+        kfd = kf.astype(jnp.float32) * ksf[..., None]
+        vfd = vf.astype(jnp.float32) * vsf[..., None]
+        np.testing.assert_allclose(np.asarray(kfd), np.asarray(krf),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(vfd), np.asarray(vrf),
+                                   atol=1e-6)
+
+    def test_chunk_matches_dequant_reference(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            paged_chunk_attention_batched,
+            paged_chunk_attention_batched_reference)
+        rng = np.random.RandomState(24)
+        NC, Cs, H, Hkv, D, bs, MB = 2, 16, 4, 2, 128, 128, 2
+        NB = NC * MB + 1
+        kq, ks, kd, vq, vs, vd = self._qpages(rng, NB, Hkv, bs, D)
+        q = jnp.asarray(rng.randn(NC, Cs, H, D), jnp.float32)
+        bt = jnp.asarray(rng.permutation(NB - 1)[:NC * MB].reshape(NC, MB) + 1,
+                         jnp.int32)
+        q0s = jnp.asarray([0, 100], jnp.int32)
+        ctxs = jnp.asarray([16, 116], jnp.int32)
+        out = paged_chunk_attention_batched(q, kq, vq, bt, q0s, ctxs,
+                                            k_scales=ks, v_scales=vs)
+        ref = paged_chunk_attention_batched_reference(q, kd, vd, bt, q0s, ctxs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5, rtol=3e-4)
