@@ -441,7 +441,7 @@ def bench_decode(on_tpu: bool) -> dict:
     log(f"decode: measured HBM stream peak {hbm_peak:,.0f} GB/s")
 
     def measure(kv_heads, n_seqs, measure_prefill, weight_bits=None,
-                window=None):
+                window=None, kv_bits=None):
         """One engine at (kv_heads, n_seqs): optional prefill tput + the
         device-rate decode step. Decode timing: run the C1-step and C2-step
         fused programs (single dispatch + single ids fetch each, state reset
@@ -473,6 +473,8 @@ def bench_decode(on_tpu: bool) -> dict:
         }}
         if weight_bits:
             econf["quantization"] = {"weight_bits": weight_bits}
+        if kv_bits:
+            econf["kv_quant"] = {"enabled": True, "bits": kv_bits}
         engine = InferenceEngineV2(model=model, model_parameters=params,
                                    config=econf)
         prompts = [rng.randint(0, vocab, size=(prompt,)).astype(np.int32)
@@ -576,18 +578,22 @@ def bench_decode(on_tpu: bool) -> dict:
         import gc
         #   - gqa256_win128: sliding-window serving leg (Mistral/Qwen2
         #     analog): window mask + page-ring reuse in the paged kernels.
-        for key, kvh, nseq, wb, win in (
-                ("mha32_int8", heads, 32, 8, None),
-                ("mha64", heads, 64, None, None),
-                ("gqa64", 4, 64, None, None),
-                ("gqa128", 4, 128, None, None),
-                ("gqa256", 4, 256, None, None),
-                ("gqa256_int8", 4, 256, 8, None),
-                ("gqa256_win128", 4, 256, None, 128)):
+        for key, kvh, nseq, wb, win, kvb in (
+                ("mha32_int8", heads, 32, 8, None, None),
+                ("mha64", heads, 64, None, None, None),
+                ("gqa64", 4, 64, None, None, None),
+                ("gqa128", 4, 128, None, None, None),
+                ("gqa256", 4, 256, None, None, None),
+                ("gqa256_int8", 4, 256, 8, None, None),
+                # int8 KV pages (kv_quant tier on the blocked cache) and the
+                # fully-quantized serving point (int8 weights + int8 KV)
+                ("gqa256_kv8", 4, 256, None, None, 8),
+                ("gqa256_w8kv8", 4, 256, 8, None, 8),
+                ("gqa256_win128", 4, 256, None, 128, None)):
             gc.collect()
             try:
                 leg, _, _ = measure(kvh, nseq, False, weight_bits=wb,
-                                    window=win)
+                                    window=win, kv_bits=kvb)
                 out[key] = leg
                 log(f"decode: {key} {leg['tokens_per_sec']:,.0f} tok/s "
                     f"({leg['hbm_frac']:.0%} of peak)")
@@ -853,45 +859,60 @@ def bench_kernels(on_tpu: bool) -> dict:
         results[f"flash_{B}x{T}x{H}x{D}_{jnp.dtype(dtype).name}"] = \
             round(max(err_f, err_b), 5)
 
-    # paged decode + chunk attention over a paged KV pool
+    # paged decode + chunk attention over a combined paged KV pool
     NB, bs_, Hkv, D, S = 16, 8, 4, 64, 3
     H = 8
-    k_pages = mk(NB, Hkv, bs_, D, k=100)
-    v_pages = mk(NB, Hkv, bs_, D, k=101)
+    kv_pages = mk(NB, 2, Hkv, bs_, D, k=100)
     q = mk(S, H, D, k=102)
     bts = jnp.asarray(np.arange(S * 4).reshape(S, 4) % NB, jnp.int32)
     cls_ = jnp.asarray([9, 17, 30], jnp.int32)
-    o = paged_decode_attention(q, k_pages, v_pages, bts, cls_)
-    o_ref = paged_decode_attention_reference(q, k_pages, v_pages, bts, cls_)
+    o = paged_decode_attention(q, kv_pages, bts, cls_)
+    o_ref = paged_decode_attention_reference(q, kv_pages, bts, cls_)
     err = float(jnp.max(jnp.abs(o.astype(jnp.float32)
                                 - o_ref.astype(jnp.float32))))
     assert err < 2e-2, f"paged decode mismatch {err:.4f}"
     results["paged_decode"] = round(err, 5)
 
     # fused decode step (prior-context flash + inline current token + page
-    # write, pools aliased through) — the serving hot path's kernel
+    # write, pool aliased through) — the serving hot path's kernel
     from deepspeed_tpu.ops.pallas.paged_attention import (
         paged_decode_attention_step, paged_decode_attention_step_reference)
     kn = mk(S, Hkv, D, k=110)
     vn = mk(S, Hkv, D, k=111)
-    o, kf, vf = jax.jit(paged_decode_attention_step)(
-        q, kn, vn, k_pages, v_pages, bts, cls_)
-    o_ref, kr, vr = paged_decode_attention_step_reference(
-        q, kn, vn, k_pages, v_pages, bts, cls_)
+    o, kvf = jax.jit(paged_decode_attention_step)(
+        q, kn, vn, kv_pages, bts, cls_)
+    o_ref, kvr = paged_decode_attention_step_reference(
+        q, kn, vn, kv_pages, bts, cls_)
     err = float(jnp.max(jnp.abs(o.astype(jnp.float32)
                                 - o_ref.astype(jnp.float32))))
-    err_k = float(jnp.max(jnp.abs(kf.astype(jnp.float32)
-                                  - kr.astype(jnp.float32))))
+    err_k = float(jnp.max(jnp.abs(kvf.astype(jnp.float32)
+                                  - kvr.astype(jnp.float32))))
     assert err < 2e-2 and err_k == 0.0, \
         f"paged decode step mismatch out={err:.4f} pool={err_k:.4f}"
     results["paged_decode_step"] = round(err, 5)
 
+    # int8 pages: the quantized decode path vs the dequantized reference
+    from deepspeed_tpu.ops.pallas.paged_attention import kv_quantize_rows
+    kvq128 = mk(NB, 2, Hkv, 128, 128, k=120)
+    kvq_i8, kv_sc = kv_quantize_rows(kvq128)
+    kv_deq = kvq_i8.astype(jnp.float32) * kv_sc[..., None]
+    q128 = mk(S, H, 128, k=121)
+    bts1 = jnp.asarray(np.arange(S * 2).reshape(S, 2) % NB, jnp.int32)
+    cls1 = jnp.asarray([9, 140, 250], jnp.int32)
+    o = paged_decode_attention(q128, kvq_i8, bts1, cls1, kv_scales=kv_sc)
+    o_ref = paged_decode_attention_reference(
+        q128, kv_deq.astype(jnp.bfloat16), bts1, cls1)
+    err = float(jnp.max(jnp.abs(o.astype(jnp.float32)
+                                - o_ref.astype(jnp.float32))))
+    assert err < 3e-2, f"int8 paged decode mismatch {err:.4f}"
+    results["paged_decode_int8"] = round(err, 5)
+
     C = 16
     qc = mk(C, H, D, k=103)
     bt = jnp.asarray(np.arange(8) % NB, jnp.int32)
-    o = paged_chunk_attention(qc, k_pages, v_pages, bt,
+    o = paged_chunk_attention(qc, kv_pages, bt,
                               jnp.int32(8), jnp.int32(8 + C))
-    o_ref = paged_chunk_attention_reference(qc, k_pages, v_pages, bt,
+    o_ref = paged_chunk_attention_reference(qc, kv_pages, bt,
                                             jnp.int32(8), jnp.int32(8 + C))
     err = float(jnp.max(jnp.abs(o.astype(jnp.float32)
                                 - o_ref.astype(jnp.float32))))

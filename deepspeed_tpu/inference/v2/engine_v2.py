@@ -110,13 +110,11 @@ class InferenceEngineV2:
                 raise NotImplementedError(
                     "kv_quant with tensor_parallel > 1 is not wired")
             if (self.spec.head_dim % 128 != 0
-                    or (self.spec.num_kv_heads
-                        * cfg.kv_cache.block_size) % 128 != 0):
+                    or cfg.kv_cache.block_size % 128 != 0):
                 raise ValueError(
                     "kv_quant needs head_dim % 128 == 0 and "
-                    "kv_heads * block_size % 128 == 0 (got head_dim="
-                    f"{self.spec.head_dim}, kv_heads="
-                    f"{self.spec.num_kv_heads}, block_size="
+                    "block_size % 128 == 0 (got head_dim="
+                    f"{self.spec.head_dim}, block_size="
                     f"{cfg.kv_cache.block_size})")
         kv_cfg = KVCacheConfig(
             num_layers=self.spec.num_layers,
@@ -137,7 +135,7 @@ class InferenceEngineV2:
                         and self.spec.num_heads % tp == 0) else 1
         self._eff_tp = eff_tp
         fwd = build_ragged_forward(self.spec, mesh=self.topology.mesh, tp=eff_tp)
-        self._pass = jax.jit(fwd, donate_argnums=(1, 2))
+        self._pass = jax.jit(fwd, donate_argnums=(1,))
         self._pass_prefill = None  # built on the first pure-prefill pass
         self._rng = np.random.RandomState(cfg.seed)
         self._rng_key = jax.random.PRNGKey(cfg.seed)
@@ -342,16 +340,16 @@ class InferenceEngineV2:
                                          tp=tp if tp > 1 else 1,
                                          do_sample=do_sample, top_k=top_k,
                                          window_ring_ok=win_ok)
-            return jax.jit(fwd, donate_argnums=(1, 2))
+            return jax.jit(fwd, donate_argnums=(1,))
 
         fn = self._multistep.get_or_create(
             (n_steps, S, bool(do_sample), int(top_k)), _build)
         ids0 = self._sample_device(uids, do_sample, temperature, top_k)
         self._rng_key, sub = jax.random.split(self._rng_key)
-        out_ids, final_logits, new_k, new_v = fn(
-            self.weights, self.kv.k, self.kv.v, ids0, pos0, bt, ctx0, sub,
+        out_ids, final_logits, new_kv = fn(
+            self.weights, self.kv.kv, ids0, pos0, bt, ctx0, sub,
             jnp.float32(temperature))
-        self.kv.update(new_k, new_v)
+        self.kv.update(new_kv)
         for i, u in enumerate(uids):
             self.scheduler.advance(u, n_steps)
             self._last_ref[u] = (final_logits, i)
@@ -379,15 +377,15 @@ class InferenceEngineV2:
                 self._pass_prefill = jax.jit(
                     build_prefill_forward(self.spec, mesh=self.topology.mesh,
                                           tp=self._eff_tp),
-                    donate_argnums=(1, 2))
+                    donate_argnums=(1,))
             pass_fn = self._pass_prefill
             arrays = {k: arrays[k] for k in PREFILL_PASS_KEYS}
         else:
             pass_fn = self._pass
             arrays = {k: arrays[k] for k in PAGED_PASS_KEYS}
-        chunk_logits, decode_logits, new_k, new_v = pass_fn(
-            self.weights, self.kv.k, self.kv.v, arrays)
-        self.kv.update(new_k, new_v)
+        chunk_logits, decode_logits, new_kv = pass_fn(
+            self.weights, self.kv.kv, arrays)
+        self.kv.update(new_kv)
         finished = self.scheduler.complete_pass(batch)
         for uid in finished:
             if uid in batch.slot_uid:

@@ -70,37 +70,44 @@ class KVCacheConfig:
 
 
 class BlockedKVCache:
-    """Owns the page arrays and their sharding."""
+    """Owns the combined page array [L, NB, 2, Hkv, bs, D] (K = index 0,
+    V = index 1 — one page per sequence-chunk holds BOTH, because the
+    decode kernel is per-DMA-copy bound; see ops/pallas/paged_attention.py)
+    and its sharding. With ``config.quantized`` the pool is an (int8
+    values, f32 per-token-head scales [L, NB, 2, Hkv, bs]) tuple."""
 
     def __init__(self, config: KVCacheConfig, topology: Optional[MeshTopology] = None):
         self.config = config
         self.topology = topology
-        shape = (config.num_layers, config.num_blocks, config.num_kv_heads,
-                 config.block_size, config.head_dim)
+        shape = (config.num_layers, config.num_blocks, 2,
+                 config.num_kv_heads, config.block_size, config.head_dim)
         sharding = None
         if topology is not None:
             tp = topology.tp_world_size
-            spec = [None] * 5
+            spec = [None] * 6
             if tp > 1 and config.num_kv_heads % tp == 0:
-                spec[2] = TENSOR_AXIS
+                spec[3] = TENSOR_AXIS
             sharding = NamedSharding(topology.mesh, P(*spec))
         if config.quantized:
             if sharding is not None and topology.tp_world_size > 1:
                 raise NotImplementedError(
                     "int8 KV pages with tensor_parallel > 1 are not wired")
-            sshape = shape[:-1]                   # per-token-head scales
-            self.k = (_zeros(shape, jnp.int8, None),
-                      _zeros(sshape, jnp.float32, None))
-            self.v = (_zeros(shape, jnp.int8, None),
-                      _zeros(sshape, jnp.float32, None))
+            # scales live in the kernels' DMA tile layout AT REST
+            # ([L, NB, R8, 128] f32; paged_attention.kv_scale_tiles_shape) so
+            # no pass ever pays a pool-sized pad+reshape to convert them
+            from deepspeed_tpu.ops.pallas.paged_attention import (
+                kv_scale_tiles_shape)
+            sshape = (config.num_layers,) + kv_scale_tiles_shape(
+                config.num_blocks, config.num_kv_heads, config.block_size)
+            self.kv = (_zeros(shape, jnp.int8, None),
+                       _zeros(sshape, jnp.float32, None))
         else:
-            self.k = _zeros(shape, config.dtype, sharding)
-            self.v = _zeros(shape, config.dtype, sharding)
+            self.kv = _zeros(shape, config.dtype, sharding)
         self.sharding = sharding
 
-    def update(self, k: jax.Array, v: jax.Array) -> None:
+    def update(self, kv) -> None:
         """Adopt the pages returned by a jitted pass (donated in, aliased out)."""
-        self.k, self.v = k, v
+        self.kv = kv
 
     def flat_write_index(self, block_id: np.ndarray, slot: np.ndarray) -> np.ndarray:
         """Host-side: flat scatter destination over the fused page dim; padding

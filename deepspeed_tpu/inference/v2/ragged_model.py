@@ -35,14 +35,18 @@ import numpy as np
 
 from deepspeed_tpu.ops.pallas.flash_attention import flash_attention_packed
 from deepspeed_tpu.ops.pallas.paged_attention import (
-    kv_quantize_rows, paged_chunk_attention_batched, paged_decode_attention,
-    paged_decode_attention_sidebuf, paged_decode_attention_step)
+    _scale_tile_rows, kv_quantize_rows, paged_chunk_attention_batched,
+    paged_decode_attention, paged_decode_attention_sidebuf,
+    paged_decode_attention_step)
 
 
 def _kv_unpack(kp):
-    """KV pool argument -> (pages, scales-or-None). int8 KV pages travel as
-    a (values int8, per-token-head f32 scales) tuple through every jit
-    boundary so the engine's (k, v) plumbing is dtype-agnostic."""
+    """KV pool argument -> (pages, scales-or-None). The combined pool
+    [L, NB, 2, Hkv, bs, D] holds K (index 0) and V (index 1) in ONE page —
+    the decode kernel is per-DMA-copy bound, so one value copy per page
+    (see ops/pallas/paged_attention.py module docstring). int8 pools travel
+    as a (values int8, per-token-head f32 scale TILES [L, NB, R8, 128]) tuple
+    through every jit boundary so the plumbing is dtype-agnostic."""
     if isinstance(kp, tuple):
         return kp
     return kp, None
@@ -365,7 +369,19 @@ def _moe_ffn(x: jax.Array, w: Dict, top_k: int, dtype) -> jax.Array:
     xs = x[src]                                                        # [T*K, hid]
     group_sizes = jnp.bincount(expert_ids, length=E).astype(jnp.int32)
 
+    row_e = expert_ids[order]
+
     def gg(lhs, rhs):
+        if isinstance(rhs, dict) and "w8" in rhs:
+            # int8 expert stacks (ADVICE r4: the experts are the dominant
+            # streamed bytes of an MoE serving step — leaving them bf16 made
+            # quantization.weight_bits a silent no-op on mixtral). The
+            # per-(expert, output-column) scale applies per ROW of the
+            # grouped output, indexed by the row's expert.
+            raw = jax.lax.ragged_dot(lhs, rhs["w8"].astype(lhs.dtype),
+                                     group_sizes,
+                                     preferred_element_type=jnp.float32)
+            return (raw * rhs["scale"][row_e, 0, :]).astype(lhs.dtype)
         return jax.lax.ragged_dot(lhs, rhs.astype(lhs.dtype), group_sizes)
 
     if "w_gate" in w:
@@ -429,6 +445,15 @@ def quantize_weights_int8(weights: Dict) -> Dict:
         for key in _QUANT_MLP_KEYS:
             if key in mlp and not isinstance(mlp[key], dict):
                 mlp[key] = q(mlp[key])
+    moe = layers.get("moe")
+    if isinstance(moe, dict):
+        # expert stacks [L, E, K, N] — the dominant streamed bytes of an MoE
+        # serving step (ADVICE r4: silently skipping them made weight_bits=8
+        # a near-no-op on mixtral); scale per (layer, expert, out-column).
+        # The router stays fp32 (tiny, feeds top_k).
+        for key in _QUANT_MLP_KEYS:
+            if key in moe and not isinstance(moe[key], dict):
+                moe[key] = q(moe[key])
     if "lm_head" in weights and not isinstance(weights["lm_head"], dict):
         weights["lm_head"] = q(weights["lm_head"])
     return weights
@@ -516,47 +541,84 @@ def _unembed(spec: "RaggedModelSpec", weights, xs):
     return logits
 
 
-def _kv_page_write(kp, vp, k, v, dest_tok, Hkv, bs):
-    """Scatter of new K/V rows into the FLAT head-major paged cache
-    [L*NB*Hkv*bs, D]; out-of-range dest rows (padding sentinels) drop.
+def _kv_write_rows(dest_tok, Hkv, bs):
+    """Flat K and V row destinations in the combined head-major pool
+    [L*NB*2*Hkv*bs, D] for LAYER-GLOBAL token indices ``dest_tok``
+    (global_page * bs + slot): K row ((g*2 + 0)*Hkv + h)*bs + slot, V row
+    ((g*2 + 1)*Hkv + h)*bs + slot. Sentinel dest (>= pool tokens) maps past
+    the pool and drops."""
+    page_g = dest_tok // bs
+    h = jnp.arange(Hkv)[None, :]
+    slot = (dest_tok % bs)[:, None]
+    k_rows = ((page_g[:, None] * 2 + 0) * Hkv + h) * bs + slot
+    v_rows = ((page_g[:, None] * 2 + 1) * Hkv + h) * bs + slot
+    return jnp.concatenate([k_rows.reshape(-1), v_rows.reshape(-1)])
 
-    ``dest_tok`` are LAYER-GLOBAL token indices (global_page * bs + slot);
-    each token lands as Hkv rows at (global_page * Hkv + h) * bs + slot.
+
+def _kv_page_write(kvp, k, v, dest_tok, Hkv, bs):
+    """Scatter of new K/V rows into the FLAT combined head-major paged cache
+    [L*NB*2*Hkv*bs, D]; out-of-range dest rows (padding sentinels) drop.
 
     The flat-rows-with-layer-offset layout is the load-bearing design choice:
-    the pools ride the layer scan as CARRY and this scatter is their only
+    the pool rides the layer scan as CARRY and this scatter is its only
     consumer, so XLA updates the (hundreds of MB) pool in place. The earlier
     per-layer layout — pools as scan xs/ys with a per-layer dynamic-slice +
     scatter + re-stack — materialised two full pool copies per pass and was
     the single largest cost in the decode step (measured ~5 ms of a 16 ms
     step at 0.55B/32 seqs on v5e; see docs/ROUND3_NOTES.md)."""
     T = dest_tok.shape[0]
-    page_g = dest_tok // bs
-    rows = ((page_g[:, None] * Hkv + jnp.arange(Hkv)[None, :]) * bs
-            + (dest_tok % bs)[:, None]).reshape(-1)            # [T*Hkv]
-    kf = kp.at[rows].set(k.reshape(T * Hkv, -1).astype(kp.dtype), mode="drop")
-    vf = vp.at[rows].set(v.reshape(T * Hkv, -1).astype(vp.dtype), mode="drop")
-    return kf, vf
+    rows = _kv_write_rows(dest_tok, Hkv, bs)
+    new = jnp.concatenate([k.reshape(T * Hkv, -1), v.reshape(T * Hkv, -1)])
+    return kvp.at[rows].set(new.astype(kvp.dtype), mode="drop")
 
 
-def _kv_page_write_quant(kp, vp, ks, vs, k, v, dest_tok, Hkv, bs):
+def _scale_dest(rows, Hkv, bs):
+    """Value-row index [*, in L*NB*2*Hkv*bs] -> flat index into the TILED
+    scale pool [L*NB*R8*128]: page r8*128-strided, in-page offset = the flat
+    scale index (kv*Hkv*bs + h*bs + t). OOB value rows map OOB."""
+    hb2 = 2 * Hkv * bs
+    r8 = _scale_tile_rows(Hkv, bs)
+    return (rows // hb2) * (r8 * 128) + rows % hb2
+
+
+def _kv_page_write_quant(kvp, sc, k, v, dest_tok, Hkv, bs):
     """int8 variant of :func:`_kv_page_write`: quantize the new rows on
-    append (per token-head) and scatter values + scales with the same row
-    index (the flat scale pool [L*NB*Hkv*bs] shares the row addressing)."""
+    append (per token-head) and scatter values + scales. ``sc`` is the FLAT
+    view of the tiled at-rest scale pool ([L*NB*R8*128] f32)."""
     T = dest_tok.shape[0]
-    page_g = dest_tok // bs
-    rows = ((page_g[:, None] * Hkv + jnp.arange(Hkv)[None, :]) * bs
-            + (dest_tok % bs)[:, None]).reshape(-1)            # [T*Hkv]
+    rows = _kv_write_rows(dest_tok, Hkv, bs)
     kq, ksc = kv_quantize_rows(k)                              # [T,Hkv,D]/[T,Hkv]
     vq, vsc = kv_quantize_rows(v)
-    kf = kp.at[rows].set(kq.reshape(T * Hkv, -1), mode="drop")
-    vf = vp.at[rows].set(vq.reshape(T * Hkv, -1), mode="drop")
-    ksf = ks.at[rows].set(ksc.reshape(-1), mode="drop")
-    vsf = vs.at[rows].set(vsc.reshape(-1), mode="drop")
-    return kf, vf, ksf, vsf
+    new = jnp.concatenate([kq.reshape(T * Hkv, -1), vq.reshape(T * Hkv, -1)])
+    news = jnp.concatenate([ksc.reshape(-1), vsc.reshape(-1)])
+    kvf = kvp.at[rows].set(new, mode="drop")
+    scf = sc.at[_scale_dest(rows, Hkv, bs)].set(news, mode="drop")
+    return kvf, scf
 
 
-def _kv_page_write_pages(kp, vp, k, v, l, page_ids, page_rows, page_fill,
+def _page_plan_gather(k, v, page_rows, page_fill, bs):
+    """Gather the page plan's token windows: -> K/V [PW, Hkv, bs, D]."""
+    CT = k.shape[0]
+    j = jnp.arange(bs, dtype=jnp.int32)
+    rows = jnp.minimum(page_rows[:, None] + j[None, :], CT - 1)     # [PW, bs]
+    valid = j[None, :] < page_fill[:, None]                         # [PW, bs]
+    kg = jnp.where(valid[..., None, None], k[rows], 0)              # [PW,bs,Hkv,D]
+    vg = jnp.where(valid[..., None, None], v[rows], 0)
+    return jnp.moveaxis(kg, 2, 1), jnp.moveaxis(vg, 2, 1)
+
+
+def _page_plan_tgt(page_ids, l, NB, L, Hkv):
+    """Combined-pool [L*NB*2*Hkv, bs, D] head-row targets for a page plan:
+    K rows (g*2+0)*Hkv + h, V rows (g*2+1)*Hkv + h. Sentinel pages (id >=
+    NB) go out of range GLOBALLY, not into the next layer's pages."""
+    page_g = jnp.where(page_ids < NB, l * NB + page_ids, L * NB)
+    h = jnp.arange(Hkv)[None, :]
+    tgt_k = ((page_g[:, None] * 2 + 0) * Hkv + h).reshape(-1)
+    tgt_v = ((page_g[:, None] * 2 + 1) * Hkv + h).reshape(-1)
+    return jnp.concatenate([tgt_k, tgt_v])
+
+
+def _kv_page_write_pages(kvp, k, v, l, page_ids, page_rows, page_fill,
                          NB, bs, L, Hkv):
     """Page-granular pool update for prefill-from-zero passes.
 
@@ -569,51 +631,47 @@ def _kv_page_write_pages(kp, vp, k, v, l, page_ids, page_rows, page_fill,
     ctx_len) so overwriting a freed page's stale tail is safe."""
     PW = page_ids.shape[0]
     D = k.shape[-1]
-    CT = k.shape[0]
-    j = jnp.arange(bs, dtype=jnp.int32)
-    rows = jnp.minimum(page_rows[:, None] + j[None, :], CT - 1)     # [PW, bs]
-    valid = j[None, :] < page_fill[:, None]                         # [PW, bs]
-    kg = jnp.where(valid[..., None, None], k[rows], 0)              # [PW,bs,Hkv,D]
-    vg = jnp.where(valid[..., None, None], v[rows], 0)
-    kg = jnp.moveaxis(kg, 2, 1)                                     # [PW,Hkv,bs,D]
-    vg = jnp.moveaxis(vg, 2, 1)
-    kp3 = kp.reshape(L * NB * Hkv, bs, D)
-    vp3 = vp.reshape(L * NB * Hkv, bs, D)
-    # sentinel pages (id >= NB) must go out of range GLOBALLY, not into the
-    # next layer's pages
-    page_g = jnp.where(page_ids < NB, l * NB + page_ids, L * NB)
-    tgt = (page_g[:, None] * Hkv + jnp.arange(Hkv)[None, :]).reshape(-1)
-    kp3 = kp3.at[tgt].set(kg.reshape(PW * Hkv, bs, D).astype(kp.dtype),
-                          mode="drop")
-    vp3 = vp3.at[tgt].set(vg.reshape(PW * Hkv, bs, D).astype(vp.dtype),
-                          mode="drop")
-    return kp3.reshape(-1, D), vp3.reshape(-1, D)
+    kg, vg = _page_plan_gather(k, v, page_rows, page_fill, bs)
+    kv3 = kvp.reshape(L * NB * 2 * Hkv, bs, D)
+    tgt = _page_plan_tgt(page_ids, l, NB, L, Hkv)
+    new = jnp.concatenate([kg.reshape(PW * Hkv, bs, D),
+                           vg.reshape(PW * Hkv, bs, D)])
+    kv3 = kv3.at[tgt].set(new.astype(kvp.dtype), mode="drop")
+    return kv3.reshape(-1, D)
 
 
-def _kv_page_write_pages_quant(kp, vp, ks, vs, k, v, l, page_ids, page_rows,
+def _scale_page_tiles(ksc, vsc, Hkv, bs):
+    """Per-page K/V scales [PW, Hkv, bs] x2 -> at-rest tiles [PW, R8, 128]
+    (flat order kv*Hkv*bs + h*bs + t, zero-padded to the tile)."""
+    PW = ksc.shape[0]
+    r8 = _scale_tile_rows(Hkv, bs)
+    flat = jnp.concatenate([ksc.reshape(PW, Hkv * bs),
+                            vsc.reshape(PW, Hkv * bs)], axis=1)
+    pad = r8 * 128 - 2 * Hkv * bs
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    return flat.reshape(PW, r8, 128)
+
+
+def _kv_page_write_pages_quant(kvp, sc, k, v, l, page_ids, page_rows,
                                page_fill, NB, bs, L, Hkv):
     """int8 variant of :func:`_kv_page_write_pages`: the gathered page
-    windows quantize per token-head row; scale pools [L*NB*Hkv, bs] get the
-    same page-granular scatter at the same target index."""
+    windows quantize per token-head row; the tiled scale pool
+    ([L*NB, R8, 128] view) gets one whole-tile scatter per page."""
     PW = page_ids.shape[0]
     D = k.shape[-1]
-    CT = k.shape[0]
-    j = jnp.arange(bs, dtype=jnp.int32)
-    rows = jnp.minimum(page_rows[:, None] + j[None, :], CT - 1)     # [PW, bs]
-    valid = j[None, :] < page_fill[:, None]                         # [PW, bs]
-    kg = jnp.where(valid[..., None, None], k[rows], 0)              # [PW,bs,Hkv,D]
-    vg = jnp.where(valid[..., None, None], v[rows], 0)
-    kgq, kgs = kv_quantize_rows(jnp.moveaxis(kg, 2, 1))             # [PW,Hkv,bs,D]
-    vgq, vgs = kv_quantize_rows(jnp.moveaxis(vg, 2, 1))
-    kp3 = kp.reshape(L * NB * Hkv, bs, D)
-    vp3 = vp.reshape(L * NB * Hkv, bs, D)
+    kg, vg = _page_plan_gather(k, v, page_rows, page_fill, bs)
+    kgq, kgs = kv_quantize_rows(kg)                                # [PW,Hkv,bs,D]
+    vgq, vgs = kv_quantize_rows(vg)
+    kv3 = kvp.reshape(L * NB * 2 * Hkv, bs, D)
+    tgt = _page_plan_tgt(page_ids, l, NB, L, Hkv)
+    new = jnp.concatenate([kgq.reshape(PW * Hkv, bs, D),
+                           vgq.reshape(PW * Hkv, bs, D)])
+    kv3 = kv3.at[tgt].set(new, mode="drop")
     page_g = jnp.where(page_ids < NB, l * NB + page_ids, L * NB)
-    tgt = (page_g[:, None] * Hkv + jnp.arange(Hkv)[None, :]).reshape(-1)
-    kp3 = kp3.at[tgt].set(kgq.reshape(PW * Hkv, bs, D), mode="drop")
-    vp3 = vp3.at[tgt].set(vgq.reshape(PW * Hkv, bs, D), mode="drop")
-    ksf = ks.at[tgt].set(kgs.reshape(PW * Hkv, bs), mode="drop")
-    vsf = vs.at[tgt].set(vgs.reshape(PW * Hkv, bs), mode="drop")
-    return kp3.reshape(-1, D), vp3.reshape(-1, D), ksf, vsf
+    sc = sc.at[page_g].set(_scale_page_tiles(kgs, vgs, Hkv, bs),
+                           mode="drop")
+    return kv3.reshape(-1, D), sc
 
 
 def _layer_dest(dest, l, NB, bs, L):
@@ -647,12 +705,13 @@ def _tp_wrap(fn, mesh, in_specs, out_specs):
 def build_ragged_forward(spec: RaggedModelSpec,
                          mesh=None,
                          tp: int = 1) -> Callable:
-    """Returns ``fwd(weights, k_pages, v_pages, batch) ->
-    (chunk_logits [NC, V], decode_logits [S, V], new_k, new_v)`` where
+    """Returns ``fwd(weights, kv_pages, batch) ->
+    (chunk_logits [NC, V], decode_logits [S, V], new_kv)`` where
     ``chunk_logits[j]`` holds the logits after slot j's last token.
 
-    k/v_pages: [L, NB, Hkv, bs, D] (head-major pages — see
-    ragged/kv_cache.py). ``batch`` is RaggedBatch.device_arrays().
+    kv_pages: [L, NB, 2, Hkv, bs, D] combined head-major pages (see
+    ragged/kv_cache.py), or an (int8 values, f32 scales) tuple for the
+    kv_quant tier. ``batch`` is RaggedBatch.device_arrays().
     When ``tp > 1`` the paged attention kernels run under shard_map on the
     'tensor' axis (heads sharded); everything else partitions via XLA SPMD.
     """
@@ -665,7 +724,7 @@ def build_ragged_forward(spec: RaggedModelSpec,
     chunk_win = functools.partial(paged_chunk_attention_batched,
                                   window=spec.window)
 
-    def _decode_attn(q, k_l, v_l, bts, cls_, **sc_kw):
+    def _decode_attn(q, kv_l, bts, cls_, **sc_kw):
         if tp > 1:
             assert not sc_kw, "int8 KV pages + TP not wired"
             from jax.sharding import PartitionSpec as P
@@ -673,13 +732,13 @@ def build_ragged_forward(spec: RaggedModelSpec,
             fn = _tp_wrap(
                 decode_win, mesh,
                 in_specs=(P(None, TENSOR_AXIS, None),
-                          P(None, TENSOR_AXIS, None, None),
-                          P(None, TENSOR_AXIS, None, None), P(None, None), P(None)),
+                          P(None, None, TENSOR_AXIS, None, None),
+                          P(None, None), P(None)),
                 out_specs=P(None, TENSOR_AXIS, None))
-            return fn(q, k_l, v_l, bts, cls_)
-        return decode_win(q, k_l, v_l, bts, cls_, **sc_kw)
+            return fn(q, kv_l, bts, cls_)
+        return decode_win(q, kv_l, bts, cls_, **sc_kw)
 
-    def _chunk_attn(q, k_l, v_l, bts, q0s, ctxs, **sc_kw):
+    def _chunk_attn(q, kv_l, bts, q0s, ctxs, **sc_kw):
         if tp > 1:
             assert not sc_kw, "int8 KV pages + TP not wired"
             from jax.sharding import PartitionSpec as P
@@ -687,70 +746,62 @@ def build_ragged_forward(spec: RaggedModelSpec,
             fn = _tp_wrap(
                 chunk_win, mesh,
                 in_specs=(P(None, None, TENSOR_AXIS, None),
-                          P(None, TENSOR_AXIS, None, None),
-                          P(None, TENSOR_AXIS, None, None),
+                          P(None, None, TENSOR_AXIS, None, None),
                           P(None, None), P(None), P(None)),
                 out_specs=P(None, None, TENSOR_AXIS, None))
-            return fn(q, k_l, v_l, bts, q0s, ctxs)
-        return chunk_win(q, k_l, v_l, bts, q0s, ctxs, **sc_kw)
+            return fn(q, kv_l, bts, q0s, ctxs)
+        return chunk_win(q, kv_l, bts, q0s, ctxs, **sc_kw)
 
-    def fwd(weights, k_pages, v_pages, b):
-        k_pages, k_sc = _kv_unpack(k_pages)
-        v_pages, v_sc = _kv_unpack(v_pages)
-        kvq = k_sc is not None
+    def fwd(weights, kv_pages, b):
+        kv_pages, kv_sc = _kv_unpack(kv_pages)
+        kvq = kv_sc is not None
         NC = b["chunk_ntok"].shape[0]
         CT = b["chunk_tokens"].shape[0]
         Cs = CT // NC
         S = b["decode_tokens"].shape[0]
-        L, NB, bs = k_pages.shape[0], k_pages.shape[1], k_pages.shape[3]
-        kp0 = k_pages.reshape(L * NB * Hkv * bs, D)  # flat rows (bitcast);
-        vp0 = v_pages.reshape(L * NB * Hkv * bs, D)  # see _kv_page_write
-        ks0 = k_sc.reshape(L * NB * Hkv * bs) if kvq else None
-        vs0 = v_sc.reshape(L * NB * Hkv * bs) if kvq else None
+        L, NB, bs = kv_pages.shape[0], kv_pages.shape[1], kv_pages.shape[4]
+        kvp0 = kv_pages.reshape(L * NB * 2 * Hkv * bs, D)  # flat (bitcast);
+        r8 = _scale_tile_rows(Hkv, bs) if kvq else 0
+        sc0 = kv_sc.reshape(L * NB * r8 * 128) if kvq else None
         tokens = jnp.concatenate([b["chunk_tokens"], b["decode_tokens"]])
         positions = jnp.concatenate([b["chunk_positions"], b["decode_positions"]])
 
         x = _embed_in(spec, weights, tokens, positions)
 
         def layer_fn(carry, scanned):
-            x, kp, vp, ks, vs = carry
+            x, kvp, sc = carry
             w, l = scanned
 
             def attend(q, k, v):
                 dest = _layer_dest(b["kv_dest"], l, NB, bs, L)
                 if kvq:
-                    kp_, vp_, ks_, vs_ = _kv_page_write_quant(
-                        kp, vp, ks, vs, k, v, dest, Hkv, bs)
+                    kvp_, sc_ = _kv_page_write_quant(kvp, sc, k, v, dest,
+                                                     Hkv, bs)
                     sc_kw = dict(
-                        k_scales=ks_.reshape(L * NB, Hkv, bs),
-                        v_scales=vs_.reshape(L * NB, Hkv, bs))
+                        kv_scales=sc_.reshape(L * NB, r8, 128))
                 else:
-                    kp_, vp_ = _kv_page_write(kp, vp, k, v, dest, Hkv, bs)
-                    ks_, vs_, sc_kw = ks, vs, {}
-                k_l = kp_.reshape(L * NB, Hkv, bs, D)
-                v_l = vp_.reshape(L * NB, Hkv, bs, D)
-                out_c = _chunk_attn(q[:CT].reshape(NC, Cs, H, D), k_l, v_l,
+                    kvp_ = _kv_page_write(kvp, k, v, dest, Hkv, bs)
+                    sc_, sc_kw = sc, {}
+                kv_l = kvp_.reshape(L * NB, 2, Hkv, bs, D)
+                out_c = _chunk_attn(q[:CT].reshape(NC, Cs, H, D), kv_l,
                                     b["chunk_block_tables"] + l * NB,
                                     b["chunk_q0"], b["chunk_ctx_lens"],
                                     **sc_kw)
-                out_d = _decode_attn(q[CT:], k_l, v_l,
+                out_d = _decode_attn(q[CT:], kv_l,
                                      b["decode_block_tables"] + l * NB,
                                      b["decode_ctx_lens"], **sc_kw)
                 return (jnp.concatenate([out_c.reshape(CT, H, D), out_d],
-                                        axis=0), kp_, vp_, ks_, vs_)
+                                        axis=0), kvp_, sc_)
 
-            x, (kp, vp, ks, vs) = _transformer_layer(spec, w, x, positions,
-                                                     attend)
-            return (x, kp, vp, ks, vs), None
+            x, (kvp, sc) = _transformer_layer(spec, w, x, positions, attend)
+            return (x, kvp, sc), None
 
-        (x, kp, vp, ks, vs), _ = jax.lax.scan(
-            layer_fn, (x, kp0, vp0, ks0, vs0),
+        (x, kvp, sc), _ = jax.lax.scan(
+            layer_fn, (x, kvp0, sc0),
             (weights["layers"], jnp.arange(L, dtype=jnp.int32)))
-        new_k = kp.reshape(L, NB, Hkv, bs, D)
-        new_v = vp.reshape(L, NB, Hkv, bs, D)
+        new_kv = kvp.reshape(L, NB, 2, Hkv, bs, D)
         if kvq:
-            new_k = (new_k, ks.reshape(L, NB, Hkv, bs))
-            new_v = (new_v, vs.reshape(L, NB, Hkv, bs))
+            new_kv = (new_kv, sc.reshape(L, NB, r8, 128))
 
         x = _norm(x, weights["final_norm"], spec.norm, spec.eps, dtype,
                   spec.norm_plus_one)
@@ -760,7 +811,7 @@ def build_ragged_forward(spec: RaggedModelSpec,
                      + jnp.maximum(b["chunk_ntok"] - 1, 0))    # [NC]
         xs = jnp.concatenate([x[last_rows], x[CT:]], axis=0)   # [NC + S, hid]
         logits = _unembed(spec, weights, xs)
-        return logits[:NC], logits[NC:], new_k, new_v
+        return logits[:NC], logits[NC:], new_kv
 
     return fwd
 
@@ -799,19 +850,17 @@ def build_prefill_forward(spec: RaggedModelSpec,
             return fn(q, k, v, seg)
         return packed_win(q, k, v, seg)
 
-    def fwd(weights, k_pages, v_pages, b):
+    def fwd(weights, kv_pages, b):
         NC = b["chunk_ntok"].shape[0]
         CT = b["chunk_tokens"].shape[0]
         Cs = CT // NC
         S = b["decode_tokens"].shape[0]
-        k_pages, k_sc = _kv_unpack(k_pages)
-        v_pages, v_sc = _kv_unpack(v_pages)
-        kvq = k_sc is not None
-        L, NB, bs = k_pages.shape[0], k_pages.shape[1], k_pages.shape[3]
-        kp0 = k_pages.reshape(L * NB * Hkv * bs, D)
-        vp0 = v_pages.reshape(L * NB * Hkv * bs, D)
-        ks0 = k_sc.reshape(L * NB * Hkv, bs) if kvq else None
-        vs0 = v_sc.reshape(L * NB * Hkv, bs) if kvq else None
+        kv_pages, kv_sc = _kv_unpack(kv_pages)
+        kvq = kv_sc is not None
+        L, NB, bs = kv_pages.shape[0], kv_pages.shape[1], kv_pages.shape[4]
+        kvp0 = kv_pages.reshape(L * NB * 2 * Hkv * bs, D)
+        r8 = _scale_tile_rows(Hkv, bs) if kvq else 0
+        sc0 = kv_sc.reshape(L * NB, r8, 128) if kvq else None
         tokens = b["chunk_tokens"]
         positions = b["chunk_positions"]
         seg = b["row_seg"]
@@ -819,7 +868,7 @@ def build_prefill_forward(spec: RaggedModelSpec,
         x = _embed_in(spec, weights, tokens, positions)
 
         def layer_fn(carry, scanned):
-            x, kp, vp, ks, vs = carry
+            x, kvp, sc = carry
             w, l = scanned
 
             def attend(q, k, v):
@@ -827,28 +876,25 @@ def build_prefill_forward(spec: RaggedModelSpec,
                 # only the page write quantizes
                 out = _packed_attn(q, k, v, seg)
                 if kvq:
-                    kp_, vp_, ks_, vs_ = _kv_page_write_pages_quant(
-                        kp, vp, ks, vs, k, v, l, b["page_ids"],
+                    kvp_, sc_ = _kv_page_write_pages_quant(
+                        kvp, sc, k, v, l, b["page_ids"],
                         b["page_rows"], b["page_fill"], NB, bs, L, Hkv)
                 else:
-                    kp_, vp_ = _kv_page_write_pages(
-                        kp, vp, k, v, l, b["page_ids"], b["page_rows"],
+                    kvp_ = _kv_page_write_pages(
+                        kvp, k, v, l, b["page_ids"], b["page_rows"],
                         b["page_fill"], NB, bs, L, Hkv)
-                    ks_, vs_ = ks, vs
-                return out, kp_, vp_, ks_, vs_
+                    sc_ = sc
+                return out, kvp_, sc_
 
-            x, (kp, vp, ks, vs) = _transformer_layer(spec, w, x, positions,
-                                                     attend)
-            return (x, kp, vp, ks, vs), None
+            x, (kvp, sc) = _transformer_layer(spec, w, x, positions, attend)
+            return (x, kvp, sc), None
 
-        (x, kp, vp, ks, vs), _ = jax.lax.scan(
-            layer_fn, (x, kp0, vp0, ks0, vs0),
+        (x, kvp, sc), _ = jax.lax.scan(
+            layer_fn, (x, kvp0, sc0),
             (weights["layers"], jnp.arange(L, dtype=jnp.int32)))
-        new_k = kp.reshape(L, NB, Hkv, bs, D)
-        new_v = vp.reshape(L, NB, Hkv, bs, D)
+        new_kv = kvp.reshape(L, NB, 2, Hkv, bs, D)
         if kvq:
-            new_k = (new_k, ks.reshape(L, NB, Hkv, bs))
-            new_v = (new_v, vs.reshape(L, NB, Hkv, bs))
+            new_kv = (new_kv, sc.reshape(L, NB, r8, 128))
 
         x = _norm(x, weights["final_norm"], spec.norm, spec.eps, dtype,
                   spec.norm_plus_one)
@@ -856,7 +902,7 @@ def build_prefill_forward(spec: RaggedModelSpec,
                      + jnp.maximum(b["chunk_ntok"] - 1, 0))    # [NC]
         logits = _unembed(spec, weights, x[last_rows])
         decode_logits = jnp.zeros((S, logits.shape[1]), logits.dtype)
-        return logits, decode_logits, new_k, new_v
+        return logits, decode_logits, new_kv
 
     return fwd
 
@@ -903,18 +949,18 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
         Cb += 1
     scale = 1.0 / (D ** 0.5)
 
-    def fwd(weights, k_pages, v_pages, ids0, positions0, block_tables, ctx0,
+    def fwd(weights, kv_pages, ids0, positions0, block_tables, ctx0,
             key, temperature=1.0):
-        k_pages, k_sc = _kv_unpack(k_pages)
-        v_pages, v_sc = _kv_unpack(v_pages)
-        kvq = k_sc is not None
+        kv_pages, kv_sc = _kv_unpack(kv_pages)
+        kvq = kv_sc is not None
         S = ids0.shape[0]
-        L, NB, bs = k_pages.shape[0], k_pages.shape[1], k_pages.shape[3]
+        L, NB, bs = kv_pages.shape[0], kv_pages.shape[1], kv_pages.shape[4]
         MB = block_tables.shape[1]
-        kp4 = k_pages.reshape(L * NB, Hkv, bs, D)
-        vp4 = v_pages.reshape(L * NB, Hkv, bs, D)
-        ks4 = k_sc.reshape(L * NB, Hkv, bs) if kvq else None
-        vs4 = v_sc.reshape(L * NB, Hkv, bs) if kvq else None
+        kvp5 = kv_pages.reshape(L * NB, 2, Hkv, bs, D)
+        # scales are stored in kernel tile layout AT REST — the view below
+        # is a bitcast, so the frozen-pool scans never pay a conversion
+        r8 = _scale_tile_rows(Hkv, bs) if kvq else 0
+        sc4 = kv_sc.reshape(L * NB, r8, 128) if kvq else None
         # engine contract: ctx0 counts tokens INCLUDING the first current
         # token; the pages hold only the frozen prefix [0, ctx0 - 1) — the
         # current token (and everything after) lives in the side buffers
@@ -939,18 +985,19 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
                     sv_new = jax.lax.dynamic_update_slice(
                         sv_all, v[None, :, None].astype(sv_all.dtype),
                         (l, 0, j, 0, 0))
-                    sk = jax.lax.dynamic_slice(
-                        sk_new, (l, 0, 0, 0, 0), (1, S, Cb, Hkv, D))[0]
-                    sv = jax.lax.dynamic_slice(
-                        sv_new, (l, 0, 0, 0, 0), (1, S, Cb, Hkv, D))[0]
                     sc_kw = {}
                     if kvq:
                         # the frozen prefix streams int8 (the dominant read);
                         # the in-chunk side slab stays full precision
-                        sc_kw = dict(k_scales=ks4, v_scales=vs4)
+                        sc_kw = dict(kv_scales=sc4)
+                    # the WHOLE [L, S, Cb, Hkv, D] stack goes to the kernel,
+                    # which BlockSpec-indexes layer l — a dynamic_slice here
+                    # would materialise the layer's slab per call (measured
+                    # ~150 us/layer of pure copy traffic)
                     out = paged_decode_attention_sidebuf(
-                        q, kp4, vp4, block_tables + l * NB, prefix,
-                        sk, sv, j, window=spec.window, **sc_kw)
+                        q, kvp5, block_tables + l * NB, prefix,
+                        sk_new, sv_new, j, window=spec.window, layer_idx=l,
+                        **sc_kw)
                     return out, sk_new, sv_new
 
                 x, (sk_all, sv_all) = _transformer_layer(spec, w, x, pos,
@@ -986,12 +1033,12 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
             step, (ids0, positions0, side_k0, side_v0, init_logits),
             jnp.arange(C))
 
-        # ---- chunk-end flush: side buffers -> pools, page-granular RMW ---- #
-        # the kernels READ the pools inside the scan; the barrier ties the
+        # ---- chunk-end flush: side buffers -> pool, page-granular RMW ---- #
+        # the kernels READ the pool inside the scan; the barrier ties the
         # flush's pool operand to the scan result so XLA orders the in-place
-        # scatter after the reads instead of cloning the (GB-scale) pools
-        kp4b, vp4b, ks4b, vs4b, _ = jax.lax.optimization_barrier(
-            (kp4, vp4, ks4, vs4, final_logits))
+        # scatter after the reads instead of cloning the (GB-scale) pool
+        kvp5b, sc4b, _ = jax.lax.optimization_barrier(
+            (kvp5, sc4, final_logits))
         n_span = -(-C // bs) + 1
         t_idx = jnp.arange(n_span)
         lp = prefix[:, None] // bs + t_idx[None, :]             # [S, n_span]
@@ -1008,37 +1055,48 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
         s_idx = jnp.arange(S)[:, None, None]
         phys_l = (phys[None] + (jnp.arange(L) * NB)[:, None, None])
         phys_l = jnp.where(page_valid[None], phys_l, L * NB)    # OOB -> drop
+        idx = jnp.minimum(phys_l, L * NB - 1)
 
-        def flush(pool4, side, spool=None):                     # per k/v
-            # side [L, S, C, Hkv, D] -> new values [L, S, n_span, bs, Hkv, D]
-            newv = side[:, s_idx, j_clamp]                      # [L,S,n_span,bs,Hkv,D]
-            newv = jnp.moveaxis(newv, 4, 3)                     # [...,Hkv,bs,D]
-            old = pool4[jnp.minimum(phys_l, L * NB - 1)]
-            tv = tok_valid[None, :, :, None, :, None]
-            if spool is None:
-                comb = jnp.where(tv, newv.astype(pool4.dtype), old)
-                return pool4.at[phys_l.reshape(-1)].set(
-                    comb.reshape(-1, Hkv, bs, D), mode="drop"), None
-            # int8 pools: quantize the flushed rows; the RMW keeps the old
-            # page values AND old scales where the span page's slots predate
-            # the chunk
-            newq, news = kv_quantize_rows(newv)    # [...,Hkv,bs,D]/[...,Hkv,bs]
-            comb = jnp.where(tv, newq, old)
-            olds = spool[jnp.minimum(phys_l, L * NB - 1)]
-            combs = jnp.where(tok_valid[None, :, :, None, :], news, olds)
-            return (pool4.at[phys_l.reshape(-1)].set(
-                        comb.reshape(-1, Hkv, bs, D), mode="drop"),
-                    spool.at[phys_l.reshape(-1)].set(
-                        combs.reshape(-1, Hkv, bs), mode="drop"))
+        # side [L, S, C, Hkv, D] -> combined new values
+        # [L, S, n_span, 2, Hkv, bs, D]
+        def span_of(side):
+            newv = side[:, s_idx, j_clamp]          # [L,S,n_span,bs,Hkv,D]
+            return jnp.moveaxis(newv, 4, 3)         # [...,Hkv,bs,D]
 
-        kf, ksf = flush(kp4b, sk_all, ks4b)
-        vf, vsf = flush(vp4b, sv_all, vs4b)
-        new_k = kf.reshape(L, NB, Hkv, bs, D)
-        new_v = vf.reshape(L, NB, Hkv, bs, D)
+        newv = jnp.stack([span_of(sk_all), span_of(sv_all)], axis=3)
+        old = kvp5b[idx]                            # [L,S,n_span,2,Hkv,bs,D]
+        tv = tok_valid[None, :, :, None, None, :, None]
         if kvq:
-            new_k = (new_k, ksf.reshape(L, NB, Hkv, bs))
-            new_v = (new_v, vsf.reshape(L, NB, Hkv, bs))
-        return (out_ids, final_logits, new_k, new_v)
+            # int8 pool: quantize the flushed rows; the RMW keeps the old
+            # page values AND old scales where the span page's slots predate
+            # the chunk. Scales combine in the at-rest TILE layout (flat
+            # per-page order kv*Hkv*bs + h*bs + t, zero-padded to R8*128).
+            newq, news = kv_quantize_rows(newv)     # [L,S,n_span,2,Hkv,bs]
+            comb = jnp.where(tv, newq, old)
+            olds = sc4b[idx]                        # [L,S,n_span,R8,128]
+            n_sp = news.shape[2]
+            pad = r8 * 128 - 2 * Hkv * bs
+            newt = news.reshape(L, S, n_sp, 2 * Hkv * bs)
+            tvf = jnp.broadcast_to(tok_valid[:, :, None, :],
+                                   (S, n_sp, 2 * Hkv, bs)
+                                   ).reshape(S, n_sp, 2 * Hkv * bs)
+            if pad:
+                newt = jnp.pad(newt, ((0, 0),) * 3 + ((0, pad),))
+                tvf = jnp.pad(tvf, ((0, 0),) * 2 + ((0, pad),))
+            combs = jnp.where(tvf.reshape(1, S, n_sp, r8, 128),
+                              newt.reshape(L, S, n_sp, r8, 128), olds)
+            kvf = kvp5b.at[phys_l.reshape(-1)].set(
+                comb.reshape(-1, 2, Hkv, bs, D), mode="drop")
+            scf = sc4b.at[phys_l.reshape(-1)].set(
+                combs.reshape(-1, r8, 128), mode="drop")
+            new_kv = (kvf.reshape(L, NB, 2, Hkv, bs, D),
+                      scf.reshape(L, NB, r8, 128))
+        else:
+            comb = jnp.where(tv, newv.astype(kvp5b.dtype), old)
+            kvf = kvp5b.at[phys_l.reshape(-1)].set(
+                comb.reshape(-1, 2, Hkv, bs, D), mode="drop")
+            new_kv = kvf.reshape(L, NB, 2, Hkv, bs, D)
+        return (out_ids, final_logits, new_kv)
 
     return fwd
 
@@ -1072,9 +1130,9 @@ def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
     schedule does not need); above this budget the general loop is used
     (default from DSTPU_SIDEBUF_MAX_MB, 2048 MB — ADVICE r4).
 
-    Returns ``fwd(weights, k_pages, v_pages, ids0 [S], positions0 [S],
+    Returns ``fwd(weights, kv_pages, ids0 [S], positions0 [S],
     block_tables [S, MB], ctx0 [S], key) -> (out_ids [n_steps, S],
-    final_logits [S, V], new_k, new_v)`` where ``out_ids[j]`` is the token
+    final_logits [S, V], new_kv)`` where ``out_ids[j]`` is the token
     *consumed* by step j (ids0 first), and ``final_logits`` predict the token
     after the last generated one (so the serving loop can continue seamlessly).
     """
@@ -1092,13 +1150,13 @@ def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
     esize = jnp.dtype(spec.dtype).itemsize
     budget = max_side_bytes
 
-    def fwd(weights, k_pages, v_pages, ids0, *rest, **kw):
+    def fwd(weights, kv_pages, ids0, *rest, **kw):
         S = ids0.shape[0]
-        L = _kv_unpack(k_pages)[0].shape[0]
+        L = _kv_unpack(kv_pages)[0].shape[0]
         side_bytes = (2 * L * S * n_steps * spec.num_kv_heads
                       * spec.head_dim * esize)
         impl = sidebuf if side_bytes <= budget else general
-        return impl(weights, k_pages, v_pages, ids0, *rest, **kw)
+        return impl(weights, kv_pages, ids0, *rest, **kw)
 
     return fwd
 
@@ -1117,7 +1175,7 @@ def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
     step_win = functools.partial(paged_decode_attention_step,
                                  window=spec.window)
 
-    def _decode_step(q, k_new, v_new, k_l, v_l, bts, cls_):
+    def _decode_step(q, k_new, v_new, kv_l, bts, cls_):
         if tp > 1:
             from jax import shard_map
             from jax.sharding import PartitionSpec as P
@@ -1127,65 +1185,58 @@ def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
                 in_specs=(P(None, TENSOR_AXIS, None),
                           P(None, TENSOR_AXIS, None),
                           P(None, TENSOR_AXIS, None),
-                          P(None, TENSOR_AXIS, None, None),
-                          P(None, TENSOR_AXIS, None, None), P(None, None), P(None)),
+                          P(None, None, TENSOR_AXIS, None, None),
+                          P(None, None), P(None)),
                 out_specs=(P(None, TENSOR_AXIS, None),
-                           P(None, TENSOR_AXIS, None, None),
-                           P(None, TENSOR_AXIS, None, None)), check_vma=False)
-            return fn(q, k_new, v_new, k_l, v_l, bts, cls_)
-        return step_win(q, k_new, v_new, k_l, v_l, bts, cls_)
+                           P(None, None, TENSOR_AXIS, None, None)),
+                check_vma=False)
+            return fn(q, k_new, v_new, kv_l, bts, cls_)
+        return step_win(q, k_new, v_new, kv_l, bts, cls_)
 
-    def fwd(weights, k_pages, v_pages, ids0, positions0, block_tables, ctx0,
+    def fwd(weights, kv_pages, ids0, positions0, block_tables, ctx0,
             key, temperature=1.0):
-        k_pages, k_sc = _kv_unpack(k_pages)
-        v_pages, v_sc = _kv_unpack(v_pages)
-        kvq = k_sc is not None
+        kv_pages, kv_sc = _kv_unpack(kv_pages)
+        kvq = kv_sc is not None
         assert not (kvq and tp > 1), "int8 KV pages + TP not wired"
         S = ids0.shape[0]
-        L, NB, bs = k_pages.shape[0], k_pages.shape[1], k_pages.shape[3]
+        L, NB, bs = kv_pages.shape[0], kv_pages.shape[1], kv_pages.shape[4]
+        r8 = _scale_tile_rows(Hkv, bs) if kvq else 0
 
-        def one_pass(x_ids, pos, ctx, kp, vp, ks, vs):
-            # kp/vp flat [L*NB*Hkv*bs, D]. The attention + page-write is one
+        def one_pass(x_ids, pos, ctx, kvp, sc):
+            # kvp flat [L*NB*2*Hkv*bs, D]. The attention + page-write is one
             # fused unit (paged_decode_attention_step): pool aliased through
-            # the kernel, new rows scattered in place after — the pools flow
+            # the kernel, new rows scattered in place after — the pool flows
             # through the layer scan with no copies (see the kernel docstring
             # for why a pre-kernel scatter forces XLA to clone the pool).
             x = _embed_in(spec, weights, x_ids, pos)
 
             def layer_fn(carry, scanned):
-                x, kp, vp, ks, vs = carry
+                x, kvp, sc = carry
                 w, l = scanned
 
                 def attend(q, k, v):
                     if kvq:
-                        out, kp4, vp4, ks4, vs4 = step_win(
-                            q, k, v, kp.reshape(L * NB, Hkv, bs, D),
-                            vp.reshape(L * NB, Hkv, bs, D),
+                        out, kv5, sc4 = step_win(
+                            q, k, v, kvp.reshape(L * NB, 2, Hkv, bs, D),
                             block_tables + l * NB, ctx,
-                            k_scales=ks.reshape(L * NB, Hkv, bs),
-                            v_scales=vs.reshape(L * NB, Hkv, bs))
-                        return (out, kp4.reshape(L * NB * Hkv * bs, D),
-                                vp4.reshape(L * NB * Hkv * bs, D),
-                                ks4.reshape(L * NB * Hkv * bs),
-                                vs4.reshape(L * NB * Hkv * bs))
-                    out, kp4, vp4 = _decode_step(
-                        q, k, v, kp.reshape(L * NB, Hkv, bs, D),
-                        vp.reshape(L * NB, Hkv, bs, D),
+                            kv_scales=sc.reshape(L * NB, r8, 128))
+                        return (out, kv5.reshape(L * NB * 2 * Hkv * bs, D),
+                                sc4.reshape(L * NB * r8 * 128))
+                    out, kv5 = _decode_step(
+                        q, k, v, kvp.reshape(L * NB, 2, Hkv, bs, D),
                         block_tables + l * NB, ctx)
-                    return (out, kp4.reshape(L * NB * Hkv * bs, D),
-                            vp4.reshape(L * NB * Hkv * bs, D), ks, vs)
+                    return (out, kv5.reshape(L * NB * 2 * Hkv * bs, D), sc)
 
-                x, (kp, vp, ks, vs) = _transformer_layer(spec, w, x, pos,
-                                                         attend)
-                return (x, kp, vp, ks, vs), None
+                x, (kvp, sc) = _transformer_layer(spec, w, x, pos, attend)
+                return (x, kvp, sc), None
 
-            (x, kp, vp, ks, vs), _ = jax.lax.scan(
-                layer_fn, (x, kp, vp, ks, vs),
+            (x, kvp, sc), _ = jax.lax.scan(
+                layer_fn, (x, kvp, sc),
                 (weights["layers"], jnp.arange(L, dtype=jnp.int32)))
             x = _norm(x, weights["final_norm"], spec.norm, spec.eps, dtype,
                       spec.norm_plus_one)
             logits = _unembed(spec, weights, x)
-            return logits, kp, vp, ks, vs
+            return logits, kvp, sc
 
         def sample(logits, step_key):
             if not do_sample:
@@ -1197,25 +1248,21 @@ def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
             return jax.random.categorical(step_key, z, axis=-1).astype(jnp.int32)
 
         def step(carry, j):
-            ids, pos, ctx, kp, vp, ks, vs, _ = carry
-            logits, kp, vp, ks, vs = one_pass(ids, pos, ctx, kp, vp, ks, vs)
+            ids, pos, ctx, kvp, sc, _ = carry
+            logits, kvp, sc = one_pass(ids, pos, ctx, kvp, sc)
             nxt = sample(logits, jax.random.fold_in(key, j))
-            return (nxt, pos + 1, ctx + 1, kp, vp, ks, vs, logits), ids
+            return (nxt, pos + 1, ctx + 1, kvp, sc, logits), ids
 
         V = weights["embed"].shape[0]
         init_logits = jnp.zeros((ids0.shape[0], V), jnp.float32)
-        kp0 = k_pages.reshape(L * NB * Hkv * bs, D)
-        vp0 = v_pages.reshape(L * NB * Hkv * bs, D)
-        ks0 = k_sc.reshape(L * NB * Hkv * bs) if kvq else None
-        vs0 = v_sc.reshape(L * NB * Hkv * bs) if kvq else None
-        (_, _, _, kp, vp, ks, vs, final_logits), out_ids = jax.lax.scan(
-            step, (ids0, positions0, ctx0, kp0, vp0, ks0, vs0, init_logits),
+        kvp0 = kv_pages.reshape(L * NB * 2 * Hkv * bs, D)
+        sc0 = kv_sc.reshape(L * NB * r8 * 128) if kvq else None
+        (_, _, _, kvp, sc, final_logits), out_ids = jax.lax.scan(
+            step, (ids0, positions0, ctx0, kvp0, sc0, init_logits),
             jnp.arange(n_steps))
-        new_k = kp.reshape(L, NB, Hkv, bs, D)
-        new_v = vp.reshape(L, NB, Hkv, bs, D)
+        new_kv = kvp.reshape(L, NB, 2, Hkv, bs, D)
         if kvq:
-            new_k = (new_k, ks.reshape(L, NB, Hkv, bs))
-            new_v = (new_v, vs.reshape(L, NB, Hkv, bs))
-        return (out_ids, final_logits, new_k, new_v)
+            new_kv = (new_kv, sc.reshape(L, NB, r8, 128))
+        return (out_ids, final_logits, new_kv)
 
     return fwd

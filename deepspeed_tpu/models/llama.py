@@ -293,10 +293,33 @@ class LlamaAttention(nn.Module):
                     layer_cache[name], q8, (0, cache_index, 0, 0))
                 new_cache[f"{name}_scale"] = jax.lax.dynamic_update_slice(
                     layer_cache[f"{name}_scale"], scale, (0, cache_index, 0))
-            ck = (new_cache["k"].astype(x.dtype)
-                  * new_cache["k_scale"].astype(x.dtype)[..., None])
-            cv = (new_cache["v"].astype(x.dtype)
-                  * new_cache["v_scale"].astype(x.dtype)[..., None])
+            # ADVICE r4: dequant is FOLDED into the attention dots — the
+            # per-token-head scales apply to score columns (K) and to p
+            # before the pv contraction (V), so no dequantized
+            # [B, S_max, Hkv, D] cache (nor its repeat_kv to H heads) is
+            # ever materialised; the transient peak that offset the int8
+            # tier's 1.94x capacity gain is gone by construction.
+            S = new_cache["k"].shape[1]
+            k_pos = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+            bias = _window_bias(positions, k_pos, cfg.sliding_window)
+            Hq = cfg.num_attention_heads
+            Hkv = cfg.num_key_value_heads
+            G, D = Hq // Hkv, cfg.head_dim
+            qg = q.reshape(B, T, Hkv, G, D).astype(jnp.float32)
+            sc = jnp.einsum("btkgd,bskd->btkgs", qg,
+                            new_cache["k"].astype(jnp.float32))
+            sc = sc * new_cache["k_scale"].astype(jnp.float32) \
+                .transpose(0, 2, 1)[:, None, :, None, :] / (D ** 0.5)
+            # bias [B, 1, T, S] -> broadcast over (Hkv, G)
+            sc = sc + bias[:, 0][:, :, None, None, :]
+            p = jax.nn.softmax(sc, axis=-1)
+            pv = p * new_cache["v_scale"].astype(jnp.float32) \
+                .transpose(0, 2, 1)[:, None, :, None, :]
+            out = jnp.einsum("btkgs,bskd->btkgd", pv,
+                             new_cache["v"].astype(jnp.float32))
+            out = out.reshape(B, T, Hq, D).astype(x.dtype)
+            out = self.o_proj(out.reshape(B, T, Hq * D))
+            return out, new_cache
         else:
             ck = jax.lax.dynamic_update_slice(
                 layer_cache["k"], k.astype(layer_cache["k"].dtype),

@@ -81,9 +81,20 @@ class MixtralSparseMoeBlock(nn.Module):
         w_down = self.param("w_down", init, (E, cfg.intermediate_size, C), cfg.dtype)
 
         if cfg.dispatch_mode == "dropless":
-            from deepspeed_tpu.parallel.moe import (_reject_ep_dropless,
-                                                    dropless_moe)
-            _reject_ep_dropless(True)
+            from deepspeed_tpu.parallel.moe import (_ep_size, dropless_moe,
+                                                    dropless_moe_ep)
+            ep, topo = _ep_size(True)
+            if ep > 1:
+                def swiglu_ws(ws, rows, group_sizes):
+                    wg, wu, wd = ws
+                    g = jax.lax.ragged_dot(rows, wg, group_sizes)
+                    u = jax.lax.ragged_dot(rows, wu, group_sizes)
+                    return jax.lax.ragged_dot(nn.silu(g) * u, wd, group_sizes)
+
+                out, l_aux = dropless_moe_ep(
+                    tokens, logits, cfg.num_experts_per_tok,
+                    (w_gate, w_up, w_down), swiglu_ws, topo.mesh, ep)
+                return out.reshape(B, T, C), l_aux.astype(jnp.float32)
 
             def swiglu_grouped(rows, group_sizes):
                 g = jax.lax.ragged_dot(rows, w_gate, group_sizes)

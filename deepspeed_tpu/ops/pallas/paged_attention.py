@@ -5,14 +5,21 @@ paged KV cache (``inference/v2/kernels/ragged_ops/blocked_flash``, the CUDA
 flash-attn wrapper reading ``linear_blocked_kv_rotary``-filled KV pages). SURVEY §7
 ranks this the hardest kernel in the project; this is the TPU-native take:
 
-  - The KV cache lives in HBM as HEAD-MAJOR pages ``[num_blocks, H_kv, bs, D]``.
-    Head-major is load-bearing twice over: (1) a page's trailing dims are
-    (block_size, head_dim) = (128, 128)-class shapes, so no array view in the
-    serving program ever carries a padded sublane tile — with the head count
-    second-minor (e.g. 12 for an MHA-12 model), XLA assigns a padded layout and
+  - The KV cache lives in HBM as COMBINED head-major pages
+    ``[num_blocks, 2, H_kv, bs, D]`` — one page holds a sequence-chunk's K
+    (index 0) AND V (index 1). Two design forces meet here:
+    (1) HEAD-MAJOR rows: a page's trailing dims are (block_size, head_dim) =
+    (128, 128)-class shapes, so no pool view ever carries a padded sublane
+    tile — with the head count second-minor, XLA assigns a padded layout and
     every pool-sized reshape in the layer scan materialises a multi-hundred-MB
-    copy (measured 26+ ms per decode step at 0.55B); (2) TP slices the pool on
-    the head dim with each shard's pages still contiguous.
+    copy (measured 26+ ms per decode step at 0.55B); TP slices the pool on the
+    head dim with each shard's pages still contiguous.
+    (2) K+V COMBINED: the decode kernel is per-DMA-copy bound, not byte bound
+    (round-4 measurement: doubling the page size doubled standalone kernel
+    speed; round-5: adding two scale copies per page for int8 made the int8
+    path SLOWER than bf16 despite halving the bytes). One page = one value
+    copy — half the copy count of split K/V pools — and the int8 scale tile
+    rides as one more small copy instead of two.
   - One grid step = (one sequence, one CHUNK of P pages). Page ids come from the
     scalar-prefetched block table and the chunk streams HBM->VMEM through a
     manual two-slot DMA pipeline (``pltpu.make_async_copy``): while chunk c
@@ -29,6 +36,14 @@ ranks this the hardest kernel in the project; this is the TPU-native take:
     flop overhead is irrelevant — decode attention is HBM-bandwidth bound —
     while the alternative (H_kv separate M=G dots per page, each with ~fixed-op
     cost) dominated the old kernel's runtime at MHA head counts.
+  - int8 pages (``kv_scales``): values int8 with per-token-head f32 scales
+    (reference role: ZeRO-Inference's KV quantization, README.md:23, on the
+    blocked-flash path). Scales live in one (8k, 128) f32 tile per page —
+    K rows then V rows, flat index kv*Hkv*bs + h*bs + t at (idx//128,
+    idx%128) — so the dequant stream is a single aligned DMA. In-kernel the
+    scales fold in as score-column (K) and p-column (V) multipliers applied
+    per 128-lane sub-block (tile lane rows map 1:1 onto score column blocks;
+    no relayout, no dequantized slab).
 
 Decode-only by design (one query token per sequence): SplitFuse prompt chunks take
 the chunked-flash path (``paged_chunk_attention``) — chunk attention is
@@ -68,36 +83,67 @@ def kv_quantize_rows(x: jax.Array):
 
 def _scale_tile_rows(h_kv: int, bs: int) -> int:
     """Sublane rows of one page's scale tile, padded to the (8, 128) f32
-    tile: a page's Hkv*bs scales occupy Hkv*bs/128 lane rows; Mosaic DMA
-    slices must be whole tiles, so the row count rounds up to 8 (~6% of the
-    int8 page body — the price of an aligned one-tile-per-page stream)."""
-    r = (h_kv * bs) // 128
+    tile: a page's 2*Hkv*bs scales (K + V) occupy 2*Hkv*bs/128 lane rows;
+    Mosaic DMA slices must be whole tiles, so the row count rounds up to 8
+    (<= 6% of the int8 page body — the price of one aligned copy)."""
+    r = (2 * h_kv * bs) // 128
     return -(-r // 8) * 8
 
 
-def _scales_to_tiles(s: jax.Array, NB: int, h_kv: int, bs: int) -> jax.Array:
-    """[NB, Hkv, bs] f32 logical scales -> [NB, R8, 128] DMA-aligned tiles
-    (flat scale index h*bs + t at (idx // 128, idx % 128)). XLA hoists this
-    out of the decode scan when the pools are frozen (the sidebuf path)."""
+def _scales_to_tiles(s: jax.Array) -> jax.Array:
+    """[NB, 2, Hkv, bs] f32 logical scales -> [NB, R8, 128] DMA-aligned
+    tiles (flat index kv*Hkv*bs + h*bs + t at (idx // 128, idx % 128)).
+    Already-tiled input (ndim 3) passes through. The SERVING pools store
+    scales in tile layout AT REST (ragged/kv_cache.py) so no pass ever pays
+    a pool-sized pad+reshape; this conversion exists for logical-layout
+    callers (tests, one-shot uses)."""
+    if s.ndim == 3:
+        return s
+    NB, _, h_kv, bs = s.shape
     r8 = _scale_tile_rows(h_kv, bs)
-    flat = s.reshape(NB, h_kv * bs).astype(jnp.float32)
-    pad = r8 * 128 - h_kv * bs
+    flat = s.reshape(NB, 2 * h_kv * bs).astype(jnp.float32)
+    pad = r8 * 128 - 2 * h_kv * bs
     if pad:
         flat = jnp.pad(flat, ((0, 0), (0, pad)))
     return flat.reshape(NB, r8, 128)
 
 
+def kv_scales_to_tiles(s: jax.Array) -> jax.Array:
+    """Public tiling hook (see :func:`_scales_to_tiles`)."""
+    return _scales_to_tiles(s)
+
+
+def kv_scale_tiles_shape(num_blocks: int, h_kv: int, bs: int):
+    """At-rest tile-layout shape of a scale pool: [NB, R8, 128] f32."""
+    return (num_blocks, _scale_tile_rows(h_kv, bs), 128)
+
+
+def _colscale_pages(mat, tile_ref, n_pages, nsub, off):
+    """Apply per-token-head dequant scales to ``mat``'s columns, one aligned
+    128-lane piece at a time: column block (page jp, sub t) multiplies by
+    scale-tile lane row ``tile_ref[jp, off + t, :]``. The ONE shared
+    implementation of the int8 fold for every kernel (decode, batched
+    sidebuf, chunk) — the lane-alignment assumption (bs*Hkv % 128 == 0 and,
+    for per-head addressing, bs % 128 == 0) lives here."""
+    cols = []
+    for jp in range(n_pages):
+        for t in range(nsub):
+            c0 = (jp * nsub + t) * 128
+            cols.append(mat[:, c0:c0 + 128] * tile_ref[jp, off + t, :][None, :])
+    return jnp.concatenate(cols, axis=1) if len(cols) > 1 else cols[0]
+
+
 def _pick_pages_per_chunk(bs: int, h_kv: int, d: int, esize: int,
                           max_blocks: int, reserve_bytes: int = 0) -> int:
-    """Largest P with the 2-slot K+V slabs within ~8 MB of VMEM (~16 MB on
-    v5e; q/o blocks, score tiles and accumulators are small). Fatter chunks
-    amortise the per-grid-step fixed cost, the dominant decode overhead.
-    ``reserve_bytes``: VMEM the caller holds besides the page slabs (the
-    sidebuf kernel's side slabs) — subtracted from the budget."""
+    """Largest P with the 2-slot combined-KV slabs within ~8 MB of VMEM
+    (~16 MB on v5e; q/o blocks, score tiles and accumulators are small).
+    Fatter chunks amortise the per-grid-step fixed cost, the dominant
+    decode overhead. ``reserve_bytes``: VMEM the caller holds besides the
+    page slabs (the sidebuf kernel's side slabs)."""
     import os
     budget = int(os.environ.get("DSTPU_PAGED_VMEM_BUDGET",
                                 8 * 1024 * 1024)) - reserve_bytes
-    per_page = 2 * 2 * bs * h_kv * d * esize        # 2 slots x (K + V)
+    per_page = 2 * 2 * bs * h_kv * d * esize     # 2 slots x (K + V)
     return max(1, min(max_blocks, budget // per_page))
 
 
@@ -149,12 +195,12 @@ def _flash_update(sc, mask, vv, m_sc, l_sc, acc_sc, v_scale_fn=None,
 
 
 def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
-                 k_hbm, v_hbm, o_ref,
-                 k_buf, v_buf, sems, acc_sc, m_sc, l_sc, *,
+                 kv_hbm, o_ref,
+                 kv_buf, sems, acc_sc, m_sc, l_sc, *,
                  scale, block_size, pages_per_chunk, n_chunks, max_blocks,
                  n_seqs, h_kv, groups, window=None, lse_ref=None,
                  j_ref=None, sidek_ref=None, sidev_ref=None, n_side=0,
-                 ks_hbm=None, vs_hbm=None, ks_buf=None, vs_buf=None):
+                 sc_hbm=None, sc_buf=None):
     """Shared batched-decode body (see module docstring). With
     ``knew_ref/vnew_ref`` (step mode) the pages hold tokens [0, ctx-1) and
     the current token's attention term folds in from registers at finalize;
@@ -165,10 +211,9 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
     ``[n_side*Hkv, D]`` holds the chunk's freshly decoded K/V rows (row
     cc*Hkv + h = step cc's kv head h, token position cl + cc); at finalize
     rows cc <= ``j_ref[0]`` fold into the same (m, l, acc) state — one flash
-    stream over pages + side, no separate dense piece, no lse merge (the
-    round-4 schedule computed the side piece in jnp and merged by lse, which
-    re-read the [C, S, Hkv, D] slab from HBM per layer per step; folding it
-    here reads one sequence's [C, Hkv, D] slab into VMEM instead).
+    stream over pages + side, no separate dense piece, no lse merge.
+
+    ``sc_hbm/sc_buf`` (int8 pages): per-page scale tiles, one DMA per page.
 
     ``window`` (static, sliding-window serving — Mistral/Qwen2 parity,
     reference ``inference/v2/model_implementations/mistral``): the query at
@@ -179,8 +224,10 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
     mode the query position is cl + j, so the window start moves with j."""
     inline_current = knew_ref is not None
     side = sidek_ref is not None
+    quant = sc_hbm is not None
     ctx_off = 1 if inline_current else 0
     P, bs, T = pages_per_chunk, block_size, pages_per_chunk * block_size
+    HB = h_kv * bs
     s, c = pl.program_id(0), pl.program_id(1)
     g = s * n_chunks + c                   # global step: the pipeline clock
     H = h_kv * groups
@@ -218,29 +265,23 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
             need = jnp.logical_and(need, t0 + bs > tok_lo_of(s_))
         return need
 
-    quant = ks_hbm is not None
-
     def chunk_copies(s_, c_, slot):
         """The per-page copy descriptors for chunk c_ of sequence s_ (built
         identically at start and wait — same (src, dst, sem) triples and
-        the same ``page_needed`` predicates). int8 pages add a per-page
-        [Hkv*bs] f32 scale-row copy for K and V (2 KB each — noise next to
-        the page body, which the int8 dtype just halved)."""
+        the same ``page_needed`` predicates). One combined K+V copy per
+        page (+ one scale-tile copy for int8 pages) — the kernel is
+        per-copy bound, so copy count is the scarce resource."""
         cps = []
         for j in range(P):
             page = bt_ref[s_, jnp.minimum(c_ * P + j, max_blocks - 1)]
             cps.append((page_needed(s_, c_, j), pltpu.make_async_copy(
-                k_hbm.at[page], k_buf.at[slot, j], sems.at[slot])))
-            cps.append((page_needed(s_, c_, j), pltpu.make_async_copy(
-                v_hbm.at[page], v_buf.at[slot, j], sems.at[slot])))
+                kv_hbm.at[page], kv_buf.at[slot, j], sems.at[slot])))
             if quant:
                 cps.append((page_needed(s_, c_, j), pltpu.make_async_copy(
-                    ks_hbm.at[page], ks_buf.at[slot, j], sems.at[slot])))
-                cps.append((page_needed(s_, c_, j), pltpu.make_async_copy(
-                    vs_hbm.at[page], vs_buf.at[slot, j], sems.at[slot])))
+                    sc_hbm.at[page], sc_buf.at[slot, j], sems.at[slot])))
         return cps
 
-    per_page = 4 if quant else 2
+    per_page = 2 if quant else 1
 
     def start_copies(s_, c_, slot):
         for need, cp in chunk_copies(s_, c_, slot):
@@ -253,21 +294,21 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
             @pl.when(need)
             def _():
                 cp.wait()
-            if j2 % per_page == 1:  # V copy of page j2 // per_page
-                # a skipped page's V buffer holds garbage; the online-softmax
-                # p rows are exactly 0 there, but 0 * NaN = NaN, so the V slab
-                # must be finite — zero it (K needs nothing: masked scores are
-                # replaced before use)
+            if j2 % per_page == 0:   # the combined value copy of page j2
+                # a skipped page's V half holds garbage; the online-softmax
+                # p rows are exactly 0 there, but 0 * NaN = NaN, so the V
+                # slab must be finite — zero it (K needs nothing: masked
+                # scores are replaced before use)
                 @pl.when(jnp.logical_not(need))
                 def _():
-                    v_buf[slot, j2 // per_page] = jnp.zeros_like(
-                        v_buf[slot, j2 // per_page])
-            if quant and j2 % per_page == 3:  # V-scale copy
-                # same reasoning: the V scale folds into p (0 * NaN = NaN)
+                    kv_buf[slot, j2 // per_page, HB:, :] = jnp.zeros_like(
+                        kv_buf[slot, j2 // per_page, HB:, :])
+            if quant and j2 % per_page == 1:
+                # same reasoning for the V scale rows (they fold into p)
                 @pl.when(jnp.logical_not(need))
                 def _():
-                    vs_buf[slot, j2 // per_page] = jnp.zeros_like(
-                        vs_buf[slot, j2 // per_page])
+                    sc_buf[slot, j2 // per_page] = jnp.zeros_like(
+                        sc_buf[slot, j2 // per_page])
 
     # prime the pipeline — only when chunk (0, 0) is real (with a window,
     # sequence 0 may start at a later chunk, whose copy is issued by the
@@ -306,8 +347,9 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
             acc_sc[:] = jnp.zeros_like(acc_sc)
 
         q = q_ref[0]                                           # [H, D]
-        kk = k_buf[slot].reshape(P * h_kv * bs, -1)            # leading-dim
-        vv = v_buf[slot].reshape(P * h_kv * bs, -1)            # collapse only
+        slab = kv_buf[slot]                                    # [P, 2HB, D]
+        kk = slab[:, :HB, :].reshape(P * HB, -1)
+        vv = slab[:, HB:, :].reshape(P * HB, -1)
         mask = _chunk_mask(c, ctx - ctx_off, T, h_kv, bs, H,
                            tok_lo=None if window is None else tok_lo_of(s))
         v_scale_fn = None
@@ -321,27 +363,17 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
             # column blocks — no cross-tile relayout), never materialising
             # a dequantized slab.
             kk = kk.astype(q.dtype)
-            nsub = (h_kv * bs) // 128
-            kst = ks_buf[slot]                      # [P, R8, 128]
-            vst = vs_buf[slot]
-
-            def colscale(mat, st):
-                cols = []
-                for jp in range(P):
-                    for t in range(nsub):
-                        c0 = (jp * nsub + t) * 128
-                        cols.append(mat[:, c0:c0 + 128]
-                                    * st[jp, t, :][None, :])
-                return jnp.concatenate(cols, axis=1)
-
-            v_scale_fn = functools.partial(colscale, st=vst)
+            nsub = HB // 128
+            st = sc_buf[slot]                      # [P, R8, 128]
+            v_scale_fn = functools.partial(_colscale_pages, tile_ref=st,
+                                           n_pages=P, nsub=nsub, off=nsub)
         # dots run in the page dtype (bf16 MXU path for serving caches) with
         # f32 accumulation; identical math to before for f32 pools
         sc = jax.lax.dot_general(q.astype(kk.dtype), kk,
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32) * scale
         if quant:
-            sc = colscale(sc, kst)
+            sc = _colscale_pages(sc, st, P, nsub, 0)
         _flash_update(sc, mask, vv, m_sc, l_sc, acc_sc,
                       v_scale_fn=v_scale_fn, compute_dtype=q.dtype)
 
@@ -354,8 +386,8 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
                 # always visible, so l > 0 even at prefix 0 (no empty-row
                 # special case).
                 jcur = j_ref[0]
-                sk = sidek_ref[0]                              # [Cs*Hkv, D]
-                sv = sidev_ref[0]
+                sk = sidek_ref[0, 0]                           # [Cs*Hkv, D]
+                sv = sidev_ref[0, 0]
                 Ws = n_side * h_kv
                 col = jax.lax.broadcasted_iota(jnp.int32, (H, Ws), 1)
                 row_kv = jax.lax.broadcasted_iota(jnp.int32, (H, Ws), 0) \
@@ -416,49 +448,260 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
             o_ref[0] = jnp.where(ctx > 0, out, jnp.zeros_like(out))
 
 
-def _decode_kernel(bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref,
-                   k_buf, v_buf, sems, acc_sc, m_sc, l_sc, **kw):
-    _decode_body(bt_ref, cl_ref, q_ref, None, None, k_hbm, v_hbm, o_ref,
-                 k_buf, v_buf, sems, acc_sc, m_sc, l_sc, **kw)
+def _decode_kernel(bt_ref, cl_ref, q_ref, kv_hbm, o_ref,
+                   kv_buf, sems, acc_sc, m_sc, l_sc, **kw):
+    _decode_body(bt_ref, cl_ref, q_ref, None, None, kv_hbm, o_ref,
+                 kv_buf, sems, acc_sc, m_sc, l_sc, **kw)
 
 
-def _decode_kernel_lse(bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref, lse_ref,
-                       k_buf, v_buf, sems, acc_sc, m_sc, l_sc, **kw):
-    _decode_body(bt_ref, cl_ref, q_ref, None, None, k_hbm, v_hbm, o_ref,
-                 k_buf, v_buf, sems, acc_sc, m_sc, l_sc, lse_ref=lse_ref, **kw)
+def _decode_kernel_lse(bt_ref, cl_ref, q_ref, kv_hbm, o_ref, lse_ref,
+                       kv_buf, sems, acc_sc, m_sc, l_sc, **kw):
+    _decode_body(bt_ref, cl_ref, q_ref, None, None, kv_hbm, o_ref,
+                 kv_buf, sems, acc_sc, m_sc, l_sc, lse_ref=lse_ref, **kw)
 
 
-def _decode_kernel_quant(bt_ref, cl_ref, q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm,
-                         o_ref, k_buf, v_buf, ks_buf, vs_buf, sems,
+def _decode_kernel_quant(bt_ref, cl_ref, q_ref, kv_hbm, sc_hbm,
+                         o_ref, kv_buf, sc_buf, sems,
                          acc_sc, m_sc, l_sc, **kw):
-    _decode_body(bt_ref, cl_ref, q_ref, None, None, k_hbm, v_hbm, o_ref,
-                 k_buf, v_buf, sems, acc_sc, m_sc, l_sc,
-                 ks_hbm=ks_hbm, vs_hbm=vs_hbm, ks_buf=ks_buf, vs_buf=vs_buf,
-                 **kw)
+    _decode_body(bt_ref, cl_ref, q_ref, None, None, kv_hbm, o_ref,
+                 kv_buf, sems, acc_sc, m_sc, l_sc,
+                 sc_hbm=sc_hbm, sc_buf=sc_buf, **kw)
 
 
-def _decode_kernel_sidebuf(bt_ref, cl_ref, j_ref, q_ref, sidek_ref, sidev_ref,
-                           k_hbm, v_hbm, o_ref,
-                           k_buf, v_buf, sems, acc_sc, m_sc, l_sc, **kw):
-    _decode_body(bt_ref, cl_ref, q_ref, None, None, k_hbm, v_hbm, o_ref,
-                 k_buf, v_buf, sems, acc_sc, m_sc, l_sc,
+def _sidebuf_batched_body(bt_ref, cl_ref, j_ref, q_ref, sidek_ref, sidev_ref,
+                          kv_hbm, o_ref,
+                          kv_buf, sc_buf, sems, acc_sc, m_sc, l_sc, *,
+                          scale, block_size, pages_per_chunk, n_chunks,
+                          max_blocks, n_seqs, h_kv, groups, window=None,
+                          n_side=0, batch_seqs=1, sc_hbm=None):
+    """SB-batched side-slab decode body: one grid step carries
+    ``batch_seqs`` sequences' chunks. The decode grid is sequential
+    ("arbitrary" semantics for the 2-slot DMA pipeline) and MEASURED to be
+    bound by per-grid-step overhead, not DMA bytes or copy count (round 5:
+    combined K+V pages halved copies for +2%; int8 halved bytes and LOST —
+    the stream is already hidden under the per-step floor). Batching SB
+    sequences per step divides that floor by SB while keeping each
+    sequence's dot/flash exactly as in the single-sequence body.
+
+    Scratch: kv_buf [2, SB, P, 2*Hkv*bs, D], per-sequence flash state
+    acc [SB, H, D] / m, l [SB, H, 128]."""
+    quant = sc_hbm is not None
+    SB = batch_seqs
+    P, bs, T = pages_per_chunk, block_size, pages_per_chunk * block_size
+    HB = h_kv * bs
+    sb, c = pl.program_id(0), pl.program_id(1)
+    g = sb * n_chunks + c
+    H = h_kv * groups
+
+    def tok_lo_of(s_):
+        if window is None:
+            return jnp.int32(0)
+        return jnp.maximum(cl_ref[s_] + j_ref[0] + 1 - window, 0)
+
+    def n_chunks_of(s_):
+        return jax.lax.div(jnp.maximum(cl_ref[s_], 1) + (T - 1), T)
+
+    def c0_of(s_):
+        if window is None:
+            return jnp.int32(0)
+        return jnp.minimum(jax.lax.div(tok_lo_of(s_), T),
+                           n_chunks_of(s_) - 1)
+
+    def page_needed(s_, c_, j):
+        t0 = (c_ * P + j) * bs
+        need = t0 < jnp.maximum(cl_ref[s_], 1)
+        if window is not None:
+            need = jnp.logical_and(need, t0 + bs > tok_lo_of(s_))
+        return need
+
+    def block_runs(sb_, c_):
+        """Does chunk c_ run for ANY sequence of block sb_?"""
+        runs = jnp.bool_(False)
+        for i in range(SB):
+            s_ = sb_ * SB + i
+            runs = jnp.logical_or(
+                runs, jnp.logical_and(c_ < n_chunks_of(s_), c_ >= c0_of(s_)))
+        return runs
+
+    def chunk_copies(sb_, c_, slot):
+        cps = []
+        for i in range(SB):
+            s_ = sb_ * SB + i
+            # a sequence whose chunk range excludes c_ skips its copies;
+            # the predicates are identical at start and wait
+            seq_on = jnp.logical_and(c_ < n_chunks_of(s_), c_ >= c0_of(s_))
+            for j in range(P):
+                page = bt_ref[s_, jnp.minimum(c_ * P + j, max_blocks - 1)]
+                need = jnp.logical_and(seq_on, page_needed(s_, c_, j))
+                cps.append((need, i, pltpu.make_async_copy(
+                    kv_hbm.at[page], kv_buf.at[slot, i, j], sems.at[slot])))
+                if quant:
+                    cps.append((need, i, pltpu.make_async_copy(
+                        sc_hbm.at[page], sc_buf.at[slot, i, j],
+                        sems.at[slot])))
+        return cps
+
+    per_page = 2 if quant else 1
+
+    def start_copies(sb_, c_, slot):
+        for need, _i, cp in chunk_copies(sb_, c_, slot):
+            @pl.when(need)
+            def _():
+                cp.start()
+
+    def wait_copies(sb_, c_, slot):
+        for j2, (need, i, cp) in enumerate(chunk_copies(sb_, c_, slot)):
+            @pl.when(need)
+            def _():
+                cp.wait()
+            if j2 % per_page == 0:
+                jj = (j2 // per_page) % P
+                # skipped pages: V half must be finite (0 * NaN = NaN)
+                @pl.when(jnp.logical_not(need))
+                def _():
+                    kv_buf[slot, i, jj, HB:, :] = jnp.zeros_like(
+                        kv_buf[slot, i, jj, HB:, :])
+            if quant and j2 % per_page == 1:
+                jj = (j2 // per_page) % P
+                @pl.when(jnp.logical_not(need))
+                def _():
+                    sc_buf[slot, i, jj] = jnp.zeros_like(sc_buf[slot, i, jj])
+
+    n_blocks = n_seqs // SB
+
+    @pl.when(jnp.logical_and(g == 0, block_runs(0, 0)))
+    def _():
+        start_copies(0, 0, 0)
+
+    sb_n = jax.lax.div(g + 1, n_chunks)
+    c_n = jax.lax.rem(g + 1, n_chunks)
+    next_real = jnp.logical_and(g + 1 < n_blocks * n_chunks,
+                                block_runs(sb_n, c_n))
+
+    @pl.when(next_real)
+    def _():
+        start_copies(sb_n, c_n, jax.lax.rem(g + 1, 2))
+
+    @pl.when(block_runs(sb, c))
+    def _():
+        slot = jax.lax.rem(g, 2)
+        wait_copies(sb, c, slot)
+
+        for i in range(SB):
+            s_ = sb * SB + i
+            ctx = cl_ref[s_]
+            nc_s = n_chunks_of(s_)
+            c0_s = c0_of(s_)
+
+            @pl.when(c == c0_s)
+            def _():
+                m_sc[i] = jnp.full_like(m_sc[i], NEG_INF)
+                l_sc[i] = jnp.zeros_like(l_sc[i])
+                acc_sc[i] = jnp.zeros_like(acc_sc[i])
+
+            @pl.when(jnp.logical_and(c < nc_s, c >= c0_s))
+            def _():
+                q = q_ref[i]                                   # [H, D]
+                slab = kv_buf[slot, i]                         # [P, 2HB, D]
+                kk = slab[:, :HB, :].reshape(P * HB, -1)
+                vv = slab[:, HB:, :].reshape(P * HB, -1)
+                mask = _chunk_mask(c, ctx, T, h_kv, bs, H,
+                                   tok_lo=None if window is None
+                                   else tok_lo_of(s_))
+                v_scale_fn = None
+                if quant:
+                    kk = kk.astype(q.dtype)
+                    nsub = HB // 128
+                    st = sc_buf[slot, i]
+                    v_scale_fn = functools.partial(
+                        _colscale_pages, tile_ref=st, n_pages=P, nsub=nsub,
+                        off=nsub)
+                sc = jax.lax.dot_general(q.astype(kk.dtype), kk,
+                                         (((1,), (1,)), ((), ())),
+                                         preferred_element_type=jnp.float32
+                                         ) * scale
+                if quant:
+                    sc = _colscale_pages(sc, st, P, nsub, 0)
+                # per-sequence flash state rows i
+                m_i, l_i, acc_i = m_sc.at[i], l_sc.at[i], acc_sc.at[i]
+                _flash_update(sc, mask, vv, m_i, l_i, acc_i,
+                              v_scale_fn=v_scale_fn, compute_dtype=q.dtype)
+
+            @pl.when(c == nc_s - 1)
+            def _():
+                jcur = j_ref[0]
+                sk = sidek_ref[0, i]                           # [Cs*Hkv, D]
+                sv = sidev_ref[0, i]
+                Ws = n_side * h_kv
+                col = jax.lax.broadcasted_iota(jnp.int32, (H, Ws), 1)
+                row_kv = jax.lax.broadcasted_iota(jnp.int32, (H, Ws), 0) \
+                    // groups
+                cc = col // h_kv
+                col_kv = jax.lax.rem(col, h_kv)
+                smask = jnp.logical_and(col_kv == row_kv, cc <= jcur)
+                if window is not None:
+                    smask = jnp.logical_and(smask, cc >= jcur + 1 - window)
+                sc_s = jax.lax.dot_general(
+                    q_ref[i].astype(sk.dtype), sk,
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+                row1 = jax.lax.broadcasted_iota(jnp.int32, (Ws, 1), 0)
+                sv = jnp.where(row1 // h_kv <= jcur, sv, 0.0)
+                m_i, l_i, acc_i = m_sc.at[i], l_sc.at[i], acc_sc.at[i]
+                _flash_update(sc_s, smask, sv, m_i, l_i, acc_i)
+                l = l_sc[i, :, 0:1]
+                safe_l = jnp.where(l > 0.0, l, 1.0)
+                o_ref[i] = (acc_sc[i] / safe_l).astype(o_ref.dtype)
+
+
+def _decode_kernel_sidebuf(bt_ref, cl_ref, j_ref, l_ref, q_ref, sidek_ref,
+                           sidev_ref, kv_hbm, o_ref,
+                           kv_buf, sems, acc_sc, m_sc, l_sc, **kw):
+    del l_ref  # layer index: consumed by the side-slab BlockSpec index maps
+    _decode_body(bt_ref, cl_ref, q_ref, None, None, kv_hbm, o_ref,
+                 kv_buf, sems, acc_sc, m_sc, l_sc,
                  j_ref=j_ref, sidek_ref=sidek_ref, sidev_ref=sidev_ref, **kw)
 
 
-def _decode_kernel_sidebuf_quant(bt_ref, cl_ref, j_ref, q_ref, sidek_ref,
-                                 sidev_ref, k_hbm, v_hbm, ks_hbm, vs_hbm,
-                                 o_ref, k_buf, v_buf, ks_buf, vs_buf, sems,
+def _decode_kernel_sidebuf_quant(bt_ref, cl_ref, j_ref, l_ref, q_ref,
+                                 sidek_ref, sidev_ref, kv_hbm, sc_hbm,
+                                 o_ref, kv_buf, sc_buf, sems,
                                  acc_sc, m_sc, l_sc, **kw):
-    _decode_body(bt_ref, cl_ref, q_ref, None, None, k_hbm, v_hbm, o_ref,
-                 k_buf, v_buf, sems, acc_sc, m_sc, l_sc,
+    del l_ref
+    _decode_body(bt_ref, cl_ref, q_ref, None, None, kv_hbm, o_ref,
+                 kv_buf, sems, acc_sc, m_sc, l_sc,
                  j_ref=j_ref, sidek_ref=sidek_ref, sidev_ref=sidev_ref,
-                 ks_hbm=ks_hbm, vs_hbm=vs_hbm, ks_buf=ks_buf, vs_buf=vs_buf,
-                 **kw)
+                 sc_hbm=sc_hbm, sc_buf=sc_buf, **kw)
+
+
+def _sidebuf_batched_kernel(bt_ref, cl_ref, j_ref, l_ref, q_ref, sidek_ref,
+                            sidev_ref, kv_hbm, o_ref,
+                            kv_buf, sems, acc_sc, m_sc, l_sc, **kw):
+    del l_ref  # layer index: consumed by the side-slab BlockSpec index maps
+    _sidebuf_batched_body(bt_ref, cl_ref, j_ref, q_ref, sidek_ref, sidev_ref,
+                          kv_hbm, o_ref, kv_buf, None, sems,
+                          acc_sc, m_sc, l_sc, **kw)
+
+
+def _sidebuf_batched_kernel_quant(bt_ref, cl_ref, j_ref, l_ref, q_ref,
+                                  sidek_ref, sidev_ref, kv_hbm, sc_hbm, o_ref,
+                                  kv_buf, sc_buf, sems, acc_sc, m_sc, l_sc,
+                                  **kw):
+    del l_ref
+    _sidebuf_batched_body(bt_ref, cl_ref, j_ref, q_ref, sidek_ref, sidev_ref,
+                          kv_hbm, o_ref, kv_buf, sc_buf, sems,
+                          acc_sc, m_sc, l_sc, sc_hbm=sc_hbm, **kw)
+
+
+def _kv_flat(kv_pages):
+    """[NB, 2, Hkv, bs, D] -> [NB, 2*Hkv*bs, D] (bitcast view for the DMA)."""
+    NB, two, Hkv, bs, D = kv_pages.shape
+    assert two == 2
+    return kv_pages.reshape(NB, 2 * Hkv * bs, D)
 
 
 def paged_decode_attention_sidebuf(q: jax.Array,
-                                   k_pages: jax.Array,
-                                   v_pages: jax.Array,
+                                   kv_pages: jax.Array,
                                    block_tables: jax.Array,
                                    prefix_lens: jax.Array,
                                    side_k: jax.Array,
@@ -466,47 +709,125 @@ def paged_decode_attention_sidebuf(q: jax.Array,
                                    j,
                                    softmax_scale: Optional[float] = None,
                                    window: Optional[int] = None,
-                                   k_scales: Optional[jax.Array] = None,
-                                   v_scales: Optional[jax.Array] = None
-                                   ) -> jax.Array:
+                                   kv_scales: Optional[jax.Array] = None,
+                                   layer_idx=None) -> jax.Array:
     """Decode attention over a FROZEN paged prefix plus a per-sequence side
     slab of freshly decoded K/V — the kernel of the scatter-free multistep
     schedule (``inference/v2/ragged_model._build_multistep_sidebuf``).
 
     q:            [S, H, D]         one query per sequence (step j's token)
-    k/v_pages:    [NB, H_kv, bs, D] frozen prefix pages
+    kv_pages:     [NB, 2, H_kv, bs, D] frozen prefix pages (K + V combined)
     block_tables: [S, MB] int32
     prefix_lens:  [S] int32         tokens in the pages (EXCLUDING the chunk)
     side_k/v:     [S, C, H_kv, D]   side slab; rows 0..j are real (row j is
-                                    the current token), rows > j are ignored
+                  the current token), rows > j are ignored. MAY instead be
+                  the whole per-layer stack [L, S, C, H_kv, D] with
+                  ``layer_idx`` (traced int32): the kernel's BlockSpec then
+                  pulls layer ``layer_idx``'s block directly — the caller
+                  avoids a dynamic_slice that would MATERIALISE the layer's
+                  [S, C, Hkv, D] slab per call (measured ~150 us/layer of
+                  pure copy traffic in the multistep loop).
     j:            int32 scalar      current step within the chunk
     window:       optional static sliding window over position prefix + j
-    k/v_scales:   [NB, H_kv, bs] f32 — int8 pages: per-token-head dequant
+    kv_scales:    [NB, 2, H_kv, bs] f32 — int8 pages: per-token-head dequant
                   scales (the side slab stays bf16; only the prefix pages,
                   the dominant stream, are quantized)
 
     Returns [S, H, D]. Reference role: the blocked-flash KV stream fused with
-    the in-flight tokens (``inference/v2/kernels/ragged_ops/blocked_flash``) —
-    the round-4 two-piece lse merge collapsed into one flash stream.
+    the in-flight tokens (``inference/v2/kernels/ragged_ops/blocked_flash``).
     """
     S, H, D = q.shape
-    NB, Hkv, bs, Dk = k_pages.shape
-    S2, Cs, Hkv2, D2 = side_k.shape
-    assert Dk == D and D2 == D and S2 == S and Hkv2 == Hkv
+    NB, two, Hkv, bs, Dk = kv_pages.shape
+    if side_k.ndim == 4:
+        side_k = side_k[None]
+        side_v = side_v[None]
+        layer_idx = 0
+    assert layer_idx is not None, "5D side slabs need layer_idx"
+    Ls, S2, Cs, Hkv2, D2 = side_k.shape
+    assert two == 2 and Dk == D and D2 == D and S2 == S and Hkv2 == Hkv
     assert H % Hkv == 0
     assert D % 128 == 0 and (Cs * Hkv) % 8 == 0, \
         "side-slab kernel needs lane-aligned D and 8-sublane-aligned C*Hkv"
     G = H // Hkv
     MB = block_tables.shape[1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
-    quant = k_scales is not None
+    quant = kv_scales is not None
+    esize = jnp.dtype(kv_pages.dtype).itemsize
     side_vmem = 2 * Cs * Hkv * D * jnp.dtype(side_k.dtype).itemsize
-    P = _pick_pages_per_chunk(bs, Hkv, D, jnp.dtype(k_pages.dtype).itemsize,
-                              MB, reserve_bytes=side_vmem)
+    P = _pick_pages_per_chunk(bs, Hkv, D, esize, MB,
+                              reserve_bytes=side_vmem)
     NC = -(-MB // P)
     assert (bs * Hkv) % 8 == 0
     if quant:
-        assert (Hkv * bs) % 128 == 0, "scale-row DMA needs lane alignment"
+        assert (Hkv * bs) % 128 == 0, "scale tiles need lane alignment"
+    r8 = _scale_tile_rows(Hkv, bs)
+
+    # SB-batched grid: the sequential decode grid is bound by per-grid-step
+    # overhead (see _sidebuf_batched_body); pick the largest SB dividing S
+    # whose 2-slot kv slabs PLUS the pipeline's double-buffered side blocks
+    # (K + V, x2 buffers, xSB sequences) fit the VMEM budget
+    import os
+    budget = int(os.environ.get("DSTPU_PAGED_VMEM_BUDGET",
+                                8 * 1024 * 1024))
+    side_block = 2 * Cs * Hkv * D * jnp.dtype(side_k.dtype).itemsize
+    SB = 1
+    for cand in (8, 4, 2):
+        slab = 2 * cand * P * 2 * Hkv * bs * D * esize
+        slab += 2 * cand * side_block          # (1, SB, Cs*Hkv, D) x2 bufs x k/v
+        if quant:
+            slab += 2 * cand * P * r8 * 128 * 4
+        if S % cand == 0 and slab <= budget:
+            SB = cand
+            break
+
+    operands = [block_tables.astype(jnp.int32), prefix_lens.astype(jnp.int32),
+                jnp.asarray(j, jnp.int32).reshape(1),
+                jnp.asarray(layer_idx, jnp.int32).reshape(1), q,
+                side_k.reshape(Ls, S, Cs * Hkv, D),
+                side_v.reshape(Ls, S, Cs * Hkv, D),
+                _kv_flat(kv_pages)]
+    if SB > 1:
+        kernel = functools.partial(
+            _sidebuf_batched_kernel_quant if quant
+            else _sidebuf_batched_kernel,
+            scale=scale, block_size=bs, pages_per_chunk=P, n_chunks=NC,
+            max_blocks=MB, n_seqs=S, h_kv=Hkv, groups=G, window=window,
+            n_side=Cs, batch_seqs=SB)
+        in_specs = [
+            pl.BlockSpec((SB, H, D), lambda s, c, bt, cl, jj, ll: (s, 0, 0)),
+            pl.BlockSpec((1, SB, Cs * Hkv, D),
+                         lambda s, c, bt, cl, jj, ll: (ll[0], s, 0, 0)),
+            pl.BlockSpec((1, SB, Cs * Hkv, D),
+                         lambda s, c, bt, cl, jj, ll: (ll[0], s, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ]
+        scratch = [pltpu.VMEM((2, SB, P, 2 * Hkv * bs, D), kv_pages.dtype)]
+        if quant:
+            in_specs += [pl.BlockSpec(memory_space=pl.ANY)]
+            scratch += [pltpu.VMEM((2, SB, P, r8, 128), jnp.float32)]
+            operands += [_scales_to_tiles(kv_scales)]
+        scratch += [
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((SB, H, D), jnp.float32),
+            pltpu.VMEM((SB, H, 128), jnp.float32),
+            pltpu.VMEM((SB, H, 128), jnp.float32),
+        ]
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(S // SB, NC),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((SB, H, D),
+                                   lambda s, c, bt, cl, jj, ll: (s, 0, 0)),
+            scratch_shapes=scratch,
+        )
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((S, H, D), q.dtype),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary", "arbitrary")),
+            interpret=_interpret(),
+        )(*operands)
 
     kernel = functools.partial(
         _decode_kernel_sidebuf_quant if quant else _decode_kernel_sidebuf,
@@ -514,29 +835,18 @@ def paged_decode_attention_sidebuf(q: jax.Array,
         pages_per_chunk=P, n_chunks=NC, max_blocks=MB, n_seqs=S, h_kv=Hkv,
         groups=G, window=window, n_side=Cs)
     in_specs = [
-        pl.BlockSpec((1, H, D), lambda s, c, bt, cl, jj: (s, 0, 0)),
-        pl.BlockSpec((1, Cs * Hkv, D), lambda s, c, bt, cl, jj: (s, 0, 0)),
-        pl.BlockSpec((1, Cs * Hkv, D), lambda s, c, bt, cl, jj: (s, 0, 0)),
-        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec((1, H, D), lambda s, c, bt, cl, jj, ll: (s, 0, 0)),
+        pl.BlockSpec((1, 1, Cs * Hkv, D),
+                     lambda s, c, bt, cl, jj, ll: (ll[0], s, 0, 0)),
+        pl.BlockSpec((1, 1, Cs * Hkv, D),
+                     lambda s, c, bt, cl, jj, ll: (ll[0], s, 0, 0)),
         pl.BlockSpec(memory_space=pl.ANY),
     ]
-    scratch = [
-        pltpu.VMEM((2, P, Hkv * bs, D), k_pages.dtype),
-        pltpu.VMEM((2, P, Hkv * bs, D), v_pages.dtype),
-    ]
-    operands = [block_tables.astype(jnp.int32), prefix_lens.astype(jnp.int32),
-                jnp.asarray(j, jnp.int32).reshape(1), q,
-                side_k.reshape(S, Cs * Hkv, D), side_v.reshape(S, Cs * Hkv, D),
-                k_pages.reshape(NB, Hkv * bs, D),
-                v_pages.reshape(NB, Hkv * bs, D)]
+    scratch = [pltpu.VMEM((2, P, 2 * Hkv * bs, D), kv_pages.dtype)]
     if quant:
-        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
-                     pl.BlockSpec(memory_space=pl.ANY)]
-        r8 = _scale_tile_rows(Hkv, bs)
-        scratch += [pltpu.VMEM((2, P, r8, 128), jnp.float32),
-                    pltpu.VMEM((2, P, r8, 128), jnp.float32)]
-        operands += [_scales_to_tiles(k_scales, NB, Hkv, bs),
-                     _scales_to_tiles(v_scales, NB, Hkv, bs)]
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY)]
+        scratch += [pltpu.VMEM((2, P, r8, 128), jnp.float32)]
+        operands += [_scales_to_tiles(kv_scales)]
     scratch += [
         pltpu.SemaphoreType.DMA((2,)),
         pltpu.VMEM((H, D), jnp.float32),
@@ -544,10 +854,11 @@ def paged_decode_attention_sidebuf(q: jax.Array,
         pltpu.VMEM((H, 128), jnp.float32),
     ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(S, NC),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, H, D), lambda s, c, bt, cl, jj: (s, 0, 0)),
+        out_specs=pl.BlockSpec((1, H, D),
+                               lambda s, c, bt, cl, jj, ll: (s, 0, 0)),
         scratch_shapes=scratch,
     )
     return pl.pallas_call(
@@ -560,77 +871,7 @@ def paged_decode_attention_sidebuf(q: jax.Array,
     )(*operands)
 
 
-def paged_decode_attention_sidebuf_reference(q, k_pages, v_pages, block_tables,
-                                             prefix_lens, side_k, side_v, j,
-                                             softmax_scale=None, window=None):
-    """jnp reference: paged prefix piece (with lse) merged with dense masked
-    attention over the side slab — the exact round-4 two-piece computation
-    the fused kernel replaces."""
-    S, H, D = q.shape
-    _, Cs, Hkv, _ = side_k.shape
-    G = H // Hkv
-    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
-    if window is not None:
-        # page piece window start moves with the in-chunk step j
-        eff_ctx = prefix_lens + j + 1
-        out_p, lse_p = _paged_reference_lse_lo(
-            q, k_pages, v_pages, block_tables, prefix_lens,
-            jnp.maximum(eff_ctx - window, 0), scale)
-    else:
-        out_p, lse_p = paged_decode_attention_reference(
-            q, k_pages, v_pages, block_tables, prefix_lens, scale,
-            with_lse=True)
-    qg = q.reshape(S, Hkv, G, D).astype(jnp.float32)
-    sc = jnp.einsum("shgd,schd->shgc", qg,
-                    side_k.astype(jnp.float32)) * scale
-    col_ok = (jnp.arange(Cs) <= j)[None, None, None, :]
-    if window is not None:
-        col_ok = jnp.logical_and(col_ok,
-                                 (jnp.arange(Cs) >= j + 1 - window)
-                                 [None, None, None, :])
-    sc = jnp.where(col_ok, sc, NEG_INF)
-    m_s = jnp.max(sc, axis=-1, keepdims=True)
-    p = jnp.where(col_ok, jnp.exp(sc - m_s), 0.0)
-    l_s = jnp.sum(p, axis=-1, keepdims=True)
-    out_s = jnp.einsum("shgc,schd->shgd", p,
-                       side_v.astype(jnp.float32)) / jnp.maximum(l_s, 1e-30)
-    lse_s = (m_s + jnp.log(jnp.maximum(l_s, 1e-30)))[..., 0]
-    lse_pg = lse_p.reshape(S, Hkv, G)
-    m_tot = jnp.maximum(lse_pg, lse_s)
-    w_p = jnp.exp(lse_pg - m_tot)[..., None]
-    w_s = jnp.exp(lse_s - m_tot)[..., None]
-    out = (w_p * out_p.reshape(S, Hkv, G, D).astype(jnp.float32)
-           + w_s * out_s) / (w_p + w_s)
-    return out.reshape(S, H, D).astype(q.dtype)
-
-
-def _paged_reference_lse_lo(q, k_pages, v_pages, block_tables, ctx_lens,
-                            tok_lo, scale):
-    """Dense paged reference with a per-sequence lower bound on visible
-    tokens (side-slab window reference support)."""
-    S, H, D = q.shape
-    NB, Hkv, bs, _ = k_pages.shape
-    G = H // Hkv
-    MB = block_tables.shape[1]
-    k_seq = jnp.moveaxis(k_pages[block_tables], 2, 3).reshape(S, MB * bs, Hkv, D)
-    v_seq = jnp.moveaxis(v_pages[block_tables], 2, 3).reshape(S, MB * bs, Hkv, D)
-    k_seq = jnp.repeat(k_seq, G, axis=2)
-    v_seq = jnp.repeat(v_seq, G, axis=2)
-    sc = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),
-                    k_seq.astype(jnp.float32)) * scale
-    pos = jnp.arange(MB * bs)[None, None, :]
-    mask = (pos < ctx_lens[:, None, None]) & (pos >= tok_lo[:, None, None])
-    sc = jnp.where(mask, sc, NEG_INF)
-    any_row = jnp.any(mask, axis=-1)
-    p = jax.nn.softmax(sc, axis=-1)
-    p = jnp.where(any_row[:, :, None], p, 0.0)
-    out = jnp.einsum("sht,sthd->shd", p, v_seq.astype(jnp.float32))
-    lse = jax.scipy.special.logsumexp(sc, axis=-1)
-    lse = jnp.where(any_row, lse, NEG_INF)
-    return out.astype(q.dtype), lse
-
-
-def _decode_kernel_smalld(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
+def _decode_kernel_smalld(bt_ref, cl_ref, q_ref, kv_ref, o_ref,
                           acc_sc, m_sc, l_sc, *, scale, block_size,
                           max_blocks, h_kv, groups, window=None):
     """BlockSpec-pipelined fallback for head dims the manual-DMA path can't
@@ -659,8 +900,8 @@ def _decode_kernel_smalld(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
         for h in range(h_kv):
             rows = slice(h * groups, (h + 1) * groups)
             qh = q[rows, :]                                    # [G, D]
-            kh = k_ref[0, h].astype(jnp.float32)               # [bs, D]
-            vh = v_ref[0, h].astype(jnp.float32)
+            kh = kv_ref[0, 0, h].astype(jnp.float32)           # [bs, D]
+            vh = kv_ref[0, 1, h].astype(jnp.float32)
             sc = jax.lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32) * scale
             mh = mask[rows, :]
@@ -683,10 +924,10 @@ def _decode_kernel_smalld(bt_ref, cl_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0] = (acc_sc[:] / safe_l).astype(o_ref.dtype)
 
 
-def _paged_decode_smalld(q, k_pages, v_pages, block_tables, ctx_lens, scale,
+def _paged_decode_smalld(q, kv_pages, block_tables, ctx_lens, scale,
                          window=None):
     S, H, D = q.shape
-    NB, Hkv, bs, _ = k_pages.shape
+    NB, _, Hkv, bs, _ = kv_pages.shape
     G = H // Hkv
     MB = block_tables.shape[1]
     kernel = functools.partial(_decode_kernel_smalld, scale=scale,
@@ -697,8 +938,8 @@ def _paged_decode_smalld(q, k_pages, v_pages, block_tables, ctx_lens, scale,
         grid=(S, MB),
         in_specs=[
             pl.BlockSpec((1, H, D), lambda s, i, bt, cl: (s, 0, 0)),
-            pl.BlockSpec((1, Hkv, bs, D), lambda s, i, bt, cl: (bt[s, i], 0, 0, 0)),
-            pl.BlockSpec((1, Hkv, bs, D), lambda s, i, bt, cl: (bt[s, i], 0, 0, 0)),
+            pl.BlockSpec((1, 2, Hkv, bs, D),
+                         lambda s, i, bt, cl: (bt[s, i], 0, 0, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, H, D), lambda s, i, bt, cl: (s, 0, 0)),
         scratch_shapes=[
@@ -715,57 +956,51 @@ def _paged_decode_smalld(q, k_pages, v_pages, block_tables, ctx_lens, scale,
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
     )(block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32),
-      q, k_pages, v_pages)
+      q, kv_pages)
 
 
 def paged_decode_attention(q: jax.Array,
-                           k_pages: jax.Array,
-                           v_pages: jax.Array,
+                           kv_pages: jax.Array,
                            block_tables: jax.Array,
                            ctx_lens: jax.Array,
                            softmax_scale: Optional[float] = None,
                            window: Optional[int] = None,
                            with_lse: bool = False,
-                           k_scales: Optional[jax.Array] = None,
-                           v_scales: Optional[jax.Array] = None):
+                           kv_scales: Optional[jax.Array] = None):
     """Single-token-per-sequence attention over a paged KV cache.
 
     q:            [S, H, D]        one query token per sequence
-    k_pages:      [NB, H_kv, bs, D] (head-major pages; see module docstring)
-    v_pages:      [NB, H_kv, bs, D]
+    kv_pages:     [NB, 2, H_kv, bs, D] combined head-major pages (K=0, V=1)
     block_tables: [S, MB] int32    physical page ids per sequence (0-padded)
     ctx_lens:     [S] int32        tokens visible per sequence (incl. current)
     window:       optional static sliding-window span (Mistral-style): only
                   tokens >= ctx - window are attended or read.
     with_lse:     also return lse [S, H] f32 (m + log l; NEG_INF for empty
-                  rows) — the hook for merging with a second attention piece
-                  (the fused multistep side-buffer path).
-    k/v_scales:   [NB, H_kv, bs] f32 — int8 pages: per-token-head dequant
-                  scales, streamed per page and folded into the dots
-                  in-kernel (reference role: the int8 KV tier of
-                  ZeRO-Inference, README.md:23, on the blocked-flash path).
+                  rows) — the hook for merging with a second attention piece.
+    kv_scales:    [NB, 2, H_kv, bs] f32 — int8 pages (see module docstring).
 
     Returns [S, H, D] (plus lse when requested). Rows whose ctx_len is 0
     return zeros.
     """
     S, H, D = q.shape
-    NB, Hkv, bs, Dk = k_pages.shape
-    assert Dk == D, (Dk, D)
+    NB, two, Hkv, bs, Dk = kv_pages.shape
+    assert two == 2 and Dk == D, (kv_pages.shape, D)
     assert H % Hkv == 0, f"GQA: {H} q heads not divisible by {Hkv} kv heads"
     G = H // Hkv
     MB = block_tables.shape[1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
-    quant = k_scales is not None
+    quant = kv_scales is not None
     if D % 128 != 0:   # manual-DMA lane-alignment limit — see _paged_decode_smalld
         assert not with_lse, "with_lse needs the manual-DMA path (D % 128 == 0)"
         assert not quant, "int8 pages need the manual-DMA path (D % 128 == 0)"
-        return _paged_decode_smalld(q, k_pages, v_pages, block_tables,
+        return _paged_decode_smalld(q, kv_pages, block_tables,
                                     ctx_lens, scale, window=window)
-    P = _pick_pages_per_chunk(bs, Hkv, D, jnp.dtype(k_pages.dtype).itemsize, MB)
+    P = _pick_pages_per_chunk(bs, Hkv, D, jnp.dtype(kv_pages.dtype).itemsize,
+                              MB)
     NC = -(-MB // P)
     if quant:
         assert not with_lse, "with_lse + int8 pages not needed by any caller"
-        assert (Hkv * bs) % 128 == 0, "scale-row DMA needs lane alignment"
+        assert (Hkv * bs) % 128 == 0, "scale tiles need lane alignment"
 
     kernel = functools.partial(
         _decode_kernel_quant if quant
@@ -783,26 +1018,16 @@ def paged_decode_attention(q: jax.Array,
         out_shape = [out_shape, jax.ShapeDtypeStruct((S, H, 128), jnp.float32)]
     in_specs = [
         pl.BlockSpec((1, H, D), lambda s, c, bt, cl: (s, 0, 0)),
-        pl.BlockSpec(memory_space=pl.ANY),     # K pages stay in HBM;
-        pl.BlockSpec(memory_space=pl.ANY),     # chunks stream via DMA
+        pl.BlockSpec(memory_space=pl.ANY),     # pages stay in HBM;
     ]
-    scratch = [
-        # pages flattened to [Hkv*bs, D] rows — (bs, D) trailing tiles,
-        # aligned for any head count
-        pltpu.VMEM((2, P, Hkv * bs, D), k_pages.dtype),
-        pltpu.VMEM((2, P, Hkv * bs, D), v_pages.dtype),
-    ]
+    scratch = [pltpu.VMEM((2, P, 2 * Hkv * bs, D), kv_pages.dtype)]
     operands = [block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32), q,
-                k_pages.reshape(NB, Hkv * bs, D),
-                v_pages.reshape(NB, Hkv * bs, D)]
+                _kv_flat(kv_pages)]
     if quant:
-        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
-                     pl.BlockSpec(memory_space=pl.ANY)]
         r8 = _scale_tile_rows(Hkv, bs)
-        scratch += [pltpu.VMEM((2, P, r8, 128), jnp.float32),
-                    pltpu.VMEM((2, P, r8, 128), jnp.float32)]
-        operands += [_scales_to_tiles(k_scales, NB, Hkv, bs),
-                     _scales_to_tiles(v_scales, NB, Hkv, bs)]
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY)]
+        scratch += [pltpu.VMEM((2, P, r8, 128), jnp.float32)]
+        operands += [_scales_to_tiles(kv_scales)]
     scratch += [
         pltpu.SemaphoreType.DMA((2,)),
         pltpu.VMEM((H, D), jnp.float32),
@@ -834,11 +1059,11 @@ def paged_decode_attention(q: jax.Array,
 
 
 def _decode_step_kernel(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
-                        k_hbm, v_hbm, o_ref, kout_ref, vout_ref,
-                        k_buf, v_buf, sems, acc_sc, m_sc, l_sc, **kw):
+                        kv_hbm, o_ref, kvout_ref,
+                        kv_buf, sems, acc_sc, m_sc, l_sc, **kw):
     """Decode STEP attention: the shared body in step mode — paged flash over
     the PRIOR context (pages hold tokens [0, ctx-1)) + the current token's
-    term inline from the k_new/v_new operands; the pools pass through
+    term inline from the k_new/v_new operands; the pool passes through
     untouched, aliased input -> output.
 
     Why this shape: the current token's K/V must both enter attention AND
@@ -854,83 +1079,90 @@ def _decode_step_kernel(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
     copied.
 
     ``cl_ref[s]`` counts tokens INCLUDING the current one."""
-    del kout_ref, vout_ref  # aliased pass-throughs; written by the caller
-    _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref, k_hbm, v_hbm,
-                 o_ref, k_buf, v_buf, sems, acc_sc, m_sc, l_sc, **kw)
+    del kvout_ref  # aliased pass-through; written by the caller
+    _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref, kv_hbm,
+                 o_ref, kv_buf, sems, acc_sc, m_sc, l_sc, **kw)
 
 
 def _decode_step_kernel_quant(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
-                              k_hbm, v_hbm, ks_hbm, vs_hbm,
-                              o_ref, kout_ref, vout_ref,
-                              k_buf, v_buf, ks_buf, vs_buf, sems,
+                              kv_hbm, sc_hbm,
+                              o_ref, kvout_ref,
+                              kv_buf, sc_buf, sems,
                               acc_sc, m_sc, l_sc, **kw):
-    # value pools alias through (caller-side scatter); scale TILES are
+    # value pool aliases through (caller-side scatter); scale TILES are
     # read-only inputs — they are a fresh pad/reshape copy of the at-rest
-    # scale pools, so the caller's scale scatter needs no aliasing or
+    # scale pool, so the caller's scale scatter needs no aliasing or
     # ordering against this kernel
-    del kout_ref, vout_ref
-    _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref, k_hbm, v_hbm,
-                 o_ref, k_buf, v_buf, sems, acc_sc, m_sc, l_sc,
-                 ks_hbm=ks_hbm, vs_hbm=vs_hbm, ks_buf=ks_buf, vs_buf=vs_buf,
-                 **kw)
+    del kvout_ref
+    _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref, kv_hbm,
+                 o_ref, kv_buf, sems, acc_sc, m_sc, l_sc,
+                 sc_hbm=sc_hbm, sc_buf=sc_buf, **kw)
+
+
+def _step_write_rows(block_tables, ctx_lens, NB, Hkv, bs, S):
+    """Flat head-major row destinations of the current token's K and V rows
+    in the combined pool [NB*2*Hkv*bs, D]: K row ((page*2 + 0)*Hkv + h)*bs
+    + slot, V row ((page*2 + 1)*Hkv + h)*bs + slot; ctx 0 -> OOB drop."""
+    pv = jnp.maximum(ctx_lens - 1, 0)
+    page_w = block_tables[jnp.arange(S), pv // bs]
+    h = jnp.arange(Hkv)[None, :]
+    slot = (pv % bs)[:, None]
+    k_rows = ((page_w[:, None] * 2 + 0) * Hkv + h) * bs + slot   # [S, Hkv]
+    v_rows = ((page_w[:, None] * 2 + 1) * Hkv + h) * bs + slot
+    oob = NB * 2 * Hkv * bs
+    valid = ctx_lens[:, None] > 0
+    k_rows = jnp.where(valid, k_rows, oob)
+    v_rows = jnp.where(valid, v_rows, oob)
+    return jnp.concatenate([k_rows.reshape(-1), v_rows.reshape(-1)])
 
 
 def paged_decode_attention_step(q: jax.Array,
                                 k_new: jax.Array,
                                 v_new: jax.Array,
-                                k_pages: jax.Array,
-                                v_pages: jax.Array,
+                                kv_pages: jax.Array,
                                 block_tables: jax.Array,
                                 ctx_lens: jax.Array,
                                 softmax_scale: Optional[float] = None,
                                 window: Optional[int] = None,
-                                k_scales: Optional[jax.Array] = None,
-                                v_scales: Optional[jax.Array] = None):
+                                kv_scales: Optional[jax.Array] = None):
     """One fused decode step per sequence: write ``k_new/v_new`` (the current
     token's K/V, position ``ctx_lens - 1``) into the paged cache AND return
     attention over the full context including the current token (with
     ``window``, over the trailing ``window`` tokens only).
 
     q:            [S, H, D]       k_new/v_new: [S, H_kv, D]
-    k/v_pages:    [NB, H_kv, bs, D] — ALIASED: the returned pools reuse the
-                  input buffers (donate them at the jit boundary)
+    kv_pages:     [NB, 2, H_kv, bs, D] — ALIASED: the returned pool reuses
+                  the input buffer (donate it at the jit boundary)
     block_tables: [S, MB] int32   ctx_lens: [S] int32 (INCLUDING current)
-    k/v_scales:   [NB, H_kv, bs] f32 — int8 pages: per-token-head dequant
-                  scales; ALIASED through like the pools, the new token's
-                  rows quantized and scattered by the same post-kernel path.
+    kv_scales:    [NB, 2, H_kv, bs] f32 — int8 pages; the new token's rows
+                  quantize and scatter into the returned scale pool.
 
-    Returns ``(out [S, H, D], k_pages, v_pages)`` — with scales,
-    ``(out, k_pages, v_pages, k_scales, v_scales)``. ctx_lens == 0 rows
-    write nothing and return zeros.
+    Returns ``(out [S, H, D], kv_pages)`` — with scales,
+    ``(out, kv_pages, kv_scales)``. ctx_lens == 0 rows write nothing and
+    return zeros.
     """
     S, H, D = q.shape
-    NB, Hkv, bs, Dk = k_pages.shape
-    assert Dk == D and H % Hkv == 0
+    NB, two, Hkv, bs, Dk = kv_pages.shape
+    assert two == 2 and Dk == D and H % Hkv == 0
     G = H // Hkv
     MB = block_tables.shape[1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
-    quant = k_scales is not None
+    quant = kv_scales is not None
     if quant:
         assert D % 128 == 0 and (Hkv * bs) % 128 == 0
     if D % 128 != 0:
         # small-D fallback: scatter first (pools here are small), then the
         # BlockSpec-pipelined kernel over the full context
-        pv0 = jnp.maximum(ctx_lens - 1, 0)
-        page_w0 = block_tables[jnp.arange(S), pv0 // bs]
-        dest0 = ((page_w0[:, None] * Hkv + jnp.arange(Hkv)[None, :]) * bs
-                 + (pv0 % bs)[:, None])
-        dest0 = jnp.where(ctx_lens[:, None] > 0, dest0,
-                          NB * Hkv * bs).reshape(-1)
-        kf = k_pages.reshape(NB * Hkv * bs, D).at[dest0].set(
-            k_new.reshape(S * Hkv, D).astype(k_pages.dtype), mode="drop")
-        vf = v_pages.reshape(NB * Hkv * bs, D).at[dest0].set(
-            v_new.reshape(S * Hkv, D).astype(v_pages.dtype), mode="drop")
-        kf = kf.reshape(NB, Hkv, bs, D)
-        vf = vf.reshape(NB, Hkv, bs, D)
-        out = _paged_decode_smalld(q, kf, vf, block_tables, ctx_lens, scale,
+        rows = _step_write_rows(block_tables, ctx_lens, NB, Hkv, bs, S)
+        new = jnp.concatenate([k_new.reshape(S * Hkv, D),
+                               v_new.reshape(S * Hkv, D)])
+        kvf = kv_pages.reshape(NB * 2 * Hkv * bs, D).at[rows].set(
+            new.astype(kv_pages.dtype), mode="drop").reshape(kv_pages.shape)
+        out = _paged_decode_smalld(q, kvf, block_tables, ctx_lens, scale,
                                    window=window)
-        return out, kf, vf
-    P = _pick_pages_per_chunk(bs, Hkv, D, jnp.dtype(k_pages.dtype).itemsize, MB)
+        return out, kvf
+    P = _pick_pages_per_chunk(bs, Hkv, D, jnp.dtype(kv_pages.dtype).itemsize,
+                              MB)
     NC = -(-MB // P)
     assert (bs * Hkv) % 8 == 0
 
@@ -939,39 +1171,31 @@ def paged_decode_attention_step(q: jax.Array,
         scale=scale, block_size=bs, pages_per_chunk=P,
         n_chunks=NC, max_blocks=MB, n_seqs=S, h_kv=Hkv, groups=G,
         window=window)
-    flat = (NB, Hkv * bs, D)
+    flat = (NB, 2 * Hkv * bs, D)
     in_specs = [
         pl.BlockSpec((1, H, D), lambda s, c, bt, cl: (s, 0, 0)),
         pl.BlockSpec((1, Hkv, D), lambda s, c, bt, cl: (s, 0, 0)),
         pl.BlockSpec((1, Hkv, D), lambda s, c, bt, cl: (s, 0, 0)),
         pl.BlockSpec(memory_space=pl.ANY),
-        pl.BlockSpec(memory_space=pl.ANY),
     ]
     out_specs = [
         pl.BlockSpec((1, H, D), lambda s, c, bt, cl: (s, 0, 0)),
         pl.BlockSpec(memory_space=pl.ANY),
-        pl.BlockSpec(memory_space=pl.ANY),
     ]
     out_shape = [jax.ShapeDtypeStruct((S, H, D), q.dtype),
-                 jax.ShapeDtypeStruct(flat, k_pages.dtype),
-                 jax.ShapeDtypeStruct(flat, v_pages.dtype)]
-    scratch = [
-        pltpu.VMEM((2, P, Hkv * bs, D), k_pages.dtype),
-        pltpu.VMEM((2, P, Hkv * bs, D), v_pages.dtype),
-    ]
+                 jax.ShapeDtypeStruct(flat, kv_pages.dtype)]
+    scratch = [pltpu.VMEM((2, P, 2 * Hkv * bs, D), kv_pages.dtype)]
     operands = [block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32),
-                q, k_new, v_new, k_pages.reshape(flat), v_pages.reshape(flat)]
-    # call args: (bt, cl, q, k_new, v_new, k_pool, v_pool[, ks, vs]) ->
-    # value pools alias input -> output; scale tiles are read-only copies
-    aliases = {5: 1, 6: 2}
+                q, k_new, v_new, _kv_flat(kv_pages)]
+    # call args: (bt, cl, q, k_new, v_new, kv_pool[, scale_tiles]) ->
+    # the value pool aliases input -> output; scale tiles are a read-only
+    # converted copy
+    aliases = {5: 1}
     if quant:
-        in_specs += [pl.BlockSpec(memory_space=pl.ANY),
-                     pl.BlockSpec(memory_space=pl.ANY)]
         r8 = _scale_tile_rows(Hkv, bs)
-        scratch += [pltpu.VMEM((2, P, r8, 128), jnp.float32),
-                    pltpu.VMEM((2, P, r8, 128), jnp.float32)]
-        operands += [_scales_to_tiles(k_scales, NB, Hkv, bs),
-                     _scales_to_tiles(v_scales, NB, Hkv, bs)]
+        in_specs += [pl.BlockSpec(memory_space=pl.ANY)]
+        scratch += [pltpu.VMEM((2, P, r8, 128), jnp.float32)]
+        operands += [_scales_to_tiles(kv_scales)]
     scratch += [
         pltpu.SemaphoreType.DMA((2,)),
         pltpu.VMEM((H, D), jnp.float32),
@@ -994,63 +1218,41 @@ def paged_decode_attention_step(q: jax.Array,
             dimension_semantics=("arbitrary", "arbitrary")),
         interpret=_interpret(),
     )(*operands)
-    out, kf, vf = res[0], res[1], res[2]
+    out, kvf = res[0], res[1]
     # the write happens HERE, after the kernel: a canonical in-place scatter
-    # on the aliased-through pool (see _decode_step_kernel docstring).
-    # Head-major flat rows: row of (page, head, slot) = (page*Hkv + h)*bs + slot.
-    pv = jnp.maximum(ctx_lens - 1, 0)
-    page_w = block_tables[jnp.arange(S), pv // bs]
-    dest = ((page_w[:, None] * Hkv + jnp.arange(Hkv)[None, :]) * bs
-            + (pv % bs)[:, None])                              # [S, Hkv]
-    dest = jnp.where(ctx_lens[:, None] > 0, dest, NB * Hkv * bs).reshape(-1)
+    # on the aliased-through pool (see _decode_step_kernel docstring)
+    rows = _step_write_rows(block_tables, ctx_lens, NB, Hkv, bs, S)
     if quant:
-        kq, ks_new = kv_quantize_rows(k_new)                   # [S, Hkv, D]/[S, Hkv]
+        kq, ks_new = kv_quantize_rows(k_new)                   # [S,Hkv,D]/[S,Hkv]
         vq, vs_new = kv_quantize_rows(v_new)
-        kf = kf.reshape(NB * Hkv * bs, D).at[dest].set(
-            kq.reshape(S * Hkv, D), mode="drop")
-        vf = vf.reshape(NB * Hkv * bs, D).at[dest].set(
-            vq.reshape(S * Hkv, D), mode="drop")
-        # scale scatter targets the AT-REST pools (the kernel read a tile
-        # copy, so this is an ordinary in-place scatter)
-        ksf = k_scales.reshape(NB * Hkv * bs).at[dest].set(
-            ks_new.reshape(-1), mode="drop")
-        vsf = v_scales.reshape(NB * Hkv * bs).at[dest].set(
-            vs_new.reshape(-1), mode="drop")
-        return (out, kf.reshape(NB, Hkv, bs, D), vf.reshape(NB, Hkv, bs, D),
-                ksf.reshape(NB, Hkv, bs), vsf.reshape(NB, Hkv, bs))
-    kf = kf.reshape(NB * Hkv * bs, D).at[dest].set(
-        k_new.reshape(S * Hkv, D).astype(kf.dtype), mode="drop")
-    vf = vf.reshape(NB * Hkv * bs, D).at[dest].set(
-        v_new.reshape(S * Hkv, D).astype(vf.dtype), mode="drop")
-    return (out, kf.reshape(NB, Hkv, bs, D), vf.reshape(NB, Hkv, bs, D))
-
-
-def paged_decode_attention_step_reference(q, k_new, v_new, k_pages, v_pages,
-                                          block_tables, ctx_lens,
-                                          softmax_scale: Optional[float] = None,
-                                          window: Optional[int] = None):
-    """jnp reference: scatter the new rows, then dense paged-decode reference."""
-    S, H, D = q.shape
-    NB, Hkv, bs, _ = k_pages.shape
-    pv = jnp.maximum(ctx_lens - 1, 0)
-    page_w = block_tables[jnp.arange(S), pv // bs]
-    dest = ((page_w[:, None] * Hkv + jnp.arange(Hkv)[None, :]) * bs
-            + (pv % bs)[:, None])
-    dest = jnp.where(ctx_lens[:, None] > 0, dest, NB * Hkv * bs).reshape(-1)
-    kf = k_pages.reshape(NB * Hkv * bs, D).at[dest].set(
-        k_new.reshape(S * Hkv, D).astype(k_pages.dtype),
-        mode="drop").reshape(NB, Hkv, bs, D)
-    vf = v_pages.reshape(NB * Hkv * bs, D).at[dest].set(
-        v_new.reshape(S * Hkv, D).astype(v_pages.dtype),
-        mode="drop").reshape(NB, Hkv, bs, D)
-    out = paged_decode_attention_reference(q, kf, vf, block_tables, ctx_lens,
-                                           softmax_scale, window=window)
-    return out, kf, vf
+        new = jnp.concatenate([kq.reshape(S * Hkv, D),
+                               vq.reshape(S * Hkv, D)])
+        kvf = kvf.reshape(NB * 2 * Hkv * bs, D).at[rows].set(
+            new, mode="drop")
+        # scale scatter targets the AT-REST pool in its own layout (the
+        # kernel read tiles, so this is an ordinary in-place scatter)
+        news = jnp.concatenate([ks_new.reshape(-1), vs_new.reshape(-1)])
+        if kv_scales.ndim == 3:                # tiled at rest [NB, R8, 128]
+            r8 = _scale_tile_rows(Hkv, bs)
+            hb2 = 2 * Hkv * bs
+            sdest = (rows // hb2) * (r8 * 128) + rows % hb2
+            scf = kv_scales.reshape(NB * r8 * 128).at[sdest].set(
+                news, mode="drop")
+            return (out, kvf.reshape(NB, 2, Hkv, bs, D),
+                    scf.reshape(NB, r8, 128))
+        scf = kv_scales.reshape(NB * 2 * Hkv * bs).at[rows].set(
+            news, mode="drop")
+        return (out, kvf.reshape(NB, 2, Hkv, bs, D),
+                scf.reshape(NB, 2, Hkv, bs))
+    new = jnp.concatenate([k_new.reshape(S * Hkv, D),
+                           v_new.reshape(S * Hkv, D)])
+    kvf = kvf.reshape(NB * 2 * Hkv * bs, D).at[rows].set(
+        new.astype(kvf.dtype), mode="drop")
+    return (out, kvf.reshape(NB, 2, Hkv, bs, D))
 
 
 def paged_chunk_attention(q: jax.Array,
-                          k_pages: jax.Array,
-                          v_pages: jax.Array,
+                          kv_pages: jax.Array,
                           block_table: jax.Array,
                           q_start,
                           ctx_len,
@@ -1064,7 +1266,7 @@ def paged_chunk_attention(q: jax.Array,
     softmax fix lands in both paths by construction).
 
     q:           [C, H, D]
-    k/v_pages:   [NB, H_kv, bs, D] (head-major pages)
+    kv_pages:    [NB, 2, H_kv, bs, D] (combined head-major pages)
     block_table: [MB] int32
     q_start:     int32 — absolute position of q row 0
     ctx_len:     int32 — KV tokens visible in total (= q_start + C for prefill)
@@ -1073,35 +1275,47 @@ def paged_chunk_attention(q: jax.Array,
     ignores them); with ctx_len == 0 the output is zeros.
     """
     return paged_chunk_attention_batched(
-        q[None], k_pages, v_pages, jnp.asarray(block_table)[None],
+        q[None], kv_pages, jnp.asarray(block_table)[None],
         jnp.asarray(q_start, jnp.int32)[None],
         jnp.asarray(ctx_len, jnp.int32)[None],
         softmax_scale=softmax_scale, block_q=block_q, window=window)[0]
 
 
-def _apply_scale_rows(mat, s_ref, h, bs):
-    """Multiply ``mat`` [rows, bs] by head h's per-token dequant scales read
-    from a page scale tile ref [1, R8, 128] — one aligned 128-lane piece at
-    a time (the tile's lane rows map 1:1 onto token sub-blocks)."""
-    pieces = []
-    for t0 in range(bs // 128):
-        row = (h * bs) // 128 + t0
-        pieces.append(mat[:, t0 * 128:(t0 + 1) * 128]
-                      * s_ref[0, row, :][None, :])
-    return jnp.concatenate(pieces, axis=1) if len(pieces) > 1 else pieces[0]
 
 
-def _chunk_kernel_batched(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
+
+def _chunk_head_scale(mat, sc_ref, flat0, bs):
+    """Multiply ``mat`` [rows, bs] by one head's per-token dequant scales,
+    read from a page scale tile ref [1, R8, 128] starting at FLAT scale
+    index ``flat0`` (= kv*Hkv*bs + h*bs). Handles bs that is not itself a
+    multiple of 128: the engine gate requires (Hkv*bs) % 128 == 0, so a
+    head's span either covers whole lane rows (bs >= 128) or shares one
+    lane row with its neighbours at a 128-aligned base (bs < 128), in which
+    case the span is sliced out of that row."""
+    if bs % 128 == 0:
+        pieces = []
+        for t0 in range(bs // 128):
+            row = flat0 // 128 + t0
+            pieces.append(mat[:, t0 * 128:(t0 + 1) * 128]
+                          * sc_ref[0, row, :][None, :])
+        return jnp.concatenate(pieces, axis=1) if len(pieces) > 1 \
+            else pieces[0]
+    row = flat0 // 128
+    lane0 = flat0 % 128
+    return mat * sc_ref[0, row, lane0:lane0 + bs][None, :]
+
+
+def _chunk_kernel_batched(bt_ref, meta_ref, q_ref, kv_ref, o_ref,
                           acc_sc, m_sc, l_sc, *, scale, block_size, block_q,
                           max_blocks, h_kv, groups, window=None,
-                          ks_ref=None, vs_ref=None):
+                          sc_ref=None):
     """Multi-slot variant of ``_chunk_kernel``: grid (slot, q-block, page);
     each slot is an independent prompt chunk with its own block table and
     (q_start, ctx) row in ``meta_ref``. Slot padding (ctx 0) writes zeros.
     With ``window``, row q_pos attends only k_pos > q_pos - window (and
-    pages wholly below the q-block's window skip). ``ks_ref/vs_ref``
-    (int8 pages): the page's per-token-head dequant scales, applied as
-    score-column (K) and p-column (V) multipliers."""
+    pages wholly below the q-block's window skip). ``sc_ref`` (int8 pages):
+    the page's scale tile, applied as score-column (K) and p-column (V)
+    multipliers."""
     sl, iq, i = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     q0 = meta_ref[sl, 0]
     ctx = meta_ref[sl, 1]
@@ -1129,16 +1343,18 @@ def _chunk_kernel_batched(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
             mask = mask & (k_pos > q_pos - window)
         mask = jnp.broadcast_to(mask[:, None, :], (bq, G, bs)).reshape(bq * G, bs)
 
+        nrow = bs // 128
         for h in range(h_kv):
             qh = q[:, h * G:(h + 1) * G, :].reshape(bq * G, -1)
-            kh = k_ref[0, h].astype(jnp.float32)               # [bs, D]
-            vh = v_ref[0, h].astype(jnp.float32)
+            kh = kv_ref[0, 0, h].astype(jnp.float32)           # [bs, D]
+            vh = kv_ref[0, 1, h].astype(jnp.float32)
             sc = jax.lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32) * scale
-            if ks_ref is not None:
-                # scale tiles [1, R8, 128]: head h's bs scales live in lane
-                # rows h*bs/128 .. — multiply per 128-lane piece (aligned)
-                sc = _apply_scale_rows(sc, ks_ref, h, bs)
+            if sc_ref is not None:
+                # K scales for head h start at flat index h*bs in the tile;
+                # the (Hkv*bs) % 128 == 0 gate guarantees 128-alignment of
+                # every head's span even when bs < 128
+                sc = _chunk_head_scale(sc, sc_ref, h * bs, bs)
             sc = jnp.where(mask, sc, NEG_INF)
             rows = slice(h * bq * G, (h + 1) * bq * G)
             m_prev = m_sc[rows, 0:1]
@@ -1148,8 +1364,8 @@ def _chunk_kernel_batched(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
             l_sc[rows, 0:1] = l_sc[rows, 0:1] * alpha + jnp.sum(p, axis=1,
                                                                keepdims=True)
             m_sc[rows, 0:1] = m_new
-            pv = p if vs_ref is None \
-                else _apply_scale_rows(p, vs_ref, h, bs)
+            pv = p if sc_ref is None \
+                else _chunk_head_scale(p, sc_ref, (h_kv + h) * bs, bs)
             acc_sc[rows, :] = acc_sc[rows, :] * alpha + jax.lax.dot_general(
                 pv, vh, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -1165,17 +1381,21 @@ def _chunk_kernel_batched(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
                                                  -1).astype(o_ref.dtype)
 
 
+def _chunk_kernel_batched_quant(bt_ref, meta_ref, q_ref, kv_ref, sc_ref,
+                                o_ref, acc_sc, m_sc, l_sc, **kw):
+    _chunk_kernel_batched(bt_ref, meta_ref, q_ref, kv_ref, o_ref,
+                          acc_sc, m_sc, l_sc, sc_ref=sc_ref, **kw)
+
+
 def paged_chunk_attention_batched(q: jax.Array,
-                                  k_pages: jax.Array,
-                                  v_pages: jax.Array,
+                                  kv_pages: jax.Array,
                                   block_tables: jax.Array,
                                   q_starts: jax.Array,
                                   ctx_lens: jax.Array,
                                   softmax_scale: Optional[float] = None,
                                   block_q: int = 128,
                                   window: Optional[int] = None,
-                                  k_scales: Optional[jax.Array] = None,
-                                  v_scales: Optional[jax.Array] = None
+                                  kv_scales: Optional[jax.Array] = None
                                   ) -> jax.Array:
     """Prefill flash attention for SEVERAL prompt chunks in one kernel.
 
@@ -1184,21 +1404,21 @@ def paged_chunk_attention_batched(q: jax.Array,
     N prompts' chunks prefill in one launch.
 
     q:            [NC, Cs, H, D]  — slot-major chunk rows
-    k/v_pages:    [NB, H_kv, bs, D] (head-major pages)
+    kv_pages:     [NB, 2, H_kv, bs, D] (combined head-major pages)
     block_tables: [NC, MB] int32
     q_starts:     [NC] int32 — absolute position of each slot's row 0
     ctx_lens:     [NC] int32 — KV tokens visible per slot (0 = empty slot)
-    k/v_scales:   [NB, H_kv, bs] f32 — int8 pages (dequant in-kernel)
+    kv_scales:    [NB, 2, H_kv, bs] f32 — int8 pages (dequant in-kernel)
 
     Returns [NC, Cs, H, D]; empty slots return zeros.
     """
     NC, Cs, H, D = q.shape
-    NB, Hkv, bs, _ = k_pages.shape
-    assert H % Hkv == 0
+    NB, two, Hkv, bs, _ = kv_pages.shape
+    assert two == 2 and H % Hkv == 0
     G = H // Hkv
     MB = block_tables.shape[1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
-    quant = k_scales is not None
+    quant = kv_scales is not None
     bq = block_q
     while Cs % bq != 0:
         bq //= 2
@@ -1213,22 +1433,18 @@ def paged_chunk_attention_batched(q: jax.Array,
         h_kv=Hkv, groups=G, window=window)
     in_specs = [
         pl.BlockSpec((1, bq, H, D), lambda sl, iq, i, bt, m: (sl, iq, 0, 0)),
-        pl.BlockSpec((1, Hkv, bs, D),
-                     lambda sl, iq, i, bt, m: (bt[sl, i], 0, 0, 0)),
-        pl.BlockSpec((1, Hkv, bs, D),
-                     lambda sl, iq, i, bt, m: (bt[sl, i], 0, 0, 0)),
+        pl.BlockSpec((1, 2, Hkv, bs, D),
+                     lambda sl, iq, i, bt, m: (bt[sl, i], 0, 0, 0, 0)),
     ]
-    operands = [block_tables.astype(jnp.int32), meta, q, k_pages, v_pages]
+    operands = [block_tables.astype(jnp.int32), meta, q, kv_pages]
     if quant:
+        assert (Hkv * bs) % 128 == 0
         r8 = _scale_tile_rows(Hkv, bs)
         in_specs += [
             pl.BlockSpec((1, r8, 128),
                          lambda sl, iq, i, bt, m: (bt[sl, i], 0, 0)),
-            pl.BlockSpec((1, r8, 128),
-                         lambda sl, iq, i, bt, m: (bt[sl, i], 0, 0)),
         ]
-        operands += [_scales_to_tiles(k_scales, NB, Hkv, bs),
-                     _scales_to_tiles(v_scales, NB, Hkv, bs)]
+        operands += [_scales_to_tiles(kv_scales)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(NC, nq, MB),
@@ -1251,71 +1467,32 @@ def paged_chunk_attention_batched(q: jax.Array,
     )(*operands)
 
 
-def _chunk_kernel_batched_quant(bt_ref, meta_ref, q_ref, k_ref, v_ref,
-                                ks_ref, vs_ref, o_ref, acc_sc, m_sc, l_sc,
-                                **kw):
-    _chunk_kernel_batched(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
-                          acc_sc, m_sc, l_sc, ks_ref=ks_ref, vs_ref=vs_ref,
-                          **kw)
+# --------------------------------------------------------------------------- #
+# jnp references
+# --------------------------------------------------------------------------- #
+
+def _gather_seq(kv_pages, block_tables, G):
+    """[S, MB] tables over combined pages -> per-sequence K/V
+    [S, MB*bs, H, D] (repeated to q heads) — the copy the kernels avoid."""
+    S, MB = block_tables.shape
+    NB, _, Hkv, bs, D = kv_pages.shape
+    pages = kv_pages[block_tables]                 # [S, MB, 2, Hkv, bs, D]
+    k_seq = jnp.moveaxis(pages[:, :, 0], 2, 3).reshape(S, MB * bs, Hkv, D)
+    v_seq = jnp.moveaxis(pages[:, :, 1], 2, 3).reshape(S, MB * bs, Hkv, D)
+    return jnp.repeat(k_seq, G, axis=2), jnp.repeat(v_seq, G, axis=2)
 
 
-def paged_chunk_attention_batched_reference(q, k_pages, v_pages, block_tables,
-                                            q_starts, ctx_lens,
-                                            softmax_scale: Optional[float] = None,
-                                            window: Optional[int] = None):
-    """jnp reference: per-slot single-chunk reference, stacked."""
-    outs = []
-    for sl in range(q.shape[0]):
-        outs.append(paged_chunk_attention_reference(
-            q[sl], k_pages, v_pages, block_tables[sl],
-            q_starts[sl], ctx_lens[sl], softmax_scale, window=window))
-    return jnp.stack(outs)
-
-
-def paged_chunk_attention_reference(q, k_pages, v_pages, block_table, q_start,
-                                    ctx_len, softmax_scale: Optional[float] = None,
-                                    window: Optional[int] = None):
-    """jnp reference for the chunk kernel (materialises the [C, MB*bs] scores)."""
-    C, H, D = q.shape
-    NB, Hkv, bs, _ = k_pages.shape
-    G = H // Hkv
-    MB = block_table.shape[0]
-    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
-    # [MB, Hkv, bs, D] -> sequence-major [MB*bs, Hkv, D]
-    k_seq = jnp.moveaxis(k_pages[block_table], 1, 2).reshape(MB * bs, Hkv, D)
-    v_seq = jnp.moveaxis(v_pages[block_table], 1, 2).reshape(MB * bs, Hkv, D)
-    k_seq = jnp.repeat(k_seq, G, axis=1)
-    v_seq = jnp.repeat(v_seq, G, axis=1)
-    sc = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
-                    k_seq.astype(jnp.float32)) * scale
-    q_pos = q_start + jnp.arange(C)
-    k_pos = jnp.arange(MB * bs)
-    mask = (k_pos[None, :] <= q_pos[:, None]) & (k_pos[None, :] < ctx_len)
-    if window is not None:
-        mask = mask & (k_pos[None, :] > q_pos[:, None] - window)
-    sc = jnp.where(mask[None], sc, NEG_INF)
-    p = jax.nn.softmax(sc, axis=-1)
-    p = jnp.where(jnp.any(mask, axis=-1)[None, :, None], p, 0.0)
-    out = jnp.einsum("hqk,khd->qhd", p, v_seq.astype(jnp.float32))
-    return out.astype(q.dtype)
-
-
-def paged_decode_attention_reference(q, k_pages, v_pages, block_tables, ctx_lens,
+def paged_decode_attention_reference(q, kv_pages, block_tables, ctx_lens,
                                      softmax_scale: Optional[float] = None,
                                      window: Optional[int] = None,
                                      with_lse: bool = False):
-    """jnp reference (gathers each sequence's pages — the copy the kernel avoids)."""
+    """jnp reference (gathers each sequence's pages)."""
     S, H, D = q.shape
-    NB, Hkv, bs, _ = k_pages.shape
+    NB, _, Hkv, bs, _ = kv_pages.shape
     G = H // Hkv
     MB = block_tables.shape[1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
-
-    # [S, MB, Hkv, bs, D] -> sequence-major [S, MB*bs, Hkv, D]
-    k_seq = jnp.moveaxis(k_pages[block_tables], 2, 3).reshape(S, MB * bs, Hkv, D)
-    v_seq = jnp.moveaxis(v_pages[block_tables], 2, 3).reshape(S, MB * bs, Hkv, D)
-    k_seq = jnp.repeat(k_seq, G, axis=2)
-    v_seq = jnp.repeat(v_seq, G, axis=2)
+    k_seq, v_seq = _gather_seq(kv_pages, block_tables, G)
     sc = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),
                     k_seq.astype(jnp.float32)) * scale
     mask = jnp.arange(MB * bs)[None, None, :] < ctx_lens[:, None, None]
@@ -1330,4 +1507,125 @@ def paged_decode_attention_reference(q, k_pages, v_pages, block_tables, ctx_lens
         lse = jax.scipy.special.logsumexp(sc, axis=-1)
         lse = jnp.where(ctx_lens[:, None] > 0, lse, NEG_INF)
         return out.astype(q.dtype), lse
+    return out.astype(q.dtype)
+
+
+def paged_decode_attention_step_reference(q, k_new, v_new, kv_pages,
+                                          block_tables, ctx_lens,
+                                          softmax_scale: Optional[float] = None,
+                                          window: Optional[int] = None):
+    """jnp reference: scatter the new rows, then dense paged-decode reference."""
+    S, H, D = q.shape
+    NB, _, Hkv, bs, _ = kv_pages.shape
+    rows = _step_write_rows(block_tables, ctx_lens, NB, Hkv, bs, S)
+    new = jnp.concatenate([k_new.reshape(S * Hkv, D),
+                           v_new.reshape(S * Hkv, D)])
+    kvf = kv_pages.reshape(NB * 2 * Hkv * bs, D).at[rows].set(
+        new.astype(kv_pages.dtype), mode="drop").reshape(kv_pages.shape)
+    out = paged_decode_attention_reference(q, kvf, block_tables, ctx_lens,
+                                           softmax_scale, window=window)
+    return out, kvf
+
+
+def paged_decode_attention_sidebuf_reference(q, kv_pages, block_tables,
+                                             prefix_lens, side_k, side_v, j,
+                                             softmax_scale=None, window=None):
+    """jnp reference: paged prefix piece (with lse) merged with dense masked
+    attention over the side slab — the two-piece computation the fused
+    kernel replaces."""
+    S, H, D = q.shape
+    _, Cs, Hkv, _ = side_k.shape
+    G = H // Hkv
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+    if window is not None:
+        # page piece window start moves with the in-chunk step j
+        eff_ctx = prefix_lens + j + 1
+        out_p, lse_p = _paged_reference_lse_lo(
+            q, kv_pages, block_tables, prefix_lens,
+            jnp.maximum(eff_ctx - window, 0), scale)
+    else:
+        out_p, lse_p = paged_decode_attention_reference(
+            q, kv_pages, block_tables, prefix_lens, scale, with_lse=True)
+    qg = q.reshape(S, Hkv, G, D).astype(jnp.float32)
+    sc = jnp.einsum("shgd,schd->shgc", qg,
+                    side_k.astype(jnp.float32)) * scale
+    col_ok = (jnp.arange(Cs) <= j)[None, None, None, :]
+    if window is not None:
+        col_ok = jnp.logical_and(col_ok,
+                                 (jnp.arange(Cs) >= j + 1 - window)
+                                 [None, None, None, :])
+    sc = jnp.where(col_ok, sc, NEG_INF)
+    m_s = jnp.max(sc, axis=-1, keepdims=True)
+    p = jnp.where(col_ok, jnp.exp(sc - m_s), 0.0)
+    l_s = jnp.sum(p, axis=-1, keepdims=True)
+    out_s = jnp.einsum("shgc,schd->shgd", p,
+                       side_v.astype(jnp.float32)) / jnp.maximum(l_s, 1e-30)
+    lse_s = (m_s + jnp.log(jnp.maximum(l_s, 1e-30)))[..., 0]
+    lse_pg = lse_p.reshape(S, Hkv, G)
+    m_tot = jnp.maximum(lse_pg, lse_s)
+    w_p = jnp.exp(lse_pg - m_tot)[..., None]
+    w_s = jnp.exp(lse_s - m_tot)[..., None]
+    out = (w_p * out_p.reshape(S, Hkv, G, D).astype(jnp.float32)
+           + w_s * out_s) / (w_p + w_s)
+    return out.reshape(S, H, D).astype(q.dtype)
+
+
+def _paged_reference_lse_lo(q, kv_pages, block_tables, ctx_lens,
+                            tok_lo, scale):
+    """Dense paged reference with a per-sequence lower bound on visible
+    tokens (side-slab window reference support)."""
+    S, H, D = q.shape
+    NB, _, Hkv, bs, _ = kv_pages.shape
+    G = H // Hkv
+    MB = block_tables.shape[1]
+    k_seq, v_seq = _gather_seq(kv_pages, block_tables, G)
+    sc = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),
+                    k_seq.astype(jnp.float32)) * scale
+    pos = jnp.arange(MB * bs)[None, None, :]
+    mask = (pos < ctx_lens[:, None, None]) & (pos >= tok_lo[:, None, None])
+    sc = jnp.where(mask, sc, NEG_INF)
+    any_row = jnp.any(mask, axis=-1)
+    p = jax.nn.softmax(sc, axis=-1)
+    p = jnp.where(any_row[:, :, None], p, 0.0)
+    out = jnp.einsum("sht,sthd->shd", p, v_seq.astype(jnp.float32))
+    lse = jax.scipy.special.logsumexp(sc, axis=-1)
+    lse = jnp.where(any_row, lse, NEG_INF)
+    return out.astype(q.dtype), lse
+
+
+def paged_chunk_attention_batched_reference(q, kv_pages, block_tables,
+                                            q_starts, ctx_lens,
+                                            softmax_scale: Optional[float] = None,
+                                            window: Optional[int] = None):
+    """jnp reference: per-slot single-chunk reference, stacked."""
+    outs = []
+    for sl in range(q.shape[0]):
+        outs.append(paged_chunk_attention_reference(
+            q[sl], kv_pages, block_tables[sl],
+            q_starts[sl], ctx_lens[sl], softmax_scale, window=window))
+    return jnp.stack(outs)
+
+
+def paged_chunk_attention_reference(q, kv_pages, block_table, q_start,
+                                    ctx_len, softmax_scale: Optional[float] = None,
+                                    window: Optional[int] = None):
+    """jnp reference for the chunk kernel (materialises the [C, MB*bs] scores)."""
+    C, H, D = q.shape
+    NB, _, Hkv, bs, _ = kv_pages.shape
+    G = H // Hkv
+    MB = block_table.shape[0]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+    k_seq, v_seq = _gather_seq(kv_pages, block_table[None], G)
+    k_seq, v_seq = k_seq[0], v_seq[0]              # [MB*bs, H, D]
+    sc = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                    k_seq.astype(jnp.float32)) * scale
+    q_pos = q_start + jnp.arange(C)
+    k_pos = jnp.arange(MB * bs)
+    mask = (k_pos[None, :] <= q_pos[:, None]) & (k_pos[None, :] < ctx_len)
+    if window is not None:
+        mask = mask & (k_pos[None, :] > q_pos[:, None] - window)
+    sc = jnp.where(mask[None], sc, NEG_INF)
+    p = jax.nn.softmax(sc, axis=-1)
+    p = jnp.where(jnp.any(mask, axis=-1)[None, :, None], p, 0.0)
+    out = jnp.einsum("hqk,khd->qhd", p, v_seq.astype(jnp.float32))
     return out.astype(q.dtype)

@@ -254,7 +254,7 @@ class TestEngineV2:
 
                 eng.scheduler.schedule_pass = no_fast
             logits = eng.put([1, 2, 3], prompts)
-            pools = (np.asarray(eng.kv.k), np.asarray(eng.kv.v))
+            pools = (np.asarray(eng.kv.kv),)
             eng.flush([1, 2, 3])
             return logits, pools
 
@@ -620,3 +620,31 @@ def test_kv_quant_multistep_matches_per_token(eight_devices, window):
         e2.put([1, 2], [np.asarray([nxt[0]], np.int32),
                         np.asarray([nxt[1]], np.int32)])
     assert np.array_equal(ids_ms, np.stack(step_ids, 1))
+
+
+def test_int8_weights_quantize_moe_experts(eight_devices):
+    """ADVICE r4: weight_bits=8 on an MoE model must quantize the expert
+    stacks (the dominant streamed bytes), and the quantized engine's greedy
+    output must match the bf16 engine on the test model."""
+    from deepspeed_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+    from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+    cfg = MixtralConfig.tiny(dtype=jnp.float32)
+    model = MixtralForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+    e_bf = InferenceEngineV2(model=model,
+                             config=RaggedInferenceEngineConfig.load(
+                                 dict(V2_CONFIG)),
+                             model_parameters=params)
+    qcfg = dict(V2_CONFIG)
+    qcfg["quantization"] = {"weight_bits": 8}
+    e_q = InferenceEngineV2(model=model,
+                            config=RaggedInferenceEngineConfig.load(qcfg),
+                            model_parameters=params)
+    # the expert stacks really are int8 now
+    moe = e_q.weights["layers"]["moe"]
+    for key in ("w_gate", "w_up", "w_down"):
+        assert isinstance(moe[key], dict) and moe[key]["w8"].dtype == jnp.int8
+    out_bf = e_bf.generate(PROMPTS[:2], max_new_tokens=4)
+    out_q = e_q.generate(PROMPTS[:2], max_new_tokens=4)
+    assert out_bf == out_q

@@ -96,15 +96,14 @@ class TestPagedEdgeCases:
     def test_decode_ragged_contexts(self, bs, ctxs):
         NB, Hkv, H, D = 24, 2, 4, 32
         S = len(ctxs)
-        kp = _rand(20, NB, Hkv, bs, D)
-        vp = _rand(21, NB, Hkv, bs, D)
+        kv = _rand(20, NB, 2, Hkv, bs, D)
         q = _rand(22, S, H, D)
         mb = max(-(-max(max(ctxs), 1) // bs), 1)
         bts = jnp.asarray(
             np.arange(S * mb).reshape(S, mb) % NB, jnp.int32)
         cls_ = jnp.asarray(ctxs, jnp.int32)
-        got = paged_decode_attention(q, kp, vp, bts, cls_)
-        ref = paged_decode_attention_reference(q, kp, vp, bts, cls_)
+        got = paged_decode_attention(q, kv, bts, cls_)
+        ref = paged_decode_attention_reference(q, kv, bts, cls_)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    atol=2e-5, rtol=2e-4)
         # zero-context rows must be exactly zero, not NaN
@@ -115,28 +114,26 @@ class TestPagedEdgeCases:
     @pytest.mark.parametrize("C,q_start", [(1, 0), (5, 3), (31, 1), (17, 40)])
     def test_chunk_odd_sizes_and_offsets(self, C, q_start):
         NB, bs, Hkv, H, D = 16, 8, 2, 4, 32
-        kp = _rand(23, NB, Hkv, bs, D)
-        vp = _rand(24, NB, Hkv, bs, D)
+        kv = _rand(23, NB, 2, Hkv, bs, D)
         q = _rand(25, C, H, D)
         ctx = q_start + C
         nb = -(-ctx // bs)
         bt = jnp.asarray(np.arange(nb) % NB, jnp.int32)
-        got = paged_chunk_attention(q, kp, vp, bt, jnp.int32(q_start),
+        got = paged_chunk_attention(q, kv, bt, jnp.int32(q_start),
                                     jnp.int32(ctx))
-        ref = paged_chunk_attention_reference(q, kp, vp, bt, jnp.int32(q_start),
+        ref = paged_chunk_attention_reference(q, kv, bt, jnp.int32(q_start),
                                               jnp.int32(ctx))
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                    atol=2e-5, rtol=2e-4)
 
     def test_decode_single_token_context_bf16(self):
         NB, bs, Hkv, H, D = 8, 8, 1, 2, 64
-        kp = _rand(26, NB, Hkv, bs, D, dtype=jnp.bfloat16)
-        vp = _rand(27, NB, Hkv, bs, D, dtype=jnp.bfloat16)
+        kv = _rand(26, NB, 2, Hkv, bs, D, dtype=jnp.bfloat16)
         q = _rand(28, 1, H, D, dtype=jnp.bfloat16)
         bts = jnp.zeros((1, 1), jnp.int32)
         cls_ = jnp.asarray([1], jnp.int32)
-        got = paged_decode_attention(q, kp, vp, bts, cls_)
-        ref = paged_decode_attention_reference(q, kp, vp, bts, cls_)
+        got = paged_decode_attention(q, kv, bts, cls_)
+        ref = paged_decode_attention_reference(q, kv, bts, cls_)
         np.testing.assert_allclose(np.asarray(got, np.float32),
                                    np.asarray(ref, np.float32),
                                    atol=2e-2, rtol=2e-2)

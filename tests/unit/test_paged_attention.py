@@ -1,5 +1,7 @@
 """Paged attention kernel tests (parity role: reference
-``tests/unit/inference/v2/kernels/ragged_ops`` — kernel vs reference comparisons)."""
+``tests/unit/inference/v2/kernels/ragged_ops`` — kernel vs reference
+comparisons). Pools use the combined page layout [NB, 2, Hkv, bs, D]
+(K = index 0, V = index 1; see ops/pallas/paged_attention.py)."""
 
 import jax
 import jax.numpy as jnp
@@ -14,10 +16,9 @@ from deepspeed_tpu.ops.pallas.paged_attention import (
 
 def _setup(rng, S, H, D, Hkv, NB, bs, MB):
     q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
-    k = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
-    v = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
+    kv = jnp.asarray(rng.randn(NB, 2, Hkv, bs, D), jnp.float32)
     bt = jnp.asarray(rng.permutation(NB)[:S * MB].reshape(S, MB), jnp.int32)
-    return q, k, v, bt
+    return q, kv, bt
 
 
 class TestPagedDecode:
@@ -26,29 +27,41 @@ class TestPagedDecode:
     def test_matches_reference(self, Hkv):
         rng = np.random.RandomState(0)
         S, H, D, NB, bs, MB = 5, 8, 64, 32, 8, 4
-        q, k, v, bt = _setup(rng, S, H, D, Hkv, NB, bs, MB)
+        q, kv, bt = _setup(rng, S, H, D, Hkv, NB, bs, MB)
         cl = jnp.asarray([1, 8, 13, 30, 32], jnp.int32)
-        out = paged_decode_attention(q, k, v, bt, cl)
-        ref = paged_decode_attention_reference(q, k, v, bt, cl)
+        out = paged_decode_attention(q, kv, bt, cl)
+        ref = paged_decode_attention_reference(q, kv, bt, cl)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
     def test_empty_rows_zero(self):
         rng = np.random.RandomState(1)
-        q, k, v, bt = _setup(rng, 3, 4, 64, 2, 16, 8, 2)
+        q, kv, bt = _setup(rng, 3, 4, 64, 2, 16, 8, 2)
         cl = jnp.asarray([5, 0, 0], jnp.int32)
-        out = np.asarray(paged_decode_attention(q, k, v, bt, cl))
+        out = np.asarray(paged_decode_attention(q, kv, bt, cl))
         assert np.all(out[1:] == 0)
         assert np.any(out[0] != 0)
 
     def test_jit(self):
         rng = np.random.RandomState(2)
-        q, k, v, bt = _setup(rng, 4, 8, 64, 4, 16, 8, 2)
+        q, kv, bt = _setup(rng, 4, 8, 64, 4, 16, 8, 2)
         cl = jnp.asarray([3, 9, 16, 1], jnp.int32)
-        out = jax.jit(paged_decode_attention)(q, k, v, bt, cl)
-        ref = paged_decode_attention_reference(q, k, v, bt, cl)
+        out = jax.jit(paged_decode_attention)(q, kv, bt, cl)
+        ref = paged_decode_attention_reference(q, kv, bt, cl)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
+
+    def test_large_d_manual_dma_path(self):
+        """D = 128 exercises the manual-DMA two-slot pipeline (the serving
+        path) rather than the BlockSpec fallback."""
+        rng = np.random.RandomState(6)
+        S, H, Hkv, D, NB, bs, MB = 3, 4, 2, 128, 16, 8, 4
+        q, kv, bt = _setup(rng, S, H, D, Hkv, NB, bs, MB)
+        cl = jnp.asarray([2, 17, 32], jnp.int32)
+        out = paged_decode_attention(q, kv, bt, cl)
+        ref = paged_decode_attention_reference(q, kv, bt, cl)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-4)
 
 
 class TestPagedChunkBatched:
@@ -59,15 +72,14 @@ class TestPagedChunkBatched:
         rng = np.random.RandomState(11)
         NC, Cs, H, Hkv, D, bs, MB = 4, 16, 8, 2, 64, 8, 6
         NB = NC * MB + 2
-        k = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
-        v = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
+        kv = jnp.asarray(rng.randn(NB, 2, Hkv, bs, D), jnp.float32)
         q = jnp.asarray(rng.randn(NC, Cs, H, D), jnp.float32)
         bt = jnp.asarray(rng.permutation(NB - 1)[:NC * MB].reshape(NC, MB) + 1,
                          jnp.int32)
         q0s = jnp.asarray([0, 13, 40, 0], jnp.int32)
         ctxs = jnp.asarray([16, 29, 56, 0], jnp.int32)   # last slot empty
-        out = jax.jit(paged_chunk_attention_batched)(q, k, v, bt, q0s, ctxs)
-        ref = paged_chunk_attention_batched_reference(q, k, v, bt, q0s, ctxs)
+        out = jax.jit(paged_chunk_attention_batched)(q, kv, bt, q0s, ctxs)
+        ref = paged_chunk_attention_batched_reference(q, kv, bt, q0s, ctxs)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-4)
         assert np.all(np.asarray(out)[3] == 0)
@@ -75,7 +87,7 @@ class TestPagedChunkBatched:
 
 class TestPagedDecodeStep:
     """Fused decode step: prior-context flash + inline current token + page
-    write, pools aliased through. Edge cases: ctx 1 (no pages yet), page
+    write, pool aliased through. Edge cases: ctx 1 (no pages yet), page
     boundary, ctx 0 (padding row: no write, zero output)."""
 
     @pytest.mark.parametrize("Hkv,ctxs", [
@@ -88,8 +100,7 @@ class TestPagedDecodeStep:
         S, H, D, bs = len(ctxs), 8, 64, 8
         MB = 4
         NB = S * MB + 2
-        k = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
-        v = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
+        kv = jnp.asarray(rng.randn(NB, 2, Hkv, bs, D), jnp.float32)
         q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
         kn = jnp.asarray(rng.randn(S, Hkv, D), jnp.float32)
         vn = jnp.asarray(rng.randn(S, Hkv, D), jnp.float32)
@@ -97,17 +108,33 @@ class TestPagedDecodeStep:
         bt = jnp.asarray(rng.permutation(NB - 1)[:S * MB].reshape(S, MB) + 1,
                          jnp.int32)
         cl = jnp.asarray(ctxs, jnp.int32)
-        out, kf, vf = jax.jit(paged_decode_attention_step)(q, kn, vn, k, v,
-                                                           bt, cl)
-        orf, krf, vrf = paged_decode_attention_step_reference(q, kn, vn, k, v,
-                                                              bt, cl)
+        out, kvf = jax.jit(paged_decode_attention_step)(q, kn, vn, kv, bt, cl)
+        orf, kvrf = paged_decode_attention_step_reference(q, kn, vn, kv,
+                                                          bt, cl)
         np.testing.assert_allclose(np.asarray(out), np.asarray(orf),
                                    atol=2e-5, rtol=2e-4)
-        np.testing.assert_array_equal(np.asarray(kf), np.asarray(krf))
-        np.testing.assert_array_equal(np.asarray(vf), np.asarray(vrf))
+        np.testing.assert_array_equal(np.asarray(kvf), np.asarray(kvrf))
         for i, c in enumerate(ctxs):
             if c == 0:
                 assert np.all(np.asarray(out)[i] == 0)
+
+    def test_manual_dma_path_d128(self):
+        rng = np.random.RandomState(8)
+        S, H, Hkv, D, bs, MB = 2, 4, 2, 128, 8, 3
+        NB = S * MB + 1
+        kv = jnp.asarray(rng.randn(NB, 2, Hkv, bs, D), jnp.float32)
+        q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
+        kn = jnp.asarray(rng.randn(S, Hkv, D), jnp.float32)
+        vn = jnp.asarray(rng.randn(S, Hkv, D), jnp.float32)
+        bt = jnp.asarray(rng.permutation(NB - 1)[:S * MB].reshape(S, MB) + 1,
+                         jnp.int32)
+        cl = jnp.asarray([6, 20], jnp.int32)
+        out, kvf = paged_decode_attention_step(q, kn, vn, kv, bt, cl)
+        orf, kvrf = paged_decode_attention_step_reference(q, kn, vn, kv,
+                                                          bt, cl)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(orf),
+                                   atol=2e-5, rtol=2e-4)
+        np.testing.assert_array_equal(np.asarray(kvf), np.asarray(kvrf))
 
 
 class TestPagedChunk:
@@ -117,21 +144,19 @@ class TestPagedChunk:
         rng = np.random.RandomState(3)
         C, H, D, Hkv, NB, bs, MB = 16, 8, 64, 2, 32, 8, 8
         q = jnp.asarray(rng.randn(C, H, D), jnp.float32)
-        k = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
-        v = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
+        kv = jnp.asarray(rng.randn(NB, 2, Hkv, bs, D), jnp.float32)
         bt = jnp.asarray(rng.permutation(NB)[:MB], jnp.int32)
-        out = paged_chunk_attention(q, k, v, bt, q_start, ctx)
-        ref = paged_chunk_attention_reference(q, k, v, bt, q_start, ctx)
+        out = paged_chunk_attention(q, kv, bt, q_start, ctx)
+        ref = paged_chunk_attention_reference(q, kv, bt, q_start, ctx)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
     def test_empty_ctx_zero(self):
         rng = np.random.RandomState(4)
         q = jnp.asarray(rng.randn(8, 4, 64), jnp.float32)
-        k = jnp.asarray(rng.randn(16, 2, 8, 64), jnp.float32)
-        v = jnp.asarray(rng.randn(16, 2, 8, 64), jnp.float32)
+        kv = jnp.asarray(rng.randn(16, 2, 2, 8, 64), jnp.float32)
         bt = jnp.zeros((4,), jnp.int32)
-        out = np.asarray(paged_chunk_attention(q, k, v, bt, 0, 0))
+        out = np.asarray(paged_chunk_attention(q, kv, bt, 0, 0))
         assert np.all(out == 0)
 
     def test_matches_dense_flash_prefill(self):
@@ -144,13 +169,12 @@ class TestPagedChunk:
         kd = jnp.asarray(rng.randn(C, H, D), jnp.float32)
         vd = jnp.asarray(rng.randn(C, H, D), jnp.float32)
         bt = jnp.asarray([3, 5], jnp.int32)
-        k_pages = jnp.zeros((NB, H, bs, D), jnp.float32)
-        v_pages = jnp.zeros((NB, H, bs, D), jnp.float32)
-        k_pages = k_pages.at[bt].set(
+        kv_pages = jnp.zeros((NB, 2, H, bs, D), jnp.float32)
+        kv_pages = kv_pages.at[bt, 0].set(
             jnp.moveaxis(kd.reshape(MB, bs, H, D), 1, 2))
-        v_pages = v_pages.at[bt].set(
+        kv_pages = kv_pages.at[bt, 1].set(
             jnp.moveaxis(vd.reshape(MB, bs, H, D), 1, 2))
-        out = paged_chunk_attention(q, k_pages, v_pages, bt, 0, C)
+        out = paged_chunk_attention(q, kv_pages, bt, 0, C)
         ref = reference_attention(q[None], kd[None], vd[None], causal=True)[0]
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
@@ -236,8 +260,7 @@ class TestPagedDecodeSidebuf:
         S, H, D, bs, MB, C = 4, 8, 128, 8, 3, 8
         NB = S * MB + 1
         q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
-        k = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
-        v = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
+        kv = jnp.asarray(rng.randn(NB, 2, Hkv, bs, D), jnp.float32)
         bt = jnp.asarray(rng.permutation(NB - 1)[:S * MB].reshape(S, MB) + 1,
                          jnp.int32)
         # prefix 0 (fresh sequence: all context in the side slab), mid-page,
@@ -246,8 +269,8 @@ class TestPagedDecodeSidebuf:
         sk = jnp.asarray(rng.randn(S, C, Hkv, D), jnp.float32)
         sv = jnp.asarray(rng.randn(S, C, Hkv, D), jnp.float32)
         out = jax.jit(paged_decode_attention_sidebuf,
-                      static_argnames=())(q, k, v, bt, prefix, sk, sv, j)
-        ref = paged_decode_attention_sidebuf_reference(q, k, v, bt, prefix,
+                      static_argnames=())(q, kv, bt, prefix, sk, sv, j)
+        ref = paged_decode_attention_sidebuf_reference(q, kv, bt, prefix,
                                                        sk, sv, j)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-4)
@@ -263,17 +286,16 @@ class TestPagedDecodeSidebuf:
         S, H, Hkv, D, bs, MB, C = 3, 4, 2, 128, 8, 3, 8
         NB = S * MB + 1
         q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
-        k = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
-        v = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
+        kv = jnp.asarray(rng.randn(NB, 2, Hkv, bs, D), jnp.float32)
         bt = jnp.asarray(rng.permutation(NB - 1)[:S * MB].reshape(S, MB) + 1,
                          jnp.int32)
         prefix = jnp.asarray([0, 7, 2 * bs + 3], jnp.int32)
         sk = jnp.asarray(rng.randn(S, C, Hkv, D), jnp.float32)
         sv = jnp.asarray(rng.randn(S, C, Hkv, D), jnp.float32)
-        out = paged_decode_attention_sidebuf(q, k, v, bt, prefix, sk, sv, j,
+        out = paged_decode_attention_sidebuf(q, kv, bt, prefix, sk, sv, j,
                                              window=window)
         ref = paged_decode_attention_sidebuf_reference(
-            q, k, v, bt, prefix, sk, sv, j, window=window)
+            q, kv, bt, prefix, sk, sv, j, window=window)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-4)
 
@@ -286,13 +308,10 @@ class TestInt8Pages:
 
     def _qpages(self, rng, NB, Hkv, bs, D):
         from deepspeed_tpu.ops.pallas.paged_attention import kv_quantize_rows
-        k = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
-        v = jnp.asarray(rng.randn(NB, Hkv, bs, D), jnp.float32)
-        kq, ks = kv_quantize_rows(k)
-        vq, vs = kv_quantize_rows(v)
-        kd = kq.astype(jnp.float32) * ks[..., None]
-        vd = vq.astype(jnp.float32) * vs[..., None]
-        return kq, ks, kd, vq, vs, vd
+        kv = jnp.asarray(rng.randn(NB, 2, Hkv, bs, D), jnp.float32)
+        kvq, sc = kv_quantize_rows(kv)
+        kvd = kvq.astype(jnp.float32) * sc[..., None]
+        return kvq, sc, kvd
 
     def test_decode_matches_dequant_reference(self):
         from deepspeed_tpu.ops.pallas.paged_attention import (
@@ -300,14 +319,13 @@ class TestInt8Pages:
         rng = np.random.RandomState(21)
         S, H, Hkv, D, bs, MB = 3, 8, 2, 128, 128, 2
         NB = S * MB + 1
-        kq, ks, kd, vq, vs, vd = self._qpages(rng, NB, Hkv, bs, D)
+        kvq, sc, kvd = self._qpages(rng, NB, Hkv, bs, D)
         q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
         bt = jnp.asarray(rng.permutation(NB - 1)[:S * MB].reshape(S, MB) + 1,
                          jnp.int32)
         cl = jnp.asarray([5, 130, 256], jnp.int32)
-        out = paged_decode_attention(q, kq, vq, bt, cl,
-                                     k_scales=ks, v_scales=vs)
-        ref = paged_decode_attention_reference(q, kd, vd, bt, cl)
+        out = paged_decode_attention(q, kvq, bt, cl, kv_scales=sc)
+        ref = paged_decode_attention_reference(q, kvd, bt, cl)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=3e-5, rtol=3e-4)
 
@@ -318,16 +336,16 @@ class TestInt8Pages:
         rng = np.random.RandomState(22)
         S, H, Hkv, D, bs, MB, C = 3, 4, 2, 128, 128, 2, 8
         NB = S * MB + 1
-        kq, ks, kd, vq, vs, vd = self._qpages(rng, NB, Hkv, bs, D)
+        kvq, sc, kvd = self._qpages(rng, NB, Hkv, bs, D)
         q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
         bt = jnp.asarray(rng.permutation(NB - 1)[:S * MB].reshape(S, MB) + 1,
                          jnp.int32)
         prefix = jnp.asarray([0, 70, 200], jnp.int32)
         sk = jnp.asarray(rng.randn(S, C, Hkv, D), jnp.float32)
         sv = jnp.asarray(rng.randn(S, C, Hkv, D), jnp.float32)
-        out = paged_decode_attention_sidebuf(q, kq, vq, bt, prefix, sk, sv, 5,
-                                             k_scales=ks, v_scales=vs)
-        ref = paged_decode_attention_sidebuf_reference(q, kd, vd, bt, prefix,
+        out = paged_decode_attention_sidebuf(q, kvq, bt, prefix, sk, sv, 5,
+                                             kv_scales=sc)
+        ref = paged_decode_attention_sidebuf_reference(q, kvd, bt, prefix,
                                                        sk, sv, 5)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=3e-5, rtol=3e-4)
@@ -339,35 +357,31 @@ class TestInt8Pages:
         rng = np.random.RandomState(23)
         S, H, Hkv, D, bs, MB = 2, 4, 2, 128, 128, 2
         NB = S * MB + 1
-        kq, ks, kd, vq, vs, vd = self._qpages(rng, NB, Hkv, bs, D)
+        kvq, sc, kvd = self._qpages(rng, NB, Hkv, bs, D)
         q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
         kn = jnp.asarray(rng.randn(S, Hkv, D), jnp.float32)
         vn = jnp.asarray(rng.randn(S, Hkv, D), jnp.float32)
         bt = jnp.asarray(rng.permutation(NB - 1)[:S * MB].reshape(S, MB) + 1,
                          jnp.int32)
         cl = jnp.asarray([6, 140], jnp.int32)
-        out, kf, vf, ksf, vsf = paged_decode_attention_step(
-            q, kn, vn, kq, vq, bt, cl, k_scales=ks, v_scales=vs)
+        out, kvf, scf = paged_decode_attention_step(
+            q, kn, vn, kvq, bt, cl, kv_scales=sc)
         # the kernel attends the CURRENT token at full precision from
         # registers (quantization happens at the page write, for future
         # reads) — so the attention reference uses unquantized kn/vn
-        orf, _, _ = paged_decode_attention_step_reference(
-            q, kn, vn, kd, vd, bt, cl)
+        orf, _ = paged_decode_attention_step_reference(q, kn, vn, kvd, bt, cl)
         np.testing.assert_allclose(np.asarray(out), np.asarray(orf),
                                    atol=3e-5, rtol=3e-4)
-        # the returned pools hold the QUANTIZED new rows: they must
-        # dequantize to the reference pool built from dequantized new rows
+        # the returned pool holds the QUANTIZED new rows: it must dequantize
+        # to the reference pool built from dequantized new rows
         knq, kns = kv_quantize_rows(kn)
         vnq, vns = kv_quantize_rows(vn)
         knd = knq.astype(jnp.float32) * kns[..., None]
         vnd = vnq.astype(jnp.float32) * vns[..., None]
-        _, krf, vrf = paged_decode_attention_step_reference(
-            q, knd, vnd, kd, vd, bt, cl)
-        kfd = kf.astype(jnp.float32) * ksf[..., None]
-        vfd = vf.astype(jnp.float32) * vsf[..., None]
-        np.testing.assert_allclose(np.asarray(kfd), np.asarray(krf),
-                                   atol=1e-6)
-        np.testing.assert_allclose(np.asarray(vfd), np.asarray(vrf),
+        _, kvrf = paged_decode_attention_step_reference(q, knd, vnd, kvd,
+                                                        bt, cl)
+        kvfd = kvf.astype(jnp.float32) * scf[..., None]
+        np.testing.assert_allclose(np.asarray(kvfd), np.asarray(kvrf),
                                    atol=1e-6)
 
     def test_chunk_matches_dequant_reference(self):
@@ -377,14 +391,66 @@ class TestInt8Pages:
         rng = np.random.RandomState(24)
         NC, Cs, H, Hkv, D, bs, MB = 2, 16, 4, 2, 128, 128, 2
         NB = NC * MB + 1
-        kq, ks, kd, vq, vs, vd = self._qpages(rng, NB, Hkv, bs, D)
+        kvq, sc, kvd = self._qpages(rng, NB, Hkv, bs, D)
         q = jnp.asarray(rng.randn(NC, Cs, H, D), jnp.float32)
         bt = jnp.asarray(rng.permutation(NB - 1)[:NC * MB].reshape(NC, MB) + 1,
                          jnp.int32)
         q0s = jnp.asarray([0, 100], jnp.int32)
         ctxs = jnp.asarray([16, 116], jnp.int32)
-        out = paged_chunk_attention_batched(q, kq, vq, bt, q0s, ctxs,
-                                            k_scales=ks, v_scales=vs)
-        ref = paged_chunk_attention_batched_reference(q, kd, vd, bt, q0s, ctxs)
+        out = paged_chunk_attention_batched(q, kvq, bt, q0s, ctxs,
+                                            kv_scales=sc)
+        ref = paged_chunk_attention_batched_reference(q, kvd, bt, q0s, ctxs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=3e-5, rtol=3e-4)
+
+
+class TestSidebufBatched:
+    """SB-batched sidebuf grid (multiple sequences per grid step): ragged
+    prefixes across a block, windowed, and int8 variants must all match the
+    single-sequence reference."""
+
+    @pytest.mark.parametrize("window", [None, 12])
+    def test_batched_matches_reference(self, window):
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_sidebuf,
+            paged_decode_attention_sidebuf_reference)
+        rng = np.random.RandomState(31)
+        S, H, Hkv, D, bs, MB, C = 8, 4, 2, 128, 8, 3, 8
+        NB = S * MB + 1
+        q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
+        kv = jnp.asarray(rng.randn(NB, 2, Hkv, bs, D), jnp.float32)
+        bt = jnp.asarray(rng.permutation(NB - 1)[:S * MB].reshape(S, MB) + 1,
+                         jnp.int32)
+        prefix = jnp.asarray([0, 5, 8, 24, 1, 16, 13, 20], jnp.int32)
+        sk = jnp.asarray(rng.randn(S, C, Hkv, D), jnp.float32)
+        sv = jnp.asarray(rng.randn(S, C, Hkv, D), jnp.float32)
+        out = paged_decode_attention_sidebuf(q, kv, bt, prefix, sk, sv, 4,
+                                             window=window)
+        ref = paged_decode_attention_sidebuf_reference(q, kv, bt, prefix,
+                                                       sk, sv, 4,
+                                                       window=window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-4)
+
+    def test_batched_int8_matches_dequant_reference(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            kv_quantize_rows, paged_decode_attention_sidebuf,
+            paged_decode_attention_sidebuf_reference)
+        rng = np.random.RandomState(32)
+        S, H, Hkv, D, bs, MB, C = 4, 4, 2, 128, 128, 2, 8
+        NB = S * MB + 1
+        kv = jnp.asarray(rng.randn(NB, 2, Hkv, bs, D), jnp.float32)
+        kvq, sc = kv_quantize_rows(kv)
+        kvd = kvq.astype(jnp.float32) * sc[..., None]
+        q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
+        bt = jnp.asarray(rng.permutation(NB - 1)[:S * MB].reshape(S, MB) + 1,
+                         jnp.int32)
+        prefix = jnp.asarray([0, 70, 128, 250], jnp.int32)
+        sk = jnp.asarray(rng.randn(S, C, Hkv, D), jnp.float32)
+        sv = jnp.asarray(rng.randn(S, C, Hkv, D), jnp.float32)
+        out = paged_decode_attention_sidebuf(q, kvq, bt, prefix, sk, sv, 5,
+                                             kv_scales=sc)
+        ref = paged_decode_attention_sidebuf_reference(q, kvd, bt, prefix,
+                                                       sk, sv, 5)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=3e-5, rtol=3e-4)

@@ -26,12 +26,12 @@ def _mk(key, *shape, k=0):
 def test_windowed_paged_decode_matches_reference(window):
     key = jax.random.PRNGKey(0)
     NB, bs, Hkv, D, S, H = 24, 8, 2, 128, 3, 4
-    kp, vp = _mk(key, NB, Hkv, bs, D, k=1), _mk(key, NB, Hkv, bs, D, k=2)
+    kv = _mk(key, NB, 2, Hkv, bs, D, k=1)
     q = _mk(key, S, H, D, k=3)
     bts = jnp.asarray(np.arange(S * 8).reshape(S, 8) % NB, jnp.int32)
     cls_ = jnp.asarray([5, 33, 61], jnp.int32)
-    o = paged_decode_attention(q, kp, vp, bts, cls_, window=window)
-    o_ref = paged_decode_attention_reference(q, kp, vp, bts, cls_,
+    o = paged_decode_attention(q, kv, bts, cls_, window=window)
+    o_ref = paged_decode_attention_reference(q, kv, bts, cls_,
                                              window=window)
     assert float(jnp.max(jnp.abs(o - o_ref))) < 2e-2
 
@@ -39,30 +39,30 @@ def test_windowed_paged_decode_matches_reference(window):
 def test_windowed_decode_step_matches_reference():
     key = jax.random.PRNGKey(1)
     NB, bs, Hkv, D, S, H, W = 24, 8, 2, 128, 3, 4, 20
-    kp, vp = _mk(key, NB, Hkv, bs, D, k=1), _mk(key, NB, Hkv, bs, D, k=2)
+    kv = _mk(key, NB, 2, Hkv, bs, D, k=1)
     q = _mk(key, S, H, D, k=3)
     kn, vn = _mk(key, S, Hkv, D, k=4), _mk(key, S, Hkv, D, k=5)
     bts = jnp.asarray(np.arange(S * 8).reshape(S, 8) % NB, jnp.int32)
     cls_ = jnp.asarray([5, 33, 61], jnp.int32)
-    o, kf, vf = paged_decode_attention_step(q, kn, vn, kp, vp, bts, cls_,
-                                            window=W)
-    o_r, kr, vr = paged_decode_attention_step_reference(
-        q, kn, vn, kp, vp, bts, cls_, window=W)
+    o, kvf = paged_decode_attention_step(q, kn, vn, kv, bts, cls_,
+                                         window=W)
+    o_r, kvr = paged_decode_attention_step_reference(
+        q, kn, vn, kv, bts, cls_, window=W)
     assert float(jnp.max(jnp.abs(o - o_r))) < 2e-2
-    assert float(jnp.max(jnp.abs(kf - kr))) == 0.0
+    assert float(jnp.max(jnp.abs(kvf - kvr))) == 0.0
 
 
 def test_windowed_chunk_attention_matches_reference():
     key = jax.random.PRNGKey(2)
     NB, bs, Hkv, D, H, W = 24, 8, 2, 128, 4, 20
-    kp, vp = _mk(key, NB, Hkv, bs, D, k=1), _mk(key, NB, Hkv, bs, D, k=2)
+    kv = _mk(key, NB, 2, Hkv, bs, D, k=1)
     C, NC = 16, 2
     qc = _mk(key, NC, C, H, D, k=6)
     btc = jnp.asarray(np.arange(NC * 8).reshape(NC, 8) % NB, jnp.int32)
     q0s = jnp.asarray([24, 40], jnp.int32)
     ctxs = jnp.asarray([40, 56], jnp.int32)
-    oc = paged_chunk_attention_batched(qc, kp, vp, btc, q0s, ctxs, window=W)
-    oc_r = paged_chunk_attention_batched_reference(qc, kp, vp, btc, q0s,
+    oc = paged_chunk_attention_batched(qc, kv, btc, q0s, ctxs, window=W)
+    oc_r = paged_chunk_attention_batched_reference(qc, kv, btc, q0s,
                                                    ctxs, window=W)
     assert float(jnp.max(jnp.abs(oc - oc_r))) < 2e-2
 
@@ -152,15 +152,15 @@ def test_window_one_chunk_boundary_finalizes():
     review finding — previously returned uninitialized garbage)."""
     key = jax.random.PRNGKey(7)
     NB, bs, Hkv, D, S, H = 24, 8, 2, 128, 3, 4
-    kp, vp = _mk(key, NB, Hkv, bs, D, k=1), _mk(key, NB, Hkv, bs, D, k=2)
+    kv = _mk(key, NB, 2, Hkv, bs, D, k=1)
     q = _mk(key, S, H, D, k=3)
     kn, vn = _mk(key, S, Hkv, D, k=4), _mk(key, S, Hkv, D, k=5)
     bts = jnp.asarray(np.arange(S * 9).reshape(S, 9) % NB, jnp.int32)
     for W in (1, 2):
         for ctx in (65, 64, 17):
             cls_ = jnp.asarray([ctx, ctx - 1, max(ctx - 2, 1)], jnp.int32)
-            o, _, _ = paged_decode_attention_step(q, kn, vn, kp, vp, bts,
-                                                  cls_, window=W)
-            o_r, _, _ = paged_decode_attention_step_reference(
-                q, kn, vn, kp, vp, bts, cls_, window=W)
+            o, _ = paged_decode_attention_step(q, kn, vn, kv, bts,
+                                               cls_, window=W)
+            o_r, _ = paged_decode_attention_step_reference(
+                q, kn, vn, kv, bts, cls_, window=W)
             assert float(jnp.max(jnp.abs(o - o_r))) < 2e-2, (W, ctx)
