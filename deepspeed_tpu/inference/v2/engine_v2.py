@@ -131,6 +131,16 @@ class InferenceEngineV2:
         # each sequence's pages beyond the window so KV stays bounded
         self.scheduler.window = self.spec.window
 
+        if self.spec.alibi and tp > 1:
+            # the paged kernels compute ALiBi slopes from shard-LOCAL head
+            # indices; under head-sharded TP every shard would reuse the
+            # first shard's half-sized slope schedule (review r5: measured
+            # 0.72 max abs err on 8 virtual devices) — refuse until the
+            # kernels take a global head offset
+            raise NotImplementedError(
+                "ALiBi models with tensor_parallel > 1 are not wired in the "
+                "ragged engine (shard-local slope schedules would be wrong); "
+                "run tp=1 or serve through init_inference")
         eff_tp = tp if (tp > 1 and self.spec.num_kv_heads % tp == 0
                         and self.spec.num_heads % tp == 0) else 1
         self._eff_tp = eff_tp
@@ -369,8 +379,10 @@ class InferenceEngineV2:
         from deepspeed_tpu.inference.v2.ragged_model import (
             PAGED_PASS_KEYS, PREFILL_PASS_KEYS)
         # prefill-from-zero passes need no paged reads: packed-flash fast path
-        # (build_prefill_forward) — measured 3-4x wave throughput on v5e-1
-        if batch.pure_prefill:
+        # (build_prefill_forward) — measured 3-4x wave throughput on v5e-1.
+        # ALiBi models take the paged chunk path (the packed flash kernel
+        # has no per-head position bias; the paged kernels do)
+        if batch.pure_prefill and not self.spec.alibi:
             if self._pass_prefill is None:
                 from deepspeed_tpu.inference.v2.ragged_model import (
                     build_prefill_forward)

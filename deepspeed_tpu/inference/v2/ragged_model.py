@@ -80,6 +80,11 @@ class RaggedModelSpec:
     # mistral/qwen2 sliding-window span (tokens); None = full attention.
     # Reference parity: inference/v2/model_implementations/mistral.
     window: Optional[int] = None
+    # BLOOM lineage: per-head linear position bias applied inside the paged
+    # kernels (reference csrc/transformer/inference/csrc/softmax.cu) and a
+    # LayerNorm right after the embedding
+    alibi: bool = False
+    embed_norm: bool = False
     dtype: Any = jnp.bfloat16
 
 
@@ -222,16 +227,12 @@ def adapt_decoder(params: Dict, config,
     (not family names), so a config with e.g. alibi under any family is
     rejected instead of silently served wrong."""
     unsupported = []
-    if getattr(config, "alibi", False):
-        unsupported.append("alibi")
     if getattr(config, "local_window", None) is not None:
         unsupported.append("local_window")
     if any(k == "local" for k in getattr(config, "attention_layers", None) or ()):
         unsupported.append("attention_layers with 'local' entries")
     if getattr(config, "attn_scale", None) is not None:
         unsupported.append("attn_scale")
-    if getattr(config, "embed_norm", False):
-        unsupported.append("embed_norm")
     if unsupported:
         raise ValueError(
             f"config features {unsupported} are not supported by the ragged "
@@ -251,6 +252,8 @@ def adapt_decoder(params: Dict, config,
         parallel_block=config.parallel_block,
         parallel_dual_norm=config.parallel_dual_norm,
         tied_lm_head=config.tied_lm_head, head_bias=config.head_bias,
+        alibi=getattr(config, "alibi", False),
+        embed_norm=getattr(config, "embed_norm", False),
         eps=config.eps, dtype=config.dtype)
 
     layers = [params[f"layers_{i}"] for i in range(config.num_hidden_layers)]
@@ -259,6 +262,8 @@ def adapt_decoder(params: Dict, config,
         "layers": _stack(layers),
         "final_norm": params["final_norm"],
     }
+    if spec.embed_norm:
+        weights["embed_norm"] = params["embed_norm"]
     if config.learned_pos:
         weights["pos_embed"] = params["pos_embed"]["embedding"]
     if not config.tied_lm_head:
@@ -284,13 +289,16 @@ ADAPTERS: Dict[str, Callable] = {
     "gpt_neox": adapt_decoder,
     "gptj": adapt_decoder,
     "gpt_bigcode": adapt_decoder,
+    "bloom": adapt_decoder,   # ALiBi carried by the paged kernels
 }
 
 #: families whose attention needs a bias the ragged kernels don't carry —
 #: serve these through the v1 dense engine instead
 _UNSUPPORTED = {
-    "bloom": "ALiBi position bias",
-    "gpt_neo": "local-window attention layers",
+    # gpt_neo alternates GLOBAL and LOCAL attention layers; the ragged spec
+    # carries one window for all layers, so it stays on the v1 dense engine
+    # (bloom's ALiBi is supported — the kernels bias scores per head)
+    "gpt_neo": "per-layer alternating local-window attention",
 }
 
 
@@ -525,6 +533,9 @@ def _embed_in(spec: "RaggedModelSpec", weights, tokens, positions):
     x = weights["embed"][tokens]
     if spec.learned_pos:
         x = x + weights["pos_embed"][positions + spec.pos_offset]
+    if spec.embed_norm:
+        x = _norm(x.astype(spec.dtype), weights["embed_norm"], spec.norm,
+                  spec.eps, spec.dtype, spec.norm_plus_one)
     if spec.embed_scale_by_sqrt_dim:
         x = x.astype(jnp.float32) * (spec.hidden_size ** 0.5)
     return x.astype(spec.dtype)
@@ -720,9 +731,9 @@ def build_ragged_forward(spec: RaggedModelSpec,
     dtype = spec.dtype
 
     decode_win = functools.partial(paged_decode_attention,
-                                   window=spec.window)
+                                   window=spec.window, alibi=spec.alibi)
     chunk_win = functools.partial(paged_chunk_attention_batched,
-                                  window=spec.window)
+                                  window=spec.window, alibi=spec.alibi)
 
     def _decode_attn(q, kv_l, bts, cls_, **sc_kw):
         if tp > 1:
@@ -997,7 +1008,7 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
                     out = paged_decode_attention_sidebuf(
                         q, kvp5, block_tables + l * NB, prefix,
                         sk_new, sv_new, j, window=spec.window, layer_idx=l,
-                        **sc_kw)
+                        alibi=spec.alibi, **sc_kw)
                     return out, sk_new, sv_new
 
                 x, (sk_all, sv_all) = _transformer_layer(spec, w, x, pos,
@@ -1173,7 +1184,7 @@ def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
     dtype = spec.dtype
 
     step_win = functools.partial(paged_decode_attention_step,
-                                 window=spec.window)
+                                 window=spec.window, alibi=spec.alibi)
 
     def _decode_step(q, k_new, v_new, kv_l, bts, cls_):
         if tp > 1:

@@ -282,43 +282,18 @@ class LlamaAttention(nn.Module):
         q, k, v = self._qkv(x, positions)
         new_cache = {}
         if "k_scale" in layer_cache:
-            for name, rows in (("k", k), ("v", v)):
-                scale = jnp.max(jnp.abs(rows.astype(jnp.float32)),
-                                axis=-1) / 127.0                    # [B,T,Hkv]
-                scale = jnp.maximum(scale, 1e-8)
-                q8 = jnp.clip(jnp.round(rows.astype(jnp.float32)
-                                        / scale[..., None]),
-                              -127, 127).astype(jnp.int8)
-                new_cache[name] = jax.lax.dynamic_update_slice(
-                    layer_cache[name], q8, (0, cache_index, 0, 0))
-                new_cache[f"{name}_scale"] = jax.lax.dynamic_update_slice(
-                    layer_cache[f"{name}_scale"], scale, (0, cache_index, 0))
-            # ADVICE r4: dequant is FOLDED into the attention dots — the
-            # per-token-head scales apply to score columns (K) and to p
-            # before the pv contraction (V), so no dequantized
-            # [B, S_max, Hkv, D] cache (nor its repeat_kv to H heads) is
-            # ever materialised; the transient peak that offset the int8
-            # tier's 1.94x capacity gain is gone by construction.
+            # ADVICE r4: dequant FOLDED into the attention dots (see
+            # quantized_cache_attention) — no dequantized [B, S_max, Hkv, D]
+            # cache nor its repeat_kv is ever materialised, so the transient
+            # peak that offset the tier's 1.94x capacity gain is gone.
+            new_cache = quantized_cache_append(layer_cache, k, v, cache_index)
             S = new_cache["k"].shape[1]
             k_pos = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
             bias = _window_bias(positions, k_pos, cfg.sliding_window)
-            Hq = cfg.num_attention_heads
-            Hkv = cfg.num_key_value_heads
-            G, D = Hq // Hkv, cfg.head_dim
-            qg = q.reshape(B, T, Hkv, G, D).astype(jnp.float32)
-            sc = jnp.einsum("btkgd,bskd->btkgs", qg,
-                            new_cache["k"].astype(jnp.float32))
-            sc = sc * new_cache["k_scale"].astype(jnp.float32) \
-                .transpose(0, 2, 1)[:, None, :, None, :] / (D ** 0.5)
-            # bias [B, 1, T, S] -> broadcast over (Hkv, G)
-            sc = sc + bias[:, 0][:, :, None, None, :]
-            p = jax.nn.softmax(sc, axis=-1)
-            pv = p * new_cache["v_scale"].astype(jnp.float32) \
-                .transpose(0, 2, 1)[:, None, :, None, :]
-            out = jnp.einsum("btkgs,bskd->btkgd", pv,
-                             new_cache["v"].astype(jnp.float32))
-            out = out.reshape(B, T, Hq, D).astype(x.dtype)
-            out = self.o_proj(out.reshape(B, T, Hq * D))
+            out = quantized_cache_attention(q, new_cache, bias,
+                                            cfg.num_key_value_heads)
+            out = self.o_proj(out.reshape(
+                B, T, cfg.num_attention_heads * cfg.head_dim))
             return out, new_cache
         else:
             ck = jax.lax.dynamic_update_slice(
@@ -516,6 +491,54 @@ class LlamaForCausalLM(nn.Module):
         [L, B, S_max, H_kv, D]; cache_index: int32 write offset.
         Returns (logits [B, T, V] fp32, new_cache)."""
         return decode_layers(self, input_ids, cache, cache_index, positions)
+
+
+def quantized_cache_append(layer_cache, k, v, cache_index):
+    """Quantize this step's K/V rows (per token-head symmetric int8) and
+    append them to an int8 dense cache (v1 KV tier; ZeRO-Inference analog,
+    reference README.md:23). Returns the updated cache dict."""
+    new_cache = {}
+    for name, rows in (("k", k), ("v", v)):
+        scale = jnp.max(jnp.abs(rows.astype(jnp.float32)),
+                        axis=-1) / 127.0                        # [B,T,Hkv]
+        scale = jnp.maximum(scale, 1e-8)
+        q8 = jnp.clip(jnp.round(rows.astype(jnp.float32) / scale[..., None]),
+                      -127, 127).astype(jnp.int8)
+        new_cache[name] = jax.lax.dynamic_update_slice(
+            layer_cache[name], q8, (0, cache_index, 0, 0))
+        new_cache[f"{name}_scale"] = jax.lax.dynamic_update_slice(
+            layer_cache[f"{name}_scale"], scale, (0, cache_index, 0))
+    return new_cache
+
+
+def quantized_cache_attention(q, cache, bias, num_kv_heads,
+                              softmax_scale=None):
+    """Attention over an int8 dense cache with the dequant FOLDED into the
+    dots (ADVICE r4): per-token-head scales multiply score columns (K) and
+    p (V) — no dequantized [B, S, Hkv, D] cache and no repeat_kv to H heads
+    is ever materialised.
+
+    q [B, T, H, D]; cache {"k","v" int8 [B,S,Hkv,D], "k_scale","v_scale"
+    [B,S,Hkv] f32}; bias additive f32 [B, 1|H, T, S] (window mask and/or
+    ALiBi). Returns [B, T, H, D] in q's dtype."""
+    B, T, H, D = q.shape
+    S = cache["k"].shape[1]
+    Hkv = num_kv_heads
+    G = H // Hkv
+    scale = softmax_scale if softmax_scale is not None else 1.0 / (D ** 0.5)
+    qg = q.reshape(B, T, Hkv, G, D).astype(jnp.float32)
+    sc = jnp.einsum("btkgd,bskd->btkgs", qg,
+                    cache["k"].astype(jnp.float32)) * scale
+    sc = sc * cache["k_scale"].astype(jnp.float32) \
+        .transpose(0, 2, 1)[:, None, :, None, :]
+    bias_b = jnp.broadcast_to(bias, (B, H, T, S)) \
+        .reshape(B, Hkv, G, T, S).transpose(0, 3, 1, 2, 4)
+    p = jax.nn.softmax(sc + bias_b, axis=-1)
+    pv = p * cache["v_scale"].astype(jnp.float32) \
+        .transpose(0, 2, 1)[:, None, :, None, :]
+    out = jnp.einsum("btkgs,bskd->btkgd", pv,
+                     cache["v"].astype(jnp.float32))
+    return out.reshape(B, T, H, D).astype(q.dtype)
 
 
 def init_cache(config: LlamaConfig, batch_size: int, max_len: int,

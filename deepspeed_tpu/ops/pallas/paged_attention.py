@@ -147,6 +147,35 @@ def _pick_pages_per_chunk(bs: int, h_kv: int, d: int, esize: int,
     return max(1, min(max_blocks, budget // per_page))
 
 
+def _alibi_slope(head, H: int):
+    """Elementwise ALiBi slope for q-head index array ``head`` (f32) —
+    the standard geometric schedule (2^(-8/H) powers, with the
+    interpolation for non-power-of-two H), computed ANALYTICALLY so kernels
+    need no slope operand (a [H] vector operand would need sublane-layout
+    gymnastics; an exp2 over an iota needs none). Matches
+    models/decoder.alibi_slopes (parity-tested)."""
+    import math as _m
+    if _m.log2(H).is_integer():
+        s1 = 2.0 ** (-(2.0 ** -(_m.log2(H) - 3)))
+        return jnp.exp2(_m.log2(s1) * (head + 1.0))
+    closest = 2 ** _m.floor(_m.log2(H))
+    s1 = 2.0 ** (-(2.0 ** -(_m.log2(closest) - 3)))
+    s2 = 2.0 ** (-(2.0 ** -(_m.log2(2 * closest) - 3)))
+    return jnp.where(head < closest,
+                     jnp.exp2(_m.log2(s1) * (head + 1.0)),
+                     jnp.exp2(_m.log2(s2) * (2.0 * (head - closest) + 1.0)))
+
+
+# ALiBi in the paged kernels (reference parity: the v1 fused softmax takes
+# alibi on its kernel path, csrc/transformer/inference/csrc/softmax.cu, and
+# module_inject/containers/bloom.py serves BLOOM injected): the bias
+# slope_h * (k_pos - q_pos) is applied as slope_h * k_pos ONLY — the
+# -slope_h * q_pos term is constant along each softmax row and cancels
+# exactly, and dropping it keeps every kernel's bias independent of the
+# query position bookkeeping (the references use the same form, so kernel
+# and reference lse streams shift by the same row constant).
+
+
 def _chunk_mask(c, ctx_limit, T, h_kv, bs, H, tok_lo=None):
     """[H, P*Hkv*bs] block-diagonal + context mask for a head-major chunk
     slab: column j <-> (page p = j // (Hkv*bs), kv head (j // bs) % Hkv,
@@ -200,7 +229,7 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
                  scale, block_size, pages_per_chunk, n_chunks, max_blocks,
                  n_seqs, h_kv, groups, window=None, lse_ref=None,
                  j_ref=None, sidek_ref=None, sidev_ref=None, n_side=0,
-                 sc_hbm=None, sc_buf=None):
+                 sc_hbm=None, sc_buf=None, alibi=False):
     """Shared batched-decode body (see module docstring). With
     ``knew_ref/vnew_ref`` (step mode) the pages hold tokens [0, ctx-1) and
     the current token's attention term folds in from registers at finalize;
@@ -374,6 +403,11 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
                                  preferred_element_type=jnp.float32) * scale
         if quant:
             sc = _colscale_pages(sc, st, P, nsub, 0)
+        if alibi:
+            col = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+            tok = c * T + (col // HB) * bs + jax.lax.rem(col, bs)
+            head = jax.lax.broadcasted_iota(jnp.float32, sc.shape, 0)
+            sc = sc + _alibi_slope(head, H) * tok.astype(jnp.float32)
         _flash_update(sc, mask, vv, m_sc, l_sc, acc_sc,
                       v_scale_fn=v_scale_fn, compute_dtype=q.dtype)
 
@@ -401,6 +435,11 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
                     q_ref[0].astype(sk.dtype), sk,
                     (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32) * scale
+                if alibi:
+                    # side token position = prefix + cc
+                    headf = jax.lax.broadcasted_iota(jnp.float32, (H, Ws), 0)
+                    sc_s = sc_s + _alibi_slope(headf, H) \
+                        * (ctx + cc).astype(jnp.float32)
                 # rows > j may hold reused garbage; p is 0 there but
                 # 0 * inf = NaN through the pv dot, so zero sv's dead rows
                 # (same reasoning as the skipped-page V zeroing above)
@@ -432,6 +471,10 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
                 sc_rows.append(jnp.sum(qh * knh[None, :], axis=1,
                                        keepdims=True) * scale)
             sc_cur = jnp.concatenate(sc_rows, axis=0)          # [H, 1]
+            if alibi:
+                headf = jax.lax.broadcasted_iota(jnp.float32, (H, 1), 0)
+                sc_cur = sc_cur + _alibi_slope(headf, H) \
+                    * (ctx - 1).astype(jnp.float32)
             m_l = m_sc[:, 0:1]
             m_f = jnp.maximum(m_l, sc_cur)
             alpha_f = jnp.exp(m_l - m_f)
@@ -473,7 +516,7 @@ def _sidebuf_batched_body(bt_ref, cl_ref, j_ref, q_ref, sidek_ref, sidev_ref,
                           kv_buf, sc_buf, sems, acc_sc, m_sc, l_sc, *,
                           scale, block_size, pages_per_chunk, n_chunks,
                           max_blocks, n_seqs, h_kv, groups, window=None,
-                          n_side=0, batch_seqs=1, sc_hbm=None):
+                          n_side=0, batch_seqs=1, sc_hbm=None, alibi=False):
     """SB-batched side-slab decode body: one grid step carries
     ``batch_seqs`` sequences' chunks. The decode grid is sequential
     ("arbitrary" semantics for the 2-slot DMA pipeline) and MEASURED to be
@@ -622,6 +665,11 @@ def _sidebuf_batched_body(bt_ref, cl_ref, j_ref, q_ref, sidek_ref, sidev_ref,
                                          ) * scale
                 if quant:
                     sc = _colscale_pages(sc, st, P, nsub, 0)
+                if alibi:
+                    col = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 1)
+                    tok = c * T + (col // HB) * bs + jax.lax.rem(col, bs)
+                    headf = jax.lax.broadcasted_iota(jnp.float32, sc.shape, 0)
+                    sc = sc + _alibi_slope(headf, H) * tok.astype(jnp.float32)
                 # per-sequence flash state rows i
                 m_i, l_i, acc_i = m_sc.at[i], l_sc.at[i], acc_sc.at[i]
                 _flash_update(sc, mask, vv, m_i, l_i, acc_i,
@@ -645,6 +693,10 @@ def _sidebuf_batched_body(bt_ref, cl_ref, j_ref, q_ref, sidek_ref, sidev_ref,
                     q_ref[i].astype(sk.dtype), sk,
                     (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32) * scale
+                if alibi:
+                    headf = jax.lax.broadcasted_iota(jnp.float32, (H, Ws), 0)
+                    sc_s = sc_s + _alibi_slope(headf, H) \
+                        * (ctx + cc).astype(jnp.float32)
                 row1 = jax.lax.broadcasted_iota(jnp.int32, (Ws, 1), 0)
                 sv = jnp.where(row1 // h_kv <= jcur, sv, 0.0)
                 m_i, l_i, acc_i = m_sc.at[i], l_sc.at[i], acc_sc.at[i]
@@ -710,7 +762,8 @@ def paged_decode_attention_sidebuf(q: jax.Array,
                                    softmax_scale: Optional[float] = None,
                                    window: Optional[int] = None,
                                    kv_scales: Optional[jax.Array] = None,
-                                   layer_idx=None) -> jax.Array:
+                                   layer_idx=None,
+                                   alibi: bool = False) -> jax.Array:
     """Decode attention over a FROZEN paged prefix plus a per-sequence side
     slab of freshly decoded K/V — the kernel of the scatter-free multistep
     schedule (``inference/v2/ragged_model._build_multistep_sidebuf``).
@@ -792,7 +845,7 @@ def paged_decode_attention_sidebuf(q: jax.Array,
             else _sidebuf_batched_kernel,
             scale=scale, block_size=bs, pages_per_chunk=P, n_chunks=NC,
             max_blocks=MB, n_seqs=S, h_kv=Hkv, groups=G, window=window,
-            n_side=Cs, batch_seqs=SB)
+            n_side=Cs, batch_seqs=SB, alibi=alibi)
         in_specs = [
             pl.BlockSpec((SB, H, D), lambda s, c, bt, cl, jj, ll: (s, 0, 0)),
             pl.BlockSpec((1, SB, Cs * Hkv, D),
@@ -833,7 +886,7 @@ def paged_decode_attention_sidebuf(q: jax.Array,
         _decode_kernel_sidebuf_quant if quant else _decode_kernel_sidebuf,
         scale=scale, block_size=bs,
         pages_per_chunk=P, n_chunks=NC, max_blocks=MB, n_seqs=S, h_kv=Hkv,
-        groups=G, window=window, n_side=Cs)
+        groups=G, window=window, n_side=Cs, alibi=alibi)
     in_specs = [
         pl.BlockSpec((1, H, D), lambda s, c, bt, cl, jj, ll: (s, 0, 0)),
         pl.BlockSpec((1, 1, Cs * Hkv, D),
@@ -873,7 +926,8 @@ def paged_decode_attention_sidebuf(q: jax.Array,
 
 def _decode_kernel_smalld(bt_ref, cl_ref, q_ref, kv_ref, o_ref,
                           acc_sc, m_sc, l_sc, *, scale, block_size,
-                          max_blocks, h_kv, groups, window=None):
+                          max_blocks, h_kv, groups, window=None,
+                          alibi=False):
     """BlockSpec-pipelined fallback for head dims the manual-DMA path can't
     carry (Mosaic requires DMA lane extents aligned to 128; D=64-class
     models land here). One grid step = (sequence, page), pages pulled by the
@@ -904,6 +958,11 @@ def _decode_kernel_smalld(bt_ref, cl_ref, q_ref, kv_ref, o_ref,
             vh = kv_ref[0, 1, h].astype(jnp.float32)
             sc = jax.lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32) * scale
+            if alibi:
+                gof = jax.lax.broadcasted_iota(jnp.float32, (groups, bs), 0)
+                kpf = i * bs + jax.lax.broadcasted_iota(
+                    jnp.float32, (groups, bs), 1)
+                sc = sc + _alibi_slope(h * groups + gof, H) * kpf
             mh = mask[rows, :]
             sc = jnp.where(mh, sc, NEG_INF)
             m_prev = m_sc[rows, 0:1]
@@ -925,14 +984,14 @@ def _decode_kernel_smalld(bt_ref, cl_ref, q_ref, kv_ref, o_ref,
 
 
 def _paged_decode_smalld(q, kv_pages, block_tables, ctx_lens, scale,
-                         window=None):
+                         window=None, alibi=False):
     S, H, D = q.shape
     NB, _, Hkv, bs, _ = kv_pages.shape
     G = H // Hkv
     MB = block_tables.shape[1]
     kernel = functools.partial(_decode_kernel_smalld, scale=scale,
                                block_size=bs, max_blocks=MB, h_kv=Hkv,
-                               groups=G, window=window)
+                               groups=G, window=window, alibi=alibi)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S, MB),
@@ -966,7 +1025,8 @@ def paged_decode_attention(q: jax.Array,
                            softmax_scale: Optional[float] = None,
                            window: Optional[int] = None,
                            with_lse: bool = False,
-                           kv_scales: Optional[jax.Array] = None):
+                           kv_scales: Optional[jax.Array] = None,
+                           alibi: bool = False):
     """Single-token-per-sequence attention over a paged KV cache.
 
     q:            [S, H, D]        one query token per sequence
@@ -994,7 +1054,8 @@ def paged_decode_attention(q: jax.Array,
         assert not with_lse, "with_lse needs the manual-DMA path (D % 128 == 0)"
         assert not quant, "int8 pages need the manual-DMA path (D % 128 == 0)"
         return _paged_decode_smalld(q, kv_pages, block_tables,
-                                    ctx_lens, scale, window=window)
+                                    ctx_lens, scale, window=window,
+                                    alibi=alibi)
     P = _pick_pages_per_chunk(bs, Hkv, D, jnp.dtype(kv_pages.dtype).itemsize,
                               MB)
     NC = -(-MB // P)
@@ -1007,7 +1068,7 @@ def paged_decode_attention(q: jax.Array,
         else (_decode_kernel_lse if with_lse else _decode_kernel),
         scale=scale, block_size=bs, pages_per_chunk=P,
         n_chunks=NC, max_blocks=MB, n_seqs=S, h_kv=Hkv, groups=G,
-        window=window)
+        window=window, alibi=alibi)
     out_spec = pl.BlockSpec((1, H, D), lambda s, c, bt, cl: (s, 0, 0))
     out_shape = jax.ShapeDtypeStruct((S, H, D), q.dtype)
     if with_lse:
@@ -1124,7 +1185,8 @@ def paged_decode_attention_step(q: jax.Array,
                                 ctx_lens: jax.Array,
                                 softmax_scale: Optional[float] = None,
                                 window: Optional[int] = None,
-                                kv_scales: Optional[jax.Array] = None):
+                                kv_scales: Optional[jax.Array] = None,
+                                alibi: bool = False):
     """One fused decode step per sequence: write ``k_new/v_new`` (the current
     token's K/V, position ``ctx_lens - 1``) into the paged cache AND return
     attention over the full context including the current token (with
@@ -1159,7 +1221,7 @@ def paged_decode_attention_step(q: jax.Array,
         kvf = kv_pages.reshape(NB * 2 * Hkv * bs, D).at[rows].set(
             new.astype(kv_pages.dtype), mode="drop").reshape(kv_pages.shape)
         out = _paged_decode_smalld(q, kvf, block_tables, ctx_lens, scale,
-                                   window=window)
+                                   window=window, alibi=alibi)
         return out, kvf
     P = _pick_pages_per_chunk(bs, Hkv, D, jnp.dtype(kv_pages.dtype).itemsize,
                               MB)
@@ -1170,7 +1232,7 @@ def paged_decode_attention_step(q: jax.Array,
         _decode_step_kernel_quant if quant else _decode_step_kernel,
         scale=scale, block_size=bs, pages_per_chunk=P,
         n_chunks=NC, max_blocks=MB, n_seqs=S, h_kv=Hkv, groups=G,
-        window=window)
+        window=window, alibi=alibi)
     flat = (NB, 2 * Hkv * bs, D)
     in_specs = [
         pl.BlockSpec((1, H, D), lambda s, c, bt, cl: (s, 0, 0)),
@@ -1258,7 +1320,8 @@ def paged_chunk_attention(q: jax.Array,
                           ctx_len,
                           softmax_scale: Optional[float] = None,
                           block_q: int = 128,
-                          window: Optional[int] = None) -> jax.Array:
+                          window: Optional[int] = None,
+                          alibi: bool = False) -> jax.Array:
     """Prompt-chunk (prefill) flash attention over one sequence's paged KV.
 
     The single-chunk convenience wrapper: one slot of
@@ -1278,7 +1341,8 @@ def paged_chunk_attention(q: jax.Array,
         q[None], kv_pages, jnp.asarray(block_table)[None],
         jnp.asarray(q_start, jnp.int32)[None],
         jnp.asarray(ctx_len, jnp.int32)[None],
-        softmax_scale=softmax_scale, block_q=block_q, window=window)[0]
+        softmax_scale=softmax_scale, block_q=block_q, window=window,
+        alibi=alibi)[0]
 
 
 
@@ -1308,7 +1372,7 @@ def _chunk_head_scale(mat, sc_ref, flat0, bs):
 def _chunk_kernel_batched(bt_ref, meta_ref, q_ref, kv_ref, o_ref,
                           acc_sc, m_sc, l_sc, *, scale, block_size, block_q,
                           max_blocks, h_kv, groups, window=None,
-                          sc_ref=None):
+                          sc_ref=None, alibi=False):
     """Multi-slot variant of ``_chunk_kernel``: grid (slot, q-block, page);
     each slot is an independent prompt chunk with its own block table and
     (q_start, ctx) row in ``meta_ref``. Slot padding (ctx 0) writes zeros.
@@ -1355,6 +1419,15 @@ def _chunk_kernel_batched(bt_ref, meta_ref, q_ref, kv_ref, o_ref,
                 # the (Hkv*bs) % 128 == 0 gate guarantees 128-alignment of
                 # every head's span even when bs < 128
                 sc = _chunk_head_scale(sc, sc_ref, h * bs, bs)
+            if alibi:
+                # rows of this slice are (q-row, g) for q heads h*G + g;
+                # built in (bq, G, bs) then merged like the mask above
+                gof = jax.lax.broadcasted_iota(jnp.float32, (bq, G, bs), 1)
+                slope = _alibi_slope(h * G + gof, h_kv * G)
+                kpf = jnp.broadcast_to(
+                    (i * bs + jax.lax.broadcasted_iota(
+                        jnp.float32, (bq, bs), 1))[:, None, :], (bq, G, bs))
+                sc = sc + (slope * kpf).reshape(bq * G, bs)
             sc = jnp.where(mask, sc, NEG_INF)
             rows = slice(h * bq * G, (h + 1) * bq * G)
             m_prev = m_sc[rows, 0:1]
@@ -1395,8 +1468,8 @@ def paged_chunk_attention_batched(q: jax.Array,
                                   softmax_scale: Optional[float] = None,
                                   block_q: int = 128,
                                   window: Optional[int] = None,
-                                  kv_scales: Optional[jax.Array] = None
-                                  ) -> jax.Array:
+                                  kv_scales: Optional[jax.Array] = None,
+                                  alibi: bool = False) -> jax.Array:
     """Prefill flash attention for SEVERAL prompt chunks in one kernel.
 
     Multi-chunk SplitFuse: a pass that carries one chunk per pallas call
@@ -1430,7 +1503,7 @@ def paged_chunk_attention_batched(q: jax.Array,
     kernel = functools.partial(
         _chunk_kernel_batched_quant if quant else _chunk_kernel_batched,
         scale=scale, block_size=bs, block_q=bq, max_blocks=MB,
-        h_kv=Hkv, groups=G, window=window)
+        h_kv=Hkv, groups=G, window=window, alibi=alibi)
     in_specs = [
         pl.BlockSpec((1, bq, H, D), lambda sl, iq, i, bt, m: (sl, iq, 0, 0)),
         pl.BlockSpec((1, 2, Hkv, bs, D),
@@ -1485,7 +1558,8 @@ def _gather_seq(kv_pages, block_tables, G):
 def paged_decode_attention_reference(q, kv_pages, block_tables, ctx_lens,
                                      softmax_scale: Optional[float] = None,
                                      window: Optional[int] = None,
-                                     with_lse: bool = False):
+                                     with_lse: bool = False,
+                                     alibi: bool = False):
     """jnp reference (gathers each sequence's pages)."""
     S, H, D = q.shape
     NB, _, Hkv, bs, _ = kv_pages.shape
@@ -1495,6 +1569,10 @@ def paged_decode_attention_reference(q, kv_pages, block_tables, ctx_lens,
     k_seq, v_seq = _gather_seq(kv_pages, block_tables, G)
     sc = jnp.einsum("shd,sthd->sht", q.astype(jnp.float32),
                     k_seq.astype(jnp.float32)) * scale
+    if alibi:
+        head = jnp.arange(H, dtype=jnp.float32)
+        sc = sc + (_alibi_slope(head, H)[None, :, None]
+                   * jnp.arange(MB * bs, dtype=jnp.float32)[None, None, :])
     mask = jnp.arange(MB * bs)[None, None, :] < ctx_lens[:, None, None]
     if window is not None:
         mask = mask & (jnp.arange(MB * bs)[None, None, :]
@@ -1513,7 +1591,8 @@ def paged_decode_attention_reference(q, kv_pages, block_tables, ctx_lens,
 def paged_decode_attention_step_reference(q, k_new, v_new, kv_pages,
                                           block_tables, ctx_lens,
                                           softmax_scale: Optional[float] = None,
-                                          window: Optional[int] = None):
+                                          window: Optional[int] = None,
+                                          alibi: bool = False):
     """jnp reference: scatter the new rows, then dense paged-decode reference."""
     S, H, D = q.shape
     NB, _, Hkv, bs, _ = kv_pages.shape
@@ -1523,13 +1602,15 @@ def paged_decode_attention_step_reference(q, k_new, v_new, kv_pages,
     kvf = kv_pages.reshape(NB * 2 * Hkv * bs, D).at[rows].set(
         new.astype(kv_pages.dtype), mode="drop").reshape(kv_pages.shape)
     out = paged_decode_attention_reference(q, kvf, block_tables, ctx_lens,
-                                           softmax_scale, window=window)
+                                           softmax_scale, window=window,
+                                           alibi=alibi)
     return out, kvf
 
 
 def paged_decode_attention_sidebuf_reference(q, kv_pages, block_tables,
                                              prefix_lens, side_k, side_v, j,
-                                             softmax_scale=None, window=None):
+                                             softmax_scale=None, window=None,
+                                             alibi=False):
     """jnp reference: paged prefix piece (with lse) merged with dense masked
     attention over the side slab — the two-piece computation the fused
     kernel replaces."""
@@ -1545,10 +1626,16 @@ def paged_decode_attention_sidebuf_reference(q, kv_pages, block_tables,
             jnp.maximum(eff_ctx - window, 0), scale)
     else:
         out_p, lse_p = paged_decode_attention_reference(
-            q, kv_pages, block_tables, prefix_lens, scale, with_lse=True)
+            q, kv_pages, block_tables, prefix_lens, scale, with_lse=True,
+            alibi=alibi)
     qg = q.reshape(S, Hkv, G, D).astype(jnp.float32)
     sc = jnp.einsum("shgd,schd->shgc", qg,
                     side_k.astype(jnp.float32)) * scale
+    if alibi:
+        head = jnp.arange(H, dtype=jnp.float32).reshape(Hkv, G)
+        sc = sc + (_alibi_slope(head, H)[None, :, :, None]
+                   * (prefix_lens[:, None, None, None]
+                      + jnp.arange(Cs, dtype=jnp.float32)[None, None, None, :]))
     col_ok = (jnp.arange(Cs) <= j)[None, None, None, :]
     if window is not None:
         col_ok = jnp.logical_and(col_ok,
@@ -1596,19 +1683,22 @@ def _paged_reference_lse_lo(q, kv_pages, block_tables, ctx_lens,
 def paged_chunk_attention_batched_reference(q, kv_pages, block_tables,
                                             q_starts, ctx_lens,
                                             softmax_scale: Optional[float] = None,
-                                            window: Optional[int] = None):
+                                            window: Optional[int] = None,
+                                            alibi: bool = False):
     """jnp reference: per-slot single-chunk reference, stacked."""
     outs = []
     for sl in range(q.shape[0]):
         outs.append(paged_chunk_attention_reference(
             q[sl], kv_pages, block_tables[sl],
-            q_starts[sl], ctx_lens[sl], softmax_scale, window=window))
+            q_starts[sl], ctx_lens[sl], softmax_scale, window=window,
+            alibi=alibi))
     return jnp.stack(outs)
 
 
 def paged_chunk_attention_reference(q, kv_pages, block_table, q_start,
                                     ctx_len, softmax_scale: Optional[float] = None,
-                                    window: Optional[int] = None):
+                                    window: Optional[int] = None,
+                                    alibi: bool = False):
     """jnp reference for the chunk kernel (materialises the [C, MB*bs] scores)."""
     C, H, D = q.shape
     NB, _, Hkv, bs, _ = kv_pages.shape
@@ -1619,6 +1709,10 @@ def paged_chunk_attention_reference(q, kv_pages, block_table, q_start,
     k_seq, v_seq = k_seq[0], v_seq[0]              # [MB*bs, H, D]
     sc = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
                     k_seq.astype(jnp.float32)) * scale
+    if alibi:
+        head = jnp.arange(H, dtype=jnp.float32)
+        sc = sc + (_alibi_slope(head, H)[:, None, None]
+                   * jnp.arange(MB * bs, dtype=jnp.float32)[None, None, :])
     q_pos = q_start + jnp.arange(C)
     k_pos = jnp.arange(MB * bs)
     mask = (k_pos[None, :] <= q_pos[:, None]) & (k_pos[None, :] < ctx_len)

@@ -25,6 +25,11 @@ tick inputs and recomputes the local stack serially — stored bytes then SHRINK
 as n_micro grows (per-tick inputs get smaller), the 1F1B residency bound.
 Measured on the v5e AOT topology (tests/unit/test_pipeline_memory.py, n_micro
 in {4, 16}): plain {4: 1110, 16: 748} MB vs remat {4: 245, 16: 52} MB.
+The same bound holds in the MULTI-STAGE regime 1F1B exists for — pipe=4
+stages, (4, 2) v5e mesh, per-stage residuals (r5:
+test_remat_ticks_bounds_memory_at_pipe4) — so stored activations lose both
+time (single-chip ticks) and memory (4-stage AOT), and remat_ticks stays
+the default on multi-stage evidence rather than single-chip extrapolation.
 """
 
 from __future__ import annotations

@@ -190,11 +190,37 @@ def test_kv_quant_cache_bytes_halve(eight_devices):
     assert b8 / b16 < 0.53, b8 / b16
 
 
-def test_kv_quant_rejects_non_llama_cache(eight_devices):
-    # a custom (non-llama) cache builder has no int8 tier: the engine must
-    # refuse loudly instead of handing the family a cache it cannot read
-    from deepspeed_tpu.models.decoder import init_decoder_cache
+def test_kv_quant_rejects_cache_factory_without_tier(eight_devices):
+    # a custom cache builder that takes no kv_bits has no int8 tier: the
+    # engine must refuse loudly instead of handing the family a cache it
+    # cannot read (the zoo factories all take kv_bits now — r5 #9)
+    def plain_cache(config, batch_size, max_len, dtype=None):
+        from deepspeed_tpu.models.llama import init_cache
+        return init_cache(config, batch_size, max_len, dtype=dtype)
+
     eng, _ = _tiny_llama_v1(True)
-    eng._init_cache_fn = init_decoder_cache
-    with pytest.raises(NotImplementedError):
+    eng._init_cache_fn = plain_cache
+    with pytest.raises(TypeError):
         eng._make_cache(1, 8)
+
+
+@pytest.mark.parametrize("family", ["opt", "bloom", "gpt_neox"])
+def test_kv_quant_decoder_zoo_greedy_match(family):
+    """int8 dense-cache tier beyond llama-lineage (VERDICT r4 #9): the
+    decoder zoo (incl. BLOOM's per-head ALiBi bias) must greedy-match its
+    bf16-cache engine."""
+    from deepspeed_tpu.models.decoder import DecoderConfig, DecoderLM
+    cfg = DecoderConfig.tiny(family, dtype=jnp.float32)
+    model = DecoderLM(cfg)
+    params = model.init(jax.random.PRNGKey(2),
+                        {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, cfg.vocab_size, size=(1, 12)).astype(np.int32)
+    e_bf = deepspeed_tpu.init_inference(model, model_parameters=params,
+                                        dtype="fp32", max_tokens=48)
+    e_q = deepspeed_tpu.init_inference(model, model_parameters=params,
+                                       dtype="fp32", max_tokens=48,
+                                       kv_quant={"enabled": True})
+    out_bf = e_bf.generate(prompt, max_new_tokens=8)
+    out_q = e_q.generate(prompt, max_new_tokens=8)
+    np.testing.assert_array_equal(out_bf, out_q)

@@ -435,26 +435,21 @@ class TestEngineV2:
         out = eng.generate(PROMPTS[:2], max_new_tokens=4)
         assert out == ref
 
-    def test_feature_guard_catches_alibi_under_any_family(self):
+    def test_feature_guard_catches_local_layers_under_any_family(self):
+        """ALiBi is ragged-supported since r5; the remaining genuinely
+        uncarryable feature — per-layer alternating local windows
+        (gpt_neo) — must still be refused with v1 guidance."""
         from deepspeed_tpu.inference.v2.ragged_model import adapt_decoder
         from deepspeed_tpu.models.decoder import DecoderConfig, DecoderLM
-        cfg = DecoderConfig.tiny("opt", alibi=True, dtype=jnp.float32)
+        cfg = DecoderConfig.tiny("opt", dtype=jnp.float32)
+        object.__setattr__(cfg, "attention_layers",
+                           ("global", "local") * (cfg.num_hidden_layers // 2))
+        object.__setattr__(cfg, "local_window", 8)
         model = DecoderLM(cfg)
         params = model.init(jax.random.PRNGKey(11),
                             {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
-        with pytest.raises(ValueError, match="alibi"):
-            adapt_decoder(params, cfg)
-
-    def test_alibi_family_rejected_with_guidance(self):
-        from deepspeed_tpu.models.decoder import DecoderConfig, DecoderLM
-        cfg = DecoderConfig.tiny("bloom", dtype=jnp.float32)
-        model = DecoderLM(cfg)
-        params = model.init(jax.random.PRNGKey(8),
-                            {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
         with pytest.raises(ValueError, match="v1 dense engine"):
-            InferenceEngineV2(model=model,
-                              config=RaggedInferenceEngineConfig.load(dict(V2_CONFIG)),
-                              model_parameters=params)
+            adapt_decoder(params, cfg)
 
     def test_gpt2_family(self):
         from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
@@ -648,3 +643,27 @@ def test_int8_weights_quantize_moe_experts(eight_devices):
     out_bf = e_bf.generate(PROMPTS[:2], max_new_tokens=4)
     out_q = e_q.generate(PROMPTS[:2], max_new_tokens=4)
     assert out_bf == out_q
+
+
+def test_bloom_alibi_served_via_v2(eight_devices):
+    """BLOOM (ALiBi + embed-LayerNorm) through the ragged v2 engine must
+    greedy-match the v1 dense engine (VERDICT r4 'do this' #6: lift
+    _UNSUPPORTED['bloom'] — the paged kernels now carry the per-head
+    position bias; reference parity: csrc/.../softmax.cu alibi path +
+    module_inject/containers/bloom.py)."""
+    from deepspeed_tpu.models.decoder import DecoderConfig, DecoderLM
+    from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+    cfg = DecoderConfig.tiny("bloom", dtype=jnp.float32)
+    model = DecoderLM(cfg)
+    params = model.init(jax.random.PRNGKey(6),
+                        {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+    v1 = deepspeed_tpu.init_inference(model, model_parameters=params,
+                                      dtype="fp32", max_tokens=64)
+    ref = [v1.generate(np.asarray([p], np.int32),
+                       max_new_tokens=6)[0].tolist() for p in PROMPTS]
+    eng = InferenceEngineV2(model=model,
+                            config=RaggedInferenceEngineConfig.load(
+                                dict(V2_CONFIG)),
+                            model_parameters=params)
+    out = eng.generate(PROMPTS, max_new_tokens=6)
+    assert out == ref

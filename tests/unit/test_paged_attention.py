@@ -454,3 +454,92 @@ class TestSidebufBatched:
                                                        sk, sv, 5)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=3e-5, rtol=3e-4)
+
+
+class TestAlibi:
+    """ALiBi in the paged kernels (BLOOM serving parity — reference
+    csrc/transformer/inference/csrc/softmax.cu applies alibi on the fused
+    softmax path). The kernels add slope_h * k_pos; the -slope_h * q_pos
+    term is a softmax row constant and cancels."""
+
+    def test_slope_helper_matches_model_slopes(self):
+        from deepspeed_tpu.models.decoder import alibi_slopes
+        from deepspeed_tpu.ops.pallas.paged_attention import _alibi_slope
+        for H in (4, 8, 16, 12, 14):
+            got = _alibi_slope(jnp.arange(H, dtype=jnp.float32), H)
+            np.testing.assert_allclose(np.asarray(got),
+                                       np.asarray(alibi_slopes(H)),
+                                       rtol=1e-6)
+
+    @pytest.mark.parametrize("D", [64, 128])
+    def test_decode_matches_reference(self, D):
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention, paged_decode_attention_reference)
+        rng = np.random.RandomState(41)
+        S, H, Hkv, NB, bs, MB = 3, 8, 2, 20, 8, 4
+        q, kv, bt = _setup(rng, S, H, D, Hkv, NB, bs, MB)
+        cl = jnp.asarray([1, 9, 30], jnp.int32)
+        out = paged_decode_attention(q, kv, bt, cl, alibi=True)
+        ref = paged_decode_attention_reference(q, kv, bt, cl, alibi=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-4)
+
+    def test_step_matches_reference(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_step, paged_decode_attention_step_reference)
+        rng = np.random.RandomState(42)
+        S, H, Hkv, D, bs, MB = 2, 4, 2, 128, 8, 3
+        NB = S * MB + 1
+        kv = jnp.asarray(rng.randn(NB, 2, Hkv, bs, D), jnp.float32)
+        q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
+        kn = jnp.asarray(rng.randn(S, Hkv, D), jnp.float32)
+        vn = jnp.asarray(rng.randn(S, Hkv, D), jnp.float32)
+        bt = jnp.asarray(rng.permutation(NB - 1)[:S * MB].reshape(S, MB) + 1,
+                         jnp.int32)
+        cl = jnp.asarray([6, 17], jnp.int32)
+        out, kvf = paged_decode_attention_step(q, kn, vn, kv, bt, cl,
+                                               alibi=True)
+        orf, kvrf = paged_decode_attention_step_reference(q, kn, vn, kv,
+                                                          bt, cl, alibi=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(orf),
+                                   atol=2e-5, rtol=2e-4)
+
+    def test_chunk_matches_reference(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            paged_chunk_attention_batched,
+            paged_chunk_attention_batched_reference)
+        rng = np.random.RandomState(43)
+        NC, Cs, H, Hkv, D, bs, MB = 2, 16, 8, 2, 64, 8, 6
+        NB = NC * MB + 1
+        kv = jnp.asarray(rng.randn(NB, 2, Hkv, bs, D), jnp.float32)
+        q = jnp.asarray(rng.randn(NC, Cs, H, D), jnp.float32)
+        bt = jnp.asarray(rng.permutation(NB - 1)[:NC * MB].reshape(NC, MB) + 1,
+                         jnp.int32)
+        q0s = jnp.asarray([0, 13], jnp.int32)
+        ctxs = jnp.asarray([16, 29], jnp.int32)
+        out = paged_chunk_attention_batched(q, kv, bt, q0s, ctxs, alibi=True)
+        ref = paged_chunk_attention_batched_reference(q, kv, bt, q0s, ctxs,
+                                                      alibi=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-4)
+
+    def test_sidebuf_matches_reference(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_sidebuf,
+            paged_decode_attention_sidebuf_reference)
+        rng = np.random.RandomState(44)
+        S, H, Hkv, D, bs, MB, C = 4, 8, 2, 128, 8, 3, 8
+        NB = S * MB + 1
+        kv = jnp.asarray(rng.randn(NB, 2, Hkv, bs, D), jnp.float32)
+        q = jnp.asarray(rng.randn(S, H, D), jnp.float32)
+        bt = jnp.asarray(rng.permutation(NB - 1)[:S * MB].reshape(S, MB) + 1,
+                         jnp.int32)
+        prefix = jnp.asarray([0, 5, bs, 2 * bs + 3], jnp.int32)
+        sk = jnp.asarray(rng.randn(S, C, Hkv, D), jnp.float32)
+        sv = jnp.asarray(rng.randn(S, C, Hkv, D), jnp.float32)
+        out = paged_decode_attention_sidebuf(q, kv, bt, prefix, sk, sv, 5,
+                                             alibi=True)
+        ref = paged_decode_attention_sidebuf_reference(q, kv, bt, prefix,
+                                                       sk, sv, 5, alibi=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-4)
