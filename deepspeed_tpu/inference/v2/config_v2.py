@@ -64,8 +64,10 @@ class QuantizationConfig:
     weight_bits: Optional[int] = None
 
     def __post_init__(self):
-        if self.weight_bits not in (None, 8):
-            raise ValueError("quantization.weight_bits must be None or 8, "
+        # 4 = PACKED int4 (two per byte along K, 4x under bf16 at rest —
+        # reference csrc/quantization/quantize_intX.cu); 8 = int8
+        if self.weight_bits not in (None, 4, 8):
+            raise ValueError("quantization.weight_bits must be None, 4 or 8, "
                              f"got {self.weight_bits!r}")
 
 

@@ -86,15 +86,17 @@ class InferenceEngineV2:
         self.spec, weights = adapt_model(family, params, model_config,
                                          max_context=cfg.state_manager.max_context)
         self.spec.dtype = cfg.dtype
-        if cfg.quantization.weight_bits == 8:
+        if cfg.quantization.weight_bits in (4, 8):
             if tp > 1:
                 raise NotImplementedError(
-                    "weight-only int8 with tensor_parallel > 1 is not wired "
-                    "yet (the AutoTP rule walker shards plain arrays); run "
-                    "int8 at tp=1 or bf16 under tp")
+                    "weight-only int4/int8 with tensor_parallel > 1 is not "
+                    "wired yet (the AutoTP rule walker shards plain arrays); "
+                    "run quantized at tp=1 or bf16 under tp")
             from deepspeed_tpu.inference.v2.ragged_model import (
-                quantize_weights_int8)
-            weights = quantize_weights_int8(weights)
+                quantize_weights_int4, quantize_weights_int8)
+            weights = (quantize_weights_int8(weights)
+                       if cfg.quantization.weight_bits == 8
+                       else quantize_weights_int4(weights))
         self.weights = self._shard_weights(weights)
 
         # KV cache + allocator + scheduler

@@ -421,11 +421,43 @@ def _mm(x, w):
                                 (((x.ndim - 1,), (0,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         return (o * w["scale"]).astype(x.dtype)
+    if isinstance(w, dict) and "w4" in w:
+        # packed int4 (two values per byte along K — the reference's
+        # quantize_intX.cu storage win, /4 vs bf16 at rest): unpack with
+        # sign-extending shifts, then the same mixed dot as int8
+        from deepspeed_tpu.ops.quantizer import unpack_int4
+        wk = unpack_int4(w["w4"], axis=-2)
+        o = jax.lax.dot_general(x, wk.astype(x.dtype),
+                                (((x.ndim - 1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        return (o * w["scale"]).astype(x.dtype)
     return x @ w
 
 
 _QUANT_KEYS = ("wq", "wk", "wv", "wo")
 _QUANT_MLP_KEYS = ("w_gate", "w_up", "w_down")
+
+
+def quantize_weights_int4(weights: Dict) -> Dict:
+    """Packed-int4 weight-only serving store (reference parity:
+    ``csrc/quantization/quantize_intX.cu`` packed 4-bit). Same tree walk as
+    :func:`quantize_weights_int8`, but values quantize to [-7, 7] with
+    per-output-column scales and STORE two-per-byte along K
+    (``ops/quantizer.pack_int4``) — at-rest HBM is K*N/2 bytes, a measured
+    4x under bf16. The matmul unpacks with sign-extending shifts (``_mm``).
+    """
+    from deepspeed_tpu.ops.quantizer import pack_int4
+
+    def q4(w):
+        absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2,
+                         keepdims=True)
+        scale = jnp.where(absmax > 0, absmax / 7.0, 1.0)
+        qv = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                      -7, 7).astype(jnp.int8)
+        return {"w4": pack_int4(qv, axis=-2),
+                "scale": scale.astype(jnp.float32)}
+
+    return _quantize_weight_tree(weights, q4)
 
 
 def quantize_weights_int8(weights: Dict) -> Dict:
@@ -444,6 +476,10 @@ def quantize_weights_int8(weights: Dict) -> Dict:
                       -127, 127).astype(jnp.int8)
         return {"w8": w8, "scale": scale.astype(jnp.float32)}
 
+    return _quantize_weight_tree(weights, q)
+
+
+def _quantize_weight_tree(weights: Dict, q) -> Dict:
     layers = weights["layers"]
     for key in _QUANT_KEYS:
         if key in layers and not isinstance(layers[key], dict):
@@ -976,8 +1012,13 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
         # token; the pages hold only the frozen prefix [0, ctx0 - 1) — the
         # current token (and everything after) lives in the side buffers
         prefix = jnp.maximum(ctx0 - 1, 0)
-        side_k0 = jnp.zeros((L, S, Cb, Hkv, D), dtype)
-        side_v0 = jnp.zeros((L, S, Cb, Hkv, D), dtype)
+        # side buffers live PRE-FLATTENED as [L, S, Cb*Hkv, D] rows
+        # (row cc*Hkv + h): with Hkv second-minor, the per-call reshape to
+        # kernel rows relayout-copies the WHOLE buffer at head counts whose
+        # (Hkv, D) tile pads (measured: 14 ms/step vs 2.9 at MHA-12 — the
+        # same padded-sublane trap the kv pool layout avoids, kv_cache.py)
+        side_k0 = jnp.zeros((L, S, Cb * Hkv, D), dtype)
+        side_v0 = jnp.zeros((L, S, Cb * Hkv, D), dtype)
 
         def one_pass(x_ids, pos, j, sk_all, sv_all):
             x = _embed_in(spec, weights, x_ids, pos)
@@ -990,12 +1031,14 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
                 w, l = scanned
 
                 def attend(q, k, v):
+                    # step j's rows are the contiguous flat span
+                    # [j*Hkv, (j+1)*Hkv)
                     sk_new = jax.lax.dynamic_update_slice(
-                        sk_all, k[None, :, None].astype(sk_all.dtype),
-                        (l, 0, j, 0, 0))
+                        sk_all, k[None].astype(sk_all.dtype),
+                        (l, 0, j * Hkv, 0))
                     sv_new = jax.lax.dynamic_update_slice(
-                        sv_all, v[None, :, None].astype(sv_all.dtype),
-                        (l, 0, j, 0, 0))
+                        sv_all, v[None].astype(sv_all.dtype),
+                        (l, 0, j * Hkv, 0))
                     sc_kw = {}
                     if kvq:
                         # the frozen prefix streams int8 (the dominant read);
@@ -1068,10 +1111,11 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
         phys_l = jnp.where(page_valid[None], phys_l, L * NB)    # OOB -> drop
         idx = jnp.minimum(phys_l, L * NB - 1)
 
-        # side [L, S, C, Hkv, D] -> combined new values
+        # side [L, S, Cb*Hkv, D] flat rows -> combined new values
         # [L, S, n_span, 2, Hkv, bs, D]
         def span_of(side):
-            newv = side[:, s_idx, j_clamp]          # [L,S,n_span,bs,Hkv,D]
+            rows = j_clamp[..., None] * Hkv + jnp.arange(Hkv)  # [S,nsp,bs,Hkv]
+            newv = side[:, s_idx[..., None], rows]  # [L,S,n_span,bs,Hkv,D]
             return jnp.moveaxis(newv, 4, 3)         # [...,Hkv,bs,D]
 
         newv = jnp.stack([span_of(sk_all), span_of(sv_all)], axis=3)
@@ -1139,7 +1183,11 @@ def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
     ``max_side_bytes``: the side-buffer schedule carries two
     [L, S, C, Hkv, D] buffers through the scan (transient HBM the per-step
     schedule does not need); above this budget the general loop is used
-    (default from DSTPU_SIDEBUF_MAX_MB, 2048 MB — ADVICE r4).
+    (default from DSTPU_SIDEBUF_MAX_MB, 6144 MB — ADVICE r4's OOM guard.
+    6 GB not 2: an MHA-12 serving leg's buffers are 2.3 GB and the general
+    loop is 4x slower there — measured bench regression when the gate was
+    2 GB — while v5e HBM comfortably holds 6 GB transient beside a
+    sub-1B serving model; larger models use the env knob).
 
     Returns ``fwd(weights, kv_pages, ids0 [S], positions0 [S],
     block_tables [S, MB], ctx0 [S], key) -> (out_ids [n_steps, S],
@@ -1157,7 +1205,7 @@ def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
     if max_side_bytes is None:
         import os
         max_side_bytes = int(float(os.environ.get(
-            "DSTPU_SIDEBUF_MAX_MB", "2048")) * 1e6)
+            "DSTPU_SIDEBUF_MAX_MB", "6144")) * 1e6)
     esize = jnp.dtype(spec.dtype).itemsize
     budget = max_side_bytes
 

@@ -376,9 +376,10 @@ def _decode_body(bt_ref, cl_ref, q_ref, knew_ref, vnew_ref,
             acc_sc[:] = jnp.zeros_like(acc_sc)
 
         q = q_ref[0]                                           # [H, D]
-        slab = kv_buf[slot]                                    # [P, 2HB, D]
-        kk = slab[:, :HB, :].reshape(P * HB, -1)
-        vv = slab[:, HB:, :].reshape(P * HB, -1)
+        # slice the REF, not a loaded value: loading the whole combined
+        # slab and slicing the value forces a full-slab relayout per chunk
+        kk = kv_buf[slot, :, :HB, :].reshape(P * HB, -1)
+        vv = kv_buf[slot, :, HB:, :].reshape(P * HB, -1)
         mask = _chunk_mask(c, ctx - ctx_off, T, h_kv, bs, H,
                            tok_lo=None if window is None else tok_lo_of(s))
         v_scale_fn = None
@@ -645,9 +646,8 @@ def _sidebuf_batched_body(bt_ref, cl_ref, j_ref, q_ref, sidek_ref, sidev_ref,
             @pl.when(jnp.logical_and(c < nc_s, c >= c0_s))
             def _():
                 q = q_ref[i]                                   # [H, D]
-                slab = kv_buf[slot, i]                         # [P, 2HB, D]
-                kk = slab[:, :HB, :].reshape(P * HB, -1)
-                vv = slab[:, HB:, :].reshape(P * HB, -1)
+                kk = kv_buf[slot, i, :, :HB, :].reshape(P * HB, -1)
+                vv = kv_buf[slot, i, :, HB:, :].reshape(P * HB, -1)
                 mask = _chunk_mask(c, ctx, T, h_kv, bs, H,
                                    tok_lo=None if window is None
                                    else tok_lo_of(s_))
@@ -791,13 +791,25 @@ def paged_decode_attention_sidebuf(q: jax.Array,
     """
     S, H, D = q.shape
     NB, two, Hkv, bs, Dk = kv_pages.shape
-    if side_k.ndim == 4:
+    if side_k.ndim == 4 and layer_idx is None:
+        # single-layer logical [S, C, Hkv, D]
         side_k = side_k[None]
         side_v = side_v[None]
         layer_idx = 0
-    assert layer_idx is not None, "5D side slabs need layer_idx"
-    Ls, S2, Cs, Hkv2, D2 = side_k.shape
-    assert two == 2 and Dk == D and D2 == D and S2 == S and Hkv2 == Hkv
+    if side_k.ndim == 5:
+        # [L, S, C, Hkv, D] logical -> flat rows (NOTE: at head counts
+        # whose (Hkv, D) tile pads this reshape relayout-copies the whole
+        # stack per call — hot callers keep the buffer PRE-FLATTENED as
+        # [L, S, C*Hkv, D] and skip this branch)
+        assert layer_idx is not None, "multi-layer side slabs need layer_idx"
+        Ls, S2, Cs, Hkv2, D2 = side_k.shape
+        assert Hkv2 == Hkv and D2 == D
+        side_k = side_k.reshape(Ls, S2, Cs * Hkv, D)
+        side_v = side_v.reshape(Ls, S2, Cs * Hkv, D)
+    Ls, S2, CsH, D2 = side_k.shape
+    assert CsH % Hkv == 0
+    Cs = CsH // Hkv
+    assert two == 2 and Dk == D and D2 == D and S2 == S
     assert H % Hkv == 0
     assert D % 128 == 0 and (Cs * Hkv) % 8 == 0, \
         "side-slab kernel needs lane-aligned D and 8-sublane-aligned C*Hkv"
@@ -836,8 +848,7 @@ def paged_decode_attention_sidebuf(q: jax.Array,
     operands = [block_tables.astype(jnp.int32), prefix_lens.astype(jnp.int32),
                 jnp.asarray(j, jnp.int32).reshape(1),
                 jnp.asarray(layer_idx, jnp.int32).reshape(1), q,
-                side_k.reshape(Ls, S, Cs * Hkv, D),
-                side_v.reshape(Ls, S, Cs * Hkv, D),
+                side_k, side_v,
                 _kv_flat(kv_pages)]
     if SB > 1:
         kernel = functools.partial(

@@ -56,6 +56,33 @@ def quantize(x: jax.Array, num_bits: int = 8, group_size: int = 256,
     return q, scale[:, 0], zero[:, 0]
 
 
+def pack_int4(q: jax.Array, axis: int = -2) -> jax.Array:
+    """Pack int4 values (stored one-per-int8, range [-8, 7]) TWO PER BYTE
+    along ``axis``: byte i holds value 2i in its low nibble and 2i+1 in its
+    high nibble. Parity: the reference's packed 4-bit storage
+    (``csrc/quantization/quantize_intX.cu``) — the actual /4-vs-bf16 memory
+    footprint, not just int4 numerics."""
+    axis = axis % q.ndim
+    if q.shape[axis] % 2 != 0:
+        raise ValueError(f"axis {axis} size {q.shape[axis]} must be even")
+    lo = jax.lax.slice_in_dim(q, 0, q.shape[axis], 2, axis)
+    hi = jax.lax.slice_in_dim(q, 1, q.shape[axis], 2, axis)
+    return ((hi.astype(jnp.uint8) << 4)
+            | (lo.astype(jnp.uint8) & 0xF)).astype(jnp.int8)
+
+
+def unpack_int4(p: jax.Array, axis: int = -2) -> jax.Array:
+    """Inverse of :func:`pack_int4`: [.., K/2, ..] int8 -> [.., K, ..] int8
+    with sign-extended nibbles (arithmetic shifts on int8)."""
+    axis = axis % p.ndim
+    lo = (p.astype(jnp.int8) << 4) >> 4          # sign-extend low nibble
+    hi = p.astype(jnp.int8) >> 4                 # arithmetic: high nibble
+    stacked = jnp.stack([lo, hi], axis=axis + 1)  # [.., K/2, 2, ..]
+    shape = list(p.shape)
+    shape[axis] = shape[axis] * 2
+    return stacked.reshape(shape)
+
+
 def dequantize(q: jax.Array, scale: jax.Array, zero: jax.Array,
                orig_shape: tuple, num_bits: int = 8,
                symmetric: bool = True, dtype=jnp.float32) -> jax.Array:
