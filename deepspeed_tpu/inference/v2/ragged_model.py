@@ -398,7 +398,10 @@ def _moe_ffn(x: jax.Array, w: Dict, top_k: int, dtype) -> jax.Array:
         h = jax.nn.gelu(gg(xs, w["w_up"]))
     ys = gg(h, w["w_down"])                                            # [T*K, hid]
     scale = gates.reshape(-1)[order].astype(ys.dtype)
-    out = jnp.zeros((T, hid), ys.dtype).at[src].add(ys * scale[:, None])
+    # scatter-free combine: invert the sort permutation and sum the K
+    # choices (parallel/moe.py dropless_moe — TPU scatter-add serializes)
+    inv = jnp.argsort(order)
+    out = (ys * scale[:, None])[inv].reshape(T, top_k, hid).sum(axis=1)
     return out.astype(dtype)
 
 
