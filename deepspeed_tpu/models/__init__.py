@@ -9,3 +9,6 @@ from deepspeed_tpu.models.decoder import (DecoderConfig, DecoderLM,
 from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
 from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM, init_cache
 from deepspeed_tpu.models.mixtral import MixtralConfig, MixtralForCausalLM
+from deepspeed_tpu.models.diffusion import (DiffusionConfig,
+                                            DiffusionPipeline,
+                                            init_diffusion_inference)
