@@ -5,5 +5,8 @@ the ``ragged/`` KV subsystem, and the Dynamic SplitFuse scheduling described in
 ``blogs/deepspeed-fastgen``). TPU-native design notes live in ``engine_v2.py``.
 """
 
-from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+from deepspeed_tpu.inference.v2.config_v2 import (PrefixCacheConfig,
+                                                  RaggedInferenceEngineConfig)
 from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+from deepspeed_tpu.inference.v2.prefix_cache import (PrefixCacheStats,
+                                                     RadixPrefixCache)

@@ -88,11 +88,39 @@ class KVQuantConfig:
 
 
 @dataclass
+class PrefixCacheConfig:
+    """Automatic prefix caching (parity role: SGLang RadixAttention / vLLM
+    automatic-prefix-caching; see ``inference/v2/prefix_cache.py``). When
+    enabled, completed sequences' KV pages are retained in a radix tree keyed
+    on token blocks and new prompts reuse every cached whole-block prefix —
+    zero prefill is scheduled for the matched span. Off by default: sharing is
+    a semantic no-op (outputs stay logit-exact) but the tree holds pool blocks
+    that eviction must reclaim under pressure.
+
+    ``max_cached_blocks`` caps how many pool blocks the tree may retain
+    (None = bounded only by the pool itself; idle cached blocks are evicted
+    LRU whenever an allocation would otherwise fail). ``eviction`` names the
+    policy; only ``"lru"`` is implemented."""
+    enabled: bool = False
+    max_cached_blocks: Optional[int] = None
+    eviction: str = "lru"
+
+    def __post_init__(self):
+        if self.eviction != "lru":
+            raise ValueError(
+                f"prefix_cache.eviction must be 'lru', got {self.eviction!r}")
+        if self.max_cached_blocks is not None and self.max_cached_blocks < 1:
+            raise ValueError("prefix_cache.max_cached_blocks must be >= 1 "
+                             f"(or None), got {self.max_cached_blocks}")
+
+
+@dataclass
 class RaggedInferenceEngineConfig:
     state_manager: DSStateManagerConfig = field(default_factory=DSStateManagerConfig)
     kv_cache: KVCacheSizingConfig = field(default_factory=KVCacheSizingConfig)
     quantization: QuantizationConfig = field(default_factory=QuantizationConfig)
     kv_quant: KVQuantConfig = field(default_factory=KVQuantConfig)
+    prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
     tensor_parallel: int = 1
     dtype: Any = jnp.bfloat16
     seed: int = 0
@@ -116,8 +144,10 @@ class RaggedInferenceEngineConfig:
             qz = QuantizationConfig(**qz) if isinstance(qz, dict) else qz
             kq = d.pop("kv_quant", {})
             kq = KVQuantConfig(**kq) if isinstance(kq, dict) else kq
+            pc = d.pop("prefix_cache", {})
+            pc = PrefixCacheConfig(**pc) if isinstance(pc, dict) else pc
             cfg = cls(state_manager=sm, kv_cache=kv, quantization=qz,
-                      kv_quant=kq, **d)
+                      kv_quant=kq, prefix_cache=pc, **d)
         if cfg.state_manager.chunk_budget <= 0:
             raise ValueError("max_ragged_batch_size must exceed max_ragged_sequence_count")
         return cfg

@@ -128,7 +128,24 @@ class InferenceEngineV2:
             quantized=cfg.kv_quant.enabled)
         self.kv = BlockedKVCache(kv_cfg, self.topology)
         self.allocator = BlockedAllocator(nb)
-        self.scheduler = DynamicSplitFuseScheduler(sm, self.kv, self.allocator)
+        self.prefix_cache = None
+        if cfg.prefix_cache.enabled:
+            if self.spec.window is not None:
+                raise NotImplementedError(
+                    "prefix_cache with a sliding-window model is not wired: "
+                    "the page ring overwrites pages in place, which would rot "
+                    "cached content under a live sharer")
+            if cfg.kv_quant.enabled:
+                raise NotImplementedError(
+                    "prefix_cache with int8 KV pages is not wired (the COW "
+                    "page copy does not handle the tiled scale layout)")
+            from deepspeed_tpu.inference.v2.prefix_cache import RadixPrefixCache
+            self.prefix_cache = RadixPrefixCache(
+                self.allocator, kv_cfg.block_size,
+                max_cached_blocks=cfg.prefix_cache.max_cached_blocks,
+                cow_fn=self.kv.copy_page)
+        self.scheduler = DynamicSplitFuseScheduler(sm, self.kv, self.allocator,
+                                                   prefix_cache=self.prefix_cache)
         # sliding-window serving (Mistral/Qwen2): the scheduler ring-reuses
         # each sequence's pages beyond the window so KV stays bounded
         self.scheduler.window = self.spec.window
@@ -426,6 +443,17 @@ class InferenceEngineV2:
     @property
     def free_blocks(self) -> int:
         return self.allocator.free_blocks
+
+    # ------------------------------------------------------------------ #
+    # prefix-cache support
+    # ------------------------------------------------------------------ #
+
+    def write_monitor_events(self, monitor, step: int = 0) -> None:
+        """Emit the prefix-cache counters (hit rate, tokens saved, evictions,
+        ...) through a ``monitor/`` backend (``MonitorMaster.write_events``
+        shape). No-op with the cache off."""
+        if self.prefix_cache is not None:
+            monitor.write_events(self.prefix_cache.stats.events(step))
 
     # ------------------------------------------------------------------ #
     # continuous-batching generation loop (parity role: MII serving loop)

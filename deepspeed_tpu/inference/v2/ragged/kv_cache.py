@@ -79,6 +79,7 @@ class BlockedKVCache:
     def __init__(self, config: KVCacheConfig, topology: Optional[MeshTopology] = None):
         self.config = config
         self.topology = topology
+        self._copy_prog = None      # COW page-copy program (copy_page)
         shape = (config.num_layers, config.num_blocks, 2,
                  config.num_kv_heads, config.block_size, config.head_dim)
         sharding = None
@@ -108,6 +109,25 @@ class BlockedKVCache:
     def update(self, kv) -> None:
         """Adopt the pages returned by a jitted pass (donated in, aliased out)."""
         self.kv = kv
+
+    def copy_page(self, src_block: int, dst_block: int) -> None:
+        """Device-side copy of one whole page (all layers, K and V) — the
+        prefix cache's copy-on-write step when a sequence adopts a
+        partially-filled cached page it must keep writing into. One jitted
+        program reused for every (src, dst) pair via traced scalar indices.
+        Not valid for quantized pools (the scales' tiled layout folds the
+        page dim; the engine gates prefix_cache + kv_quant off)."""
+        if self._copy_prog is None:
+            import functools
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def _copy(kv, src, dst):
+                return jax.tree_util.tree_map(
+                    lambda a: a.at[:, dst].set(a[:, src]), kv)
+
+            self._copy_prog = _copy
+        self.kv = self._copy_prog(self.kv, jnp.int32(src_block),
+                                  jnp.int32(dst_block))
 
     def flat_write_index(self, block_id: np.ndarray, slot: np.ndarray) -> np.ndarray:
         """Host-side: flat scatter destination over the fused page dim; padding

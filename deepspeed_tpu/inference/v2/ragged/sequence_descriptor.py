@@ -21,6 +21,25 @@ class DSSequenceDescriptor:
     blocks: List[int] = field(default_factory=list)
     pending: np.ndarray = field(default_factory=lambda: np.zeros((0,), np.int32))
     in_flight_tokens: int = 0                 # tokens scheduled in the current pass
+    # prefix-cache support (scheduler fills these only when a cache is wired):
+    # every token the host has seen for this sequence, in order — the radix
+    # tree is keyed on token blocks, so releasing KV pages to the cache needs
+    # the ids that produced them. Device-generated tokens the host never saw
+    # (fused decode bursts) are NOT here; pages beyond the history are freed,
+    # not cached. Buffered as a part-list so the per-decode-token append is
+    # O(1) (a flat-array concatenate per token is O(n^2) over a generation);
+    # ``history()`` flattens on demand.
+    history_parts: List[np.ndarray] = field(default_factory=list)
+    history_len: int = 0
+    # length of the CONTIGUOUS recorded prefix (None = all of history). The
+    # fused device decode loop (scheduler.advance) appends tokens the host
+    # never records; any tokens recorded AFTER such a gap sit at later
+    # positions than their history index, so keying KV pages by them would
+    # poison the radix tree with wrong token->page mappings. advance() seals
+    # the valid prefix at the pre-gap length.
+    history_valid: "int | None" = None
+    cached_tokens: int = 0                    # prompt tokens served from cache
+    filed_tokens: int = 0                     # tokens already eager-inserted
 
     @property
     def cur_allocated_blocks(self) -> int:
@@ -34,6 +53,22 @@ class DSSequenceDescriptor:
 
     def extend_pending(self, tokens: np.ndarray) -> None:
         self.pending = np.concatenate([self.pending, np.asarray(tokens, np.int32)])
+
+    def record_history(self, tokens: np.ndarray) -> None:
+        t = np.asarray(tokens, np.int32)
+        self.history_parts.append(t)
+        self.history_len += len(t)
+
+    def history(self, n: int | None = None) -> np.ndarray:
+        """The recorded token history (first ``n`` tokens). Flattens the part
+        buffer in place — called per prompt completion / flush, not per
+        token."""
+        if len(self.history_parts) != 1:
+            self.history_parts = [
+                np.concatenate(self.history_parts) if self.history_parts
+                else np.zeros((0,), np.int32)]
+        h = self.history_parts[0]
+        return h if n is None else h[:n]
 
     def block_table(self, max_blocks: int) -> np.ndarray:
         bt = np.zeros((max_blocks,), np.int32)

@@ -15,7 +15,7 @@ chunked flash for the slots, paged flash-decode for the rest) in
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, TYPE_CHECKING, Tuple
 
 import numpy as np
 
@@ -25,14 +25,28 @@ from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache
 from deepspeed_tpu.inference.v2.ragged.ragged_batch import RaggedBatch
 from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import DSSequenceDescriptor
 
+if TYPE_CHECKING:  # avoid an import cycle at runtime
+    from deepspeed_tpu.inference.v2.prefix_cache import RadixPrefixCache
+
 
 class DynamicSplitFuseScheduler:
 
     def __init__(self, config: DSStateManagerConfig, cache: BlockedKVCache,
-                 allocator: BlockedAllocator):
+                 allocator: BlockedAllocator,
+                 prefix_cache: "Optional[RadixPrefixCache]" = None):
         self.config = config
         self.cache = cache
         self.allocator = allocator
+        # radix-tree KV reuse (prefix_cache.py): new prompts adopt cached
+        # pages at admission, completed sequences release pages back to the
+        # tree instead of the free list. None = cache off (reference
+        # recompute-everything behaviour). Mutually exclusive with the
+        # sliding-window page ring (ring reuse overwrites pages in place, so
+        # a cached page's content would rot under a live sharer).
+        self.prefix_cache = prefix_cache
+        # prompt tokens actually prefilled (post-cache); the shared-prefix
+        # bench leg reads this to report computed-prefill savings
+        self.prefill_tokens_completed = 0
         self.seqs: Dict[int, DSSequenceDescriptor] = {}
         bs = cache.config.block_size
         self.max_blocks = -(-config.max_context // bs)
@@ -89,19 +103,55 @@ class DynamicSplitFuseScheduler:
         if total > self.config.max_context:
             raise ValueError(f"sequence {uid}: {total} tokens > max_context "
                              f"{self.config.max_context}")
-        if seq is None:
+        new_seq = seq is None
+        if new_seq:
             if len(self.seqs) >= self.config.max_tracked_sequences:
                 raise RuntimeError(
                     f"max_tracked_sequences={self.config.max_tracked_sequences} exceeded")
             seq = self.seqs[uid] = DSSequenceDescriptor(uid=uid)
+        if self._cache_active:
+            seq.record_history(tokens)
+            if new_seq and len(tokens) > 1:
+                # adopt every cached whole-block prefix: matched pages join
+                # the block table with ZERO prefill scheduled; only the
+                # uncached tail (always >= 1 token, so the last token's
+                # logits are computed fresh) goes through SplitFuse
+                m = self.prefix_cache.match(tokens)
+                if m.n_cached:
+                    seq.blocks.extend(m.blocks)
+                    seq.seen_tokens = m.n_cached
+                    seq.cached_tokens = m.n_cached
+                    tokens = tokens[m.n_cached:]
         seq.extend_pending(tokens)
 
+    @property
+    def _cache_active(self) -> bool:
+        return self.prefix_cache is not None and self.window is None
+
     def flush(self, uid: int) -> None:
-        """Release a sequence's KV blocks (parity: ``engine_v2.flush``)."""
+        """Release a sequence's KV blocks (parity: ``engine_v2.flush``). With
+        the prefix cache on, pages return to the radix tree — warm for the
+        next matching prompt — instead of the free list; eviction reclaims
+        them under pool pressure."""
         seq = self.seqs.pop(uid, None)
-        if seq is not None and seq.blocks:
-            # ring reuse repeats physical ids in the logical list — free each once
-            self.allocator.free(dict.fromkeys(seq.blocks))
+        if seq is None or not seq.blocks:
+            return
+        # ring reuse repeats physical ids in the logical list — settle each once
+        uniq = list(dict.fromkeys(seq.blocks))
+        if self._cache_active:
+            known = self._cacheable_tokens(seq)
+            self.prefix_cache.release(seq.history(known), uniq)
+        else:
+            self.allocator.free(uniq)
+
+    @staticmethod
+    def _cacheable_tokens(seq: DSSequenceDescriptor) -> int:
+        """Tokens whose (position -> token id) mapping is certain: the
+        contiguous recorded-history prefix, capped by what the KV actually
+        holds. Pages beyond this are released, never cached."""
+        valid = seq.history_len if seq.history_valid is None \
+            else seq.history_valid
+        return min(valid, seq.seen_tokens)
 
     # ------------------------------------------------------------------ #
     # capacity queries (parity: engine_v2.query/can_schedule :153-227)
@@ -118,24 +168,45 @@ class DynamicSplitFuseScheduler:
             need = min(need, max(0, ring - len(seq.blocks)))
         return need
 
+    def _available_blocks(self) -> int:
+        """Blocks obtainable right now: the free list plus cached pages held
+        only by the radix tree (evicted on demand by ``_alloc``)."""
+        free = self.allocator.free_blocks
+        if self._cache_active:
+            free += self.prefix_cache.evictable_blocks
+        return free
+
+    def _alloc(self, num_blocks: int) -> np.ndarray:
+        """Allocate, LRU-evicting idle cached pages to cover a shortfall."""
+        short = num_blocks - self.allocator.free_blocks
+        if short > 0 and self._cache_active:
+            self.prefix_cache.evict(short)
+        return self.allocator.allocate(num_blocks)
+
     def query(self, uid: int, max_request_tokens: int) -> Tuple[int, int]:
-        """(max new tokens fundable by free blocks, free blocks). Accounts for
-        queued-but-unprocessed pending tokens, which will consume the same pool."""
+        """(max new tokens fundable by free blocks, available blocks).
+        Accounts for queued-but-unprocessed pending tokens, which will consume
+        the same pool; cached-but-idle prefix pages count as available (they
+        evict on demand)."""
         seq = self.seqs.get(uid, DSSequenceDescriptor(uid=uid))
         bs = self.cache.config.block_size
+        avail = self._available_blocks()
         if self.ring_pages is not None and len(seq.blocks) >= self.ring_pages:
             # ring complete: any request fits in place (up to max_context)
-            return max_request_tokens, self.allocator.free_blocks
+            return max_request_tokens, avail
         slack = len(seq.blocks) * bs - seq.seen_tokens - len(seq.pending)
-        fundable = max(0, slack + self.allocator.free_blocks * bs)
-        return min(max_request_tokens, fundable), self.allocator.free_blocks
+        fundable = max(0, slack + avail * bs)
+        return min(max_request_tokens, fundable), avail
 
     def can_schedule(self, uids: List[int], lengths: List[int]) -> bool:
         needed = 0
         for uid, n in zip(uids, lengths):
             seq = self.seqs.get(uid, DSSequenceDescriptor(uid=uid))
             needed += self._new_blocks_needed(seq, len(seq.pending) + n)
-        if needed > self.allocator.free_blocks:
+        # free list first: the evictable count walks the whole radix tree,
+        # only worth it on an actual shortfall
+        if needed > self.allocator.free_blocks \
+                and needed > self._available_blocks():
             return False
         new = sum(1 for u in uids if u not in self.seqs)
         return len(self.seqs) + new <= self.config.max_tracked_sequences
@@ -163,6 +234,11 @@ class DynamicSplitFuseScheduler:
         by the fused loop; no pending compute remains)."""
         seq = self.seqs[uid]
         assert len(seq.pending) == 0, "advance() with pending host tokens"
+        if self._cache_active and seq.history_valid is None:
+            # the host never saw these tokens: history recorded after this
+            # point is position-shifted, unusable as radix keys — seal the
+            # contiguous prefix here (see DSSequenceDescriptor.history_valid)
+            seq.history_valid = seq.history_len
         seq.seen_tokens += n_tokens
 
     # ------------------------------------------------------------------ #
@@ -175,13 +251,13 @@ class DynamicSplitFuseScheduler:
         if ring is None:
             need = seq.kv_blocks_needed(new_tokens, bs)
             if need:
-                seq.blocks.extend(int(b) for b in self.allocator.allocate(need))
+                seq.blocks.extend(int(b) for b in self._alloc(need))
             return
         target = -(-(seq.seen_tokens + new_tokens) // bs)   # logical pages
         fresh = min(max(0, target - len(seq.blocks)),
                     max(0, ring - len(seq.blocks)))
         if fresh:
-            seq.blocks.extend(int(b) for b in self.allocator.allocate(fresh))
+            seq.blocks.extend(int(b) for b in self._alloc(fresh))
         while len(seq.blocks) < target:                      # ring reuse
             seq.blocks.append(seq.blocks[len(seq.blocks) - ring])
 
@@ -313,8 +389,26 @@ class DynamicSplitFuseScheduler:
             seq.seen_tokens += n
             seq.pending = seq.pending[n:]
             seq.in_flight_tokens = 0
+            self.prefill_tokens_completed += n
             if is_final:
                 finished.append(uid)
+                if self._cache_active:
+                    # eager insert: file the finished prompt's FULL pages into
+                    # the radix tree now (tree takes its own references; the
+                    # live sequence keeps its own), so later arrivals reuse
+                    # them without waiting for this sequence to flush. Partial
+                    # tails are only filed at flush — one tree node per page,
+                    # so eviction accounting stays exact. The filed_tokens
+                    # watermark skips the re-walk when no NEW full page
+                    # completed since the last insert (multi-turn put()s).
+                    bs = self.cache.config.block_size
+                    known = self._cacheable_tokens(seq)
+                    full = (known // bs) * bs
+                    if full > seq.filed_tokens:
+                        self.prefix_cache.insert(seq.history(full),
+                                                 seq.blocks[:full // bs],
+                                                 transfer_refs=False)
+                        seq.filed_tokens = full
         for uid in batch.decode_uids:
             seq = self.seqs[uid]
             seq.seen_tokens += 1

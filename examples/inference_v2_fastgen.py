@@ -24,14 +24,24 @@ def main():
                           "max_ragged_sequence_count": 4,
                           "max_ragged_batch_size": 64, "max_context": 64},
         "kv_cache": {"block_size": 8, "num_blocks": 64},
+        # radix-tree prefix reuse: repeated system prompts / few-shot headers
+        # skip prefill for every cached whole block (logit-exact)
+        "prefix_cache": {"enabled": True},
     })
     engine = InferenceEngineV2(model=model, config=cfg, model_parameters=params)
     rng = np.random.default_rng(0)
-    prompts = [rng.integers(3, 250, (n,)).tolist() for n in (5, 19, 11)]
-    outs = engine.generate(prompts, max_new_tokens=8)  # full sequences back
+    system = rng.integers(3, 250, (16,)).tolist()      # shared "system prompt"
+    prompts = [system + rng.integers(3, 250, (n,)).tolist() for n in (5, 19, 11)]
+    # first request warms the radix tree; the rest adopt the system prompt's
+    # KV pages at admission (tokens_saved counts the skipped prefill)
+    outs = engine.generate(prompts[:1], max_new_tokens=8)
+    outs += engine.generate(prompts[1:], max_new_tokens=8)
     for i, o in enumerate(outs):
         print(f"seq {i}: {len(prompts[i])} prompt tokens -> "
               f"{len(o) - len(prompts[i])} new: {o[len(prompts[i]):]}")
+    st = engine.prefix_cache.stats
+    print(f"prefix cache: hit_rate={st.hit_rate:.2f} "
+          f"tokens_saved={st.tokens_saved} evictions={st.evictions}")
 
 
 if __name__ == "__main__":

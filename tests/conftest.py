@@ -21,6 +21,12 @@ os.environ.setdefault("DSTPU_LOG_LEVEL", "warning")
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+from deepspeed_tpu.utils import jax_compat  # noqa: E402
+
+# alias modern jax names (jax.shard_map, pltpu.CompilerParams) onto older
+# installs BEFORE test modules import them
+jax_compat.apply()
+
 # The axon site config pins JAX_PLATFORMS=axon (real TPU tunnel); tests always run on
 # the 8-device virtual CPU mesh, so force the platform at the config level.
 jax.config.update("jax_platforms", "cpu")

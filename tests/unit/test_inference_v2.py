@@ -48,6 +48,53 @@ class TestBlockedAllocator:
         with pytest.raises(ValueError):
             a.free(got)
 
+    def test_single_call_duplicate_free_rejected(self):
+        # duplicates WITHIN one call used to slip past the double-free check
+        # (the in_free set was computed before any id was appended) and
+        # corrupt the free list with repeated ids
+        a = BlockedAllocator(4)
+        b = int(a.allocate(1)[0])
+        with pytest.raises(ValueError, match="double free"):
+            a.free([b, b])
+        assert a.free_blocks == 3            # nothing mutated
+        a.free([b])                          # the block is still freeable once
+        assert a.free_blocks == 4
+        assert sorted(a.allocate(4).tolist()) == [0, 1, 2, 3]  # no dup ids
+
+    def test_out_of_range_leaves_state_unchanged(self):
+        a = BlockedAllocator(4)
+        got = a.allocate(3)
+        with pytest.raises(ValueError, match="out of range"):
+            a.free([int(got[0]), 99])        # valid id first, bad id second
+        assert a.free_blocks == 1            # the valid id was NOT freed
+        a.free(got)
+        assert a.free_blocks == 4
+
+    def test_exhaustion_refill_roundtrip(self):
+        a = BlockedAllocator(6)
+        got = a.allocate(6)
+        assert a.free_blocks == 0
+        with pytest.raises(RuntimeError):
+            a.allocate(1)
+        a.free(got)
+        assert a.free_blocks == 6
+        again = a.allocate(6)
+        assert sorted(again.tolist()) == sorted(got.tolist())
+
+    def test_share_refcounts(self):
+        a = BlockedAllocator(4)
+        b = int(a.allocate(1)[0])
+        a.share([b])                         # two holders now
+        assert a.ref_count(b) == 2
+        assert a.free([b]) == []             # first release: still held
+        assert a.free_blocks == 3
+        assert a.free([b]) == [b]            # last holder frees it
+        assert a.free_blocks == 4
+        with pytest.raises(ValueError):      # refcount can never go negative
+            a.free([b])
+        with pytest.raises(ValueError):
+            a.share([b])                     # can't share a free block
+
 
 class TestScheduler:
 

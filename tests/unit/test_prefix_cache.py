@@ -1,0 +1,479 @@
+"""Prefix-cache subsystem tests (radix-tree KV block reuse).
+
+Parity role: SGLang RadixAttention / vLLM automatic-prefix-caching semantics on
+the v2 ragged engine: hit/miss/partial matching, copy-on-write adoption,
+refcount-safe sharing, LRU eviction under pool pressure, and — the invariant
+everything hangs on — decoded outputs exactly equal to the cache-off engine.
+"""
+
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.config_v2 import (DSStateManagerConfig,
+                                                  PrefixCacheConfig)
+from deepspeed_tpu.inference.v2.prefix_cache import RadixPrefixCache
+from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.inference.v2.ragged.kv_cache import (BlockedKVCache,
+                                                        KVCacheConfig)
+from deepspeed_tpu.inference.v2.scheduler import DynamicSplitFuseScheduler
+
+BS = 8
+
+
+def _toks(*vals):
+    return np.asarray(vals, np.int32)
+
+
+class TestRadixTree:
+    """Tree-level semantics against a bare allocator (no engine)."""
+
+    def _cache(self, nb=32, **kw):
+        alloc = BlockedAllocator(nb)
+        return RadixPrefixCache(alloc, BS, **kw), alloc
+
+    def test_miss_on_empty_tree(self):
+        cache, _ = self._cache()
+        m = cache.match(np.arange(20))
+        assert m.blocks == [] and m.n_cached == 0
+        assert cache.stats.misses == 1 and cache.stats.hits == 0
+
+    def test_full_block_hit_and_refcounts(self):
+        cache, alloc = self._cache()
+        toks = np.arange(20)                       # 2 full pages + 4 tail
+        blocks = alloc.allocate(3).tolist()
+        freed = cache.release(toks, blocks)        # flush: refs transfer
+        assert freed == []                         # everything adoptable
+        assert cache.cached_blocks == 3            # 2 full + 1 partial leaf
+        assert all(alloc.ref_count(b) == 1 for b in blocks)
+        m = cache.match(toks)
+        # cap at len-1: the tail (tokens 16..18) is < a page and COW is off
+        assert m.blocks == blocks[:2] and m.n_cached == 16
+        assert alloc.ref_count(blocks[0]) == 2     # matcher holds a ref now
+        alloc.free(m.blocks)
+        assert alloc.ref_count(blocks[0]) == 1
+
+    def test_match_is_capped_below_full_prompt(self):
+        # a prompt that is ENTIRELY cached must still schedule >= 1 token so
+        # the engine computes its next-token logits fresh
+        cache, alloc = self._cache()
+        toks = np.arange(16)                       # exactly 2 pages
+        cache.release(toks, alloc.allocate(2).tolist())
+        m = cache.match(toks)
+        assert m.n_cached == 8                     # second page NOT matched
+        alloc.free(m.blocks)
+
+    def test_divergent_prompt_matches_common_prefix_only(self):
+        cache, alloc = self._cache()
+        a = np.arange(24)
+        cache.release(a, alloc.allocate(3).tolist())
+        b = np.concatenate([np.arange(8), _toks(99, 98, 97, 96, 95, 94, 93, 92),
+                            np.arange(8)])
+        m = cache.match(b)
+        assert m.n_cached == 8                     # shared first page only
+        alloc.free(m.blocks)
+
+    def test_partial_leaf_cow_adoption(self):
+        copies = []
+        cache, alloc = self._cache(cow_fn=lambda s, d: copies.append((s, d)))
+        toks = np.arange(12)                       # 1 full page + 4 tail
+        blocks = alloc.allocate(2).tolist()
+        cache.release(toks, blocks)
+        m = cache.match(np.arange(20))             # extends past the tail
+        assert m.n_cached == 12 and m.cow
+        assert copies == [(blocks[1], m.blocks[-1])]
+        assert m.blocks[-1] != blocks[1]           # fresh private page
+        assert alloc.ref_count(m.blocks[-1]) == 1  # exclusively owned
+        assert alloc.ref_count(blocks[1]) == 1     # source stays tree-owned
+        assert cache.stats.partial_hits == 1 and cache.stats.cow_copies == 1
+
+    def test_release_dedupes_already_cached_content(self):
+        cache, alloc = self._cache()
+        toks = np.arange(16)
+        first = alloc.allocate(2).tolist()
+        cache.release(toks, first)
+        dup = alloc.allocate(2).tolist()           # same content, new pages
+        freed = cache.release(toks, dup)
+        assert sorted(freed) == sorted(dup)        # duplicates freed, not filed
+        assert cache.cached_blocks == 2
+
+    def test_lru_eviction_order_and_parent_exposure(self):
+        cache, alloc = self._cache()
+        a = np.arange(17)                          # path A: 2 full pages (+1)
+        b = np.concatenate([_toks(*range(50, 58)), _toks(*range(70, 78))])
+        cache.release(a[:16], alloc.allocate(2).tolist())
+        cache.release(b, alloc.allocate(2).tolist())
+        m = cache.match(a)                         # touches BOTH of A's pages
+        assert m.n_cached == 16
+        alloc.free(m.blocks)
+        assert cache.evictable_blocks == 4
+        # LRU peels path B leaf-first: B2, then its exposed parent B1
+        assert cache.evict(2) == 2
+        m2 = cache.match(a)                        # A still intact
+        assert m2.n_cached == 16
+        alloc.free(m2.blocks)
+        m3 = cache.match(b)
+        assert m3.n_cached == 0                    # B gone
+        assert cache.evict(10) == 2                # A peels child-then-parent
+        assert cache.cached_blocks == 0
+
+    def test_fresh_partial_tail_is_not_the_lru_victim(self):
+        # a just-filed partial leaf must carry the insert-time clock: with
+        # last_access left at 0 it would be evicted ahead of genuinely old
+        # entries — dropping the tail a request just paid to cache
+        cache, alloc = self._cache()
+        old = _toks(*range(100, 109))                       # 1 full page + 1
+        cache.release(old, alloc.allocate(1).tolist())      # t1: old full page
+        cache.release(np.arange(12), alloc.allocate(2).tolist())  # t2: + tail
+        assert cache.evict(1) == 1
+        m = cache.match(np.arange(12))                      # fresh path intact
+        assert m.n_cached == 8
+        alloc.free(m.blocks)
+        assert cache.match(old).n_cached == 0               # old page evicted
+
+    def test_eviction_never_touches_shared_blocks(self):
+        cache, alloc = self._cache()
+        toks = np.arange(16)
+        blocks = alloc.allocate(2).tolist()
+        cache.release(toks, blocks)
+        m = cache.match(toks)                      # a live sequence shares p0
+        assert cache.evict(10) == 1                # only the unshared leaf goes
+        assert alloc.ref_count(m.blocks[0]) == 2
+        alloc.free(m.blocks)
+        assert cache.evict(10) == 1                # now reclaimable
+        assert alloc.free_blocks == alloc.total_blocks
+
+    def test_cow_allocation_pressure_cannot_evict_match_or_source(self):
+        # pool exactly full of cached pages; the COW allocation inside match
+        # must evict some OTHER page — never the just-matched path (the
+        # sequence's refs are taken first) and never the COW source (pinned
+        # for the copy). Regression: the old order shared refs only after
+        # allocation, so the LRU victim WAS the source leaf.
+        copies = []
+        alloc = BlockedAllocator(3)
+        cache = RadixPrefixCache(alloc, BS,
+                                 cow_fn=lambda s, d: copies.append((s, d)))
+        a_blocks = alloc.allocate(2).tolist()
+        cache.release(np.arange(12), a_blocks)          # full b0 + partial b1 (LRU)
+        b_blocks = alloc.allocate(1).tolist()
+        cache.release(_toks(*range(200, 208)), b_blocks)
+        assert alloc.free_blocks == 0
+        m = cache.match(np.arange(20))                  # needs a COW page
+        assert m.n_cached == 12 and m.cow
+        assert copies and copies[0][0] == a_blocks[1]   # source intact
+        assert m.blocks[0] == a_blocks[0]
+        assert cache.stats.evictions == 1               # path B was the victim
+        assert alloc.ref_count(a_blocks[0]) == 2        # matcher + tree
+        assert alloc.ref_count(a_blocks[1]) == 1        # tree only again
+        alloc.free(m.blocks)
+
+    def test_evictable_excludes_interior_pinned_under_shared_child(self):
+        # refcount-1 interior pages whose descendant is still shared are NOT
+        # reclaimable (eviction peels leaves) — counting them would let
+        # can_schedule approve an allocation that then fails mid-pass
+        cache, alloc = self._cache(nb=3)
+        b1, b2 = alloc.allocate(2).tolist()
+        cache.release(np.arange(16), [b1, b2])
+        c = int(alloc.allocate(1)[0])
+        # a live sequence files its third page under b2 (eager insert)
+        cache.insert(np.arange(24), [b1, b2, c], transfer_refs=False)
+        assert alloc.ref_count(c) == 2                 # seq + tree
+        assert cache.evictable_blocks == 0             # whole chain pinned
+        assert cache.evict(3) == 0
+        alloc.free([c])                                # the sequence flushes
+        assert cache.evictable_blocks == 3
+        assert cache.evict(3) == 3
+        assert alloc.free_blocks == alloc.total_blocks
+
+    def test_max_cached_blocks_cap(self):
+        cache, alloc = self._cache(max_cached_blocks=2)
+        cache.release(np.arange(16), alloc.allocate(2).tolist())
+        b = _toks(*range(100, 124))
+        cache.release(b, alloc.allocate(3).tolist())
+        assert cache.cached_blocks <= 2
+        assert cache.stats.evictions >= 3
+
+    def test_refcount_never_negative_through_lifecycle(self):
+        cache, alloc = self._cache()
+        toks = np.arange(24)
+        cache.release(toks, alloc.allocate(3).tolist())
+        for _ in range(3):
+            m = cache.match(toks)
+            alloc.free(m.blocks)
+        cache.evict(10)
+        assert alloc.free_blocks == alloc.total_blocks
+        # every remaining refcount is gone; a further free must raise, not wrap
+        with pytest.raises(ValueError):
+            alloc.free([0])
+
+
+class TestSchedulerIntegration:
+
+    def _mk(self, num_blocks=32, **cache_kw):
+        cfg = DSStateManagerConfig(
+            max_tracked_sequences=8, max_ragged_sequence_count=4,
+            max_ragged_batch_size=20, max_context=64, prefill_chunk_size=8)
+        kv = BlockedKVCache(KVCacheConfig(num_layers=1, num_kv_heads=1,
+                                          head_dim=8, block_size=BS,
+                                          num_blocks=num_blocks,
+                                          dtype=jnp.float32))
+        alloc = BlockedAllocator(num_blocks)
+        cache = RadixPrefixCache(alloc, BS, **cache_kw)
+        sched = DynamicSplitFuseScheduler(cfg, kv, alloc, prefix_cache=cache)
+        return sched, alloc, cache
+
+    def _drain(self, sched):
+        while sched.has_pending():
+            sched.complete_pass(sched.schedule_pass())
+
+    def test_admission_attaches_cached_blocks_and_skips_prefill(self):
+        sched, alloc, cache = self._mk()
+        prompt = np.arange(20, dtype=np.int32)
+        sched.add_tokens(1, prompt)
+        self._drain(sched)
+        sched.flush(1)
+        sched.add_tokens(2, prompt)
+        seq = sched.seqs[2]
+        assert seq.seen_tokens == 16 and seq.cached_tokens == 16
+        assert len(seq.pending) == 4               # only the tail prefills
+        before = sched.prefill_tokens_completed
+        self._drain(sched)
+        assert sched.prefill_tokens_completed - before == 4
+        sched.flush(2)
+
+    def test_eager_insert_shares_before_flush(self):
+        # request 2 arrives while request 1 is still decoding: the tree
+        # already holds request 1's full prompt pages
+        sched, alloc, cache = self._mk()
+        prompt = np.arange(20, dtype=np.int32)
+        sched.add_tokens(1, prompt)
+        self._drain(sched)                         # prompt done; seq 1 LIVE
+        assert cache.cached_blocks == 2            # 2 full pages filed eagerly
+        sched.add_tokens(2, prompt)
+        assert sched.seqs[2].seen_tokens == 16
+        assert sched.seqs[2].blocks[:2] == sched.seqs[1].blocks[:2]
+        self._drain(sched)
+        sched.flush(1)
+        sched.flush(2)
+
+    def test_flush_releases_to_tree_not_free_list(self):
+        sched, alloc, cache = self._mk()
+        sched.add_tokens(1, np.arange(20, dtype=np.int32))
+        self._drain(sched)
+        used = alloc.total_blocks - alloc.free_blocks
+        sched.flush(1)
+        # pages stayed allocated — owned by the tree now
+        assert alloc.total_blocks - alloc.free_blocks == used
+        assert cache.evictable_blocks == used
+
+    def test_allocation_pressure_evicts_idle_cached_blocks(self):
+        sched, alloc, cache = self._mk(num_blocks=4)
+        sched.add_tokens(1, np.arange(20, dtype=np.int32))   # 3 pages
+        self._drain(sched)
+        sched.flush(1)
+        assert alloc.free_blocks == 1
+        # an unrelated 30-token prompt needs 4 pages: can_schedule must count
+        # the evictable cached pages, and allocation must reclaim them
+        fresh = _toks(*range(100, 130))
+        assert sched.can_schedule([2], [30])
+        sched.add_tokens(2, fresh)
+        self._drain(sched)
+        assert cache.stats.evictions >= 2
+        sched.flush(2)
+
+    def test_device_generated_gap_seals_cacheable_history(self):
+        # advance() (fused decode: tokens the host never records) followed by
+        # recorded per-token puts leaves history POSITION-SHIFTED relative to
+        # the KV pages. Flush must only key pages by the contiguous pre-gap
+        # prefix — keying by post-gap history would poison the tree with
+        # wrong token->page mappings.
+        sched, alloc, cache = self._mk()
+        prompt = np.arange(16, dtype=np.int32)
+        sched.add_tokens(1, prompt)
+        self._drain(sched)
+        seq = sched.seqs[1]
+        sched.reserve(1, 9)
+        sched.advance(1, 8)                    # device tokens, unrecorded
+        for t in (101, 102):                   # recorded AFTER the gap
+            sched.add_tokens(1, _toks(t))
+            self._drain(sched)
+        assert seq.history_valid == 16         # sealed at the gap
+        assert sched._cacheable_tokens(seq) == 16
+        sched.flush(1)
+        # only the 2 pre-gap full pages are cached (eager insert already
+        # filed them); nothing keyed by post-gap history
+        assert cache.cached_blocks == 2
+        m = cache.match(np.arange(24))
+        assert m.n_cached == 16                # gap pages never served
+        alloc.free(m.blocks)
+
+    def test_refcounts_settle_after_many_sharers(self):
+        sched, alloc, cache = self._mk()
+        prompt = np.arange(33, dtype=np.int32)
+        for uid in range(5):
+            sched.add_tokens(uid, prompt)
+            self._drain(sched)
+        for uid in range(5):
+            sched.flush(uid)
+        # all refs collapsed to tree-only; full pool reclaimable
+        assert cache.evictable_blocks == cache.cached_blocks
+        cache.evict(alloc.total_blocks)
+        assert alloc.free_blocks == alloc.total_blocks
+
+
+V2_BASE = {
+    "state_manager": {"max_tracked_sequences": 8, "max_ragged_sequence_count": 4,
+                      "max_ragged_batch_size": 12, "max_context": 64},
+    "kv_cache": {"block_size": 8, "num_blocks": 32},
+    "dtype": jnp.float32,
+}
+
+
+@pytest.fixture(scope="module")
+def llama_setup():
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    cfg = LlamaConfig.tiny(dtype=jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+    return model, params
+
+
+def _engine(model, params, enabled, **pc_kw):
+    c = dict(V2_BASE)
+    c["prefix_cache"] = {"enabled": enabled, **pc_kw}
+    return InferenceEngineV2(model=model,
+                             config=RaggedInferenceEngineConfig.load(c),
+                             model_parameters=params)
+
+
+class TestEngineExactness:
+
+    def test_shared_prefix_outputs_exactly_equal_cache_off(self, llama_setup):
+        model, params = llama_setup
+        rng = np.random.RandomState(3)
+        prefix = rng.randint(0, 250, size=(17,)).astype(np.int32)
+        prompts = [np.concatenate([prefix,
+                                   rng.randint(0, 250, size=(4,)).astype(np.int32)])
+                   for _ in range(3)]
+
+        def serve(enabled):
+            eng = _engine(model, params, enabled)
+            outs = [eng.generate([p.tolist()], max_new_tokens=6,
+                                 eos_token_id=-1)[0] for p in prompts]
+            return eng, outs
+
+        eng_off, outs_off = serve(False)
+        eng_on, outs_on = serve(True)
+        assert outs_on == outs_off                # token-exact reuse
+        st = eng_on.prefix_cache.stats
+        assert st.tokens_saved > 0 and st.hit_rate > 0
+        # computed prefill must actually drop
+        assert (eng_on.scheduler.prefill_tokens_completed
+                < eng_off.scheduler.prefill_tokens_completed)
+
+    def test_cow_adoption_is_logit_exact(self, llama_setup):
+        model, params = llama_setup
+        rng = np.random.RandomState(4)
+        base = rng.randint(0, 250, size=(20,)).astype(np.int32)   # 4-token tail
+        ext = np.concatenate([base, rng.randint(0, 250, size=(6,)).astype(np.int32)])
+        eng = _engine(model, params, True)
+        eng.put([1], [base])
+        eng.flush([1])                            # files the partial tail
+        logits = eng.put([2], [ext])
+        st = eng.prefix_cache.stats
+        assert st.partial_hits == 1 and st.cow_copies == 1
+        ref = _engine(model, params, False).put([9], [ext])
+        np.testing.assert_array_equal(logits, ref)
+
+    def test_fully_cached_prompt_still_yields_fresh_logits(self, llama_setup):
+        model, params = llama_setup
+        rng = np.random.RandomState(5)
+        prompt = rng.randint(0, 250, size=(16,)).astype(np.int32)  # 2 exact pages
+        eng = _engine(model, params, True)
+        first = eng.put([1], [prompt])
+        eng.flush([1])
+        second = eng.put([2], [prompt])           # >= 1 token always prefills
+        np.testing.assert_array_equal(first, second)
+
+    def test_monitor_counters_visible(self, llama_setup, tmp_path):
+        from deepspeed_tpu.monitor import CsvMonitor
+        model, params = llama_setup
+        rng = np.random.RandomState(6)
+        prompt = rng.randint(0, 250, size=(20,)).astype(np.int32)
+        eng = _engine(model, params, True)
+        eng.put([1], [prompt]); eng.flush([1])
+        eng.put([2], [prompt]); eng.flush([2])
+        mon = CsvMonitor(types.SimpleNamespace(
+            enabled=True, output_path=str(tmp_path), job_name="serve"))
+        eng.write_monitor_events(mon, step=7)
+        mon.close()
+        hit = (tmp_path / "serve" /
+               "inference_prefix_cache_hit_rate.csv").read_text()
+        saved = (tmp_path / "serve" /
+                 "inference_prefix_cache_tokens_saved.csv").read_text()
+        assert "7," in hit and float(saved.splitlines()[1].split(",")[1]) >= 16
+
+    def test_generate_loop_recycles_cache_under_pressure(self, llama_setup):
+        # pool barely fits two live sequences; the cached pages of retired
+        # ones must evict transparently, and outputs stay exact
+        model, params = llama_setup
+        c = dict(V2_BASE)
+        c["kv_cache"] = {"block_size": 8, "num_blocks": 10}
+        c["prefix_cache"] = {"enabled": True}
+        eng = InferenceEngineV2(model=model,
+                                config=RaggedInferenceEngineConfig.load(c),
+                                model_parameters=params)
+        coff = dict(V2_BASE)
+        coff["kv_cache"] = {"block_size": 8, "num_blocks": 10}
+        ref_eng = InferenceEngineV2(model=model,
+                                    config=RaggedInferenceEngineConfig.load(coff),
+                                    model_parameters=params)
+        rng = np.random.RandomState(8)
+        prefix = rng.randint(0, 250, size=(14,)).astype(np.int32)
+        for i in range(4):
+            p = np.concatenate([prefix, _toks(i)])
+            out = eng.generate([p.tolist()], max_new_tokens=4, eos_token_id=-1)
+            ref = ref_eng.generate([p.tolist()], max_new_tokens=4,
+                                   eos_token_id=-1)
+            assert out == ref
+        assert eng.prefix_cache.stats.tokens_saved > 0
+
+
+class TestConfigSurface:
+
+    def test_config_parses_from_dict(self):
+        cfg = RaggedInferenceEngineConfig.load(
+            {"prefix_cache": {"enabled": True, "max_cached_blocks": 64}})
+        assert cfg.prefix_cache.enabled
+        assert cfg.prefix_cache.max_cached_blocks == 64
+        assert cfg.prefix_cache.eviction == "lru"
+
+    def test_defaults_off(self):
+        assert RaggedInferenceEngineConfig.load({}).prefix_cache.enabled is False
+
+    def test_bad_eviction_policy_rejected(self):
+        with pytest.raises(ValueError, match="eviction"):
+            PrefixCacheConfig(eviction="fifo")
+
+    def test_bad_cap_rejected(self):
+        with pytest.raises(ValueError, match="max_cached_blocks"):
+            PrefixCacheConfig(max_cached_blocks=0)
+
+    def test_sliding_window_engine_rejects_cache(self):
+        from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig.tiny(dtype=jnp.float32, sliding_window=8)
+        model = LlamaForCausalLM(cfg)
+        params = model.init(jax.random.PRNGKey(10),
+                            {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+        c = dict(V2_BASE)
+        c["prefix_cache"] = {"enabled": True}
+        with pytest.raises(NotImplementedError, match="sliding-window"):
+            InferenceEngineV2(model=model,
+                              config=RaggedInferenceEngineConfig.load(c),
+                              model_parameters=params)
