@@ -116,24 +116,35 @@ class Autotuner:
         return {"engine": engine, "compiled": compiled,
                 "sharded_batch": sharded, "memory": mem}
 
-    def _measure_compiled(self, probe, batch_size: int, steps: int) -> float:
+    def _measure_compiled(self, probe, batch_size: int, steps: int,
+                          sync: bool = True) -> float:
         """Time the ALREADY-compiled step (no second XLA compile): the probe's
-        Compiled executable is invoked directly."""
+        Compiled executable is invoked directly.
+
+        ``sync=True`` (default) blocks on the device before and after the
+        timed loop so the score measures execution; ``sync=False`` is the
+        dispatch-latency escape hatch (JL001) for callers overlapping
+        candidate timing with other host work."""
         compiled = probe["compiled"]
         state, sharded = probe["engine"].state, probe["sharded_batch"]
         state, m = compiled(state, sharded)  # warmup execution
         import jax
-        jax.block_until_ready(m["loss"])
+        if sync:
+            jax.block_until_ready(m["loss"])
         t0 = time.time()
         for _ in range(steps):
             state, m = compiled(state, sharded)
-        jax.block_until_ready(m["loss"])
+        if sync:
+            jax.block_until_ready(m["loss"])
         dt = (time.time() - t0) / steps
+        # the warmup call DONATED the engine's state buffers (JL003): rebind
+        # the engine to the live post-measurement state so it never dangles
+        probe["engine"].state = state
         return batch_size / dt  # samples/sec
 
     def run_experiment(self, model, overrides: Dict[str, Any], batch,
-                       measure_steps: int = 3, compile_only: bool = False
-                       ) -> Experiment:
+                       measure_steps: int = 3, compile_only: bool = False,
+                       sync: bool = True) -> Experiment:
         """Compile probe always runs (feasibility + memory metrics); the
         throughput measurement runs on feasible candidates unless
         ``compile_only`` (dry mode: rank by negative memory)."""
@@ -148,7 +159,8 @@ class Autotuner:
                 exp.score = -float(temp + args)
             else:
                 exp.score = self._measure_compiled(
-                    probe, probe["engine"].train_batch_size(), measure_steps)
+                    probe, probe["engine"].train_batch_size(), measure_steps,
+                    sync=sync)
                 exp.metrics["throughput_samples_per_sec"] = exp.score
         except Exception as e:  # OOM / invalid combination => infeasible
             exp.error = f"{type(e).__name__}: {e}"
@@ -158,7 +170,7 @@ class Autotuner:
     # -- main loop (parity: Autotuner.tune autotuner.py) ------------------- #
     def tune(self, model, batch, tuner_type: Optional[str] = None,
              max_trials: Optional[int] = None, compile_only: Optional[bool] = None,
-             measure_steps: int = 3):
+             measure_steps: int = 3, sync: bool = True):
         from deepspeed_tpu.comm.mesh import reset_topology
         tuner_type = tuner_type or self.at.tuner_type
         max_trials = max_trials or self.at.tuner_num_trials
@@ -177,7 +189,7 @@ class Autotuner:
             reset_topology()  # each experiment builds its own engine/mesh
             exp = self.run_experiment(model, cand, batch,
                                       measure_steps=measure_steps,
-                                      compile_only=compile_only)
+                                      compile_only=compile_only, sync=sync)
             experiments.append(exp)
             tuner.record(cand, exp.score)
             if exp.score is not None and (best_score is None or exp.score > best_score):

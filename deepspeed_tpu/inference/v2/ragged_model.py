@@ -746,7 +746,7 @@ PREFILL_PASS_KEYS = (
 def _tp_wrap(fn, mesh, in_specs, out_specs):
     """shard_map a paged/packed attention kernel over the tensor axis (one
     helper so the TP wrapping of every kernel variant stays identical)."""
-    from jax import shard_map
+    from deepspeed_tpu.utils.jax_compat import shard_map
     return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                      check_vma=False)
 
@@ -1239,7 +1239,7 @@ def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
 
     def _decode_step(q, k_new, v_new, kv_l, bts, cls_):
         if tp > 1:
-            from jax import shard_map
+            from deepspeed_tpu.utils.jax_compat import shard_map
             from jax.sharding import PartitionSpec as P
             from deepspeed_tpu.comm.mesh import TENSOR_AXIS
             fn = shard_map(

@@ -224,7 +224,7 @@ def dropless_moe_ep(tokens: jax.Array, gate_logits: jax.Array, k: int,
     applies the local experts' FFN to expert-sorted rows.
     Returns (out [N, D] replicated over 'expert', l_aux).
     """
-    from jax import shard_map
+    from deepspeed_tpu.utils.jax_compat import shard_map
     N, D = tokens.shape
     E = gate_logits.shape[-1]
     assert E % ep == 0, (E, ep)

@@ -39,10 +39,11 @@ from typing import Any, Callable, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.comm.mesh import PIPE_AXIS, get_topology
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 
 def partition_balanced(weights: Sequence[float], n_parts: int) -> List[int]:

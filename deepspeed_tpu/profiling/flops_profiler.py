@@ -306,7 +306,8 @@ def get_model_profile(module, batch, variables=None, rng=None,
 
     Returns ``(flops, macs, params)`` for ``module`` applied to ``batch``."""
     if variables is None:
-        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        from deepspeed_tpu.utils.rng import default_rng
+        rng = rng if rng is not None else default_rng()
         abstract = jax.eval_shape(module.init, rng, batch)
         variables = jax.tree_util.tree_map(
             lambda s: np.zeros(s.shape, s.dtype), abstract)

@@ -46,7 +46,8 @@ class Eigenvalue:
         ``batch``: the batch is then a jit input rather than a baked closure,
         so the cached compiled step is reused across batches.
         """
-        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        from deepspeed_tpu.utils.rng import default_rng
+        rng = rng if rng is not None else default_rng()
         if batch is not None:
             grad_fn = jax.grad(lambda p, b: loss_fn(p, b), argnums=0)
         else:

@@ -213,7 +213,11 @@ class DeepSpeedTPUEngine:
                 self.config.curriculum_learning)
 
         # -- timers --------------------------------------------------------
-        self.timers = SynchronizedWallClockTimer()
+        # wall_clock_breakdown opts the whole timer group into device sync
+        # (JL001): breakdown numbers measure execution; the default-async
+        # timers measure dispatch so steps keep pipelining
+        self.timers = SynchronizedWallClockTimer(
+            sync=self.config.wall_clock_breakdown)
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size_,
             steps_per_output=self.config.steps_per_print)
@@ -964,7 +968,8 @@ class DeepSpeedTPUEngine:
             self._grad_buffer = self._zero_grad_buffer()
         self.timers(FORWARD_GLOBAL_TIMER).start()
         loss, self._grad_buffer = self._micro_step(self.state, self._grad_buffer, mb)
-        self.timers(FORWARD_GLOBAL_TIMER).stop()
+        self.timers(FORWARD_GLOBAL_TIMER).stop(
+            sync_obj=loss if self.config.wall_clock_breakdown else None)
         return loss
 
     def backward(self, loss=None, **kwargs):
@@ -984,7 +989,9 @@ class DeepSpeedTPUEngine:
             self._build_micro_steps()
         self.timers(STEP_GLOBAL_TIMER).start()
         self.state, metrics = self._apply_step(self.state, self._grad_buffer)
-        self.timers(STEP_GLOBAL_TIMER).stop()
+        self.timers(STEP_GLOBAL_TIMER).stop(
+            sync_obj=metrics["grad_norm"] if self.config.wall_clock_breakdown
+            else None)
         self._grad_buffer = None
         self._after_step(metrics, count_micro_steps=False)
 

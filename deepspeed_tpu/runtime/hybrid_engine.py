@@ -13,12 +13,12 @@ training state's params under the inference sharding — one ``device_put``
 
 from __future__ import annotations
 
-import time
 from typing import Any, Optional
 
 import numpy as np
 
 from deepspeed_tpu.runtime.engine import DeepSpeedTPUEngine
+from deepspeed_tpu.utils.timer import Timer
 
 
 class DeepSpeedTPUHybridEngine(DeepSpeedTPUEngine):
@@ -34,7 +34,13 @@ class DeepSpeedTPUHybridEngine(DeepSpeedTPUEngine):
         self._infer = None
         self._infer_params_fresh = False
         self._in_eval = False
-        # latency counters (parity: _generate_latency/_training_latency fields)
+        # latency counters (parity: _generate_latency/_training_latency fields).
+        # generate() materialises numpy, so its timer is host-synced by
+        # construction; train_batch() intentionally measures dispatch
+        # (sync=False) so RLHF rollout generation overlaps the queued step —
+        # the wall_clock_breakdown timers are the synced measurement path
+        self._generate_timer = Timer("hybrid_generate", sync=False)
+        self._train_timer = Timer("hybrid_train", sync=False)
         self.generate_time = 0.0
         self.train_time = 0.0
         self.generate_count = 0
@@ -103,7 +109,7 @@ class DeepSpeedTPUHybridEngine(DeepSpeedTPUEngine):
             # RLHF loops often generate rollouts before the first train step:
             # lazily init state from the prompt shape (zero.Init-style)
             self._ensure_state({"input_ids": np.asarray(input_ids)})
-        t0 = time.time()
+        self._generate_timer.start()
         self.refresh_inference_params()
         eng = self._inference_engine()
         # lazy prefill/decode traces read the GLOBAL topology (e.g. MoE
@@ -115,14 +121,16 @@ class DeepSpeedTPUHybridEngine(DeepSpeedTPUEngine):
             out = eng.generate(input_ids, **kwargs)
         finally:
             set_topology(self.topology)
-        self.generate_time = time.time() - t0
+        self._generate_timer.stop(record=False)
+        self.generate_time = self._generate_timer.elapsed()
         self.generate_count += 1
         return out
 
     def train_batch(self, *args, **kwargs):
-        t0 = time.time()
+        self._train_timer.start()
         out = super().train_batch(*args, **kwargs)
-        self.train_time = time.time() - t0
+        self._train_timer.stop(record=False)
+        self.train_time = self._train_timer.elapsed()
         self._infer_params_fresh = False  # weights moved; next generate refreshes
         return out
 

@@ -1,0 +1,5 @@
+"""Developer tooling that ships with the package (static analysis, CI gates).
+
+Nothing in here imports jax at module scope: the tools must run in seconds on
+a cold container, before any backend initialisation.
+"""

@@ -1,0 +1,12 @@
+"""jaxlint — AST static analysis for jit/sharding/donation hazards.
+
+See docs/JAXLINT.md for the rule catalog and ``python -m
+deepspeed_tpu.tools.jaxlint --list-rules`` for the live registry."""
+
+from deepspeed_tpu.tools.jaxlint.config import LintConfig, RuleSettings
+from deepspeed_tpu.tools.jaxlint.core import (Finding, SourceModule, lint_paths,
+                                              lint_text)
+from deepspeed_tpu.tools.jaxlint.rules import RULE_REGISTRY, Rule, register
+
+__all__ = ["Finding", "SourceModule", "LintConfig", "RuleSettings",
+           "RULE_REGISTRY", "Rule", "register", "lint_paths", "lint_text"]

@@ -1,0 +1,5 @@
+import sys
+
+from deepspeed_tpu.tools.jaxlint.cli import main
+
+sys.exit(main())

@@ -1,0 +1,56 @@
+"""Baseline files: grandfather existing findings without green-lighting new ones.
+
+A baseline is JSON mapping finding fingerprints (``relpath::rule::linehash``,
+see :meth:`Finding.fingerprint`) to occurrence counts. Matching findings are
+consumed count-wise, so adding a *second* identical violation on an already
+baselined line still fails. Regenerate with ``--write-baseline`` (and justify
+the entries in the PR — the goal state is an empty baseline)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from deepspeed_tpu.tools.jaxlint.core import Finding
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    if not os.path.isfile(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        raw = json.load(f)
+    if raw.get("version") != BASELINE_VERSION:
+        raise ValueError(f"unsupported baseline version in {path}: "
+                         f"{raw.get('version')!r}")
+    return {k: int(v) for k, v in (raw.get("entries") or {}).items()}
+
+
+def write_baseline(path: str, findings: List[Finding], root: str = ".") -> None:
+    entries: Dict[str, int] = {}
+    for f in findings:
+        fp = f.fingerprint(root)
+        entries[fp] = entries.get(fp, 0) + 1
+    payload = {"version": BASELINE_VERSION,
+               "entries": dict(sorted(entries.items()))}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")
+
+
+def apply_baseline(findings: List[Finding], baseline: Dict[str, int],
+                   root: str = ".") -> Tuple[List[Finding], List[Finding]]:
+    """Split findings into (new, grandfathered)."""
+    remaining = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint(root)
+        if remaining.get(fp, 0) > 0:
+            remaining[fp] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old

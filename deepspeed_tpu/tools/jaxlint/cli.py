@@ -1,0 +1,119 @@
+"""``python -m deepspeed_tpu.tools.jaxlint [paths]`` — the CI entry point.
+
+Exit codes: 0 clean (or everything baselined/suppressed), 1 non-baselined
+findings, 2 usage errors. Config discovery: ``--config``, else the first
+``.jaxlint.json`` walking up from the first path."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from deepspeed_tpu.tools.jaxlint.baseline import (apply_baseline, load_baseline,
+                                                  write_baseline)
+from deepspeed_tpu.tools.jaxlint.config import LintConfig, find_config
+from deepspeed_tpu.tools.jaxlint.core import lint_paths
+from deepspeed_tpu.tools.jaxlint.rules import RULE_REGISTRY
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="jaxlint",
+        description="Static analysis for jit/sharding/donation hazards.")
+    p.add_argument("paths", nargs="*", default=["deepspeed_tpu"],
+                   help="files or directories to lint (default: deepspeed_tpu)")
+    p.add_argument("--config", help=".jaxlint.json path (default: discovered)")
+    p.add_argument("--no-config", action="store_true",
+                   help="ignore any discovered config file")
+    p.add_argument("--baseline",
+                   help="baseline file (default: the config's 'baseline' entry)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings to the baseline and exit 0")
+    p.add_argument("--select", help="comma-separated rule ids to run exclusively")
+    p.add_argument("--disable", help="comma-separated rule ids to skip")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rid, cls in sorted(RULE_REGISTRY.items()):
+            print(f"{rid}  {cls.summary}")
+        return 0
+
+    if args.config:
+        config = LintConfig.load(args.config)
+    elif not args.no_config:
+        found = find_config(args.paths[0] if args.paths else ".")
+        config = LintConfig.load(found) if found else LintConfig()
+    else:
+        config = LintConfig()
+
+    from deepspeed_tpu.tools.jaxlint.config import RuleSettings
+    if args.select or args.disable:
+        requested = {r.strip() for r in
+                     f"{args.select or ''},{args.disable or ''}".split(",")
+                     if r.strip()}
+        unknown = requested - set(RULE_REGISTRY)
+        if unknown:
+            # a typo'd --select would otherwise disable EVERY rule and pass
+            print(f"jaxlint: unknown rule id(s): {', '.join(sorted(unknown))} "
+                  f"(known: {', '.join(sorted(RULE_REGISTRY))})", file=sys.stderr)
+            return 2
+    if args.select:
+        wanted = {r.strip() for r in args.select.split(",")}
+        for rid in RULE_REGISTRY:
+            if rid not in wanted:
+                config.rules[rid] = RuleSettings(enabled=False)
+    if args.disable:
+        for rid in args.disable.split(","):
+            config.rules[rid.strip()] = RuleSettings(enabled=False)
+
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"jaxlint: no such path: {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    findings, parse_errors = lint_paths(args.paths, config)
+
+    baseline_path = args.baseline or config.baseline_path()
+    if args.write_baseline:
+        if not baseline_path:
+            print("jaxlint: --write-baseline needs --baseline or a config "
+                  "'baseline' entry", file=sys.stderr)
+            return 2
+        # parse errors (JL000) are never baselined: an unparseable file gets
+        # NO rule coverage at all, so grandfathering it would silently exempt
+        # it from the linter forever
+        write_baseline(baseline_path, findings, root=config.root)
+        print(f"jaxlint: wrote {len(findings)} finding(s) to {baseline_path}")
+        for f in parse_errors:
+            print(f.render(), file=sys.stderr)
+        return 1 if parse_errors else 0
+
+    grandfathered: List = []
+    if baseline_path:
+        findings, grandfathered = apply_baseline(
+            findings, load_baseline(baseline_path), root=config.root)
+    findings = parse_errors + findings
+
+    if args.format == "json":
+        print(json.dumps([{"rule": f.rule, "path": f.path, "line": f.line,
+                           "col": f.col, "message": f.message}
+                          for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        tail = f", {len(grandfathered)} baselined" if grandfathered else ""
+        print(f"jaxlint: {len(findings)} finding(s){tail}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

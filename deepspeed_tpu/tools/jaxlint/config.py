@@ -1,0 +1,80 @@
+"""Per-rule configuration.
+
+A repo configures jaxlint with a ``.jaxlint.json`` next to (or above) the
+linted tree::
+
+    {
+      "exclude": ["tests/", "examples/"],
+      "baseline": ".jaxlint-baseline.json",
+      "rules": {
+        "JL002": {"enabled": true, "options": {"allow_paths": ["tests/"]}},
+        "JL005": {"options": {"known_axes": ["data", "tensor"]}}
+      }
+    }
+
+(JSON, not TOML: this container's Python predates tomllib and the no-new-deps
+rule forbids a TOML parser.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+CONFIG_FILENAME = ".jaxlint.json"
+
+
+@dataclass
+class RuleSettings:
+    enabled: bool = True
+    options: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class LintConfig:
+    rules: Dict[str, RuleSettings] = field(default_factory=dict)
+    exclude: List[str] = field(default_factory=list)
+    baseline: Optional[str] = None
+    #: directory config paths (baseline, excludes) are relative to
+    root: str = "."
+
+    def rule(self, rule_id: str) -> RuleSettings:
+        return self.rules.get(rule_id, RuleSettings())
+
+    @classmethod
+    def from_dict(cls, raw: Dict[str, Any], root: str = ".") -> "LintConfig":
+        rules = {}
+        for rid, spec in (raw.get("rules") or {}).items():
+            rules[rid] = RuleSettings(enabled=bool(spec.get("enabled", True)),
+                                      options=dict(spec.get("options") or {}))
+        return cls(rules=rules,
+                   exclude=list(raw.get("exclude") or []),
+                   baseline=raw.get("baseline"),
+                   root=root)
+
+    @classmethod
+    def load(cls, path: str) -> "LintConfig":
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+        return cls.from_dict(raw, root=os.path.dirname(os.path.abspath(path)))
+
+    def baseline_path(self) -> Optional[str]:
+        if not self.baseline:
+            return None
+        return self.baseline if os.path.isabs(self.baseline) \
+            else os.path.join(self.root, self.baseline)
+
+
+def find_config(start: str) -> Optional[str]:
+    """Walk up from ``start`` looking for ``.jaxlint.json``."""
+    cur = os.path.abspath(start if os.path.isdir(start) else os.path.dirname(start))
+    while True:
+        cand = os.path.join(cur, CONFIG_FILENAME)
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent

@@ -1,0 +1,237 @@
+"""jaxlint core: findings, suppressions, source model, and the lint driver.
+
+The linter is pure-AST (no jax import, no code execution): every rule receives
+a parsed :class:`SourceModule` and yields :class:`Finding`s. Hazard classes are
+XLA-tracing specific — async-dispatch timing, constant PRNG keys, donated-buffer
+reuse, tracer-dependent Python control flow, undeclared mesh axes, compat-shim
+bypass — the TPU analogs of the CUDA race classes DeepSpeed guards with
+sanitizers.
+
+Suppressions:
+
+- ``# jaxlint: disable=JL001`` (or ``=JL001,JL003`` or ``=all``) trailing on a
+  line suppresses those rules for findings anchored to that line.
+- ``# jaxlint: disable-file=JL005`` anywhere in a file suppresses the rule for
+  the whole file.
+
+Baselines grandfather existing findings (see :mod:`.baseline`): a finding whose
+fingerprint appears in the baseline does not fail the run.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import hashlib
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+_SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\s]+|all)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*jaxlint:\s*disable-file=([A-Za-z0-9_,\s]+|all)")
+
+
+@functools.lru_cache(maxsize=512)
+def _source_lines(path: str) -> Tuple[str, ...]:
+    """Per-path line cache for fingerprinting (a baseline application touches
+    every finding; re-reading the file each time is pure waste)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            return tuple(f.read().splitlines())
+    except OSError:
+        return ()
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation anchored to a source line."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def fingerprint(self, root: str = ".") -> str:
+        """Stable identity for baselining: relpath + rule + a hash of the
+        anchored source line (whitespace-normalized), NOT the line number —
+        findings survive unrelated edits above them."""
+        rel = os.path.relpath(self.path, root).replace(os.sep, "/")
+        lines = _source_lines(self.path)
+        text = ""
+        if 0 < self.line <= len(lines):
+            text = " ".join(lines[self.line - 1].split())
+        digest = hashlib.sha1(text.encode()).hexdigest()[:12]
+        return f"{rel}::{self.rule}::{digest}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+@dataclass
+class SourceModule:
+    """A parsed module plus the pre-computed facts rules share."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    lines: List[str] = field(default_factory=list)
+    #: rules suppressed per line number (1-based)
+    line_suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+    #: rules suppressed for the whole file
+    file_suppressions: Set[str] = field(default_factory=set)
+    #: ``import x.y as z`` -> {"z": "x.y"}; ``from a import b as c`` -> {"c": "a.b"}
+    import_aliases: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def parse(cls, path: str, source: Optional[str] = None) -> "SourceModule":
+        if source is None:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+        tree = ast.parse(source, filename=path)
+        mod = cls(path=path, source=source, tree=tree,
+                  lines=source.splitlines())
+        mod._scan_suppressions()
+        mod._scan_imports()
+        return mod
+
+    # -- facts ----------------------------------------------------------- #
+    def _scan_suppressions(self) -> None:
+        # only real COMMENT tokens count: a docstring *documenting* the
+        # suppression syntax must not install one
+        import io
+        import tokenize
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.source).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return  # ast.parse succeeded, so this should be unreachable
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                self.line_suppressions[tok.start[0]] = _parse_rule_list(m.group(1))
+            m = _SUPPRESS_FILE_RE.search(tok.string)
+            if m:
+                self.file_suppressions |= _parse_rule_list(m.group(1))
+
+    def _scan_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        self.import_aliases[alias.asname] = alias.name
+                    else:
+                        # `import a.b` binds only the top package `a`
+                        top = alias.name.split(".")[0]
+                        self.import_aliases[top] = top
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    self.import_aliases[alias.asname or alias.name] = \
+                        f"{node.module}.{alias.name}"
+
+    def resolve(self, dotted: str) -> str:
+        """Expand the leading segment of a dotted expr through the module's
+        import aliases: with ``import jax.random as jr``, ``jr.PRNGKey`` ->
+        ``jax.random.PRNGKey``."""
+        head, _, rest = dotted.partition(".")
+        full = self.import_aliases.get(head)
+        if full is None:
+            return dotted
+        return f"{full}.{rest}" if rest else full
+
+    def suppressed(self, finding: Finding) -> bool:
+        rules = self.line_suppressions.get(finding.line, set())
+        return (finding.rule in rules or "all" in rules
+                or finding.rule in self.file_suppressions
+                or "all" in self.file_suppressions)
+
+    # -- shared AST helpers ---------------------------------------------- #
+    def functions(self) -> Iterable[ast.AST]:
+        """Every function scope plus the module itself (for top-level code)."""
+        yield self.tree
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+
+def _parse_rule_list(raw: str) -> Set[str]:
+    return {part.strip() for part in raw.split(",") if part.strip()}
+
+
+def unparse(node: ast.AST) -> str:
+    """ast.unparse that never raises (rules compare expr strings)."""
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return ""
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target ('' when the target is not a name chain)."""
+    return unparse(node.func)
+
+
+def iter_files(paths: Iterable[str], exclude: Iterable[str] = ()) -> List[str]:
+    """Expand files/dirs into a sorted list of .py files, minus excluded
+    substring patterns."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__" and not d.startswith(".")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        elif p.endswith(".py"):
+            out.append(p)
+    def excluded(path: str) -> bool:
+        norm = path.replace(os.sep, "/")
+        return any(pat in norm for pat in exclude)
+    return sorted(dict.fromkeys(f for f in out if not excluded(f)))
+
+
+def lint_module(mod: SourceModule, config) -> List[Finding]:
+    """Run every enabled rule over one parsed module; suppressions applied."""
+    from deepspeed_tpu.tools.jaxlint.rules import RULE_REGISTRY
+    findings: List[Finding] = []
+    for rule_id, rule_cls in sorted(RULE_REGISTRY.items()):
+        settings = config.rule(rule_id)
+        if not settings.enabled:
+            continue
+        rule = rule_cls()
+        options = dict(rule_cls.default_options)
+        options.update(settings.options)
+        for f in rule.check(mod, options):
+            if not mod.suppressed(f):
+                findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def lint_paths(paths: Iterable[str], config) -> Tuple[List[Finding], List[Finding]]:
+    """Lint files/dirs. Returns ``(findings, parse_errors)`` — parse errors are
+    reported as rule ``JL000`` findings (compileall catches them too, but the
+    linter should not silently skip broken files)."""
+    findings: List[Finding] = []
+    errors: List[Finding] = []
+    for path in iter_files(paths, exclude=config.exclude):
+        try:
+            mod = SourceModule.parse(path)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            line = getattr(e, "lineno", 1) or 1
+            errors.append(Finding("JL000", path, line, 0,
+                                  f"could not parse: {e.msg if hasattr(e, 'msg') else e}"))
+            continue
+        findings.extend(lint_module(mod, config))
+    return findings, errors
+
+
+def lint_text(source: str, path: str = "<memory>.py", config=None) -> List[Finding]:
+    """Lint an in-memory snippet (the unit-test entry point)."""
+    if config is None:
+        from deepspeed_tpu.tools.jaxlint.config import LintConfig
+        config = LintConfig()
+    return lint_module(SourceModule.parse(path, source), config)

@@ -17,6 +17,8 @@ from typing import Any, Optional
 
 import jax
 
+from deepspeed_tpu.utils.rng import default_rng
+
 _ON_DEVICE: Optional["OnDevice"] = None
 
 
@@ -48,7 +50,7 @@ def current_on_device() -> Optional[OnDevice]:
 
 def abstract_init(model, sample_batch, rng=None) -> Any:
     """Param tree of ShapeDtypeStructs — no memory allocated (device='meta')."""
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rng = rng if rng is not None else default_rng()
     shapes = jax.eval_shape(lambda r, b: model.init(r, b), rng, sample_batch)
     return shapes["params"] if isinstance(shapes, dict) and "params" in shapes \
         else shapes
@@ -57,7 +59,7 @@ def abstract_init(model, sample_batch, rng=None) -> Any:
 def materialize_sharded(model, sample_batch, shardings, rng=None) -> Any:
     """Jitted init with out_shardings: every param materialises directly in its
     partition (no full-model replication transient — zero.Init's goal)."""
-    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    rng = rng if rng is not None else default_rng()
 
     def init_fn(r, b):
         out = model.init(r, b)

@@ -5,6 +5,18 @@ The repo is written against the modern jax surface (``jax.shard_map``,
 functionality under earlier names; ``apply()`` aliases the new names onto the
 installed modules so every call site can use the modern spelling. Idempotent
 and a no-op on new jax.
+
+Call sites import the shimmed surfaces from HERE rather than from jax
+directly (enforced by jaxlint JL006):
+
+- ``from deepspeed_tpu.utils.jax_compat import shard_map`` — the modern
+  ``jax.shard_map`` signature (``check_vma``, ``axis_names``) on every
+  supported jax.
+- ``pltpu = jax_compat.import_pltpu()`` — ``jax.experimental.pallas.tpu``
+  with the ``CompilerParams`` alias guaranteed.
+
+Raw ``jax.experimental.shard_map`` / ``jax.experimental.pallas.tpu`` imports
+bypass the aliasing and break on one side of the rename fence.
 """
 
 from __future__ import annotations
@@ -26,6 +38,35 @@ def _pinned_platform(jax) -> str:
     plat = getattr(jax.config, "jax_platforms", None) \
         or os.environ.get("JAX_PLATFORMS", "")
     return str(plat).split(",")[0].strip()
+
+
+def shard_map(*args, **kwargs):
+    """``jax.shard_map`` with the modern signature on every supported jax.
+
+    This is the package's blessed entry point (jaxlint JL006): on old jax the
+    monkey-patched alias only exists after ``apply()`` ran, so importing
+    ``shard_map`` from jax directly is an import-order trap; importing it from
+    here is always safe."""
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        apply()
+    return jax.shard_map(*args, **kwargs)
+
+
+def import_pltpu():
+    """``jax.experimental.pallas.tpu`` with ``CompilerParams`` guaranteed.
+
+    The blessed import path for Pallas TPU modules (jaxlint JL006)::
+
+        from deepspeed_tpu.utils.jax_compat import import_pltpu
+        pltpu = import_pltpu()
+
+    Raises ImportError where pallas itself is unavailable — same contract as
+    the raw import, but with the rename shims applied first."""
+    apply()
+    from jax.experimental.pallas import tpu as pltpu  # jaxlint: disable=JL006
+    return pltpu
 
 
 def apply() -> None:

@@ -1,0 +1,36 @@
+"""Default PRNG key plumbing (jaxlint JL002).
+
+Library code must not bake ``jax.random.PRNGKey(0)`` into call sites: every
+such site draws the same stream, so dropout masks repeat and init is silently
+correlated across components. Functions thread an ``rng=None`` parameter and
+default it here — one seed knob (``DSTPU_SEED``) governs every library
+default, and the seed flows through ``PRNGKey(seed)`` as a variable, which is
+exactly what JL002 accepts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+#: Fallback seed when neither an rng nor DSTPU_SEED is provided. Mirrors the
+#: engine's config default so library helpers and engine-managed paths draw
+#: from the same stream family by default.
+DEFAULT_SEED = 1234
+
+
+def default_prng_seed() -> int:
+    """The process-wide default seed: ``DSTPU_SEED`` env var, else 1234."""
+    try:
+        return int(os.environ.get("DSTPU_SEED", DEFAULT_SEED))
+    except ValueError:
+        return DEFAULT_SEED
+
+
+def default_rng(seed: Optional[int] = None):
+    """A PRNG key for library code whose caller didn't thread one."""
+    import jax
+
+    if seed is None:
+        seed = default_prng_seed()
+    return jax.random.PRNGKey(seed)

@@ -29,24 +29,60 @@ def _sync(obj: Any = None):
             pass
 
 
-class Timer:
+_NOOP = None
 
-    def __init__(self, name: str):
+
+def _drain():
+    """Full-queue sync when there is no array to block on: dispatch a trivial
+    program to the default device and block on it. Device execution streams
+    are FIFO, so its completion implies all previously dispatched work
+    finished — the TPU analog of ``cuda.synchronize()``."""
+    global _NOOP
+    try:
+        import jax
+        if _NOOP is None:
+            import jax.numpy as jnp
+            _NOOP = jax.jit(lambda: jnp.zeros(()))
+        jax.block_until_ready(_NOOP())
+    except Exception:
+        pass
+
+
+def _sync_point(sync_obj: Any, sync: bool):
+    """One sync decision for every timer edge: block on the given object if
+    any, drain the whole queue if the timer opted into sync, else async."""
+    if sync_obj is not None:
+        _sync(sync_obj)
+    elif sync:
+        _drain()
+
+
+class Timer:
+    """One named stopwatch.
+
+    ``sync=True`` opts into device synchronization (JL001): ``stop()`` blocks
+    on the given ``sync_obj`` — or drains the dispatch queue when none is
+    given — so the recorded span measures execution, not dispatch. The
+    ``sync=False`` default is the escape hatch for intentionally-async
+    callers that want to overlap host work with device work."""
+
+    def __init__(self, name: str, sync: bool = False):
         self.name = name
+        self.sync = sync
         self.started = False
         self._start = 0.0
         self._elapsed = 0.0
         self._record: List[float] = []
 
     def start(self, sync_obj: Any = None):
-        _sync(sync_obj)
+        _sync_point(sync_obj, self.sync)
         self._start = time.time()
         self.started = True
 
     def stop(self, record: bool = True, sync_obj: Any = None):
         if not self.started:
             return
-        _sync(sync_obj)
+        _sync_point(sync_obj, self.sync)
         dt = time.time() - self._start
         self._elapsed += dt
         if record:
@@ -78,12 +114,13 @@ class SynchronizedWallClockTimer:
     """Group of named timers; log a breakdown line like the reference's
     ``wall_clock_breakdown`` output."""
 
-    def __init__(self):
+    def __init__(self, sync: bool = False):
+        self.sync = sync
         self.timers: Dict[str, Timer] = {}
 
     def __call__(self, name: str) -> Timer:
         if name not in self.timers:
-            self.timers[name] = Timer(name)
+            self.timers[name] = Timer(name, sync=self.sync)
         return self.timers[name]
 
     def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
@@ -105,8 +142,9 @@ class ThroughputTimer:
     """Samples/sec + tokens/sec tracking. Parity: ``utils/timer.py ThroughputTimer``."""
 
     def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50,
-                 monitor_memory: bool = False, logging_fn=None):
+                 monitor_memory: bool = False, logging_fn=None, sync: bool = False):
         self.batch_size = max(1, batch_size)
+        self.sync = sync
         self.start_step = start_step
         self.steps_per_output = steps_per_output
         self.total_elapsed_time = 0.0
@@ -126,7 +164,7 @@ class ThroughputTimer:
         if global_step:
             self.step_count += 1
         if self.step_count > self.start_step:
-            _sync(sync_obj)
+            _sync_point(sync_obj, self.sync)
             self.total_elapsed_time += time.time() - self._start
             if report_speed and self.steps_per_output and self.step_count % self.steps_per_output == 0:
                 self.logging(

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Fast pre-test gate (seconds, not minutes on this 2-core container):
+#   1. compileall  — broken imports/syntax fail immediately
+#   2. jaxlint     — jit/sharding/donation hazards (docs/JAXLINT.md)
+# Run from anywhere; operates on the repo this script lives in.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# pure host-side analysis: never let the lint step grab a TPU
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+python -m compileall -q deepspeed_tpu
+python -m deepspeed_tpu.tools.jaxlint deepspeed_tpu
+echo "lint: OK"

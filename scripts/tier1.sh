@@ -3,6 +3,11 @@
 # This encodes the ROADMAP.md "Tier-1 verify" command verbatim; if the
 # command there changes, change it here (and nowhere else).
 set -o pipefail
+
+# fast pre-test gate: jaxlint + compileall fail in seconds where a broken
+# import would cost minutes of pytest collection on this 2-core container
+bash "$(dirname "$0")/lint.sh" || exit 1
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}

@@ -118,3 +118,40 @@ def test_autotuner_measured_mode(tmp_path):
     best, exps = at.tune(model, batch, compile_only=False, measure_steps=2)
     assert exps[0].score is not None and exps[0].score > 0
     assert "throughput_samples_per_sec" in exps[0].metrics
+
+
+def test_measure_compiled_rebinds_donated_engine_state(tmp_path):
+    """JL003 regression: the measurement loop donates the probe engine's state
+    buffers to the compiled step. Before the fix the engine was left holding
+    the donated (freed, on TPU) tree; now it must hold the live
+    post-measurement state — observable as the stepped optimizer counter and
+    non-deleted leaves."""
+    import jax
+
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+    from deepspeed_tpu.comm.mesh import reset_topology
+
+    model = GPT2LMHead(GPT2Config(vocab_size=64, n_positions=16, n_embd=32,
+                                  n_layer=1, n_head=2))
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 64, (8, 16)).astype(np.int32)}
+    at = Autotuner({
+        "train_batch_size": 8,
+        "mesh": {"data": -1},
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "autotuning": {"enabled": True},
+    }, results_dir=str(tmp_path / "res"))
+    reset_topology()
+    probe = at._compile_probe(model, at._apply(
+        {"zero_optimization.stage": 1, "train_micro_batch_size_per_gpu": 1}),
+        batch)
+    steps = 2
+    throughput = at._measure_compiled(probe, batch_size=8, steps=steps)
+    assert throughput > 0
+    eng = probe["engine"]
+    # warmup + `steps` measured executions all visible through the engine
+    assert int(np.asarray(eng.state["step"])) == steps + 1
+    # and no leaf dangles into donated storage (donation is stripped on
+    # old-jax CPU, but on TPU these would be freed buffers)
+    assert not any(getattr(leaf, "is_deleted", lambda: False)()
+                   for leaf in jax.tree_util.tree_leaves(eng.state))

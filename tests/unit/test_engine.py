@@ -229,6 +229,17 @@ def test_wall_clock_breakdown_with_steps_per_print_zero(eight_devices):
     assert engine.global_steps == 1
 
 
+def test_facade_with_wall_clock_breakdown(eight_devices):
+    """Regression: the facade's synced timer stop (JL001 fix) must read a
+    metric key that exists — apply-step metrics carry grad_norm, not loss."""
+    engine = make_engine(extra={"wall_clock_breakdown": True})
+    loss = engine.forward(make_batch(8))
+    engine.backward(loss)
+    engine.step()
+    assert engine.global_steps == 1
+    assert engine.timers("step").mean() >= 0.0
+
+
 def test_facade_micro_step_counting(eight_devices):
     """Regression: micro_steps counted once per microbatch on the facade path."""
     engine = make_engine(gas=2, bs=16)

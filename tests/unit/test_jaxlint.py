@@ -1,0 +1,613 @@
+"""jaxlint unit tests: one failing and one passing fixture per rule, plus the
+suppression, baseline, config, and CLI machinery."""
+
+import json
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.tools.jaxlint import (LintConfig, RULE_REGISTRY,
+                                         RuleSettings, lint_text)
+from deepspeed_tpu.tools.jaxlint.baseline import (apply_baseline,
+                                                  load_baseline,
+                                                  write_baseline)
+from deepspeed_tpu.tools.jaxlint.cli import main as jaxlint_main
+
+
+def lint(src, **rule_options):
+    cfg = LintConfig()
+    for rid, opts in rule_options.items():
+        cfg.rules[rid] = RuleSettings(options=opts)
+    return lint_text(textwrap.dedent(src), path="pkg/mod.py", config=cfg)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def test_registry_has_all_six_rules():
+    assert set(RULE_REGISTRY) == {"JL001", "JL002", "JL003", "JL004",
+                                  "JL005", "JL006"}
+
+
+# --------------------------------------------------------------------------- #
+# JL001 — untimed async dispatch
+# --------------------------------------------------------------------------- #
+
+def test_jl001_flags_unsynced_delta():
+    findings = lint("""
+        import time
+
+        def bench(f, x):
+            t0 = time.time()
+            y = f(x)
+            return time.time() - t0
+    """)
+    assert rules_of(findings) == ["JL001"]
+
+
+def test_jl001_clean_with_block_until_ready():
+    findings = lint("""
+        import time
+        import jax
+
+        def bench(f, x):
+            t0 = time.time()
+            y = f(x)
+            jax.block_until_ready(y)
+            return time.time() - t0
+    """)
+    assert findings == []
+
+
+def test_jl001_ignores_pure_host_timing():
+    # no significant call inside the timed window: nothing to sync
+    findings = lint("""
+        import time
+
+        def tick():
+            t0 = time.time()
+            return time.time() - t0
+    """)
+    assert findings == []
+
+
+def test_jl001_reassigned_clock_var_uses_latest_stamp():
+    # the second window is pure-host: re-stamping t0 must reset the window,
+    # not stretch it back over the earlier dispatch
+    findings = lint("""
+        import time
+        import jax
+
+        def two_windows(f, parse, x):
+            t0 = time.time()
+            y = f(x)
+            jax.block_until_ready(y)
+            d1 = time.time() - t0
+            t0 = time.time()
+            parse(x)
+            d2 = time.time() - t0
+            return d1, d2
+    """)
+    assert rules_of(findings) == ["JL001"]  # only the unsynced second window
+    assert findings[0].line == 12
+
+
+def test_jl001_perf_counter_and_aliased_start():
+    findings = lint("""
+        import time
+
+        def bench(g):
+            start = time.perf_counter()
+            g()
+            dt = time.perf_counter() - start
+            return dt
+    """)
+    assert rules_of(findings) == ["JL001"]
+
+
+# --------------------------------------------------------------------------- #
+# JL002 — constant PRNG keys
+# --------------------------------------------------------------------------- #
+
+def test_jl002_flags_constant_key():
+    findings = lint("""
+        import jax
+
+        def init(shape):
+            key = jax.random.PRNGKey(0)
+            return jax.random.normal(key, shape)
+    """)
+    assert rules_of(findings) == ["JL002"]
+
+
+def test_jl002_clean_with_threaded_rng():
+    findings = lint("""
+        import jax
+        from deepspeed_tpu.utils.rng import default_rng
+
+        def init(shape, rng=None):
+            rng = rng if rng is not None else default_rng()
+            return jax.random.normal(rng, shape)
+    """)
+    assert findings == []
+
+
+def test_jl002_variable_seed_is_fine():
+    findings = lint("""
+        import jax
+
+        def keyed(seed):
+            return jax.random.PRNGKey(seed)
+    """)
+    assert findings == []
+
+
+def test_jl002_allow_paths_skips_tests():
+    src = """
+        import jax
+        KEY = jax.random.PRNGKey(0)
+    """
+    cfg = LintConfig()
+    findings = lint_text(textwrap.dedent(src), path="tests/unit/test_x.py",
+                         config=cfg)
+    assert findings == []
+
+
+def test_jl002_resolves_import_alias():
+    findings = lint("""
+        from jax import random as jrandom
+
+        def init():
+            return jrandom.PRNGKey(42)
+    """)
+    assert rules_of(findings) == ["JL002"]
+
+
+def test_jl002_keyword_seed_form():
+    findings = lint("""
+        import jax
+
+        def init():
+            return jax.random.PRNGKey(seed=0)
+    """)
+    assert rules_of(findings) == ["JL002"]
+
+
+def test_plain_dotted_import_does_not_corrupt_resolution():
+    # `import jax.random` binds only `jax`; jax.jit must still resolve so
+    # donation tracking works in such modules
+    findings = lint("""
+        import jax.random
+
+        step = jax.jit(lambda s: s, donate_argnums=(0,))
+
+        def run(state):
+            out = step(state)
+            print(state)
+            return out
+    """)
+    assert rules_of(findings) == ["JL003"]
+
+
+# --------------------------------------------------------------------------- #
+# JL003 — donated-buffer reuse
+# --------------------------------------------------------------------------- #
+
+def test_jl003_flags_reread_after_donation():
+    findings = lint("""
+        import jax
+
+        step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+        def train(state, batch):
+            new_state = step(state, batch)
+            print(state)          # reads the donated tree
+            return new_state
+    """)
+    assert rules_of(findings) == ["JL003"]
+
+
+def test_jl003_clean_when_rebound():
+    findings = lint("""
+        import jax
+
+        step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+        def train(state, batch):
+            state = step(state, batch)
+            print(state)          # the NEW state: fine
+            return state
+    """)
+    assert findings == []
+
+
+def test_jl003_partial_decorator_and_loop_rebind():
+    findings = lint("""
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(s, b):
+            return s
+
+        def train(state, batches):
+            for b in batches:
+                state = step(state, b)
+            return state
+    """)
+    assert findings == []
+
+
+def test_jl003_flags_stale_attribute_alias():
+    # the autotuner bug shape: donate a tree read from an attribute, never
+    # rebind the attribute -> the holder keeps referencing freed buffers
+    findings = lint("""
+        import jax
+
+        step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+        def measure(engine, batch):
+            state = engine.state
+            state = step(state, batch)
+            return state
+    """)
+    assert rules_of(findings) == ["JL003"]
+
+
+def test_jl003_clean_when_attribute_rebound():
+    findings = lint("""
+        import jax
+
+        step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+
+        def measure(engine, batch):
+            state = engine.state
+            state = step(state, batch)
+            engine.state = state
+            return state
+    """)
+    assert findings == []
+
+
+def test_jl003_assume_donated_config():
+    src = """
+        def measure(probe, batch):
+            compiled = probe.compiled
+            state = probe.state
+            out = compiled(state, batch)
+            return out
+    """
+    assert rules_of(lint(src, JL003={"assume_donated": {"compiled": [0]}})) \
+        == ["JL003"]
+    assert lint(src) == []
+
+
+# --------------------------------------------------------------------------- #
+# JL004 — tracer control flow
+# --------------------------------------------------------------------------- #
+
+def test_jl004_flags_if_on_tracer():
+    findings = lint("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """)
+    assert rules_of(findings) == ["JL004"]
+
+
+def test_jl004_shape_checks_are_static():
+    findings = lint("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x.shape[0] > 1:
+                return x[:1]
+            return x
+    """)
+    assert findings == []
+
+
+def test_jl004_static_argnums_excluded():
+    findings = lint("""
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def f(x, mode):
+            if mode:
+                return x * 2
+            return x
+    """)
+    assert findings == []
+
+
+def test_jl004_while_on_tracer_via_jit_call():
+    findings = lint("""
+        import jax
+
+        def body(x):
+            while x > 0:
+                x = x - 1
+            return x
+
+        g = jax.jit(body)
+    """)
+    assert rules_of(findings) == ["JL004"]
+
+
+def test_jl004_len_and_isinstance_are_host():
+    findings = lint("""
+        import jax
+
+        @jax.jit
+        def f(xs):
+            if len(xs) > 2:
+                return xs[0]
+            return xs[-1]
+    """)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# JL005 — undeclared mesh axes
+# --------------------------------------------------------------------------- #
+
+def test_jl005_flags_unknown_axis():
+    findings = lint("""
+        import numpy as np
+        import jax
+        from jax.sharding import Mesh, PartitionSpec
+
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        spec = PartitionSpec("modle")   # typo'd axis
+    """)
+    assert rules_of(findings) == ["JL005"]
+
+
+def test_jl005_clean_with_declared_axis():
+    findings = lint("""
+        import numpy as np
+        import jax
+        from jax.sharding import Mesh, PartitionSpec
+
+        mesh = Mesh(np.array(jax.devices()), ("data", "model"))
+        spec = PartitionSpec("data", "model")
+    """)
+    assert findings == []
+
+
+def test_jl005_known_axes_config():
+    src = """
+        from jax.sharding import PartitionSpec as P
+        spec = P("tensor")
+    """
+    assert lint(src) == []  # no mesh, no config: module skipped
+    assert rules_of(lint(src, JL005={"known_axes": ["data"]})) == ["JL005"]
+    assert lint(src, JL005={"known_axes": ["tensor"]}) == []
+
+
+def test_jl005_collective_axis_name():
+    findings = lint("""
+        import jax
+        from jax import lax
+
+        def f(x):
+            return lax.psum(x, axis_name="bogus")
+    """, JL005={"known_axes": ["data"]})
+    assert rules_of(findings) == ["JL005"]
+
+
+def test_jl005_axis_index_first_positional():
+    src = """
+        from jax import lax
+
+        def f():
+            return lax.axis_index("dtaa")
+    """
+    assert rules_of(lint(src, JL005={"known_axes": ["data"]})) == ["JL005"]
+    assert lint(src.replace("dtaa", "data"),
+                JL005={"known_axes": ["data"]}) == []
+
+
+# --------------------------------------------------------------------------- #
+# JL006 — compat shim bypass
+# --------------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("stmt", [
+    "from jax.experimental.shard_map import shard_map",
+    "from jax.experimental import shard_map",
+    "import jax.experimental.shard_map",
+    "from jax.experimental.pallas import tpu as pltpu",
+    "import jax.experimental.pallas.tpu as pltpu",
+    "from jax import shard_map",
+])
+def test_jl006_flags_raw_imports(stmt):
+    assert rules_of(lint(stmt)) == ["JL006"]
+
+
+def test_jl006_compat_imports_clean():
+    findings = lint("""
+        from deepspeed_tpu.utils.jax_compat import shard_map, import_pltpu
+
+        pltpu = import_pltpu()
+    """)
+    assert findings == []
+
+
+def test_jl006_allow_paths_exempts_the_shim():
+    src = "from jax.experimental.shard_map import shard_map"
+    cfg = LintConfig()
+    findings = lint_text(src, path="deepspeed_tpu/utils/jax_compat.py",
+                         config=cfg)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# suppressions / baseline / config / CLI
+# --------------------------------------------------------------------------- #
+
+def test_line_suppression():
+    findings = lint("""
+        import jax
+
+        KEY = jax.random.PRNGKey(0)  # jaxlint: disable=JL002
+    """)
+    assert findings == []
+
+
+def test_line_suppression_wrong_rule_does_not_hide():
+    findings = lint("""
+        import jax
+
+        KEY = jax.random.PRNGKey(0)  # jaxlint: disable=JL001
+    """)
+    assert rules_of(findings) == ["JL002"]
+
+
+def test_file_suppression():
+    findings = lint("""
+        # jaxlint: disable-file=JL006
+        from jax import shard_map
+        from jax.experimental.pallas import tpu
+    """)
+    assert findings == []
+
+
+def test_docstring_mention_is_not_a_suppression():
+    # documenting the directive in a docstring must not install it
+    findings = lint('''
+        """Docs: write ``# jaxlint: disable-file=JL006`` to suppress a file."""
+        from jax import shard_map
+    ''')
+    assert rules_of(findings) == ["JL006"]
+
+
+def test_disable_all_on_line():
+    findings = lint("""
+        import jax
+        KEY = jax.random.PRNGKey(7)  # jaxlint: disable=all
+    """)
+    assert findings == []
+
+
+def test_rule_disabled_via_config():
+    src = "from jax import shard_map"
+    cfg = LintConfig(rules={"JL006": RuleSettings(enabled=False)})
+    assert lint_text(src, path="pkg/mod.py", config=cfg) == []
+
+
+def test_baseline_roundtrip(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\nKEY = jax.random.PRNGKey(0)\n")
+    findings = lint_text(bad.read_text(), path=str(bad))
+    assert rules_of(findings) == ["JL002"]
+
+    bl = tmp_path / "baseline.json"
+    write_baseline(str(bl), findings, root=str(tmp_path))
+    loaded = load_baseline(str(bl))
+    assert sum(loaded.values()) == 1
+
+    new, grandfathered = apply_baseline(findings, loaded, root=str(tmp_path))
+    assert new == [] and rules_of(grandfathered) == ["JL002"]
+
+    # a second identical finding is NOT covered by a count-1 baseline
+    new2, _ = apply_baseline(findings * 2, loaded, root=str(tmp_path))
+    assert len(new2) == 1
+
+
+def test_cli_end_to_end(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n\ndef f():\n    return jax.random.PRNGKey(0)\n")
+    good = tmp_path / "good.py"
+    good.write_text("def f(rng):\n    return rng\n")
+
+    assert jaxlint_main([str(good), "--no-config"]) == 0
+    assert jaxlint_main([str(bad), "--no-config"]) == 1
+    out = capsys.readouterr().out
+    assert "JL002" in out
+
+    # --select an unrelated rule: clean
+    assert jaxlint_main([str(bad), "--no-config", "--select", "JL006"]) == 0
+    # --disable the firing rule: clean
+    assert jaxlint_main([str(bad), "--no-config", "--disable", "JL002"]) == 0
+
+    # baseline workflow: write, then rerun green
+    bl = tmp_path / "bl.json"
+    assert jaxlint_main([str(bad), "--no-config", "--baseline", str(bl),
+                         "--write-baseline"]) == 0
+    assert jaxlint_main([str(bad), "--no-config", "--baseline", str(bl)]) == 0
+
+    # json format
+    capsys.readouterr()  # flush text-mode output from the runs above
+    assert jaxlint_main([str(bad), "--no-config", "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload and payload[0]["rule"] == "JL002"
+
+
+def test_cli_missing_path_is_usage_error(tmp_path):
+    assert jaxlint_main([str(tmp_path / "nope.py"), "--no-config"]) == 2
+
+
+def test_cli_unknown_rule_id_is_usage_error(tmp_path, capsys):
+    # a typo'd --select must NOT silently disable every rule and exit green
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    assert jaxlint_main([str(ok), "--no-config", "--select", "JL999"]) == 2
+    assert jaxlint_main([str(ok), "--no-config", "--disable", "JL13"]) == 2
+    assert "unknown rule id" in capsys.readouterr().err
+
+
+def test_cli_parse_error_reported(tmp_path, capsys):
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    assert jaxlint_main([str(broken), "--no-config"]) == 1
+    assert "JL000" in capsys.readouterr().out
+
+
+def test_parse_errors_are_never_baselined(tmp_path):
+    # an unparseable file gets no rule coverage; grandfathering it would
+    # exempt it from the linter forever
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    bl = tmp_path / "bl.json"
+    assert jaxlint_main([str(broken), "--no-config", "--baseline", str(bl),
+                         "--write-baseline"]) == 1
+    assert load_baseline(str(bl)) == {}
+    # and the rerun still fails
+    assert jaxlint_main([str(broken), "--no-config", "--baseline", str(bl)]) == 1
+
+
+def test_config_load_and_discovery(tmp_path):
+    (tmp_path / ".jaxlint.json").write_text(json.dumps({
+        "exclude": ["vendored/"],
+        "baseline": "bl.json",
+        "rules": {"JL001": {"enabled": False},
+                  "JL005": {"options": {"known_axes": ["data"]}}},
+    }))
+    sub = tmp_path / "pkg"
+    sub.mkdir()
+    from deepspeed_tpu.tools.jaxlint.config import find_config
+    found = find_config(str(sub))
+    assert found == str(tmp_path / ".jaxlint.json")
+    cfg = LintConfig.load(found)
+    assert not cfg.rule("JL001").enabled
+    assert cfg.rule("JL005").options["known_axes"] == ["data"]
+    assert cfg.baseline_path() == str(tmp_path / "bl.json")
+
+
+def test_repo_tree_is_clean():
+    """The shipped tree lints clean under the shipped config — the CI gate."""
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    pkg = os.path.join(root, "deepspeed_tpu")
+    cfg_path = os.path.join(root, ".jaxlint.json")
+    if not os.path.isdir(pkg) or not os.path.isfile(cfg_path):
+        pytest.skip("source tree layout not available")
+    assert jaxlint_main([pkg, "--config", cfg_path]) == 0
