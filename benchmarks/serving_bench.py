@@ -38,7 +38,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def build_engine(on_tpu: bool, seqs: int, prompt: int, gen: int,
                  burst: int = 8, int8: bool = False,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False, warmup: bool = False,
+                 warmup_bursts: bool = True):
     import jax
     import jax.numpy as jnp
     from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
@@ -87,6 +88,14 @@ def build_engine(on_tpu: bool, seqs: int, prompt: int, gen: int,
         econf["quantization"] = {"weight_bits": 8}
     if prefix_cache:
         econf["prefix_cache"] = {"enabled": True}
+    if warmup:
+        # AOT-warm the whole decode bucket grid (and, for legs that run
+        # fused bursts, the burst length) so the timed legs never observe an
+        # XLA compile; the multistep scan programs are the slowest compiles
+        # in the set, so legs that never burst skip them
+        econf["compile"] = {"warmup": True,
+                            "warmup_decode_steps": [burst] if warmup_bursts
+                            else []}
     engine = InferenceEngineV2(model=model, model_parameters=params,
                                config=econf)
     return engine, vocab
@@ -360,6 +369,105 @@ def run_shared_prefix(on_tpu: bool, n_requests: int, prefix_len: int,
     }
 
 
+def run_steady_state(on_tpu: bool, seqs: int, prompt: int, gen: int,
+                     seed: int = 0):
+    """Steady-state decode leg: the same fixed decode set generates ``gen``
+    tokens through (a) the per-token serving loop the engine shipped with
+    before the pipeline — blocking on-device-sample fetch + full scheduler
+    pass per token — and (b) the async double-buffered ``DecodePipeline``
+    (fused on-device sampling, bucketed descriptors, one-step-late drain).
+
+    The correctness gate: greedy token streams must be BYTE-IDENTICAL
+    between the two loops (same forward math, different orchestration), and
+    the pipeline's per-step host transfer must be exactly one int32 row per
+    bucket slot (the monitor's fetch-bytes field). Reported: tokens/sec per
+    loop, the speedup, p50/p99 per-token latency, and the pipeline's
+    per-step phase breakdown. Both loops run a short untimed round first so
+    the timed rounds are compile-free (asserted via the engine's compile
+    counter).
+    """
+    from deepspeed_tpu.utils.caching import next_pow2
+    # no fused bursts in this leg: warm only the passes + the step-prog grid
+    engine, vocab = build_engine(on_tpu, seqs=seqs, prompt=prompt, gen=gen,
+                                 warmup=True, warmup_bursts=False)
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, vocab, size=(prompt,)).astype(np.int32)
+               for _ in range(seqs)]
+    uid_base = [20_000]
+
+    def prefill():
+        uid_base[0] += seqs
+        uids = list(range(uid_base[0], uid_base[0] + seqs))
+        engine._put_nofetch(uids, prompts)
+        return uids
+
+    def sync_leg(n):
+        """Pre-PR loop: per token, one blocking token-row fetch, scheduler
+        bookkeeping, a full ragged-pass descriptor build, one pass."""
+        uids = prefill()
+        outs = [[] for _ in uids]
+        lat = []
+        t0 = time.time()
+        for j in range(n):
+            tb = time.time()
+            toks = engine.sample_next(uids)   # blocks: sample + row fetch
+            for i, t in enumerate(toks):
+                outs[i].append(int(t))
+            if j < n - 1:                     # last token's pass is unread
+                engine._put_nofetch(uids, [np.asarray([t], np.int32)
+                                           for t in toks])
+            lat.append(time.time() - tb)
+        wall = time.time() - t0
+        engine.flush(uids)
+        return outs, wall, [1e3 * x for x in lat]
+
+    def pipe_leg(n):
+        uids = prefill()
+        pipe = engine.decode_pipeline(uids)
+        st = engine.pipeline_stats
+        st.reset()
+        t0 = time.time()
+        out = pipe.run(n)                     # fully drained on return
+        wall = time.time() - t0
+        engine.flush(uids)
+        return [list(map(int, row)) for row in out], wall, list(st.step_wall_ms)
+
+    # untimed rounds: compile/warm everything either loop touches
+    sync_leg(min(4, gen))
+    pipe_leg(min(4, gen))
+    c0 = engine.compiles
+    outs_sync, wall_sync, lat_sync = sync_leg(gen)
+    outs_pipe, wall_pipe, lat_pipe = pipe_leg(gen)
+    compiles = engine.compiles - c0
+    st = engine.pipeline_stats
+    bucket = next_pow2(seqs)
+    tok = seqs * gen
+    n = max(1, st.steps)
+    return {
+        "leg": "steady_state",
+        "seqs": seqs,
+        "prompt": prompt,
+        "gen": gen,
+        "bucket": bucket,
+        "sync_tokens_per_sec": round(tok / wall_sync, 1),
+        "pipelined_tokens_per_sec": round(tok / wall_pipe, 1),
+        "speedup": round(wall_sync / wall_pipe, 2),
+        "sync_p50_tbt_ms": round(float(np.percentile(lat_sync, 50)), 3),
+        "sync_p99_tbt_ms": round(float(np.percentile(lat_sync, 99)), 3),
+        "pipe_p50_tbt_ms": round(float(np.percentile(lat_pipe, 50)), 3),
+        "pipe_p99_tbt_ms": round(float(np.percentile(lat_pipe, 99)), 3),
+        "outputs_equal": outs_pipe == outs_sync,
+        # the tentpole invariant: one int32 row per bucket slot per step
+        "fetch_bytes_per_step": st.fetch_bytes_per_step,
+        "fetch_is_token_row": st.fetch_bytes_per_step == 4.0 * bucket,
+        "dispatch_ms_per_step": round(st.dispatch_ms / n, 3),
+        "host_build_ms_per_step": round(st.host_build_ms / n, 3),
+        "fetch_drain_ms_per_step": round(st.fetch_drain_ms / n, 3),
+        "bubble_ms_per_step": round(st.bubble_ms / n, 3),
+        "compiles_during_timed_runs": compiles,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seqs", type=int, default=32)
@@ -382,6 +490,11 @@ def main():
                     help="run the shared-prefix (prefix-cache) leg instead of "
                          "the load sweep: N requests sharing a long system "
                          "prompt, cache-on vs cache-off")
+    ap.add_argument("--steady-state", action="store_true",
+                    help="run the steady-state decode leg instead of the load "
+                         "sweep: a fixed decode set through the pre-pipeline "
+                         "per-token loop vs the async double-buffered "
+                         "DecodePipeline, with a byte-identical-greedy gate")
     ap.add_argument("--requests", type=int, default=16,
                     help="shared-prefix leg: number of requests")
     ap.add_argument("--prefix", type=int, default=256,
@@ -402,6 +515,17 @@ def main():
         if not out["outputs_equal"]:
             # the leg's correctness gate: cached-KV reuse must not change
             # greedy outputs — a divergence means corrupted page adoption
+            sys.exit(1)
+        return
+    if args.steady_state:
+        out = run_steady_state(on_tpu, args.seqs, args.prompt, args.gen)
+        print(json.dumps(out), flush=True)
+        if (not out["outputs_equal"] or not out["fetch_is_token_row"]
+                or out["compiles_during_timed_runs"] != 0):
+            # gates: pipelined orchestration must not change greedy outputs,
+            # the per-step transfer must stay one token row, and warm in-grid
+            # serving must never compile (a bucket-keying regression shows
+            # up here before it shows up as a throughput mystery)
             sys.exit(1)
         return
     engine, vocab = build_engine(on_tpu, args.seqs, args.prompt, args.gen,

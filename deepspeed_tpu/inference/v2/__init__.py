@@ -5,8 +5,11 @@ the ``ragged/`` KV subsystem, and the Dynamic SplitFuse scheduling described in
 ``blogs/deepspeed-fastgen``). TPU-native design notes live in ``engine_v2.py``.
 """
 
-from deepspeed_tpu.inference.v2.config_v2 import (PrefixCacheConfig,
+from deepspeed_tpu.inference.v2.config_v2 import (CompileConfig,
+                                                  PrefixCacheConfig,
                                                   RaggedInferenceEngineConfig)
-from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
+                                                  fetch_to_host)
+from deepspeed_tpu.inference.v2.pipeline import DecodePipeline
 from deepspeed_tpu.inference.v2.prefix_cache import (PrefixCacheStats,
                                                      RadixPrefixCache)

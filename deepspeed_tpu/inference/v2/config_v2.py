@@ -115,12 +115,76 @@ class PrefixCacheConfig:
 
 
 @dataclass
+class CompileConfig:
+    """Persistent compile cache + AOT warmup for the serving hot path.
+
+    Steady-state decode cost on TPU is bounded below by recompiles: every new
+    (bucketed) batch shape pays a multi-second XLA compile, and through a
+    remote-compile tunnel a cold engine pays it for every program on its
+    first wave of traffic. This config wires ``utils/compile_cache.py``
+    (the ``jax_compilation_cache_dir`` integration) into engine construction
+    and optionally AOT-warms the whole decode bucket grid at startup so
+    serving traffic never observes a compile.
+
+    ``cache_dir``: root directory for the persistent XLA compile cache.
+    ``None`` (default) defers to the ``DSTPU_COMPILE_CACHE`` environment
+    variable; unset/empty means the engine does not touch the process-level
+    cache config (bench/test entrypoints may still have configured one).
+    CPU backends get a host-fingerprint subdir (see utils/compile_cache.py —
+    AOT CPU executables SIGILL on hosts missing the build host's ISA).
+
+    ``warmup``: pre-compile the serving program set at engine construction —
+    the ragged paged pass, the prefill fast path, and the fused decode-step
+    program for every bucket in ``warmup_buckets`` (plus fused multistep
+    programs for each burst length in ``warmup_decode_steps``). Warmup runs
+    each program once over the engine's scratch KV page, so with a persistent
+    cache a *second* engine start skips compilation entirely.
+
+    ``warmup_buckets``: decode-row buckets to pre-compile. ``None`` = the
+    full power-of-two grid ``1, 2, 4, ..., next_pow2(max_ragged_sequence_
+    count)`` — the whole reachable bucket set, since admission/retirement
+    rounds every live count to this grid.
+    """
+    cache_dir: Optional[str] = None
+    min_compile_time_secs: float = 2.0
+    warmup: bool = False
+    warmup_buckets: Optional[Any] = None     # list of ints
+    warmup_decode_steps: Any = ()            # list of fused-burst lengths
+
+    def resolve_cache_dir(self) -> str:
+        """Effective cache root: explicit config wins, else the
+        ``DSTPU_COMPILE_CACHE`` env knob ("" = leave process config alone)."""
+        if self.cache_dir is not None:
+            return self.cache_dir
+        import os
+        return os.environ.get("DSTPU_COMPILE_CACHE", "")
+
+    def __post_init__(self):
+        if self.warmup_buckets is not None:
+            if any(not isinstance(b, int) or b < 1
+                   for b in self.warmup_buckets):
+                raise ValueError("compile.warmup_buckets must be ints >= 1, "
+                                 f"got {self.warmup_buckets!r}")
+            # normalize to the pow2 grid the live path actually uses — the
+            # same rounding engine.warmup() applies to explicit buckets, so
+            # both entry points accept the same inputs
+            from deepspeed_tpu.utils.caching import next_pow2
+            self.warmup_buckets = sorted({next_pow2(b)
+                                          for b in self.warmup_buckets})
+        if any(not isinstance(n, int) or n < 1
+               for n in self.warmup_decode_steps):
+            raise ValueError("compile.warmup_decode_steps must be ints >= 1, "
+                             f"got {self.warmup_decode_steps!r}")
+
+
+@dataclass
 class RaggedInferenceEngineConfig:
     state_manager: DSStateManagerConfig = field(default_factory=DSStateManagerConfig)
     kv_cache: KVCacheSizingConfig = field(default_factory=KVCacheSizingConfig)
     quantization: QuantizationConfig = field(default_factory=QuantizationConfig)
     kv_quant: KVQuantConfig = field(default_factory=KVQuantConfig)
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
+    compile: CompileConfig = field(default_factory=CompileConfig)
     tensor_parallel: int = 1
     dtype: Any = jnp.bfloat16
     seed: int = 0
@@ -146,8 +210,10 @@ class RaggedInferenceEngineConfig:
             kq = KVQuantConfig(**kq) if isinstance(kq, dict) else kq
             pc = d.pop("prefix_cache", {})
             pc = PrefixCacheConfig(**pc) if isinstance(pc, dict) else pc
+            co = d.pop("compile", {})
+            co = CompileConfig(**co) if isinstance(co, dict) else co
             cfg = cls(state_manager=sm, kv_cache=kv, quantization=qz,
-                      kv_quant=kq, prefix_cache=pc, **d)
+                      kv_quant=kq, prefix_cache=pc, compile=co, **d)
         if cfg.state_manager.chunk_budget <= 0:
             raise ValueError("max_ragged_batch_size must exceed max_ragged_sequence_count")
         return cfg

@@ -13,6 +13,13 @@ TPU-native structure per pass (one jitted call, static shapes):
       Pallas attention; MoE grouped GEMM      (``ragged_model.py``)
     host: sample / collect last-token logits, advance descriptors
 
+The steady-state decode hot path does NOT run that per-pass loop: it runs
+bucketed fused decode programs (sampling on device, one int32 token row per
+step crossing to host) driven either as ``decode_steps`` bursts or through
+the async double-buffered ``DecodePipeline`` (``pipeline.py``); see
+docs/SERVING.md for the full picture (bucketing grids, the one-step-late
+drain, AOT warmup).
+
 KV pages are donated through the pass (XLA aliases them in HBM — the functional
 analog of the reference writing its blocked KV cache in place).
 """
@@ -34,10 +41,24 @@ from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
 from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache, KVCacheConfig
 from deepspeed_tpu.inference.v2.ragged_model import adapt_model, build_ragged_forward
 from deepspeed_tpu.inference.v2.scheduler import DynamicSplitFuseScheduler
+from deepspeed_tpu.utils.caching import LRUCache, next_pow2
 from deepspeed_tpu.utils.logging import log_dist
 
 
 import functools
+
+
+def fetch_to_host(arr) -> np.ndarray:
+    """THE device->host drain point for the v2 serving hot path.
+
+    Every blocking fetch of a device array in ``inference/v2`` routes through
+    here: the serving loops are engineered so the only thing drained per
+    decode step is a bucket-sized int32 token row, and funnelling the drain
+    through one function lets jaxlint rule JL007 statically police the hot
+    path for stray blocking fetches (an accidental ``np.asarray(logits)``
+    re-introduces the [S, V] per-step transfer this engine exists to avoid).
+    """
+    return np.asarray(arr)  # jaxlint: disable=JL007 -- the intentional drain
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4))
@@ -65,6 +86,20 @@ class InferenceEngineV2:
                  mesh_topology: Optional[MeshTopology] = None):
         self.config = RaggedInferenceEngineConfig.load(config)
         cfg = self.config
+        # persistent XLA compile cache: configured FIRST so every program this
+        # constructor (and the optional AOT warmup below) compiles lands in it
+        # — a second engine start then reloads instead of recompiling
+        cache_dir = cfg.compile.resolve_cache_dir()
+        if cache_dir:
+            from deepspeed_tpu.utils.compile_cache import setup_compile_cache
+            setup_compile_cache(
+                cache_dir=cache_dir,
+                min_compile_time_secs=cfg.compile.min_compile_time_secs)
+        # device programs built by this engine (each is called with exactly
+        # one signature, so builds == XLA compiles modulo the persistent
+        # cache). Warmup pre-builds the serving grid; a serving loop whose
+        # batch sizes stay in-grid must never increment this again.
+        self.compiles = 0
         tp = cfg.tensor_parallel
         if mesh_topology is not None:
             self.topology = set_topology(mesh_topology)
@@ -118,14 +153,21 @@ class InferenceEngineV2:
                     "block_size % 128 == 0 (got head_dim="
                     f"{self.spec.head_dim}, block_size="
                     f"{cfg.kv_cache.block_size})")
+        # the pool carries ONE page beyond the allocator's reach: the scratch
+        # page backing bucket-padding rows in the fused decode programs (pad
+        # rows read/write only it, so padding a batch to its power-of-two
+        # bucket never touches a live sequence's KV). Outside the allocator
+        # on purpose — free/total accounting and the prefix cache never see
+        # it, and it can never be handed to a sequence.
         kv_cfg = KVCacheConfig(
             num_layers=self.spec.num_layers,
             num_kv_heads=self.spec.num_kv_heads,
             head_dim=self.spec.head_dim,
             block_size=cfg.kv_cache.block_size,
-            num_blocks=nb,
+            num_blocks=nb + 1,
             dtype=cfg.dtype,
             quantized=cfg.kv_quant.enabled)
+        self.scratch_block = nb
         self.kv = BlockedKVCache(kv_cfg, self.topology)
         self.allocator = BlockedAllocator(nb)
         self.prefix_cache = None
@@ -165,6 +207,7 @@ class InferenceEngineV2:
         self._eff_tp = eff_tp
         fwd = build_ragged_forward(self.spec, mesh=self.topology.mesh, tp=eff_tp)
         self._pass = jax.jit(fwd, donate_argnums=(1,))
+        self.compiles += 1
         self._pass_prefill = None  # built on the first pure-prefill pass
         self._rng = np.random.RandomState(cfg.seed)
         self._rng_key = jax.random.PRNGKey(cfg.seed)
@@ -173,15 +216,26 @@ class InferenceEngineV2:
         # Materialised to numpy lazily (put()) or sampled on device without
         # ever shipping the [S, V] tensor to host (sample_next()).
         self._last_ref: Dict[int, Tuple[Any, int]] = {}
-        # LRU-bounded compiled multistep programs: keyed by (n_steps, S,
-        # do_sample, top_k); serving with many batch sizes must not accumulate
-        # XLA executables without eviction (round S to buckets upstream when
-        # batch sizes vary a lot)
-        from deepspeed_tpu.utils.caching import LRUCache
+        # LRU-bounded compiled multistep programs: keyed by (n_steps, BUCKET,
+        # do_sample, top_k) where BUCKET = next_pow2(live rows) — serving with
+        # many batch sizes reuses ~log2 executables, and the LRU bound keeps a
+        # long-lived process from accumulating programs for retired burst
+        # lengths. Callers hold the returned program through the call, so
+        # eviction can never free an executable mid-flight (Python refs).
         self._multistep: LRUCache = LRUCache(maxsize=8)
-        log_dist(f"engine_v2: family={family} tp={eff_tp} blocks={nb} "
+        # compiled single-step fused decode programs (DecodePipeline), keyed
+        # by (bucket, do_sample, top_k); one per grid point
+        self._step_progs: LRUCache = LRUCache(maxsize=16)
+        # aggregate double-buffer pipeline timings (monitor/serving.py);
+        # write_monitor_events emits them
+        from deepspeed_tpu.monitor.serving import PipelineStats
+        self.pipeline_stats = PipelineStats()
+        log_dist(f"engine_v2: family={family} tp={eff_tp} blocks={nb}+scratch "
                  f"block_size={kv_cfg.block_size} budget={sm.max_ragged_batch_size}",
                  ranks=[0])
+        if cfg.compile.warmup:
+            self.warmup(buckets=cfg.compile.warmup_buckets,
+                        burst_steps=cfg.compile.warmup_decode_steps)
 
     # ------------------------------------------------------------------ #
 
@@ -245,7 +299,7 @@ class InferenceEngineV2:
             arr, row = ref
             by_array.setdefault(id(arr), (arr, []))[1].append((uid, row))
         for arr, pairs in by_array.values():
-            host = np.asarray(arr)
+            host = fetch_to_host(arr)
             for uid, row in pairs:
                 self._last_logits[uid] = host[row]
 
@@ -259,7 +313,7 @@ class InferenceEngineV2:
                                                do_sample, temperature, top_k)
         # slice AFTER the host fetch: a device-side [:n] would compile a new
         # tiny executable for every distinct live-sequence count
-        return np.asarray(padded)[:n]
+        return fetch_to_host(padded)[:n]
 
     def _sample_device(self, uids: Sequence[int], do_sample: bool,
                        temperature: float, top_k: int):
@@ -304,15 +358,15 @@ class InferenceEngineV2:
                 self._rng_key, sub = jax.random.split(self._rng_key)
             else:
                 sub = self._rng_key
-            # pad the row set to the next power of two: a serving loop calls
-            # this with a DIFFERENT number of live sequences every time a
-            # sequence retires, and each distinct length would recompile
-            # _dev_sample (~seconds through a remote-compile tunnel; measured
-            # 5 s/iteration in benchmarks/serving_bench.py). Extra rows
-            # resample row 0 and are sliced off.
+            # pad the row set to its bucket (utils.caching.next_pow2): a
+            # serving loop calls this with a DIFFERENT number of live
+            # sequences every time a sequence retires, and each distinct
+            # length would recompile _dev_sample (~seconds through a
+            # remote-compile tunnel; measured 5 s/iteration in
+            # benchmarks/serving_bench.py). Extra rows resample row 0 and
+            # are sliced off.
             n_real = len(rows)
-            n_pad = 1 << (n_real - 1).bit_length() if n_real > 1 else 1
-            rows = rows + [rows[0]] * (n_pad - n_real)
+            rows = rows + [rows[0]] * (next_pow2(n_real) - n_real)
             out = _dev_sample(arr, np.asarray(rows, np.int32), sub,
                               bool(do_sample), int(top_k),
                               float(temperature))
@@ -323,8 +377,8 @@ class InferenceEngineV2:
         flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
         # pad the reorder gather to the bucket size too (same reasoning)
         n = len(uids)
-        n_pad = 1 << (n - 1).bit_length() if n > 1 else 1
-        order_pad = np.concatenate([order, np.zeros(n_pad - n, np.int64)])
+        order_pad = np.concatenate([order,
+                                    np.zeros(next_pow2(n) - n, np.int64)])
         return flat[jnp.asarray(order_pad, jnp.int32)].astype(jnp.int32), n
 
     def decode_steps(self, uids: Sequence[int], n_steps: int,
@@ -343,49 +397,239 @@ class InferenceEngineV2:
         corruption footgun when S == n_steps): the call then costs only a
         dispatch, so back-to-back bursts chain on device — through a remote
         runtime the synchronous ids fetch is ~an RTT per burst, which would
-        otherwise serialise host RTT into every burst."""
+        otherwise serialise host RTT into every burst.
+
+        The device program runs at ``next_pow2(len(uids))`` rows (pad rows
+        decode into the engine's scratch page): programs are keyed by the
+        bucket, so the live count drifting with admissions/retirements reuses
+        cached executables, and ``warmup()`` can pre-compile the whole grid.
+        Row-independent decode keeps real rows byte-identical under padding
+        (greedy); batch-sampled rows draw from a [bucket, V] noise block, so
+        SAMPLED streams depend on the bucket (not on which other rows are
+        pads) — a documented trade, not a bug."""
         uids = [int(u) for u in uids]
         S = len(uids)
         assert not self.scheduler.has_pending(), \
             "decode_steps requires a drained scheduler"
-        for u in uids:
-            self.scheduler.reserve(u, n_steps + 1)
-        seqs = [self.scheduler.seqs[u] for u in uids]
-        mb = self.scheduler.max_blocks
-        bt = np.stack([s.block_table(mb) for s in seqs])
-        pos0 = np.asarray([s.seen_tokens for s in seqs], np.int32)
-        ctx0 = pos0 + 1
-
-        def _build():
-            from deepspeed_tpu.inference.v2.ragged_model import (
-                build_multistep_decode)
-            tp = self.topology.tp_world_size
-            # windowed side-buffer chunks freeze page reads while writing
-            # n_steps (+1 reserved) tokens at the flush — safe only when the
-            # scheduler's page ring covers the frozen span
-            win_ok = self.scheduler.ring_covers(n_steps + 1)
-            fwd = build_multistep_decode(self.spec, n_steps,
-                                         mesh=self.topology.mesh,
-                                         tp=tp if tp > 1 else 1,
-                                         do_sample=do_sample, top_k=top_k,
-                                         window_ring_ok=win_ok)
-            return jax.jit(fwd, donate_argnums=(1,))
-
+        # bucketed descriptors: the program below is keyed by the BUCKET, so a
+        # serving loop admitting/retiring sequences reuses ~log2 executables
+        db = self.scheduler.decode_batch(uids, n_steps + 1, self.scratch_block)
         fn = self._multistep.get_or_create(
-            (n_steps, S, bool(do_sample), int(top_k)), _build)
-        ids0 = self._sample_device(uids, do_sample, temperature, top_k)
+            (n_steps, db.bucket, bool(do_sample), int(top_k)),
+            lambda: self._build_multistep(n_steps, do_sample, top_k))
+        # already bucket-padded: pad entries re-sample a real row's logits but
+        # run against the scratch page, so they cannot touch live KV
+        ids0, _ = self._sample_device_padded(uids, do_sample, temperature,
+                                             top_k)
+        assert ids0.shape[0] == db.bucket
         self._rng_key, sub = jax.random.split(self._rng_key)
         out_ids, final_logits, new_kv = fn(
-            self.weights, self.kv.kv, ids0, pos0, bt, ctx0, sub,
-            jnp.float32(temperature))
+            self.weights, self.kv.kv, ids0, db.positions, db.block_tables,
+            db.ctx_lens, sub, jnp.float32(temperature))
         self.kv.update(new_kv)
         for i, u in enumerate(uids):
             self.scheduler.advance(u, n_steps)
             self._last_ref[u] = (final_logits, i)
             self._last_logits.pop(u, None)
         if not fetch:
-            return out_ids.T            # device [S, n_steps]
-        return np.asarray(out_ids).T    # [S, n_steps]
+            ids_t = out_ids.T           # device [bucket, n_steps]
+            # the pad-row slice compiles one tiny gather per (bucket, S) —
+            # only paid when the bucket is not exactly full
+            return ids_t if db.bucket == S else ids_t[:S]
+        return fetch_to_host(out_ids).T[:S]    # [S, n_steps]
+
+    def _decode_step_prog(self, bucket: int, do_sample: bool, top_k: int):
+        """The fused single-step decode program (forward + on-device sampling,
+        ragged_model.build_decode_step) for one bucket — the DecodePipeline's
+        hot program. LRU-cached per (bucket, do_sample, top_k)."""
+        def _build():
+            from deepspeed_tpu.inference.v2.ragged_model import (
+                build_decode_step)
+            tp = self.topology.tp_world_size
+            fwd = build_decode_step(self.spec, mesh=self.topology.mesh,
+                                    tp=tp if tp > 1 else 1,
+                                    do_sample=do_sample, top_k=top_k,
+                                    window_ring_ok=self.scheduler.ring_covers(2))
+            self.compiles += 1
+            return jax.jit(fwd, donate_argnums=(1,))
+
+        return self._step_progs.get_or_create(
+            (bucket, bool(do_sample), int(top_k)), _build)
+
+    def decode_pipeline(self, uids: Sequence[int], do_sample: bool = False,
+                        temperature: float = 1.0, top_k: int = 0):
+        """An async double-buffered decode pipeline over ``uids`` (all must be
+        in steady decode state). See ``pipeline.DecodePipeline``: while the
+        device runs step N, the host drains step N-1's token row and builds
+        step N+1's descriptors; the only per-step transfer is one int32 row."""
+        from deepspeed_tpu.inference.v2.pipeline import DecodePipeline
+        return DecodePipeline(self, uids, do_sample=do_sample,
+                              temperature=temperature, top_k=top_k)
+
+    # ------------------------------------------------------------------ #
+    # AOT warmup (config_v2.CompileConfig)
+    # ------------------------------------------------------------------ #
+
+    @property
+    def decode_buckets(self) -> List[int]:
+        """The full reachable decode bucket grid: powers of two up to the
+        scheduler's decode-row capacity."""
+        top = next_pow2(self.config.state_manager.max_ragged_sequence_count)
+        return [1 << i for i in range(top.bit_length())]
+
+    def warmup(self, buckets: Optional[Sequence[int]] = None,
+               burst_steps: Sequence[int] = ()) -> int:
+        """Pre-compile the serving program set so in-grid traffic never
+        observes an XLA compile (and, with a persistent compile cache
+        configured, so a future engine start reloads everything from disk).
+
+        Covers: the ragged paged pass, the prefill fast path, the fused
+        decode-step program for every bucket (greedy — the serving default;
+        sampled variants compile on first use), fused multistep programs for
+        each ``burst_steps`` length across the grid, and the module-level
+        bootstrap sampler ``_dev_sample`` over the logits-source shapes the
+        serving loops read (chunk/decode pass outputs and per-bucket fused
+        outputs; host-rematerialized rows are count-shaped and stay cold).
+        Each program is executed once over scratch-page-only descriptors —
+        real KV state, scheduler state and logits refs are untouched.
+
+        Explicit ``buckets`` are rounded up to powers of two (the live path
+        always rounds, so a non-pow2 bucket would be dead weight).
+
+        Returns the number of ENGINE programs built (``self.compiles``; the
+        bootstrap-sampler warms are module-level jits outside the counter).
+        """
+        before = self.compiles
+        grid = sorted({next_pow2(int(b)) for b in buckets}) \
+            if buckets is not None else self.decode_buckets
+        # the warmed set must FIT its LRUs, or warmup evicts programs it just
+        # built and the zero-compiles invariant silently breaks on first use
+        self._step_progs.maxsize = max(self._step_progs.maxsize, len(grid) + 2)
+        self._multistep.maxsize = max(self._multistep.maxsize,
+                                      len(burst_steps) * len(grid) + 2)
+        self._warm_passes()
+        mb = self.scheduler.max_blocks
+        for b in grid:
+            prog = self._decode_step_prog(b, False, 0)
+            args = self._scratch_step_args(b, mb)
+            nxt, _logits, new_kv = prog(self.weights, self.kv.kv, *args)
+            self.kv.update(new_kv)
+            jax.block_until_ready(nxt)
+        for n_steps in burst_steps:
+            for b in grid:
+                fn = self._multistep.get_or_create(
+                    (n_steps, b, False, 0),
+                    lambda n=n_steps: self._build_multistep(n, False, 0))
+                args = self._scratch_step_args(b, mb)
+                out_ids, _logits, new_kv = fn(self.weights, self.kv.kv, *args)
+                self.kv.update(new_kv)
+                jax.block_until_ready(out_ids)
+        # the greedy bootstrap sampler over every logits-source shape a
+        # serving loop can hand it: without this, the FIRST pipeline run /
+        # burst after startup pays a small-but-real compile (an RTT-bound
+        # stall through a remote-compile tunnel) that the engine counter
+        # cannot witness (_dev_sample is a module-level jit)
+        sm = self.config.state_manager
+        V = self.spec.vocab_size
+        src_rows = {sm.num_chunk_slots, sm.max_ragged_sequence_count} | set(grid)
+        for b in grid:
+            rows = np.zeros((b,), np.int32)
+            for nr in src_rows:
+                jax.block_until_ready(_dev_sample(
+                    jnp.zeros((nr, V), jnp.float32), rows, self._rng_key,
+                    False, 0, 1.0))
+        built = self.compiles - before
+        log_dist(f"engine_v2: warmup built {built} programs "
+                 f"(buckets={grid}, burst_steps={list(burst_steps)})",
+                 ranks=[0])
+        return built
+
+    def _build_multistep(self, n_steps: int, do_sample: bool, top_k: int):
+        """Build (and count) one fused multistep program — the same builder
+        decode_steps uses, shared so warmup pre-compiles identical keys."""
+        from deepspeed_tpu.inference.v2.ragged_model import (
+            build_multistep_decode)
+        tp = self.topology.tp_world_size
+        fwd = build_multistep_decode(
+            self.spec, n_steps, mesh=self.topology.mesh,
+            tp=tp if tp > 1 else 1, do_sample=do_sample, top_k=top_k,
+            window_ring_ok=self.scheduler.ring_covers(n_steps + 1))
+        self.compiles += 1
+        return jax.jit(fwd, donate_argnums=(1,))
+
+    def _scratch_step_args(self, bucket: int, max_blocks: int):
+        """All-pad-row inputs for a fused decode program: every row is the
+        inert scratch-page fake sequence DecodeBatch pads with."""
+        ids = jnp.zeros((bucket,), jnp.int32)
+        pos = np.zeros((bucket,), np.int32)
+        bt = np.full((bucket, max_blocks), self.scratch_block, np.int32)
+        ctx = np.ones((bucket,), np.int32)
+        return ids, pos, bt, ctx, self._rng_key, jnp.float32(1.0)
+
+    def _warm_passes(self) -> None:
+        """Run the two scheduler-pass programs once on an all-padding batch
+        (one scratch-page dummy row each, so the kernels see live work): the
+        shapes are fully static, so this is exactly the executable every live
+        put()/mixed pass reuses."""
+        from deepspeed_tpu.inference.v2.ragged.ragged_batch import RaggedBatch
+        from deepspeed_tpu.inference.v2.ragged_model import (
+            PAGED_PASS_KEYS, PREFILL_PASS_KEYS)
+        sm = self.config.state_manager
+        NC, Cs = sm.num_chunk_slots, sm.chunk_slot_size
+        S, MB = sm.max_ragged_sequence_count, self.scheduler.max_blocks
+        bs = self.kv.config.block_size
+
+        def scratch_batch():
+            b = RaggedBatch(num_slots=NC, slot_size=Cs, max_sequences=S,
+                            max_blocks=MB)
+            b.kv_dest = np.full((NC * Cs + S,), self.kv.oob_sentinel, np.int32)
+            PW = NC * Cs // bs + NC
+            b.page_ids = np.full((PW,), self.kv.config.num_blocks, np.int32)
+            b.page_rows = np.zeros((PW,), np.int32)
+            b.page_fill = np.zeros((PW,), np.int32)
+            return b
+
+        # paged/mixed pass: one decode row ticking over in the scratch page
+        b = scratch_batch()
+        b.decode_block_tables[0] = self.scratch_block
+        b.decode_ctx_lens[0] = 1
+        b.kv_dest[NC * Cs] = self.kv.flat_write_index(self.scratch_block, 0)
+        arrays = b.device_arrays()
+        _, _, new_kv = self._pass(self.weights, self.kv.kv,
+                                  {k: arrays[k] for k in PAGED_PASS_KEYS})
+        # direct rebind (not .update()) so JL003 sees the donated pool's
+        # reference replaced before the next pass reads it
+        self.kv.kv = new_kv
+        if self.spec.alibi:
+            return  # ALiBi engines never take the packed prefill fast path
+        # prefill fast path: a one-token prompt prefilling into scratch
+        b = scratch_batch()
+        b.chunk_ntok[0] = 1
+        b.chunk_ctx_lens[0] = 1
+        b.chunk_block_tables[0] = self.scratch_block
+        b.row_seg[0] = 0
+        b.page_ids[0] = self.scratch_block
+        b.page_fill[0] = 1
+        b.kv_dest[0] = self.kv.flat_write_index(self.scratch_block, 0)
+        arrays = b.device_arrays()
+        logits, _, new_kv = self._ensure_prefill_pass()(
+            self.weights, self.kv.kv,
+            {k: arrays[k] for k in PREFILL_PASS_KEYS})
+        self.kv.update(new_kv)
+        jax.block_until_ready(logits)
+
+    def _ensure_prefill_pass(self):
+        """Build (once) the packed pure-prefill fast-path program — shared by
+        the live pass router and warmup so both compile the identical jit."""
+        if self._pass_prefill is None:
+            from deepspeed_tpu.inference.v2.ragged_model import (
+                build_prefill_forward)
+            self._pass_prefill = jax.jit(
+                build_prefill_forward(self.spec, mesh=self.topology.mesh,
+                                      tp=self._eff_tp),
+                donate_argnums=(1,))
+            self.compiles += 1
+        return self._pass_prefill
 
     def _run_pass(self) -> None:
         batch = self.scheduler.schedule_pass()
@@ -402,14 +646,7 @@ class InferenceEngineV2:
         # ALiBi models take the paged chunk path (the packed flash kernel
         # has no per-head position bias; the paged kernels do)
         if batch.pure_prefill and not self.spec.alibi:
-            if self._pass_prefill is None:
-                from deepspeed_tpu.inference.v2.ragged_model import (
-                    build_prefill_forward)
-                self._pass_prefill = jax.jit(
-                    build_prefill_forward(self.spec, mesh=self.topology.mesh,
-                                          tp=self._eff_tp),
-                    donate_argnums=(1,))
-            pass_fn = self._pass_prefill
+            pass_fn = self._ensure_prefill_pass()
             arrays = {k: arrays[k] for k in PREFILL_PASS_KEYS}
         else:
             pass_fn = self._pass
@@ -449,11 +686,15 @@ class InferenceEngineV2:
     # ------------------------------------------------------------------ #
 
     def write_monitor_events(self, monitor, step: int = 0) -> None:
-        """Emit the prefix-cache counters (hit rate, tokens saved, evictions,
-        ...) through a ``monitor/`` backend (``MonitorMaster.write_events``
-        shape). No-op with the cache off."""
+        """Emit the serving counters through a ``monitor/`` backend
+        (``MonitorMaster.write_events`` shape): prefix-cache stats when the
+        cache is on, and the decode pipeline's per-step timing/transfer
+        breakdown (dispatch / host-build / fetch-drain / bubble, fetch bytes)
+        once any ``DecodePipeline`` has run."""
         if self.prefix_cache is not None:
             monitor.write_events(self.prefix_cache.stats.events(step))
+        if self.pipeline_stats.steps:
+            monitor.write_events(self.pipeline_stats.events(step))
 
     # ------------------------------------------------------------------ #
     # continuous-batching generation loop (parity role: MII serving loop)

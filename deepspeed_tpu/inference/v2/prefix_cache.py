@@ -182,7 +182,7 @@ class RadixPrefixCache:
         last token's logits computed fresh). Allocator references for the
         returned pages are already taken for the caller; COW pages come
         exclusively owned at refcount 1."""
-        tokens = [int(t) for t in np.asarray(tokens).reshape(-1)]
+        tokens = [int(t) for t in np.asarray(tokens, np.int64).reshape(-1)]
         self.stats.lookups += 1
         self.stats.tokens_requested += len(tokens)
         bs = self.block_size
@@ -257,7 +257,7 @@ class RadixPrefixCache:
         ids actually freed back to the pool (content already cached under
         other pages, or pages past the known-token coverage).
         """
-        tokens = [int(t) for t in np.asarray(tokens).reshape(-1)]
+        tokens = [int(t) for t in np.asarray(tokens, np.int64).reshape(-1)]
         blocks = [int(b) for b in blocks]
         bs = self.block_size
         freed: List[int] = []

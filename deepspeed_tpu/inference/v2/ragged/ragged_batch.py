@@ -136,3 +136,47 @@ class RaggedBatch:
             "page_rows": self.page_rows,
             "page_fill": self.page_fill,
         }
+
+
+@dataclass
+class DecodeBatch:
+    """BUCKETED decode-only descriptor set for the fused decode programs
+    (``decode_steps`` bursts and the double-buffered ``DecodePipeline``).
+
+    Row count is padded to ``bucket = next_pow2(len(uids))`` so every device
+    program downstream is keyed by the bucket, not the live count: admitting
+    or retiring a sequence moves between cached executables instead of
+    triggering a recompile (docs/SERVING.md "bucketing grids"). Pad rows are
+    inert fake sequences — position 0, context 1, and a block table that is
+    ALL the engine's scratch page, so whatever they read is garbage that
+    never reaches a real row and whatever they write lands in the scratch
+    page no real sequence maps. This relies on decode being row-independent
+    (true for the dense ragged models served here; a capacity-constrained
+    MoE router would couple rows and need pad-row masking first).
+
+    Advanced per step by :meth:`advance` — the pipeline's "build step N+1"
+    stage is exactly these two tiny allocations, which is why the host side
+    of a pipelined decode step is ~free once KV blocks are pre-reserved.
+    """
+    uids: List[int]
+    bucket: int
+    positions: np.ndarray       # [bucket] int32; pad rows 0
+    block_tables: np.ndarray    # [bucket, MB] int32; pad rows all-scratch
+    ctx_lens: np.ndarray        # [bucket] int32; pad rows 1
+
+    @property
+    def live(self) -> int:
+        return len(self.uids)
+
+    def advance(self, n: int = 1) -> None:
+        """Advance every row (pad rows included — their writes stay inside
+        the scratch page at any position) by ``n`` generated tokens.
+
+        REBINDS the arrays instead of ``+=``: the previous step's dispatch is
+        still in flight and jax's CPU backend may alias host numpy buffers
+        zero-copy, so an in-place increment can race the async computation
+        reading them (observed as nondeterministic token divergence in the
+        pipeline tests; jax arrays made from these buffers must be treated
+        as frozen once dispatched)."""
+        self.positions = self.positions + np.int32(n)
+        self.ctx_lens = self.ctx_lens + np.int32(n)

@@ -1069,14 +1069,8 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
             return _unembed(spec, weights, x), sk_new, sv_new
 
         def sample(logits, step_key):
-            if not do_sample:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            z = logits / jnp.maximum(temperature, 1e-6)
-            if top_k > 0:
-                kth = jax.lax.top_k(z, top_k)[0][:, -1:]
-                z = jnp.where(z < kth, -jnp.inf, z)
-            return jax.random.categorical(key=step_key, logits=z,
-                                          axis=-1).astype(jnp.int32)
+            return _sample_logits(logits, step_key, do_sample, top_k,
+                                  temperature)
 
         def step(carry, j):
             ids, pos, sk_all, sv_all, _ = carry
@@ -1223,6 +1217,63 @@ def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
     return fwd
 
 
+
+def _sample_logits(logits, key, do_sample: bool, top_k: int, temperature):
+    """The ONE greedy/temperature/top-k sampler shared by every fused decode
+    program (multistep scan steps and the pipeline's decode-step wrapper).
+    build_decode_step's byte-identical-to-burst guarantee depends on all
+    sites running these exact ops with the same key fold — change it here,
+    nowhere else."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    z = logits / jnp.maximum(temperature, 1e-6)
+    if top_k > 0:
+        kth = jax.lax.top_k(z, top_k)[0][:, -1:]
+        z = jnp.where(z < kth, -jnp.inf, z)
+    return jax.random.categorical(key, z, axis=-1).astype(jnp.int32)
+
+def build_decode_step(spec: RaggedModelSpec, mesh=None, tp: int = 1,
+                      do_sample: bool = False, top_k: int = 0,
+                      window_ring_ok: bool = False) -> Callable:
+    """One fused decode step for the double-buffered serving pipeline:
+    consume ``ids`` [S] (this step's tokens, already sampled), write their KV,
+    run the forward pass, and sample the NEXT token row — all in ONE device
+    program, so the only thing that ever needs to cross back to the host per
+    decode step is the [S] int32 token row (4 bytes/sequence instead of the
+    [S, V] logits block the per-token loop fetched).
+
+    The forward body is exactly ``build_multistep_decode(n_steps=1)`` — the
+    same one-pass math the fused bursts run, so a pipelined token stream is
+    bit-identical to a ``decode_steps`` burst under greedy decoding. On top
+    of it this wrapper re-derives the step's sampled next token from the
+    returned logits (same key fold as the scan's step 0, so XLA CSEs it with
+    the scan-internal sample) and RETURNS it, which the multistep builders
+    deliberately do not: the pipeline chains step N+1's dispatch on step N's
+    device-resident token row with no host round trip in between.
+
+    Returns ``fwd(weights, kv_pages, ids [S], positions [S],
+    block_tables [S, MB], ctx [S], key, temperature) ->
+    (next_ids [S] int32, logits [S, V], new_kv)`` where ``logits`` predict
+    ``next_ids`` (kept for the engine's continuation refs).
+    """
+    inner = build_multistep_decode(spec, 1, mesh=mesh, tp=tp,
+                                   do_sample=do_sample, top_k=top_k,
+                                   window_ring_ok=window_ring_ok)
+
+    def fwd(weights, kv_pages, ids, positions, block_tables, ctx,
+            key, temperature=1.0):
+        out_ids, logits, new_kv = inner(weights, kv_pages, ids, positions,
+                                        block_tables, ctx, key, temperature)
+        del out_ids  # == ids: the pipeline already holds this step's row
+        # same fold as the scan's step 0, so XLA CSEs this with the
+        # scan-internal sample
+        nxt = _sample_logits(logits, jax.random.fold_in(key, 0), do_sample,
+                             top_k, temperature)
+        return nxt, logits, new_kv
+
+    return fwd
+
+
 def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
                              mesh=None, tp: int = 1,
                              do_sample: bool = False,
@@ -1301,13 +1352,8 @@ def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
             return logits, kvp, sc
 
         def sample(logits, step_key):
-            if not do_sample:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            z = logits / jnp.maximum(temperature, 1e-6)
-            if top_k > 0:
-                kth = jax.lax.top_k(z, top_k)[0][:, -1:]
-                z = jnp.where(z < kth, -jnp.inf, z)
-            return jax.random.categorical(step_key, z, axis=-1).astype(jnp.int32)
+            return _sample_logits(logits, step_key, do_sample, top_k,
+                                  temperature)
 
         def step(carry, j):
             ids, pos, ctx, kvp, sc, _ = carry

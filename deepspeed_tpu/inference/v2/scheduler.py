@@ -229,6 +229,34 @@ class DynamicSplitFuseScheduler:
                              f"{self.config.max_context}")
         self._ensure_blocks(seq, n_tokens)
 
+    def decode_batch(self, uids: List[int], n_reserve: int,
+                     scratch_block: int) -> "DecodeBatch":
+        """Bucketed decode-only descriptors for the fused decode programs.
+
+        Reserves ``n_reserve`` tokens of KV per sequence UP FRONT (so the
+        per-step host work during a fused burst / pipelined run is just the
+        ``DecodeBatch.advance`` increments — the block tables already cover
+        the whole run), then packs positions/block-tables/context-lengths
+        into arrays padded to ``next_pow2(len(uids))`` rows. Pad rows point
+        wholly at ``scratch_block`` (see DecodeBatch for why that is inert).
+        """
+        from deepspeed_tpu.utils.caching import next_pow2
+        for u in uids:
+            self.reserve(u, n_reserve)
+        bucket = next_pow2(len(uids))
+        mb = self.max_blocks
+        bt = np.full((bucket, mb), scratch_block, np.int32)
+        pos = np.zeros((bucket,), np.int32)
+        for i, u in enumerate(uids):
+            seq = self.seqs[u]
+            bt[i] = seq.block_table(mb)
+            pos[i] = seq.seen_tokens
+        # pad rows: pos 0 -> ctx 1, attending exactly one (scratch) token
+        ctx = pos + 1
+        from deepspeed_tpu.inference.v2.ragged.ragged_batch import DecodeBatch
+        return DecodeBatch(uids=[int(u) for u in uids], bucket=bucket,
+                           positions=pos, block_tables=bt, ctx_lens=ctx)
+
     def advance(self, uid: int, n_tokens: int) -> None:
         """Record ``n_tokens`` device-generated tokens (their KV was written
         by the fused loop; no pending compute remains)."""

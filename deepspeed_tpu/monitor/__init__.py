@@ -1,7 +1,9 @@
-"""Monitoring backends (parity: ``deepspeed/monitor/``)."""
+"""Monitoring backends (parity: ``deepspeed/monitor/``) plus the serving
+pipeline's per-step counters (``serving.PipelineStats``)."""
 
 from deepspeed_tpu.monitor.monitor import (CsvMonitor, Monitor, MonitorMaster,
                                            TensorBoardMonitor, WandbMonitor)
+from deepspeed_tpu.monitor.serving import PipelineStats
 
 __all__ = ["Monitor", "MonitorMaster", "TensorBoardMonitor", "WandbMonitor",
-           "CsvMonitor"]
+           "CsvMonitor", "PipelineStats"]

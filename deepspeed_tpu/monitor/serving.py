@@ -1,0 +1,104 @@
+"""Serving-pipeline observability: per-step timing/transfer counters.
+
+The double-buffered decode pipeline (``inference/v2/pipeline.py``) overlaps
+three things per generated token — the device step's dispatch, the host's
+drain of the PREVIOUS step's token row, and the host-side build of the NEXT
+step's descriptors. Whether that overlap actually happens is invisible from
+throughput alone (a loop can hit its tokens/sec while secretly serialising),
+so the pipeline accounts every step's wall time into the four phases below
+and this module turns the totals into ``monitor/`` events
+(``MonitorMaster.write_events`` ``(name, value, step)`` shape, the same
+contract ``PrefixCacheStats.events`` follows).
+
+Phase semantics (per step):
+
+- ``dispatch``: host time spent enqueueing the fused decode program (jax
+  async dispatch — this is NOT device execution time).
+- ``fetch_drain``: host time blocked waiting for the previous step's token
+  row to arrive. The transfer itself was started asynchronously right after
+  that step's dispatch, so in a healthy host-bound loop this is ~0; it grows
+  exactly when the device is the bottleneck (which is where you want to be).
+- ``host_build``: scheduler bookkeeping + building the next step's
+  descriptors (with pre-reserved KV blocks this is two array increments).
+- ``bubble``: the step's wall time not attributed to the three phases above
+  (callback work, GC, interpreter noise). Persistent growth here means the
+  host loop — not the device or the transfer — is eating the pipeline.
+
+``fetch_bytes`` counts exactly what crossed device->host per step; the
+steady-state bench asserts it equals one int32 row per bucket slot
+(4 * bucket bytes), the invariant that keeps decode transfer-bound work off
+the per-token critical path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+from deepspeed_tpu.monitor.monitor import Event
+
+
+@dataclass
+class PipelineStats:
+    """Aggregate counters for one engine's decode pipelines (cumulative
+    across runs; ``reset()`` between measurement windows)."""
+
+    steps: int = 0
+    tokens: int = 0                  # live (recorded) tokens drained
+    dispatch_ms: float = 0.0
+    host_build_ms: float = 0.0
+    fetch_drain_ms: float = 0.0
+    bubble_ms: float = 0.0
+    fetch_bytes: int = 0
+    last_fetch_bytes: int = 0        # bytes of the most recent per-step drain
+    #: per-step wall times (ms) of the MOST RECENT run only — the bench reads
+    #: p50/p99 per-token latency from here; DecodePipeline.run clears it at
+    #: run start (the scalar fields above stay cumulative)
+    step_wall_ms: List[float] = field(default_factory=list)
+
+    def record_step(self, dispatch_s: float, drain_s: float, build_s: float,
+                    wall_s: float, fetch_bytes: int, live_tokens: int) -> None:
+        self.steps += 1
+        self.tokens += live_tokens
+        self.dispatch_ms += 1e3 * dispatch_s
+        self.fetch_drain_ms += 1e3 * drain_s
+        self.host_build_ms += 1e3 * build_s
+        self.bubble_ms += 1e3 * max(0.0, wall_s - dispatch_s - drain_s
+                                    - build_s)
+        self.fetch_bytes += int(fetch_bytes)
+        self.last_fetch_bytes = int(fetch_bytes)
+        self.step_wall_ms.append(1e3 * wall_s)
+
+    def reset(self) -> None:
+        self.steps = 0
+        self.tokens = 0
+        self.dispatch_ms = 0.0
+        self.host_build_ms = 0.0
+        self.fetch_drain_ms = 0.0
+        self.bubble_ms = 0.0
+        self.fetch_bytes = 0
+        self.last_fetch_bytes = 0
+        self.step_wall_ms = []
+
+    @property
+    def fetch_bytes_per_step(self) -> float:
+        return self.fetch_bytes / self.steps if self.steps else 0.0
+
+    def events(self, step: int = 0) -> List[Event]:
+        """Monitor-ready ``(name, value, step)`` tuples; per-step averages so
+        dashboards stay comparable across runs of different lengths."""
+        n = max(1, self.steps)
+        return [
+            ("inference/v2/pipeline/steps", float(self.steps), step),
+            ("inference/v2/pipeline/tokens", float(self.tokens), step),
+            ("inference/v2/pipeline/dispatch_ms_per_step",
+             self.dispatch_ms / n, step),
+            ("inference/v2/pipeline/host_build_ms_per_step",
+             self.host_build_ms / n, step),
+            ("inference/v2/pipeline/fetch_drain_ms_per_step",
+             self.fetch_drain_ms / n, step),
+            ("inference/v2/pipeline/bubble_ms_per_step",
+             self.bubble_ms / n, step),
+            ("inference/v2/pipeline/fetch_bytes_per_step",
+             float(self.fetch_bytes_per_step), step),
+        ]

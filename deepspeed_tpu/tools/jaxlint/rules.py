@@ -9,6 +9,7 @@ JL003  donated-buffer reuse after a ``donate_argnums`` call
 JL004  Python control flow on tracer values inside a jitted body
 JL005  PartitionSpec/collective axis names no Mesh declares
 JL006  raw imports that bypass the ``utils/jax_compat`` shim layer
+JL007  blocking host fetches inside configured hot-path modules
 ====== ==============================================================
 
 Rules are registered in ``RULE_REGISTRY`` via ``@register``; adding a rule is
@@ -96,7 +97,8 @@ class UntimedAsyncDispatch(Rule):
     default_options = {
         # a call whose final name segment lands here counts as a sync point
         "sync_calls": ["block_until_ready", "effects_barrier", "device_get",
-                       "_sync", "_drain", "asarray", "sync", "item", "tolist"],
+                       "_sync", "_drain", "asarray", "sync", "item", "tolist",
+                       "fetch_to_host"],
         # calls that cannot dispatch device work (timing them is fine)
         "benign_calls": ["time", "perf_counter", "monotonic", "print", "len",
                          "int", "float", "str", "min", "max", "range", "append",
@@ -543,6 +545,82 @@ class UndeclaredMeshAxis(Rule):
                             const.col_offset,
                             f"axis name '{val}' is not declared by any Mesh "
                             "in this module nor in jaxlint's known_axes")
+
+
+# --------------------------------------------------------------------------- #
+# JL007 — blocking host fetch in a hot-path module
+# --------------------------------------------------------------------------- #
+
+@register
+class HotPathHostFetch(Rule):
+    """Blocking device->host fetches inside modules marked hot-path.
+
+    The v2 serving loop is engineered so ONE drain point per decode step
+    fetches one int32 token row; a stray ``np.asarray(logits)`` / ``.item()``
+    / ``jax.device_get(...)`` in that path silently re-serialises the host on
+    the device (and, through a remote runtime, re-adds an RTT per token) —
+    the exact regression class BENCH_r06 measured. Inert unless the config
+    lists ``hot_paths`` substrings (``.jaxlint.json``), so only modules that
+    opted into hot-path discipline are policed; the intentional drain carries
+    an inline ``# jaxlint: disable=JL007``.
+
+    Heuristics (static — no type info):
+
+    - ``jax.device_get(...)`` always blocks: flagged.
+    - ``np.asarray(x)`` / ``np.array(x)`` with a SINGLE positional argument
+      and no ``dtype`` is how this tree drains device arrays; host-side
+      conversions say ``np.asarray(x, np.int32)``. Single-arg forms are
+      flagged — give host conversions an explicit dtype (cheap and
+      self-documenting) or suppress inline.
+    - ``.item()`` / ``.tolist()`` force a transfer on jax arrays: flagged.
+    """
+
+    rule_id = "JL007"
+    summary = "blocking host fetch inside a hot-path module"
+    default_options = {
+        # path substrings whose modules are hot-path; empty = rule inert
+        "hot_paths": [],
+        # zero-arg methods that force a device->host transfer
+        "fetch_methods": ["item", "tolist"],
+    }
+
+    def check(self, mod, options):
+        norm = mod.path.replace("\\", "/")
+        if not any(pat in norm for pat in options["hot_paths"]):
+            return
+        fetch_methods = set(options["fetch_methods"])
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = mod.resolve(call_name(node))
+            if name == "jax.device_get":
+                # (block_until_ready is deliberately NOT flagged: a sync
+                # without a transfer is how warmup/timing code is SUPPOSED
+                # to wait, and JL001 already polices its absence)
+                yield Finding(
+                    self.rule_id, mod.path, node.lineno, node.col_offset,
+                    "jax.device_get() blocks the host in a hot-path module "
+                    "— route the fetch through the engine drain point "
+                    "(fetch_to_host) or suppress the intentional drain inline")
+            elif name in {"numpy.asarray", "numpy.array"}:
+                has_dtype = (len(node.args) > 1
+                             or any(kw.arg == "dtype" for kw in node.keywords))
+                if len(node.args) == 1 and not has_dtype:
+                    yield Finding(
+                        self.rule_id, mod.path, node.lineno, node.col_offset,
+                        f"{unparse(node.func)}(x) with no dtype may be a "
+                        "blocking device fetch in a hot-path module — use "
+                        "the engine drain point (fetch_to_host), or give a "
+                        "host-side conversion an explicit dtype")
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in fetch_methods
+                  and not node.args and not node.keywords
+                  and not isinstance(node.func.value, ast.Constant)):
+                yield Finding(
+                    self.rule_id, mod.path, node.lineno, node.col_offset,
+                    f".{node.func.attr}() forces a device->host transfer in "
+                    "a hot-path module — drain through fetch_to_host (or "
+                    "suppress if the receiver is host data)")
 
 
 # --------------------------------------------------------------------------- #

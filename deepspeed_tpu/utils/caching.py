@@ -1,9 +1,15 @@
-"""Small bounded-LRU cache shared by long-lived serving paths.
+"""Small bounded-LRU cache (plus its bucketing helper) shared by long-lived
+serving paths.
 
 Compiled XLA executables and host-side layout tables are cached per
 (shape/config) key; a serving process that sees many distinct keys must evict
 or it leaks executables indefinitely. One helper so every such cache behaves
 identically (inference v2 multistep programs, block-sparse layouts, ...).
+
+:func:`next_pow2` is the canonical shape-bucketing function for those cache
+keys: every device program keyed on a *variable* count (live decode rows,
+reorder-gather lengths) rounds the count up to a power of two first, so the
+reachable program set is log-sized instead of linear in the count.
 """
 
 from __future__ import annotations
@@ -13,6 +19,21 @@ from collections import OrderedDict
 from typing import Callable, Generic, Hashable, TypeVar
 
 V = TypeVar("V")
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= ``n``, with ``next_pow2(0) == 1``.
+
+    The serving engine pads every count-keyed device-program dimension to this
+    bucket (sampler rows, decode-batch rows, reorder gathers): a serving loop
+    whose live-sequence count drifts by one per admission/retirement then
+    reuses ~log2 cached executables instead of recompiling per count
+    (~seconds each through a remote-compile tunnel). Zero maps to 1 because
+    every padded program needs at least one row.
+    """
+    if n <= 1:
+        return 1
+    return 1 << (int(n) - 1).bit_length()
 
 
 class LRUCache(Generic[V]):

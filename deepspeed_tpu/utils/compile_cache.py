@@ -16,6 +16,7 @@ from __future__ import annotations
 import hashlib
 import os
 import platform
+from typing import Optional
 
 
 def host_fingerprint() -> str:
@@ -39,12 +40,20 @@ def host_fingerprint() -> str:
     return hashlib.sha1(raw.encode()).hexdigest()[:12]
 
 
-def setup_compile_cache(repo_root: str,
+def setup_compile_cache(repo_root: Optional[str] = None,
                         min_compile_time_secs: float = 2.0,
-                        cpu: str = "host-keyed") -> str:
+                        cpu: str = "host-keyed",
+                        cache_dir: Optional[str] = None) -> str:
     """Point jax's persistent compile cache at the right directory for the
     active backend. Returns the directory chosen ("" when disabled;
     best-effort: cache setup must never fail a bench or a dryrun).
+
+    The cache root is ``cache_dir`` when given (the serving engine passes the
+    ``config_v2.CompileConfig.cache_dir`` / ``DSTPU_COMPILE_CACHE`` value
+    here), else ``<repo_root>/.jax_cache`` (the bench/test entrypoints). The
+    CPU host-fingerprint subdir policy applies under either root — an
+    explicitly configured directory is just as shareable across hosts, so
+    just as SIGILL-prone.
 
     ``cpu`` picks the CPU-backend policy: "host-keyed" (default — cache in a
     per-host-fingerprint subdir; reloads still log a spurious cpu_aot_loader
@@ -53,7 +62,12 @@ def setup_compile_cache(repo_root: str,
     "off" (no persistent cache — for runs whose stderr must stay clean, e.g.
     the driver's multichip dryrun artifact)."""
     import jax
-    base = os.path.join(repo_root, ".jax_cache")
+    if cache_dir:
+        base = cache_dir
+    elif repo_root:
+        base = os.path.join(repo_root, ".jax_cache")
+    else:
+        return ""
     try:
         if jax.default_backend() == "cpu":
             fp = host_fingerprint()
@@ -62,9 +76,21 @@ def setup_compile_cache(repo_root: str,
             cache_dir = os.path.join(base, f"cpu-{fp}")
         else:
             cache_dir = base
+        prior = getattr(jax.config, "jax_compilation_cache_dir", None)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           min_compile_time_secs)
+        if prior != cache_dir:
+            # jax initializes its cache handle lazily at the FIRST compile
+            # and never re-reads the config after that — if anything compiled
+            # before this call (model init, another engine), the handle is
+            # pinned to the old dir (or to a disabled sentinel when no dir
+            # was set) and every later write silently vanishes. Reset so the
+            # next compile re-initializes against the directory just set.
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc)
+            if hasattr(_cc, "reset_cache"):
+                _cc.reset_cache()
         return cache_dir
     except Exception:
         return ""  # nothing (fully) configured

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Steady-state serving-bench smoke: keeps benchmarks/serving_bench.py
+# --steady-state RUNNABLE on a CPU-only box (tiny model, tiny sizes, <60 s
+# warm) so the decode-pipeline leg can't rot between hardware rounds.
+#
+# Exit status reflects the leg's own correctness gates (byte-identical greedy
+# streams between the per-token loop and the pipeline; one-token-row per-step
+# transfer). Throughput numbers at these sizes are smoke, not signal — real
+# numbers come from the full leg (docs/SERVING.md). tier1.sh invokes this
+# NON-FATALLY after pytest.
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+timeout -k 10 300 python benchmarks/serving_bench.py --steady-state \
+    --seqs 4 --prompt 16 --gen 24

@@ -1,0 +1,320 @@
+"""The async double-buffered serving hot path (inference/v2/pipeline.py) and
+its supporting machinery: bucketed decode batches, the compile counter + AOT
+warmup grid, the persistent compile cache wiring, and the pipeline monitor
+fields. docs/SERVING.md describes the design under test."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from deepspeed_tpu.utils.caching import next_pow2
+
+
+def _model_and_params(seed=0):
+    cfg = LlamaConfig.tiny(vocab_size=128, max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(seed),
+                        {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+    return model, params
+
+
+def _build_engine(seed=0, compile_cfg=None, model_params=None):
+    model, params = model_params or _model_and_params(seed)
+    econf = {"dtype": jnp.float32,
+             "state_manager": {"max_tracked_sequences": 4,
+                               "max_ragged_sequence_count": 4,
+                               "max_ragged_batch_size": 32,
+                               "max_context": 128},
+             "kv_cache": {"block_size": 16}}
+    if compile_cfg is not None:
+        econf["compile"] = compile_cfg
+    return InferenceEngineV2(model=model, model_parameters=params,
+                             config=econf)
+
+
+PROMPTS = [np.array([3, 14, 15, 92, 6], np.int32),
+           np.array([27, 18, 28, 18], np.int32),
+           np.array([31, 41, 59, 26, 53, 58], np.int32)]
+
+
+def _loop_decode(engine, uids, n):
+    outs = [[] for _ in uids]
+    for _ in range(n):
+        ids = engine.sample_next(uids)
+        for i, t in enumerate(ids):
+            outs[i].append(int(t))
+        engine.put(uids, [np.asarray([t], np.int32) for t in ids])
+    return outs
+
+
+@pytest.fixture(scope="module")
+def warm_engine():
+    """One warmed engine shared by the read-mostly tests (compiles are the
+    expensive part on this box; tests that need fresh state build their own)."""
+    return _build_engine(
+        compile_cfg={"warmup": True, "warmup_buckets": [1, 2, 4],
+                     "warmup_decode_steps": [3]})
+
+
+# --------------------------------------------------------------------------- #
+# correctness: pipeline == fused burst == per-token loop (greedy, with pads)
+# --------------------------------------------------------------------------- #
+
+def test_pipeline_matches_loop_with_pad_rows(warm_engine):
+    """3 live rows -> bucket 4: one pad row decodes into the scratch page.
+    Greedy streams and continuation state must match the per-token loop
+    byte for byte (row independence under padding)."""
+    N = 7
+    e1 = _build_engine()
+    e1.put([0, 1, 2], PROMPTS)
+    ref = _loop_decode(e1, [0, 1, 2], N)
+    ref_next = list(e1.sample_next([0, 1, 2]))
+
+    e2 = warm_engine
+    e2.put([0, 1, 2], PROMPTS)
+    c0 = e2.compiles
+    pipe = e2.decode_pipeline([0, 1, 2])
+    got = pipe.run(N)
+    assert got.shape == (3, N)
+    assert [list(r) for r in got] == ref
+    assert list(e2.sample_next([0, 1, 2])) == ref_next
+    # in-grid serving after warmup: ZERO new programs (acceptance criterion)
+    assert e2.compiles == c0
+    e2.flush([0, 1, 2])
+
+
+def test_warmup_covers_put_and_decode_steps(warm_engine):
+    """put() prefill + continuation passes and an in-grid decode_steps burst
+    (n_steps/buckets from the warmup config) build nothing new."""
+    e = warm_engine
+    c0 = e.compiles
+    e.put([5, 6, 7], PROMPTS)
+    got = e.decode_steps([5, 6, 7], 3)         # (3, bucket 4) pre-warmed
+    assert got.shape == (3, 3)
+    assert e.compiles == c0
+    e.flush([5, 6, 7])
+
+
+# --------------------------------------------------------------------------- #
+# bucketing: key rounding + executable reuse across live counts
+# --------------------------------------------------------------------------- #
+
+def test_decode_steps_key_rounds_to_bucket():
+    e = _build_engine()
+    e.put([0, 1, 2], PROMPTS)
+    e.decode_steps([0, 1, 2], 2)               # S=3 -> bucket 4
+    c_after_first = e.compiles
+    assert ((2, 4, False, 0) in e._multistep)  # key carries the BUCKET
+    e.put([3], [np.array([9, 9, 9], np.int32)])
+    e.decode_steps([0, 1, 2, 3], 2)            # S=4 -> same bucket, same prog
+    assert e.compiles == c_after_first
+    assert len(e._multistep) == 1
+    # a sequence retiring below the bucket boundary compiles the next bucket
+    e.flush([2, 3])
+    e.decode_steps([0, 1], 2)                  # S=2 -> bucket 2: one build
+    assert e.compiles == c_after_first + 1
+    e.flush([0, 1])
+
+
+def test_pipeline_retire_between_runs_reuses_grid(warm_engine):
+    e = warm_engine
+    e.put([0, 1, 2], PROMPTS)
+    pipe = e.decode_pipeline([0, 1, 2])
+    c0 = e.compiles
+    pipe.run(3)                                # bucket 4 (warm)
+    pipe.retire([1])
+    e.flush([1])
+    got = pipe.run(4)                          # 2 live -> bucket 2 (warm)
+    assert got.shape == (2, 4)
+    assert e.compiles == c0
+    e.flush([0, 2])
+
+
+def test_decode_batch_pad_rows_are_scratch():
+    e = _build_engine()
+    e.put([0, 1, 2], PROMPTS)
+    db = e.scheduler.decode_batch([0, 1, 2], 4, e.scratch_block)
+    assert db.bucket == 4 and db.live == 3
+    # pad row: scratch-only block table, position 0, ctx 1
+    assert (db.block_tables[3] == e.scratch_block).all()
+    assert db.positions[3] == 0 and db.ctx_lens[3] == 1
+    # real rows: the sequences' own tables and positions
+    for i, u in enumerate([0, 1, 2]):
+        seq = e.scheduler.seqs[u]
+        assert db.positions[i] == seq.seen_tokens
+        assert db.ctx_lens[i] == seq.seen_tokens + 1
+        assert db.block_tables[i, 0] == seq.blocks[0]
+    # the scratch page sits outside the allocator's pool on purpose
+    assert e.scratch_block == e.allocator.total_blocks
+    assert e.kv.config.num_blocks == e.allocator.total_blocks + 1
+    e.flush([0, 1, 2])
+    assert e.free_blocks == e.allocator.total_blocks
+
+
+# --------------------------------------------------------------------------- #
+# mid-run retirement (the one-step-late drain's stop semantics)
+# --------------------------------------------------------------------------- #
+
+def test_pipeline_on_tokens_retirement(warm_engine):
+    e = warm_engine
+    e.put([0, 1, 2], PROMPTS)
+    ref = {}
+    eref = _build_engine()
+    eref.put([0, 1, 2], PROMPTS)
+    for u, row in zip([0, 1, 2], eref.decode_steps([0, 1, 2], 6)):
+        ref[u] = list(row)
+
+    retired_at = {}
+
+    def on_tokens(step, uids, row):
+        assert len(row) == len(uids)
+        if step == 2:                      # observed token 2 -> retire uid 1
+            retired_at[1] = step
+            return [1]
+        return None
+
+    pipe = e.decode_pipeline([0, 1, 2])
+    got = pipe.run(6, on_tokens=on_tokens)
+    assert pipe.uids == [0, 2]
+    # survivors' streams are untouched by the retirement (row independence)
+    assert list(got[0]) == ref[0] and list(got[2]) == ref[2]
+    # the retired row recorded exactly step+1 tokens into its history
+    assert e.scheduler.seqs[1].seen_tokens == len(PROMPTS[1]) + 3
+    # its prefix up to retirement matches too (drained before the stop)
+    assert list(got[1][:3]) == ref[1][:3]
+    # continuation refs are dropped: the uid must be flushed / re-put
+    assert 1 not in e._last_ref and 1 not in e._last_logits
+    e.flush([0, 1, 2])
+    assert e.free_blocks == e.allocator.total_blocks
+
+
+def test_pipeline_on_tokens_exception_settles_state(warm_engine):
+    """An escaping callback must not desynchronize sequence history from the
+    KV already written: drained tokens become history, refs drop, the uids
+    leave the pipeline, and a flush fully recovers the pool."""
+    e = warm_engine
+    e.put([0, 1], PROMPTS[:2])
+    pipe = e.decode_pipeline([0, 1])
+
+    def boom(step, uids, row):
+        if step == 1:
+            raise RuntimeError("client hung up")
+
+    with pytest.raises(RuntimeError, match="client hung up"):
+        pipe.run(6, on_tokens=boom)
+    assert pipe.uids == []
+    for u in (0, 1):   # tokens 0 and 1 were drained before the raise
+        assert e.scheduler.seqs[u].seen_tokens == len(PROMPTS[u]) + 2
+        assert u not in e._last_ref and u not in e._last_logits
+    e.flush([0, 1])
+    assert e.free_blocks == e.allocator.total_blocks
+
+
+# --------------------------------------------------------------------------- #
+# monitor: per-step pipeline timings + the fetch-bytes invariant
+# --------------------------------------------------------------------------- #
+
+class _CaptureMonitor:
+    def __init__(self):
+        self.events = []
+
+    def write_events(self, event_list):
+        self.events.extend(event_list)
+
+
+def test_pipeline_stats_and_monitor_fields(warm_engine):
+    e = warm_engine
+    e.put([0, 1], PROMPTS[:2])
+    e.pipeline_stats.reset()
+    pipe = e.decode_pipeline([0, 1])
+    pipe.run(5)
+    st = e.pipeline_stats
+    assert st.steps == 5 and st.tokens == 10
+    # THE tentpole invariant: the per-step device->host transfer is one int32
+    # token row per bucket slot — not a logits block
+    assert st.fetch_bytes_per_step == 4.0 * next_pow2(2)
+    assert st.last_fetch_bytes == 4 * next_pow2(2)
+    assert len(st.step_wall_ms) == 5 and all(w > 0 for w in st.step_wall_ms)
+    mon = _CaptureMonitor()
+    e.write_monitor_events(mon, step=3)
+    names = {n for n, _, _ in mon.events}
+    for field in ("dispatch_ms_per_step", "host_build_ms_per_step",
+                  "fetch_drain_ms_per_step", "bubble_ms_per_step",
+                  "fetch_bytes_per_step", "steps", "tokens"):
+        assert f"inference/v2/pipeline/{field}" in names
+    assert all(s == 3 for _, _, s in mon.events)
+    e.flush([0, 1])
+
+
+# --------------------------------------------------------------------------- #
+# persistent compile cache (utils/compile_cache.py via config_v2.CompileConfig)
+# --------------------------------------------------------------------------- #
+
+def test_compile_config_env_knob(monkeypatch):
+    from deepspeed_tpu.inference.v2.config_v2 import CompileConfig
+    monkeypatch.delenv("DSTPU_COMPILE_CACHE", raising=False)
+    assert CompileConfig().resolve_cache_dir() == ""
+    monkeypatch.setenv("DSTPU_COMPILE_CACHE", "/tmp/xyz")
+    assert CompileConfig().resolve_cache_dir() == "/tmp/xyz"
+    # explicit config beats the env, and "" explicitly disables
+    assert CompileConfig(cache_dir="/a").resolve_cache_dir() == "/a"
+    assert CompileConfig(cache_dir="").resolve_cache_dir() == ""
+    # non-pow2 buckets normalize to the grid (same rounding as warmup())
+    assert CompileConfig(warmup_buckets=[3, 4, 6]).warmup_buckets == [4, 8]
+    with pytest.raises(ValueError):
+        CompileConfig(warmup_buckets=[0])
+    with pytest.raises(ValueError):
+        CompileConfig(warmup_decode_steps=[0])
+
+
+def test_second_engine_hits_persistent_cache(tmp_path):
+    """Engine #1 (warmup on, fresh cache dir) populates the persistent cache;
+    engine #2 with the same config must reload every program — no new cache
+    entries written (file count is the compile witness XLA gives us)."""
+    cc = pytest.importorskip("jax.experimental.compilation_cache"
+                             ".compilation_cache")
+    if not hasattr(cc, "reset_cache"):
+        pytest.skip("jax too old to re-point the compilation cache")
+    cache_root = str(tmp_path / "ccache")
+    cfg = {"cache_dir": cache_root, "min_compile_time_secs": 0.0,
+           "warmup": True, "warmup_buckets": [1]}
+    prior_dir = jax.config.jax_compilation_cache_dir
+    prior_min = jax.config.jax_persistent_cache_min_compile_time_secs
+
+    def count_entries():
+        # executables only: jax's lru_cache backend also touches "-atime"
+        # bookkeeping files on cache HITS, which must not count as compiles
+        return len([p for p in glob.glob(os.path.join(cache_root, "**"),
+                                         recursive=True)
+                    if os.path.isfile(p) and not p.endswith("-atime")])
+
+    # model init once, OUTSIDE the cached window: its programs compile before
+    # the first engine re-points the cache, so a per-engine init would write
+    # its entries only on the second pass and fake a miss
+    mp = _model_and_params()
+    try:
+        cc.reset_cache()                 # drop the conftest cache handle
+        e1 = _build_engine(compile_cfg=cfg, model_params=mp)
+        e1.put([0], [PROMPTS[0]])
+        e1.decode_pipeline([0]).run(2)
+        jax.effects_barrier()
+        n1 = count_entries()
+        assert n1 > 0, "warmup wrote nothing to the persistent cache"
+        del e1
+        e2 = _build_engine(compile_cfg=cfg, model_params=mp)
+        e2.put([0], [PROMPTS[0]])
+        e2.decode_pipeline([0]).run(2)
+        jax.effects_barrier()
+        assert count_entries() == n1, \
+            "second engine construction recompiled instead of hitting the cache"
+    finally:
+        cc.reset_cache()
+        jax.config.update("jax_compilation_cache_dir", prior_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prior_min)

@@ -25,9 +25,9 @@ def rules_of(findings):
     return [f.rule for f in findings]
 
 
-def test_registry_has_all_six_rules():
+def test_registry_has_all_seven_rules():
     assert set(RULE_REGISTRY) == {"JL001", "JL002", "JL003", "JL004",
-                                  "JL005", "JL006"}
+                                  "JL005", "JL006", "JL007"}
 
 
 # --------------------------------------------------------------------------- #
@@ -445,6 +445,88 @@ def test_jl006_allow_paths_exempts_the_shim():
     cfg = LintConfig()
     findings = lint_text(src, path="deepspeed_tpu/utils/jax_compat.py",
                          config=cfg)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# JL007 — blocking host fetch in a hot-path module
+# --------------------------------------------------------------------------- #
+
+HOT = {"JL007": {"hot_paths": ["pkg/"]}}
+
+
+def test_jl007_flags_bare_asarray_in_hot_path():
+    findings = lint("""
+        import numpy as np
+
+        def drain(arr):
+            return np.asarray(arr)
+    """, **HOT)
+    assert rules_of(findings) == ["JL007"]
+
+
+def test_jl007_flags_device_get_item_tolist():
+    findings = lint("""
+        import jax
+
+        def leak(arr):
+            a = jax.device_get(arr)
+            b = arr.item()
+            c = arr.tolist()
+            return a, b, c
+    """, **HOT)
+    assert rules_of(findings) == ["JL007", "JL007", "JL007"]
+
+
+def test_jl007_dtyped_asarray_is_host_side():
+    # an explicit dtype marks a host conversion, not a device drain
+    findings = lint("""
+        import numpy as np
+
+        def convert(tokens):
+            a = np.asarray(tokens, np.int32)
+            b = np.asarray(tokens, dtype=np.int64)
+            return a, b
+    """, **HOT)
+    assert findings == []
+
+
+def test_jl007_inert_without_hot_path_config():
+    # default options carry no hot_paths: the rule must not fire tree-wide
+    findings = lint("""
+        import numpy as np
+
+        def drain(arr):
+            return np.asarray(arr)
+    """)
+    assert findings == []
+
+
+def test_jl007_non_hot_module_skipped():
+    src = "import numpy as np\nhost = np.asarray(object())\n"
+    cfg = LintConfig(rules={"JL007": RuleSettings(
+        options={"hot_paths": ["inference/v2/"]})})
+    assert lint_text(src, path="pkg/training/loop.py", config=cfg) == []
+
+
+def test_jl007_intentional_drain_suppressed_inline():
+    findings = lint("""
+        import numpy as np
+
+        def fetch_to_host(arr):
+            return np.asarray(arr)  # jaxlint: disable=JL007 -- the drain
+    """, **HOT)
+    assert findings == []
+
+
+def test_jl007_block_until_ready_not_flagged():
+    # a sync without a transfer is legitimate hot-path code (warmup, timing)
+    findings = lint("""
+        import jax
+
+        def warm(arr):
+            jax.block_until_ready(arr)
+    """, **HOT)
     assert findings == []
 
 
