@@ -552,6 +552,26 @@ class ProgressiveLayerDropConfig(ConfigModel):
 
 
 # --------------------------------------------------------------------------- #
+# Training step-loop pipelining (docs/TRAINING.md)
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class TrainPipelineConfig(ConfigModel):
+    """Async training step loop: prefetch-to-device input staging + the
+    one-step-late metric drain. No reference analog — the reference's
+    DataLoader workers pipeline collate only; here the staged batches are
+    already device-resident and sharded. ``wall_clock_breakdown`` overrides
+    the drain back to fully synchronous regardless of these knobs."""
+
+    # Global batches staged ahead by the PrefetchLoader producer thread
+    # (collate + curriculum/PLD + sharded device_put off the critical path).
+    # 2 = classic double buffering. 0 = synchronous staging (no thread) —
+    # identical math, every stage on the caller's thread.
+    prefetch: int = 2
+
+
+# --------------------------------------------------------------------------- #
 # Checkpoint
 # --------------------------------------------------------------------------- #
 
@@ -618,6 +638,8 @@ class DeepSpeedTPUConfig(ConfigModel):
     hybrid_engine: HybridEngineConfig = field(default_factory=HybridEngineConfig)
     progressive_layer_drop: ProgressiveLayerDropConfig = field(
         default_factory=ProgressiveLayerDropConfig)
+    train_pipeline: TrainPipelineConfig = field(
+        default_factory=TrainPipelineConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
 
     # precision of gradient accumulation buffer (parity: data_types.grad_accum_dtype)

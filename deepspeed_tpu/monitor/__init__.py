@@ -1,9 +1,11 @@
 """Monitoring backends (parity: ``deepspeed/monitor/``) plus the serving
-pipeline's per-step counters (``serving.PipelineStats``)."""
+pipeline's per-step counters (``serving.PipelineStats``) and the training
+loop's (``training.TrainPipelineStats``)."""
 
 from deepspeed_tpu.monitor.monitor import (CsvMonitor, Monitor, MonitorMaster,
                                            TensorBoardMonitor, WandbMonitor)
 from deepspeed_tpu.monitor.serving import PipelineStats
+from deepspeed_tpu.monitor.training import TrainPipelineStats
 
 __all__ = ["Monitor", "MonitorMaster", "TensorBoardMonitor", "WandbMonitor",
-           "CsvMonitor", "PipelineStats"]
+           "CsvMonitor", "PipelineStats", "TrainPipelineStats"]

@@ -1,0 +1,103 @@
+"""Training step-loop observability: per-step timing/queue counters.
+
+The async training loop (``runtime/data_pipeline.py`` + the engine's deferred
+metric drain) overlaps four things per global step — dequeuing the next
+staged batch, the fused step's dispatch, the host-side staging of batch k+N
+(in the PrefetchLoader producer), and the drain of step k-1's metrics.
+Whether that overlap happens is invisible from steps/sec alone (a loop can
+hit its throughput while secretly serialising), so ``train_batch`` accounts
+every step's wall time into the phases below and this module turns the
+totals into ``monitor/`` events (``MonitorMaster.write_events``
+``(name, value, step)`` shape — the same contract ``PipelineStats`` and
+``PrefixCacheStats`` follow on the serving side).
+
+Phase semantics (per step):
+
+- ``enqueue_wait``: host time blocked on the prefetch queue. Unlike every
+  other phase this one is ALLOWED to grow: it is where the host waits when
+  the device is the bottleneck, which is the healthy steady state. It is a
+  problem only when ``queue_depth`` is simultaneously 0 — then the producer
+  (collate + device_put), not the device, is what the host is waiting for.
+- ``host_build``: synchronous staging on the caller's thread — collate,
+  curriculum truncation, PLD injection, the sharded device_put. Near-zero
+  when prefetching (the producer does it); the whole per-step tax when not.
+- ``dispatch``: host time enqueueing the fused train step (jax async
+  dispatch — NOT device execution time).
+- ``drain``: host time materialising DEFERRED metrics (step k-1's
+  loss/lr/grad_norm, fetched one step late while step k runs). Under
+  ``wall_clock_breakdown`` this becomes the step's full sync.
+- ``queue_depth``: prefetch queue occupancy at dequeue time. Persistently 0
+  with prefetch enabled means the producer is the bottleneck; persistently
+  full means the device is (the healthy steady state).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, List
+
+from deepspeed_tpu.monitor.monitor import Event
+
+#: step_wall_ms window — bounded so a long-lived engine (record_step fires on
+#: EVERY train_batch, forever) cannot grow host memory without bound; the
+#: serving twin clears its list per run, the training loop has no run scope
+WALL_WINDOW = 512
+
+
+@dataclass
+class TrainPipelineStats:
+    """Aggregate counters for one engine's training loop (cumulative;
+    ``reset()`` between measurement windows)."""
+
+    steps: int = 0
+    enqueue_wait_ms: float = 0.0
+    host_build_ms: float = 0.0
+    dispatch_ms: float = 0.0
+    drain_ms: float = 0.0
+    queue_depth_sum: int = 0
+    prefetched_steps: int = 0        # steps fed by an already-staged batch
+    #: wall times (ms) of the most recent ``WALL_WINDOW`` steps — a bounded
+    #: p50/p99 latency window (``list(...)`` it for np.percentile)
+    step_wall_ms: Deque[float] = field(
+        default_factory=lambda: deque(maxlen=WALL_WINDOW))
+
+    def record_step(self, wait_s: float, build_s: float, dispatch_s: float,
+                    drain_s: float, wall_s: float, queue_depth: int = 0,
+                    prefetched: bool = False) -> None:
+        self.steps += 1
+        self.enqueue_wait_ms += 1e3 * wait_s
+        self.host_build_ms += 1e3 * build_s
+        self.dispatch_ms += 1e3 * dispatch_s
+        self.drain_ms += 1e3 * drain_s
+        self.queue_depth_sum += int(queue_depth)
+        self.prefetched_steps += int(bool(prefetched))
+        self.step_wall_ms.append(1e3 * wall_s)
+
+    def reset(self) -> None:
+        self.steps = 0
+        self.enqueue_wait_ms = 0.0
+        self.host_build_ms = 0.0
+        self.dispatch_ms = 0.0
+        self.drain_ms = 0.0
+        self.queue_depth_sum = 0
+        self.prefetched_steps = 0
+        self.step_wall_ms = deque(maxlen=WALL_WINDOW)
+
+    def events(self, step: int = 0) -> List[Event]:
+        """Monitor-ready ``(name, value, step)`` tuples; per-step averages so
+        dashboards stay comparable across runs of different lengths."""
+        n = max(1, self.steps)
+        return [
+            ("train/pipeline/steps", float(self.steps), step),
+            ("train/pipeline/enqueue_wait_ms_per_step",
+             self.enqueue_wait_ms / n, step),
+            ("train/pipeline/host_build_ms_per_step",
+             self.host_build_ms / n, step),
+            ("train/pipeline/dispatch_ms_per_step",
+             self.dispatch_ms / n, step),
+            ("train/pipeline/drain_ms_per_step", self.drain_ms / n, step),
+            ("train/pipeline/queue_depth", self.queue_depth_sum / n, step),
+            ("train/pipeline/prefetched_fraction",
+             self.prefetched_steps / n, step),
+        ]

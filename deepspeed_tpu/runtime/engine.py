@@ -16,11 +16,23 @@ a **jitted, sharded train step** closed over the model's apply function:
     (parity: GAS bookkeeping engine.py:1920-2061), with a micro-step path exposing
     the reference's forward()/backward()/step() call discipline.
   - fp16 dynamic loss scaling runs branch-free on device (loss_scaler.py analog).
+
+The steady-state step loop is ASYNC end to end (mirror of the v2 serving
+pipeline's one-step-late drain, docs/TRAINING.md): input staging runs in a
+``runtime/data_pipeline.PrefetchLoader`` producer thread, ``train_batch``
+dispatches the fused step from an already-device-resident sharded batch, and
+``_after_step`` is split into a device-side metric enqueue and a host-side
+drain that materialises step k-1's floats while step k runs
+(``wall_clock_breakdown`` opts the whole loop back into synchronous
+execution). This module is a jaxlint JL007 hot path: every blocking
+device->host fetch routes through :func:`fetch_to_host`.
 """
 
 from __future__ import annotations
 
 import os
+import time
+from collections import deque
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 import jax
@@ -47,6 +59,21 @@ from deepspeed_tpu.utils.tree import global_norm, tree_cast
 def _last_key(path) -> str:
     from deepspeed_tpu.checkpoint.state import _path_str
     return _path_str(path[-1])
+
+
+def fetch_to_host(tree):
+    """THE device->host drain point for the training engine hot path.
+
+    Every blocking fetch of device data in this module routes through here:
+    the step loop is engineered so the only per-step materialisation is the
+    deferred metric drain (a handful of scalars, one step late), and
+    funnelling all fetches through one function lets jaxlint rule JL007
+    statically police the module for stray blocking fetches — an accidental
+    ``float(metrics["loss"])`` right after dispatch re-serialises the whole
+    loop (the exact regression class the pre-PR ``_after_step`` was). Same
+    pattern as ``inference/v2/engine_v2.fetch_to_host``.
+    """
+    return jax.device_get(tree)  # jaxlint: disable=JL007 -- the intentional drain
 
 
 def _extract_apply_fn(model: Any) -> Callable:
@@ -191,10 +218,14 @@ class DeepSpeedTPUEngine:
         self.micro_steps = 0
         self.skipped_steps = 0
         self._last_metrics: Dict[str, Any] = {}
+        # deferred metric drain: (step, samples, device-metrics) entries;
+        # _after_step enqueues, _emit_metrics materialises one step late
+        self._pending_metrics: deque = deque()
 
         # -- monitor (parity: MonitorMaster wiring, engine.py:249) ---------
-        from deepspeed_tpu.monitor import MonitorMaster
+        from deepspeed_tpu.monitor import MonitorMaster, TrainPipelineStats
         self.monitor = MonitorMaster(self.config)
+        self.train_stats = TrainPipelineStats()
 
         # -- progressive layer drop (parity: engine hook :1812) ------------
         self.progressive_layer_drop = None
@@ -207,6 +238,10 @@ class DeepSpeedTPUEngine:
 
         # -- curriculum learning (parity: data-pipeline hook engine.py:1823)
         self.curriculum_scheduler = None
+        # one-entry cache for the seqlen truncation decision: (scheduled
+        # seqlen, incoming leaf width, needs-truncation) — off bucket
+        # boundaries the staging path skips the tree walk entirely
+        self._curr_seqlen_state: Optional[Tuple[int, int, bool]] = None
         if self.config.curriculum_learning.enabled:
             from deepspeed_tpu.data.curriculum_scheduler import CurriculumScheduler
             self.curriculum_scheduler = CurriculumScheduler(
@@ -226,6 +261,12 @@ class DeepSpeedTPUEngine:
         self.state: Optional[Dict[str, Any]] = None
         self._state_shardings = None
         self._rng = rngs if rngs is not None else jax.random.PRNGKey(self.config.seed)
+        # PLD randomness is keyed by fold_in(base, step) rather than serial
+        # splits so the PrefetchLoader producer (which stages batches AHEAD of
+        # the step counter) derives the same stream the sync path would
+        self._pld_base_key = None
+        if self.progressive_layer_drop is not None:
+            self._rng, self._pld_base_key = jax.random.split(self._rng)
         if model_parameters is not None:
             self._init_state(model_parameters)
 
@@ -236,6 +277,8 @@ class DeepSpeedTPUEngine:
         self._grad_buffer = None
         self._eval_step = None
         self._data_iterator = None
+        self._prefetch_loader = None   # PrefetchLoader owned by the engine
+        self._warned_stale_staging = False
 
         # -- dataloader (parity: deepspeed_io engine.py:1684) --------------
         self.training_dataloader = None
@@ -367,7 +410,7 @@ class DeepSpeedTPUEngine:
         self._param_template = jax.eval_shape(lambda t: t, model_parameters)
         flat_master_sh = flatten_tree(master_sh)
 
-        host_master = {k: np.asarray(jax.device_get(flat[k]), np.float32)
+        host_master = {k: np.asarray(fetch_to_host(flat[k]), np.float32)
                        for k in host_names}
         self._offload = HostOffloadOptimizer(self.optimizer, host_master,
                                              self._offload_cfg)
@@ -529,7 +572,7 @@ class DeepSpeedTPUEngine:
         optimizer on per-leaf fp32 views, return the updated master as one
         flat COMPUTE-dtype host array (one half-width upload at merge —
         params are cast to the compute dtype there anyway)."""
-        host_np = np.asarray(jax.device_get(host_g_flat), np.float32)
+        host_np = np.asarray(fetch_to_host(host_g_flat), np.float32)
         assert host_np.size == self._offload_flat_size, \
             (host_np.size, self._offload_flat_size)
         views = {k: host_np[off:off + n]
@@ -539,7 +582,7 @@ class DeepSpeedTPUEngine:
 
     def _host_master_flat(self, leaves: dict) -> np.ndarray:
         wire = np.dtype(self.compute_dtype)
-        return (np.concatenate([np.asarray(leaves[k]).reshape(-1)
+        return (np.concatenate([np.asarray(leaves[k], np.float32).reshape(-1)
                                 for k, _, _, _ in self._offload_flat_meta]
                                ).astype(wire)
                 if self._offload_flat_meta else np.zeros((0,), wire))
@@ -561,11 +604,11 @@ class DeepSpeedTPUEngine:
         fetched from device, host-flow leaves read from RAM/NVMe; flat keys make
         the layout identical to non-offload checkpoints."""
         self._drain_offload()   # a delayed (DPU) host step must land first
-        dev_master = {k: np.asarray(jax.device_get(v))
+        dev_master = {k: fetch_to_host(v)
                       for k, v in self.state["master"].items()}
         host_master, moments = self._offload.state_leaves()
         full_master = {**dev_master, **host_master}
-        dev_opt = jax.device_get(self.state["opt"])
+        dev_opt = fetch_to_host(self.state["opt"])
         full_opt = {}
         for key, val in dev_opt.items():
             if isinstance(val, dict):
@@ -595,7 +638,7 @@ class DeepSpeedTPUEngine:
         self._offload.load_master_leaves({k: model_flat[k] for k in host_names})
         if load_optimizer_states and not load_module_only:
             optim_flat = cke.load(os.path.join(ckpt_dir, ck.OPTIM_FILE))
-            dev_opt = jax.device_get(self.state["opt"])
+            dev_opt = fetch_to_host(self.state["opt"])
             new_opt, host_moments = {}, {}
             for key, val in dev_opt.items():
                 if isinstance(val, dict):
@@ -784,41 +827,94 @@ class DeepSpeedTPUEngine:
             return
         if not (hasattr(self.module, "init") and hasattr(self.module, "apply")):
             raise ValueError("model_parameters required for non-flax models")
+        from deepspeed_tpu.runtime.data_pipeline import as_host_tree
         # Lazy init from the first microbatch (parity: zero.Init-style sharded init).
-        micro = jax.tree_util.tree_map(lambda x: np.asarray(x)[:1], batch)
+        micro = jax.tree_util.tree_map(lambda x: x[:1], as_host_tree(batch))
         self._rng, init_rng = jax.random.split(self._rng)
         params = self.module.init(init_rng, micro)["params"]
         self._init_state(params)
 
-    def _inject_pld(self, batch, leading: int):
+    def _inject_pld(self, batch, leading: int, step: Optional[int] = None,
+                    micro: Optional[int] = None):
         """Thread theta + a per-step key through the batch so the jitted step
         sees them as inputs (no retrace per theta change); models read
         batch["pld_theta"]/["pld_rng"] (parity: engine.py:1812 passing pld
         state into module kwargs). Used by BOTH train_batch and the
-        forward/backward facade."""
+        forward/backward facade; keys derive from (step[, micro]) folds so
+        prefetched and sync staging draw identical streams."""
         if self.progressive_layer_drop is None or not isinstance(batch, dict):
             return batch
-        batch = dict(batch)
-        theta = self.progressive_layer_drop.get_theta()
-        batch["pld_theta"] = np.full((leading,), theta, np.float32)
-        self._rng, k = jax.random.split(self._rng)
-        batch["pld_rng"] = np.asarray(jax.random.split(k, leading))
-        return batch
+        from deepspeed_tpu.runtime.data_pipeline import inject_pld
+        step = self.global_steps if step is None else step
+        key = jax.random.fold_in(self._pld_base_key, step)
+        if micro is not None:
+            key = jax.random.fold_in(key, micro)
+        return inject_pld(batch, leading,
+                          self.progressive_layer_drop.theta_at(step), key)
+
+    def _scheduled_seqlen(self, step: int) -> Optional[int]:
+        """Curriculum seqlen for a global step — a PURE schedule read, safe
+        from the PrefetchLoader producer staging future steps."""
+        if (self.curriculum_scheduler is None
+                or self.config.curriculum_learning.curriculum_type != "seqlen"):
+            return None
+        return int(self.curriculum_scheduler.get_difficulty(step))
+
+    def _staging_is_stale(self, staged_step: int) -> bool:
+        """Would a batch staged for ``staged_step`` differ from one staged
+        for the CURRENT step? PLD keys are per-step; curriculum matters only
+        when the schedule actually moved between the two steps."""
+        if self.progressive_layer_drop is not None:
+            return True
+        return (self._scheduled_seqlen(staged_step)
+                != self._scheduled_seqlen(self.global_steps))
+
+    def _apply_curriculum(self, batch, seqlen: int):
+        """Truncate to the scheduled seqlen, bucketed by difficulty_step so
+        XLA recompiles once per bucket (parity: curriculum seqlen hook).
+        The cache key is the MAX width over every rank>=2 leaf (any one of
+        them changing invalidates it), so off bucket boundaries the no-op
+        decision skips the truncation tree_map; slices are numpy views, so
+        no step ever copies."""
+        width = max((int(np.shape(x)[1])
+                     for x in jax.tree_util.tree_leaves(batch)
+                     if len(np.shape(x)) >= 2), default=0)
+        if self._curr_seqlen_state == (seqlen, width, False):
+            return batch
+        from deepspeed_tpu.runtime.data_pipeline import truncate_to_seqlen
+        need = width > seqlen
+        self._curr_seqlen_state = (seqlen, width, need)
+        return truncate_to_seqlen(batch, seqlen) if need else batch
+
+    def _prepare_batch(self, batch, step: int):
+        """Host-side staging for global step ``step``: curriculum truncation,
+        PLD injection, and the sharded device placement. Runs on the caller's
+        thread (sync mode / explicit batches) or on the PrefetchLoader
+        producer — everything schedule-dependent is keyed by ``step``, never
+        read from mutable engine counters, so staging ahead is exact."""
+        from deepspeed_tpu.runtime.data_pipeline import StagedBatch
+        self._ensure_state(batch)
+        raw = batch   # pre-schedule view: flops profiling + restage-on-mix
+        seqlen = self._scheduled_seqlen(step)
+        if seqlen is not None:
+            batch = self._apply_curriculum(batch, seqlen)
+        batch = self._inject_pld(batch, self.train_batch_size_, step=step)
+        return StagedBatch(self._shard_global_batch(batch), step, raw=raw)
 
     def _shard_global_batch(self, batch):
         """Host-side: reshape [tb, ...] -> [gas, mb*dp, ...] and place sharded."""
+        from deepspeed_tpu.runtime.data_pipeline import as_host_tree
         mesh = self.topology.mesh
         sh = NamedSharding(mesh, P(None, BATCH_AXES))
 
         def place(x):
-            x = np.asarray(x)
             if x.shape[0] != self.train_batch_size_:
                 raise ValueError(
                     f"batch leading dim {x.shape[0]} != train_batch_size {self.train_batch_size_}")
             x = x.reshape((self.gas_, -1) + x.shape[1:])
             return jax.device_put(x, sh)
 
-        return jax.tree_util.tree_map(place, batch)
+        return jax.tree_util.tree_map(place, as_host_tree(batch))
 
     def _compiler_options(self, backend: Optional[str] = None):
         """ZeRO bucket sizes -> XLA collective-combiner thresholds, applied to
@@ -842,44 +938,99 @@ class DeepSpeedTPUEngine:
                      for k, v in self.config.xla_compile_options.items()})
         return opts or None
 
+    def _build_data_iterator(self):
+        """Iterator over the engine's own dataloader: RepeatingLoader for
+        epoch auto-bump, wrapped in a PrefetchLoader staging device-resident
+        batches when ``train_pipeline.prefetch > 0``."""
+        from deepspeed_tpu.runtime.data_pipeline import PrefetchLoader
+        from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+        it = RepeatingLoader(self.training_dataloader)
+        depth = self.config.train_pipeline.prefetch
+        if depth > 0:
+            it = PrefetchLoader(it, prepare=self._prepare_batch,
+                                prefetch=depth, start_step=self.global_steps)
+            self._prefetch_loader = it
+        return iter(it)
+
+    def _reset_data_iterator(self):
+        """Drop the engine-owned iterator (and stop its producer): staged
+        batches are keyed to the step counter, so anything that moves it
+        (checkpoint load) invalidates them."""
+        if self._prefetch_loader is not None:
+            self._prefetch_loader.close()
+            self._prefetch_loader = None
+        self._data_iterator = None
+
     def train_batch(self, batch=None, data_iter=None):
         """One full training step over a global batch (parity:
         ``PipelineEngine.train_batch`` pipe/engine.py:321 and the
-        forward/backward/step cycle engine.py:1779-2118). Returns the mean loss."""
+        forward/backward/step cycle engine.py:1779-2118).
+
+        Returns the mean loss as a DEVICE scalar: ``float()`` it to block.
+        The steady-state loop is async (docs/TRAINING.md): the next staged
+        batch is dequeued (or staged inline), the fused step is dispatched,
+        and ``_after_step`` drains the PREVIOUS step's metrics while this
+        one runs. ``wall_clock_breakdown`` restores the fully synchronous
+        reference loop."""
+        from deepspeed_tpu.runtime.data_pipeline import StagedBatch
+        perf = time.perf_counter
+        t0 = perf()
+        queue_depth = 0
         if batch is None:
             if data_iter is None:
                 if self.training_dataloader is None:
                     raise ValueError("train_batch() needs a batch, a data_iter, or "
                                      "training_data passed to initialize()")
                 if self._data_iterator is None:
-                    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
-                    self._data_iterator = iter(RepeatingLoader(self.training_dataloader))
+                    self._data_iterator = self._build_data_iterator()
                 data_iter = self._data_iterator
             batch = next(data_iter)
-        self._ensure_state(batch)
+            if self._prefetch_loader is not None and data_iter is self._data_iterator:
+                queue_depth = self._prefetch_loader.depth
+        prefetched = isinstance(batch, StagedBatch)
+        if prefetched and batch.step != self.global_steps \
+                and self._staging_is_stale(batch.step):
+            # the step counter moved outside the pipeline that staged this
+            # batch (an explicit train_batch(batch), the facade, a foreign
+            # data_iter): its schedule-keyed staging (curriculum seqlen, PLD
+            # theta/rng) is for the wrong step — fall back to the raw view so
+            # the inline path below restages it at the CURRENT step. Data
+            # order is preserved; only the staging work is redone.
+            if not self._warned_stale_staging:
+                self._warned_stale_staging = True
+                logger.warning(
+                    "prefetched batch staged for step %d consumed at step %d "
+                    "(mixed explicit/argless train_batch?): restaging inline; "
+                    "schedule-dependent staging stays on the caller's thread "
+                    "until the pipeline is rebuilt", batch.step,
+                    self.global_steps)
+            batch = batch.raw
+            prefetched = False
+        t1 = perf()
+        if not prefetched:
+            self._ensure_state(batch)
+        # keep the host-visible difficulty fresh on every path (tests and
+        # callbacks read curriculum_scheduler.current_difficulty)
+        if self.curriculum_scheduler is not None:
+            self.curriculum_scheduler.update_difficulty(self.global_steps)
         if self._fused_step is None and self._offload is None:
             self._fused_step = jax.jit(self._build_fused_step(), donate_argnums=(0,),
                                        compiler_options=self._compiler_options())
         fp_cfg = self.config.flops_profiler
         if fp_cfg.enabled and self.global_steps + 1 == fp_cfg.profile_step:
-            self._run_flops_profile(batch)
-        if (self.curriculum_scheduler is not None
-                and self.config.curriculum_learning.curriculum_type == "seqlen"):
-            # truncate to the scheduled seqlen; bucketed by difficulty_step so
-            # XLA recompiles once per bucket (parity: curriculum seqlen hook)
-            seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps)
-            batch = jax.tree_util.tree_map(
-                lambda x: np.asarray(x)[:, :seqlen]
-                if getattr(np.asarray(x), "ndim", 0) >= 2 else np.asarray(x),
-                batch)
-        batch = self._inject_pld(batch, self.train_batch_size_)
+            raw = batch.raw if prefetched else batch
+            if raw is not None:
+                self._run_flops_profile(raw)
         self.tput_timer.start()
         self.timers(STEP_GLOBAL_TIMER).start()
-        sharded = self._shard_global_batch(batch)
+        staged = batch if prefetched else self._prepare_batch(batch,
+                                                              self.global_steps)
+        t2 = perf()
         if self._offload is not None:
-            metrics = self._offload_train_step(sharded)
+            metrics = self._offload_train_step(staged.tree)
         else:
-            self.state, metrics = self._fused_step(self.state, sharded)
+            self.state, metrics = self._fused_step(self.state, staged.tree)
+        t3 = perf()
         # Only force a device sync for exact timings when the user asked for a
         # wall-clock breakdown (parity: reference timers run under the
         # wall_clock_breakdown flag). An unconditional block_until_ready here
@@ -888,17 +1039,38 @@ class DeepSpeedTPUEngine:
         sync = metrics["loss"] if self.config.wall_clock_breakdown else None
         self.timers(STEP_GLOBAL_TIMER).stop(sync_obj=sync)
         self.tput_timer.stop(sync_obj=sync)
-        self._after_step(metrics)
+        self._after_step(metrics)   # enqueue + one-step-late drain
+        t4 = perf()
+        self.train_stats.record_step(
+            wait_s=(t1 - t0) if prefetched else 0.0,
+            build_s=(t2 - t1) + (0.0 if prefetched else (t1 - t0)),
+            dispatch_s=t3 - t2, drain_s=t4 - t3, wall_s=t4 - t0,
+            queue_depth=queue_depth, prefetched=prefetched)
         return metrics["loss"]
+
+    def train_steps(self, n_steps: int, data_iter=None) -> np.ndarray:
+        """Run ``n_steps`` fused steps back-to-back, metrics one step in
+        flight throughout (the multi-step dispatch loop), then drain once.
+
+        Returns the per-step loss stream as a float32 ``[n_steps]`` array —
+        materialised at the END of the burst, so the loop itself never blocks
+        on a metric fetch. Batches come from ``data_iter`` (host batches or a
+        PrefetchLoader's staged ones) or the engine's own pipeline."""
+        losses = []
+        for _ in range(int(n_steps)):
+            losses.append(self.train_batch(data_iter=data_iter))
+        self.drain_metrics()
+        return np.asarray([float(l) for l in losses], np.float32)
 
     def _run_flops_profile(self, batch):
         """Profile the model forward at ``profile_step`` (parity: flops-profiler
         engine hooks, reference engine.py:1808-1850, 2188-2200)."""
         from deepspeed_tpu.profiling import FlopsProfiler
+        from deepspeed_tpu.runtime.data_pipeline import as_host_tree
         fp_cfg = self.config.flops_profiler
         prof = FlopsProfiler(fp_cfg)
         micro = jax.tree_util.tree_map(
-            lambda x: np.asarray(x)[:max(1, self.micro_batch_size_)], batch)
+            lambda x: x[:max(1, self.micro_batch_size_)], as_host_tree(batch))
         params = self._current_params(self.state)
         if hasattr(self.module, "apply"):
             prof.start_profile(self.module, {"params": params}, micro)
@@ -914,6 +1086,12 @@ class DeepSpeedTPUEngine:
         self.flops_profiler = prof
 
     def _after_step(self, metrics, count_micro_steps: bool = True):
+        """Device-side half of the post-step work: counters, schedulers, and
+        the metric ENQUEUE. The host-side half (``_emit_metrics``) floats a
+        step's metrics ONE STEP LATE — the pre-PR version float()'d here and
+        blocked on the just-dispatched step even when nothing was printed.
+        ``wall_clock_breakdown`` keeps the reference's synchronous loop by
+        draining immediately."""
         self.global_steps += 1
         if self.compression_scheduler is not None:
             self.compression_scheduler.step()
@@ -924,26 +1102,54 @@ class DeepSpeedTPUEngine:
             # facade path counts micro steps in backward(); fused path counts here
             self.micro_steps += self.gas_
         self._last_metrics = metrics
+        self._pending_metrics.append(
+            (self.global_steps, self.global_samples, metrics))
+        self._drain_metric_queue(
+            0 if self.config.wall_clock_breakdown else 1)
+
+    def drain_metrics(self):
+        """Flush every deferred metric entry (blocks on the newest dispatched
+        step). Called automatically at checkpoint save/load, ``train_steps``
+        exit, and ``destroy()``; call it manually before reading monitor
+        output mid-run."""
+        self._drain_metric_queue(0)
+
+    def _drain_metric_queue(self, leave: int):
+        while len(self._pending_metrics) > leave:
+            step, samples, metrics = self._pending_metrics.popleft()
+            self._emit_metrics(step, samples, metrics)
+
+    def _emit_metrics(self, step: int, samples: int, metrics):
+        """Host-side half of the split ``_after_step``: materialise ONE
+        step's metric floats (a single fetch through the drain point) and
+        route them to the monitor and the steps_per_print log. When nothing
+        consumes them, the entry is dropped without touching the device."""
+        every = self.config.steps_per_print
+        printing = bool(every and step % every == 0)
+        if not (printing or self.monitor.enabled):
+            return
+        vals = fetch_to_host(metrics)
         if self.monitor.enabled:
             # parity: _write_monitor (engine.py:2259) + loss/lr/scale events
             # (engine.py:1943-1951, 2164-2185); the facade path's step metrics
             # carry no loss
-            events = [("Train/Samples/lr", float(metrics["lr"]), self.global_samples),
-                      ("Train/Samples/grad_norm", float(metrics["grad_norm"]),
-                       self.global_samples)]
-            if "loss" in metrics:
+            events = [("Train/Samples/lr", float(vals["lr"]), samples),
+                      ("Train/Samples/grad_norm", float(vals["grad_norm"]),
+                       samples)]
+            if "loss" in vals:
                 events.insert(0, ("Train/Samples/train_loss",
-                                  float(metrics["loss"]), self.global_samples))
+                                  float(vals["loss"]), samples))
             if self.config.fp16.enabled:
                 events.append(("Train/Samples/loss_scale",
-                               float(metrics["loss_scale"]), self.global_samples))
+                               float(vals["loss_scale"]), samples))
             self.monitor.write_events(events)
-        every = self.config.steps_per_print
-        if every and self.global_steps % every == 0:
-            loss = float(metrics["loss"]) if "loss" in metrics else float("nan")
-            lr = float(metrics["lr"])
-            log_dist(f"step={self.global_steps} loss={loss:.4f} lr={lr:.3e} "
-                     f"gnorm={float(metrics['grad_norm']):.3f}", ranks=[0])
+            if printing:
+                self.monitor.write_events(self.train_stats.events(samples))
+        if printing:
+            loss = float(vals["loss"]) if "loss" in vals else float("nan")
+            lr = float(vals["lr"])
+            log_dist(f"step={step} loss={loss:.4f} lr={lr:.3e} "
+                     f"gnorm={float(vals['grad_norm']):.3f}", ranks=[0])
             if self.config.wall_clock_breakdown:
                 self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER,
                                  STEP_GLOBAL_TIMER])
@@ -956,14 +1162,16 @@ class DeepSpeedTPUEngine:
         Parity: ``DeepSpeedEngine.forward`` (engine.py:1779) + ``backward``
         (:1920) — in JAX fwd and grad are one computation, so ``forward`` computes
         and buffers the (scaled) gradient and ``backward`` is bookkeeping."""
+        from deepspeed_tpu.runtime.data_pipeline import as_host_tree
         self._ensure_state(batch)
         if self._micro_step is None:
             self._build_micro_steps()
         leading = int(np.shape(jax.tree_util.tree_leaves(batch)[0])[0])
-        batch = self._inject_pld(batch, leading)
+        batch = self._inject_pld(batch, leading, micro=self.micro_steps)
         mesh = self.topology.mesh
         sh = NamedSharding(mesh, P(BATCH_AXES))
-        mb = jax.tree_util.tree_map(lambda x: jax.device_put(np.asarray(x), sh), batch)
+        mb = jax.tree_util.tree_map(lambda x: jax.device_put(x, sh),
+                                    as_host_tree(batch))
         if self._grad_buffer is None:
             self._grad_buffer = self._zero_grad_buffer()
         self.timers(FORWARD_GLOBAL_TIMER).start()
@@ -1052,6 +1260,7 @@ class DeepSpeedTPUEngine:
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[dict] = None, save_latest: bool = True):
         from deepspeed_tpu.checkpoint.state import save_engine_checkpoint
+        self.drain_metrics()   # checkpoint boundary flushes deferred metrics
         tag = tag or f"global_step{self.global_steps}"
         client_state = dict(client_state or {})
         client_state.update({
@@ -1083,6 +1292,10 @@ class DeepSpeedTPUEngine:
         if self.state is None:
             raise RuntimeError("engine state not initialised; pass model_parameters "
                                "or run a batch before load_checkpoint")
+        # flush metrics of the pre-load stream, and drop staged batches: the
+        # step counter is about to move, invalidating schedule-keyed staging
+        self.drain_metrics()
+        self._reset_data_iterator()
         if self.config.checkpoint.load_universal:
             from deepspeed_tpu.checkpoint.universal import load_universal_into_engine
             if tag is not None:
@@ -1119,7 +1332,10 @@ class DeepSpeedTPUEngine:
 
     def destroy(self):
         """Release host-side resources (parity: ``DeepSpeedEngine.destroy``):
-        the offload optimizer's AIO pools/swap files and monitor writers."""
+        the prefetch producer, deferred metrics, the offload optimizer's AIO
+        pools/swap files, and monitor writers."""
+        self._reset_data_iterator()
+        self.drain_metrics()
         if self._offload is not None:
             self._drain_offload()
             if self._offload_executor is not None:
@@ -1192,15 +1408,32 @@ class DeepSpeedTPUEngine:
         """Full (unsharded) param pytree on host (parity:
         ``_zero3_consolidated_16bit_state_dict`` engine.py:3440: gather is implicit
         in device_get of a sharded Array)."""
-        return jax.device_get(self.get_params())
+        return fetch_to_host(self.get_params())
+
+    @property
+    def compiles(self) -> int:
+        """Cumulative XLA program builds across the engine's jitted steps —
+        the executable-cache sizes of the fused/micro/apply/eval steps. A
+        steady-state loop whose batch shapes are stable must never increment
+        this after warmup (curriculum buckets each cost exactly one); the
+        train bench gates on it."""
+        n = 0
+        for fn in (self._fused_step, self._micro_step, self._apply_step,
+                   self._eval_step):
+            size = getattr(fn, "_cache_size", None)
+            if size is not None:
+                n += size()
+        return n
 
     def eval_loss(self, batch) -> float:
         """Forward-only loss on a global batch (no state change)."""
+        from deepspeed_tpu.runtime.data_pipeline import as_host_tree
         self._ensure_state(batch)
         params = self._current_params(self.state)
         mesh = self.topology.mesh
         sh = NamedSharding(mesh, P(BATCH_AXES))
-        mb = jax.tree_util.tree_map(lambda x: jax.device_put(np.asarray(x), sh), batch)
+        mb = jax.tree_util.tree_map(lambda x: jax.device_put(x, sh),
+                                    as_host_tree(batch))
         if self._eval_step is None:
             self._eval_step = jax.jit(self._loss_of)
         return float(self._eval_step(params, mb))

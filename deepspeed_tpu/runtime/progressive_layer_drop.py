@@ -27,10 +27,17 @@ class ProgressiveLayerDrop:
     def get_theta(self) -> float:
         return self.current_theta
 
+    def theta_at(self, global_step: int) -> float:
+        """Pure schedule read: theta for a given step, no state mutation.
+        ``theta_at(0) == 1.0`` (the pre-first-update value), so staging code
+        that runs AHEAD of the step counter (PrefetchLoader producer) derives
+        exactly what ``update_state``-then-``get_theta`` would have seen."""
+        return (1.0 - self.theta) * math.exp(-self.gamma * global_step) \
+            + self.theta
+
     def update_state(self, global_step: int) -> float:
         """theta decays 1 -> theta_bar (reference update_state)."""
-        self.current_theta = (1.0 - self.theta) * math.exp(
-            -self.gamma * global_step) + self.theta
+        self.current_theta = self.theta_at(global_step)
         return self.current_theta
 
     def keep_prob(self, layer_idx: int, n_layers: int) -> float:

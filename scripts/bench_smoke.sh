@@ -1,17 +1,21 @@
 #!/usr/bin/env bash
-# Steady-state serving-bench smoke: keeps benchmarks/serving_bench.py
-# --steady-state RUNNABLE on a CPU-only box (tiny model, tiny sizes, <60 s
-# warm) so the decode-pipeline leg can't rot between hardware rounds.
+# Bench smoke: keeps the serving (serving_bench.py --steady-state) and
+# training (train_bench.py) pipeline legs RUNNABLE on a CPU-only box (tiny
+# models, tiny sizes, <60 s each warm) so neither can rot between hardware
+# rounds.
 #
-# Exit status reflects the leg's own correctness gates (byte-identical greedy
-# streams between the per-token loop and the pipeline; one-token-row per-step
-# transfer). Throughput numbers at these sizes are smoke, not signal — real
-# numbers come from the full leg (docs/SERVING.md). tier1.sh invokes this
-# NON-FATALLY after pytest.
+# Exit status reflects the legs' own correctness gates (serving:
+# byte-identical greedy streams + one-token-row per-step transfer; training:
+# byte-identical loss streams + zero warm-loop compiles). Throughput numbers
+# at these sizes are smoke, not signal — real numbers come from the full legs
+# (docs/SERVING.md, docs/TRAINING.md). tier1.sh invokes this NON-FATALLY
+# after pytest.
 set -o pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
 timeout -k 10 300 python benchmarks/serving_bench.py --steady-state \
-    --seqs 4 --prompt 16 --gen 24
+    --seqs 4 --prompt 16 --gen 24 || exit 1
+
+timeout -k 10 300 python benchmarks/train_bench.py --smoke

@@ -222,6 +222,25 @@ def test_train_batch_advances_through_dataloader(eight_devices):
     assert len(seen) == 3, "train_batch() repeated the same batch"
 
 
+def test_train_steps_burst(eight_devices):
+    """train_steps: n fused dispatches, one drain at the end, loss stream
+    identical to per-step train_batch on a twin engine."""
+    e1 = make_engine()
+    e2 = make_engine()
+    batches = [make_batch(8, seed=200 + i) for i in range(4)]
+    losses_burst = e1.train_steps(4, data_iter=iter(batches))
+    assert losses_burst.shape == (4,) and losses_burst.dtype == np.float32
+    assert e1.global_steps == 4
+    assert len(e1._pending_metrics) == 0  # drained at burst exit
+    losses_single = [float(e2.train_batch(b)) for b in batches]
+    np.testing.assert_array_equal(losses_burst,
+                                  np.asarray(losses_single, np.float32))
+    # warm steady-state burst never recompiles
+    c0 = e1.compiles
+    e1.train_steps(2, data_iter=iter(batches[:2]))
+    assert e1.compiles == c0
+
+
 def test_wall_clock_breakdown_with_steps_per_print_zero(eight_devices):
     """Regression: wall_clock_breakdown must not divide by steps_per_print=0."""
     engine = make_engine(extra={"wall_clock_breakdown": True})

@@ -530,6 +530,69 @@ def test_jl007_block_until_ready_not_flagged():
     assert findings == []
 
 
+def _repo_config():
+    """The SHIPPED .jaxlint.json (not a fixture) — these tests pin that the
+    training engine is actually policed in the committed config."""
+    import json
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(root, ".jaxlint.json")
+    if not os.path.isfile(path):
+        pytest.skip("source tree layout not available")
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_jl007_shipped_config_covers_training_engine():
+    raw = _repo_config()
+    hot = raw["rules"]["JL007"]["options"]["hot_paths"]
+    assert "deepspeed_tpu/runtime/engine.py" in hot
+    assert any("inference/v2" in p for p in hot)
+
+
+def test_jl007_training_engine_path_flagged():
+    # a stray blocking fetch added to the engine module must fire under the
+    # SHIPPED hot_paths (the PR-4 deferred-drain discipline)
+    raw = _repo_config()
+    cfg = LintConfig(rules={"JL007": RuleSettings(
+        options=raw["rules"]["JL007"]["options"])})
+    src = textwrap.dedent("""
+        import numpy as np
+
+        def _after_step(metrics):
+            return float(np.asarray(metrics["loss"]))
+    """)
+    findings = lint_text(src, path="deepspeed_tpu/runtime/engine.py",
+                         config=cfg)
+    assert rules_of(findings) == ["JL007"]
+
+
+def test_jl007_training_engine_drain_pattern_clean():
+    # the engine's actual discipline: ONE suppressed drain point, dtype'd
+    # host conversions everywhere else
+    raw = _repo_config()
+    cfg = LintConfig(rules={"JL007": RuleSettings(
+        options=raw["rules"]["JL007"]["options"])})
+    src = textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        def fetch_to_host(tree):
+            return jax.device_get(tree)  # jaxlint: disable=JL007 -- the intentional drain
+
+        def _emit_metrics(metrics):
+            vals = fetch_to_host(metrics)
+            return float(vals["loss"])
+
+        def _host_master_flat(leaves):
+            return np.concatenate([np.asarray(v, np.float32) for v in leaves])
+    """)
+    findings = lint_text(src, path="deepspeed_tpu/runtime/engine.py",
+                         config=cfg)
+    assert findings == []
+
+
 # --------------------------------------------------------------------------- #
 # suppressions / baseline / config / CLI
 # --------------------------------------------------------------------------- #
