@@ -28,6 +28,17 @@ Legs:
   cheap device step. This is the acceptance-gate leg: the host work is
   GIL-free, so the producer genuinely overlaps the device and the pipeline
   clears >=1.3x on the 2-core container.
+- ``offload_cpu`` / ``offload_nvme`` (``--offload``): the OFFLOADED
+  OPTIMIZER pipeline (docs/TRAINING.md "Offloaded optimizer pipeline").
+  Param-heavy/flops-light model (the ZeRO-Offload regime) driven through
+  the SAME engine twice per rep: ``overlap_step`` flipped OFF (the pre-PR
+  serial fetch-all/step-all/upload-all host step) vs ON (the three-stage
+  fetch/step/upload group pipeline, threaded host kernel, NVMe swapper
+  double-buffering underneath). Same gates: byte-identical per-step loss
+  streams (host kernels are elementwise; the device program is shared, so
+  equality is structural — a pipeline bug breaks it) and zero timed-run
+  compiles. The nvme leg additionally reports ``swap_ms_per_step`` — the
+  pure IO cost that bounds how much slower than the cpu leg it may run.
 
 Correctness gates on BOTH legs (exit 1 on violation — throughput is
 reported, the >=1.3x bar applies to the host_bound leg's median):
@@ -150,6 +161,158 @@ def _make_engine(model, params, batch):
     engine, *_ = deepspeed_tpu.initialize(model=model, model_parameters=params,
                                           config=cfg)
     return engine
+
+
+# --------------------------------------------------------------------------- #
+# offload legs (--offload): serial host step vs the fetch/step/upload pipeline
+# --------------------------------------------------------------------------- #
+
+def build_offload_leg(on_tpu: bool, smoke: bool, nvme_dir=None):
+    """Param-heavy / flops-light workload: most leaves only feed a cheap
+    mean-square regulariser, so their grads are full-size but the device
+    step is a pass or two — the host optimizer is the step's centre of
+    gravity, exactly the regime ZeRO-Offload targets."""
+    import jax.numpy as jnp
+
+    batch, feat, hidden = 16, 256, 64
+    # full size: 4 x 2M-element wide leaves (8.4M params, ~34 MB fp32
+    # masters) — large enough that the host kernel+upload dominate the step
+    # (the ZeRO-Offload regime) and each group's kernel can hide its
+    # neighbour's upload; smaller sizes drown the overlap in the device
+    # step's fixed cost on a 2-core CPU box
+    n_wide, wide = (4, 1 << 16) if smoke else (4, 1 << 21)
+
+    def model(params, b):
+        h = jnp.tanh(jnp.mean(b["x"], axis=1) @ params["w1"])
+        pred = h @ params["w2"]
+        loss = jnp.mean((pred - b["y"]) ** 2)
+        reg = sum(jnp.mean(params[f"u{i}"] ** 2) for i in range(n_wide))
+        return loss + 1e-4 * reg
+
+    rng = np.random.default_rng(0)
+    params = {"w1": rng.standard_normal((feat, hidden)).astype(np.float32) * .05,
+              "w2": rng.standard_normal((hidden, 16)).astype(np.float32) * .05}
+    for i in range(n_wide):
+        params[f"u{i}"] = rng.standard_normal(wide).astype(np.float32) * .05
+
+    import deepspeed_tpu
+    off = {"device": "cpu", "buffer_count": 2}
+    if nvme_dir is not None:
+        off.update({"device": "nvme", "nvme_path": nvme_dir,
+                    "pipeline_read": True, "pipeline_write": True})
+    cfg = {"train_batch_size": batch, "steps_per_print": 0,
+           "zero_optimization": {"stage": 1, "offload_optimizer": off},
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}}
+    engine, *_ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                          config=cfg)
+    batches = [{"x": rng.standard_normal((batch, 8, feat)).astype(np.float32),
+                "y": rng.standard_normal((batch, 16)).astype(np.float32)}
+               for _ in range(4)]
+    n_params = sum(int(np.prod(v.shape)) for v in params.values())
+    return engine, batches, {
+        "leg": "offload_nvme" if nvme_dir else "offload_cpu",
+        "batch": batch, "params": n_params,
+        "host_groups": len(engine._offload_groups),
+        "host_kernel": engine._offload.kernel.backend,
+        "host_workers": engine._offload._workers}
+
+
+def snapshot_offload(engine):
+    import jax
+    master, moments = engine._offload.state_leaves()
+    host = ({k: np.array(v, np.float32) for k, v in master.items()},
+            {sk: {k: np.array(v, np.float32) for k, v in d.items()}
+             for sk, d in moments.items()},
+            engine._offload.step_num)
+    return (jax.device_get(engine.state), host, engine.global_steps,
+            engine.global_samples, engine.micro_steps)
+
+
+def restore_offload(engine, snap):
+    import jax
+    state, (master, moments, step_num), steps, samples, micro = snap
+    engine.state = jax.device_put(state, engine._state_shardings)
+    engine._offload.load_master_leaves(master)
+    engine._offload.load_moment_leaves(moments, step_num=step_num)
+    engine.global_steps = steps
+    engine.global_samples = samples
+    engine.micro_steps = micro
+    engine._pending_metrics.clear()
+    engine._last_metrics = {}
+
+
+def offload_run(engine, batches, n: int, overlap: bool):
+    """n steps through the SAME engine, host step orchestration selected by
+    ``overlap_step`` (the device program and the kernel math are shared —
+    only the overlap differs)."""
+    engine._offload_cfg.overlap_step = overlap
+    losses = []
+    gc.disable()
+    t0 = time.time()
+    for i in range(n):
+        losses.append(float(engine.train_batch(batches[i % len(batches)])))
+    wall = time.time() - t0
+    gc.enable()
+    return losses, wall
+
+
+def run_offload_leg(on_tpu: bool, steps: int, reps: int, smoke: bool,
+                    nvme_dir=None):
+    engine, batches, info = build_offload_leg(on_tpu, smoke, nvme_dir)
+    snap = snapshot_offload(engine)
+    warm = max(2, min(4, steps))
+    for overlap in (False, True):   # warm both orchestrations + the merge jit
+        offload_run(engine, batches, warm, overlap)
+        restore_offload(engine, snap)
+
+    c0 = engine.compiles
+    speedups, sync_walls, pipe_walls = [], [], []
+    equal, first_losses = True, None
+    phase = {"steps": 0, "groups": 0, "fetch": 0.0, "kernel": 0.0,
+             "upload": 0.0, "swap": 0.0, "depth": 0}
+    for _ in range(reps):
+        losses_s, wall_s = offload_run(engine, batches, steps, overlap=False)
+        restore_offload(engine, snap)
+        engine.offload_stats.reset()   # phase breakdown: pipelined runs only
+        losses_p, wall_p = offload_run(engine, batches, steps, overlap=True)
+        st = engine.offload_stats
+        phase["steps"] += st.steps
+        phase["groups"] += st.groups
+        phase["fetch"] += st.fetch_ms
+        phase["kernel"] += st.kernel_ms
+        phase["upload"] += st.upload_ms
+        phase["swap"] += st.swap_ms
+        phase["depth"] += st.upload_depth_sum
+        restore_offload(engine, snap)
+        equal = equal and losses_p == losses_s
+        if first_losses is None:
+            first_losses = losses_s
+        equal = equal and losses_s == first_losses
+        speedups.append(wall_s / wall_p)
+        sync_walls.append(wall_s)
+        pipe_walls.append(wall_p)
+    n = max(1, phase["steps"])
+    g = max(1, phase["groups"])
+    med = int(np.argsort(speedups)[len(speedups) // 2])
+    out = dict(info)
+    out.update({
+        "steps": steps, "reps": reps,
+        "sync_steps_per_sec": round(steps / sync_walls[med], 2),
+        "pipelined_steps_per_sec": round(steps / pipe_walls[med], 2),
+        "speedup": round(float(np.median(speedups)), 2),
+        "speedup_reps": [round(float(s), 2) for s in speedups],
+        "losses_equal": bool(equal),
+        "compiles_during_timed_runs": engine.compiles - c0,
+        "fetch_ms_per_group": round(phase["fetch"] / g, 3),
+        "kernel_ms_per_group": round(phase["kernel"] / g, 3),
+        "upload_ms_per_group": round(phase["upload"] / g, 3),
+        "swap_ms_per_step": round(phase["swap"] / n, 3),
+        "upload_depth_per_group": round(phase["depth"] / g, 3),
+    })
+    engine.destroy()
+    del engine
+    gc.collect()
+    return out
 
 
 def snapshot(engine):
@@ -284,12 +447,17 @@ def main():
     # host_bound (the acceptance-gate leg) runs first so its numbers are not
     # skewed by allocator/thread-pool state the lm leg leaves behind
     ap.add_argument("--legs", default="host_bound,lm")
+    ap.add_argument("--offload", action="store_true",
+                    help="run the offloaded-optimizer legs "
+                         "(offload_cpu,offload_nvme) instead of --legs")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny fast run for CI (scripts/bench_smoke.sh): "
                          "correctness gates only, throughput is noise")
     args = ap.parse_args()
     if args.smoke:
         args.steps, args.reps = 8, 1
+    if args.offload:
+        args.legs = "offload_cpu,offload_nvme"
 
     import jax
     on_tpu = jax.default_backend() not in ("cpu",)
@@ -297,13 +465,28 @@ def main():
     setup_compile_cache(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     builders = {"lm": build_lm_leg, "host_bound": build_host_bound_leg}
-    bad = [l for l in args.legs.split(",") if l not in builders]
+    offload_legs = ("offload_cpu", "offload_nvme")
+    bad = [l for l in args.legs.split(",")
+           if l not in builders and l not in offload_legs]
     if bad:
-        ap.error(f"unknown --legs entries {bad}; valid: {sorted(builders)}")
+        ap.error(f"unknown --legs entries {bad}; valid: "
+                 f"{sorted(builders) + list(offload_legs)}")
     ok = True
+    offload_outs = {}
     for leg in args.legs.split(","):
-        out = run_leg(builders[leg], on_tpu, args.steps, args.reps,
-                      args.prefetch)
+        if leg in offload_legs:
+            if leg == "offload_nvme":
+                import tempfile
+                with tempfile.TemporaryDirectory() as nvme_dir:
+                    out = run_offload_leg(on_tpu, args.steps, args.reps,
+                                          args.smoke, nvme_dir=nvme_dir)
+            else:
+                out = run_offload_leg(on_tpu, args.steps, args.reps,
+                                      args.smoke)
+            offload_outs[leg] = out
+        else:
+            out = run_leg(builders[leg], on_tpu, args.steps, args.reps,
+                          args.prefetch)
         print(json.dumps(out), flush=True)
         # gates: pipelined orchestration must not change the loss stream and
         # warm steady-state training must never compile — a staging or
@@ -311,6 +494,24 @@ def main():
         # throughput mystery
         ok = ok and out["losses_equal"] \
             and out["compiles_during_timed_runs"] == 0
+    if "offload_cpu" in offload_outs and "offload_nvme" in offload_outs:
+        # the nvme tier's honest bound: no slower than the cpu tier by more
+        # than the pure IO cost it actually paid (swap waits per step)
+        cpu, nvme = offload_outs["offload_cpu"], offload_outs["offload_nvme"]
+        cpu_step_ms = 1e3 / max(cpu["pipelined_steps_per_sec"], 1e-9)
+        nvme_step_ms = 1e3 / max(nvme["pipelined_steps_per_sec"], 1e-9)
+        io_ms = nvme["swap_ms_per_step"]
+        # 1.5x slack on the measured IO: this box is 2 shared cores
+        within = bool(
+            nvme_step_ms <= cpu_step_ms + 1.5 * io_ms + 0.25 * cpu_step_ms)
+        print(json.dumps({
+            "leg": "offload_nvme_vs_cpu",
+            "cpu_step_ms": round(cpu_step_ms, 3),
+            "nvme_step_ms": round(nvme_step_ms, 3),
+            "nvme_io_ms_per_step": round(io_ms, 3),
+            "within_io_cost": within,
+        }), flush=True)
+        ok = ok and within
     if not ok:
         sys.exit(1)
 

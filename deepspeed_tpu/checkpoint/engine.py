@@ -98,22 +98,35 @@ class AsyncCheckpointEngine(CheckpointEngine):
 
 
 def _atomic_savez(path: str, state_dict: Dict[str, np.ndarray]) -> None:
-    """Write-then-rename so readers never observe a torn file."""
+    """Write-then-rename so readers never observe a torn file; a writer
+    exception (disk full, bad array) must never leave a ``.tmp`` behind —
+    a later save's rename would otherwise race a stale partial file."""
     tmp = path + ".tmp"
-    np.savez(tmp, **state_dict)
-    # np.savez appends .npz to names without it
-    if not tmp.endswith(".npz") and os.path.exists(tmp + ".npz"):
-        tmp = tmp + ".npz"
-    os.replace(tmp, path)
+    try:
+        np.savez(tmp, **state_dict)
+        # np.savez appends .npz to names without it
+        if not tmp.endswith(".npz") and os.path.exists(tmp + ".npz"):
+            tmp = tmp + ".npz"
+        os.replace(tmp, path)
+    finally:
+        for leftover in (tmp, tmp + ".npz"):
+            if os.path.exists(leftover):
+                try:
+                    os.remove(leftover)
+                except OSError:
+                    pass
 
 
 def build_checkpoint_engine(name: str, config_params: Optional[dict] = None
                             ) -> CheckpointEngine:
     """Parity: engine selection (TorchCheckpointEngine vs Nebula) from the
-    ``checkpoint`` config block."""
+    ``checkpoint`` config block (``{"checkpoint": {"engine": "async",
+    "writers": N}}`` in the JSON config reaches here through the training
+    engine's ``_checkpoint_engine``)."""
     key = (name or "native").lower()
     if key in ("native", "torch", "sync"):
         return NativeCheckpointEngine(config_params)
     if key in ("async", "nebula"):
-        return AsyncCheckpointEngine(config_params)
+        workers = int((config_params or {}).get("writers", 2) or 2)
+        return AsyncCheckpointEngine(config_params, max_workers=workers)
     raise ValueError(f"unknown checkpoint engine '{name}' (native|async)")

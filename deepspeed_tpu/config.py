@@ -235,6 +235,24 @@ class OffloadOptimizerConfig(ConfigModel):
     # staleness on the offloaded leaves) for step time ~= max(device, host)
     # instead of device + transfer + host.
     delayed_param_update: bool = False
+    # Three-stage group pipeline inside the host step (docs/TRAINING.md
+    # "Offloaded optimizer pipeline"): while group g runs its host kernel,
+    # group g+1's grad D2H is in flight and group g-1's updated master is
+    # already uploading/casting back. False restores the fully serial
+    # fetch-all / step-all / upload-all step (identical math — the bench's
+    # byte-equality baseline).
+    overlap_step: bool = True
+    # Worker threads for the host optimizer kernel (leaves are chunked and
+    # stepped concurrently; both the native OpenMP kernels via ctypes and
+    # numpy's vectorized inner loops release the GIL). 0 = auto
+    # (min(4, cpu_count())).
+    host_workers: int = 0
+    # Leaves per pipeline group. 0 = buffer_count (the same sub-group sizing
+    # the NVMe swapper uses, so grad fetches, kernel runs, and state swaps
+    # all move through the pipeline in lock-step groups).
+    group_size: int = 0
+
+    _aliases = {"delayed_update": "delayed_param_update"}
 
 
 @dataclass
@@ -586,6 +604,8 @@ class CheckpointConfig(ConfigModel):
     use_node_local_storage: bool = False
     parallel_write_pipeline: bool = False
     engine: str = "native"  # native | async
+    # writer threads for the async engine (ignored by the native engine)
+    writers: int = 2
 
 
 # --------------------------------------------------------------------------- #

@@ -5,7 +5,9 @@ loop's (``training.TrainPipelineStats``)."""
 from deepspeed_tpu.monitor.monitor import (CsvMonitor, Monitor, MonitorMaster,
                                            TensorBoardMonitor, WandbMonitor)
 from deepspeed_tpu.monitor.serving import PipelineStats
-from deepspeed_tpu.monitor.training import TrainPipelineStats
+from deepspeed_tpu.monitor.training import (OffloadPipelineStats,
+                                            TrainPipelineStats)
 
 __all__ = ["Monitor", "MonitorMaster", "TensorBoardMonitor", "WandbMonitor",
-           "CsvMonitor", "PipelineStats", "TrainPipelineStats"]
+           "CsvMonitor", "PipelineStats", "TrainPipelineStats",
+           "OffloadPipelineStats"]

@@ -178,6 +178,7 @@ class DeepSpeedTPUEngine:
         self._offload = None  # HostOffloadOptimizer, built in _init_state
         self._offload_pending = None   # in-flight delayed host update (DPU)
         self._offload_executor = None
+        self._offload_upload_pool = None   # upload lane worker (built lazily)
         if off is not None and getattr(off.device, "value", off.device) != "none":
             self._offload_cfg = off
             if self.zero_stage == 0:
@@ -223,9 +224,11 @@ class DeepSpeedTPUEngine:
         self._pending_metrics: deque = deque()
 
         # -- monitor (parity: MonitorMaster wiring, engine.py:249) ---------
-        from deepspeed_tpu.monitor import MonitorMaster, TrainPipelineStats
+        from deepspeed_tpu.monitor import (MonitorMaster, OffloadPipelineStats,
+                                           TrainPipelineStats)
         self.monitor = MonitorMaster(self.config)
         self.train_stats = TrainPipelineStats()
+        self.offload_stats = OffloadPipelineStats()
 
         # -- progressive layer drop (parity: engine hook :1812) ------------
         self.progressive_layer_drop = None
@@ -410,21 +413,27 @@ class DeepSpeedTPUEngine:
         self._param_template = jax.eval_shape(lambda t: t, model_parameters)
         flat_master_sh = flatten_tree(master_sh)
 
-        host_master = {k: np.asarray(fetch_to_host(flat[k]), np.float32)
-                       for k in host_names}
+        host_master = {k: np.asarray(v, np.float32) for k, v in
+                       fetch_to_host({k: flat[k] for k in host_names}).items()}
         self._offload = HostOffloadOptimizer(self.optimizer, host_master,
                                              self._offload_cfg)
-        # flat host-flow layout: grads leave the device as ONE contiguous
-        # array and the updated master returns as one array — per-leaf
-        # transfers pay a full link round trip EACH (measured 13 s/step at 50
-        # host leaves through the axon tunnel vs ~1 s for the same bytes flat)
-        offs, off = [], 0
-        for k in host_names:
-            n = int(np.prod(np.shape(flat[k])))
-            offs.append((k, off, n, np.shape(flat[k])))
-            off += n
-        self._offload_flat_meta = offs
-        self._offload_flat_size = off
+        # Grouped flat host-flow layout: grads leave the device as ONE
+        # contiguous array PER PIPELINE GROUP and each group's updated master
+        # returns as one array — per-leaf transfers pay a full link round
+        # trip EACH (measured 13 s/step at 50 host leaves through the axon
+        # tunnel vs ~1 s for the same bytes flat), while per-group arrays are
+        # what lets group g+1's D2H ride the link during group g's kernel.
+        # Groups are contiguous chunks of host_names, so the concatenation of
+        # all groups is the same byte layout the single-flat scheme used.
+        self._offload_groups = self._offload.leaf_groups()
+        self._offload_group_meta = []   # per group: [(name, off, n, shape)]
+        for names in self._offload_groups:
+            meta, off = [], 0
+            for k in names:
+                n = int(np.prod(np.shape(flat[k])))
+                meta.append((k, off, n, np.shape(flat[k])))
+                off += n
+            self._offload_group_meta.append(meta)
 
         dev_template = {k: jax.ShapeDtypeStruct(np.shape(flat[k]), jnp.float32)
                         for k in dev_names}
@@ -490,16 +499,17 @@ class DeepSpeedTPUEngine:
             lr = self._lr_fn(state["step"])
 
             dev_g = {k: flat_g[k] * cscale for k in dev_names}
-            # host-flow grads as ONE flat array in the COMPUTE dtype: a
-            # single d2h transfer at half width under bf16 — the reference's
-            # ZeRO-Offload ships fp16 grads to the CPU and updates in fp32
-            # there (zero/stage_1_and_2.py cpu_offload); the host kernels
-            # upcast to fp32 before stepping.
+            # host-flow grads as ONE flat array PER PIPELINE GROUP in the
+            # COMPUTE dtype: group transfers at half width under bf16 — the
+            # reference's ZeRO-Offload ships fp16 grads to the CPU and
+            # updates in fp32 there (zero/stage_1_and_2.py cpu_offload); the
+            # host kernels upcast to fp32 before stepping. Per-group arrays
+            # let the host drain group g while g+1's D2H is still in flight.
             wire = self.compute_dtype
-            host_g = (jnp.concatenate(
-                [(flat_g[k].reshape(-1) * cscale).astype(wire)
-                 for k in host_names])
-                if host_names else jnp.zeros((0,), wire))
+            host_g = tuple(
+                jnp.concatenate([(flat_g[k].reshape(-1) * cscale).astype(wire)
+                                 for k, _, _, _ in meta])
+                for meta in self._offload_group_meta)
 
             def do_update(operand):
                 master, opt = operand
@@ -567,25 +577,101 @@ class DeepSpeedTPUEngine:
             host_work, host_g, metrics)
         return metrics
 
-    def _offload_host_step(self, host_g_flat, metrics):
-        """Fetch the flat host-flow grads (one transfer), run the host
-        optimizer on per-leaf fp32 views, return the updated master as one
-        flat COMPUTE-dtype host array (one half-width upload at merge —
-        params are cast to the compute dtype there anyway)."""
-        host_np = np.asarray(fetch_to_host(host_g_flat), np.float32)
-        assert host_np.size == self._offload_flat_size, \
-            (host_np.size, self._offload_flat_size)
-        views = {k: host_np[off:off + n]
-                 for k, off, n, _ in self._offload_flat_meta}
-        updated = self._offload.step(views, float(metrics["lr"]))
-        return self._host_master_flat(updated)
+    def _offload_host_step(self, host_g_groups, metrics):
+        """Run the host optimizer for one step; returns the per-group updated
+        master arrays (tuple matching ``_offload_group_meta``) ready for
+        ``_offload_merge``.
 
-    def _host_master_flat(self, leaves: dict) -> np.ndarray:
+        Pipelined (``overlap_step``, the default): every group's grad D2H is
+        queued up front, then ``HostOffloadOptimizer.step_groups`` walks the
+        groups — group g's kernel runs while g+1's fetch is still on the link
+        and g-1's upload (concat + cast + async device_put) drains on a
+        dedicated worker thread, with the NVMe swapper double-buffering
+        underneath. Serial (``overlap_step: false`` — the pre-PR baseline):
+        one blocking drain of all groups, a serial kernel pass, uploads built
+        at the end. Identical math either way (the bench gates on it)."""
+        perf = time.perf_counter
+        lr = float(fetch_to_host(metrics["lr"]))
+        meta_groups = self._offload_group_meta
+        if not meta_groups:
+            return ()
+        stats = self.offload_stats
+
+        if not self._offload_cfg.overlap_step:
+            t0 = perf()
+            host_np = [np.asarray(g, np.float32)
+                       for g in fetch_to_host(host_g_groups)]
+            t1 = perf()
+            views = {k: host_np[gi][off:off + n]
+                     for gi, meta in enumerate(meta_groups)
+                     for k, off, n, _ in meta}
+            updated = self._offload.step(views, lr)
+            t2 = perf()
+            out = self._host_master_group_flats(updated)
+            t3 = perf()
+            stats.add("fetch", t1 - t0)
+            stats.add("kernel", t2 - t1)
+            stats.add("upload", t3 - t2)
+            stats.record_step(groups=len(meta_groups), depth_sum=0)
+            return out
+
+        # queue EVERY group's D2H now: the per-group drain below then blocks
+        # only on its own transfer, so group g+1's bytes ride the link while
+        # group g's kernel runs
+        for arr in host_g_groups:
+            start = getattr(arr, "copy_to_host_async", None)
+            if start is not None:
+                start()
+
         wire = np.dtype(self.compute_dtype)
-        return (np.concatenate([np.asarray(leaves[k], np.float32).reshape(-1)
-                                for k, _, _, _ in self._offload_flat_meta]
-                               ).astype(wire)
-                if self._offload_flat_meta else np.zeros((0,), wire))
+        repl = NamedSharding(self.topology.mesh, P())
+        uploads: list = [None] * len(meta_groups)
+        depth_box = {"sum": 0}
+
+        def grad_views_for(gi):
+            host_np = np.asarray(fetch_to_host(host_g_groups[gi]), np.float32)
+            return {k: host_np[off:off + n] for k, off, n, _ in meta_groups[gi]}
+
+        def upload_group(gi, masters):
+            t0 = perf()
+            flat = np.concatenate(
+                [np.asarray(masters[k], np.float32).reshape(-1)
+                 for k, _, _, _ in meta_groups[gi]]).astype(wire)
+            dev = jax.device_put(flat, repl)   # async H2D dispatch
+            stats.add("upload", perf() - t0)
+            return dev
+
+        def on_group_done(gi, masters):
+            depth_box["sum"] += sum(1 for f in uploads
+                                    if f is not None and not f.done())
+            uploads[gi] = self._offload_uploader().submit(
+                upload_group, gi, masters)
+
+        self._offload.step_groups(grad_views_for, lr,
+                                  on_group_done=on_group_done,
+                                  record=stats.add)
+        out = tuple(f.result() for f in uploads)
+        stats.record_step(groups=len(meta_groups), depth_sum=depth_box["sum"])
+        return out
+
+    def _offload_uploader(self):
+        """Single-worker executor for the upload lane (concat + cast + async
+        device_put of each finished group's master)."""
+        if self._offload_upload_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._offload_upload_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="dstpu-offload-upload")
+        return self._offload_upload_pool
+
+    def _host_master_group_flats(self, leaves: dict) -> tuple:
+        """Per-group flat COMPUTE-dtype host arrays of the given master
+        leaves — the host-side input shape ``_offload_merge`` takes (half
+        width under bf16; params are cast to the compute dtype there anyway)."""
+        wire = np.dtype(self.compute_dtype)
+        return tuple(
+            np.concatenate([np.asarray(leaves[k], np.float32).reshape(-1)
+                            for k, _, _, _ in meta]).astype(wire)
+            for meta in self._offload_group_meta)
 
     def _drain_offload(self):
         """Wait for an in-flight delayed host update and merge it into the
@@ -604,8 +690,9 @@ class DeepSpeedTPUEngine:
         fetched from device, host-flow leaves read from RAM/NVMe; flat keys make
         the layout identical to non-offload checkpoints."""
         self._drain_offload()   # a delayed (DPU) host step must land first
-        dev_master = {k: fetch_to_host(v)
-                      for k, v in self.state["master"].items()}
+        # ONE tree-level drain for the device-flow masters (a per-leaf
+        # comprehension here paid a full device round trip per leaf)
+        dev_master = fetch_to_host(self.state["master"])
         host_master, moments = self._offload.state_leaves()
         full_master = {**dev_master, **host_master}
         dev_opt = fetch_to_host(self.state["opt"])
@@ -666,7 +753,7 @@ class DeepSpeedTPUEngine:
             self._offload_train_merge_warmup()
         self.state["params"] = self._offload_merge(
             self.state["master"],
-            self._host_master_flat(self._offload.master_leaves()))
+            self._host_master_group_flats(self._offload.master_leaves()))
         client_path = os.path.join(ckpt_dir, ck.CLIENT_FILE)
         client_state = {}
         if os.path.exists(client_path):
@@ -679,15 +766,17 @@ class DeepSpeedTPUEngine:
         param_sh = self._state_shardings["params"]
         template = self._param_template
         dtype = self.compute_dtype
-        meta = self._offload_flat_meta
+        meta_groups = self._offload_group_meta
 
-        def merge(master_dev, host_flat):
-            # host master arrives as ONE flat array (single h2d transfer);
-            # static offsets split it back into leaves
+        def merge(master_dev, host_group_flats):
+            # host master arrives as one flat array PER GROUP (each already
+            # uploading while later groups still step); static offsets split
+            # them back into leaves
             flat = {k: v.astype(dtype) for k, v in master_dev.items()}
-            for k, off, n, shape in meta:
-                flat[k] = jax.lax.dynamic_slice_in_dim(
-                    host_flat, off, n).reshape(shape).astype(dtype)
+            for meta, gflat in zip(meta_groups, host_group_flats):
+                for k, off, n, shape in meta:
+                    flat[k] = jax.lax.dynamic_slice_in_dim(
+                        gflat, off, n).reshape(shape).astype(dtype)
             return unflatten_into(template, flat)
 
         self._offload_merge = jax.jit(merge, out_shardings=param_sh)
@@ -1145,6 +1234,9 @@ class DeepSpeedTPUEngine:
             self.monitor.write_events(events)
             if printing:
                 self.monitor.write_events(self.train_stats.events(samples))
+                if self._offload is not None and self.offload_stats.steps:
+                    self.monitor.write_events(
+                        self.offload_stats.events(samples))
         if printing:
             loss = float(vals["loss"]) if "loss" in vals else float("nan")
             lr = float(vals["lr"])
@@ -1281,7 +1373,8 @@ class DeepSpeedTPUEngine:
         if getattr(self, "_ckpt_engine", None) is None:
             from deepspeed_tpu.checkpoint.engine import build_checkpoint_engine
             self._ckpt_engine = build_checkpoint_engine(
-                self.config.checkpoint.engine)
+                self.config.checkpoint.engine,
+                config_params={"writers": self.config.checkpoint.writers})
         return self._ckpt_engine
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
@@ -1341,6 +1434,9 @@ class DeepSpeedTPUEngine:
             if self._offload_executor is not None:
                 self._offload_executor.shutdown(wait=True)
                 self._offload_executor = None
+            if self._offload_upload_pool is not None:
+                self._offload_upload_pool.shutdown(wait=True)
+                self._offload_upload_pool = None
             self._offload.close()
         if getattr(self, "_ckpt_engine", None) is not None:
             close = getattr(self._ckpt_engine, "close", None)
@@ -1419,7 +1515,7 @@ class DeepSpeedTPUEngine:
         train bench gates on it."""
         n = 0
         for fn in (self._fused_step, self._micro_step, self._apply_step,
-                   self._eval_step):
+                   self._eval_step, getattr(self, "_offload_merge", None)):
             size = getattr(fn, "_cache_size", None)
             if size is not None:
                 n += size()

@@ -77,23 +77,32 @@ class OptimizerStateSwapper:
 
     # -- sync swap --------------------------------------------------------- #
     def swap_in(self, names: Sequence[str]) -> Dict[str, np.ndarray]:
+        """A failed submit or read surfaces HERE (never swallowed), and the
+        failed call releases every buffer it claimed — ``pool.outstanding``
+        is back where it started after an aborted swap-in."""
         self._submit_reads(names)
         n = self.handle.wait()
         if n < 0:
+            self._release(names)
             raise OSError(-n, "swap-in read failed")
         return {name: self._views[name] for name in names}
 
     def swap_out(self, names: Optional[Sequence[str]] = None) -> None:
         names = list(self._views) if names is None else list(names)
-        self._submit_writes(names)
-        n = self.handle.wait()
-        if n < 0:
-            raise OSError(-n, "swap-out write failed")
-        self._release(names)
+        try:
+            self._submit_writes(names)
+            n = self.handle.wait()
+            if n < 0:
+                raise OSError(-n, "swap-out write failed")
+        finally:
+            # release even on failure: the swap files may be torn, but the
+            # pooled buffers must not leak (outstanding back to baseline)
+            self._release(names)
 
     # -- internals shared with the pipelined swapper ----------------------- #
     def _submit_reads(self, names: Sequence[str], handle=None) -> None:
         handle = handle or self.handle
+        submitted: List[str] = []
         for name in names:
             meta = self.meta[name]
             buf = self.pool.get(meta.nbytes)
@@ -102,8 +111,13 @@ class OptimizerStateSwapper:
             self._views[name] = view
             rc = handle.async_pread(view, meta.path)
             if rc != 0:
-                self._release([name])
+                # drain whatever this call already queued before releasing its
+                # buffers — in-flight reads must not land in recycled memory
+                if submitted:
+                    handle.wait()
+                self._release(submitted + [name])
                 raise OSError(-rc, f"swap-in submit failed for {meta.path}")
+            submitted.append(name)
 
     def _submit_writes(self, names: Sequence[str], handle=None) -> None:
         handle = handle or self.handle
@@ -111,6 +125,7 @@ class OptimizerStateSwapper:
             meta = self.meta[name]
             rc = handle.async_pwrite(self._views[name], meta.path)
             if rc != 0:
+                handle.wait()   # drain earlier submits; caller releases
                 raise OSError(-rc, f"swap-out submit failed for {meta.path}")
 
     def _release(self, names: Iterable[str]) -> None:
@@ -173,37 +188,59 @@ class PipelinedOptimizerSwapper(OptimizerStateSwapper):
         self._write_handle = AsyncIOHandle(**kw) if pipeline_write else self.handle
 
     def run(self, groups: Sequence[Sequence[str]], step_fn) -> None:
-        """``step_fn(group_views: Dict[str, np.ndarray])`` mutates views in place."""
+        """``step_fn(group_views: Dict[str, np.ndarray])`` mutates views in place.
+
+        Abort-safe: a failed ``async_pread``/``async_pwrite`` submit, a failed
+        wait, or an exception out of ``step_fn`` surfaces HERE — the overlap
+        machinery never swallows it — and the abort path drains every handle
+        and releases every pooled buffer, so ``pool.outstanding`` returns to
+        its pre-``run`` value."""
         groups = [list(g) for g in groups if g]
         if not groups:
             return
-        inflight_writes: List[str] = []
-        for i, group in enumerate(groups):
-            if any(name not in self._views for name in group):
-                self._read_group(group)  # not prefetched (first group / no pipeline)
-            if self.pipeline_read and i + 1 < len(groups):
-                self._prefetch_group(groups[i + 1])
-            step_fn({name: self._views[name] for name in group})
+        try:
+            inflight_writes: List[str] = []
+            for i, group in enumerate(groups):
+                if any(name not in self._views for name in group):
+                    self._read_group(group)  # not prefetched (first group / no pipeline)
+                if self.pipeline_read and i + 1 < len(groups):
+                    self._prefetch_group(groups[i + 1])
+                step_fn({name: self._views[name] for name in group})
+                if inflight_writes:
+                    n = self._write_handle.wait()
+                    if n < 0:
+                        raise OSError(-n, "pipelined swap-out failed")
+                    self._release(inflight_writes)
+                    inflight_writes = []
+                if self.pipeline_write:
+                    self._submit_writes(group, handle=self._write_handle)
+                    inflight_writes = list(group)
+                else:
+                    self._write_group_sync(group)
+                if self.pipeline_read and i + 1 < len(groups):
+                    n = self._read_handle.wait()
+                    if n < 0:
+                        raise OSError(-n, "pipelined swap-in failed")
             if inflight_writes:
                 n = self._write_handle.wait()
                 if n < 0:
                     raise OSError(-n, "pipelined swap-out failed")
                 self._release(inflight_writes)
-                inflight_writes = []
-            if self.pipeline_write:
-                self._submit_writes(group, handle=self._write_handle)
-                inflight_writes = list(group)
-            else:
-                self._write_group_sync(group)
-            if self.pipeline_read and i + 1 < len(groups):
-                n = self._read_handle.wait()
-                if n < 0:
-                    raise OSError(-n, "pipelined swap-in failed")
-        if inflight_writes:
-            n = self._write_handle.wait()
-            if n < 0:
-                raise OSError(-n, "pipelined swap-out failed")
-            self._release(inflight_writes)
+        except BaseException:
+            self._abort()
+            raise
+
+    def _abort(self) -> None:
+        """Drain in-flight IO on every handle and release every held buffer
+        (the views' swap files may be torn — the error already surfaced)."""
+        for handle in {id(h): h for h in
+                       (self.handle, self._read_handle, self._write_handle)
+                       }.values():
+            try:
+                handle.wait()
+            except Exception:  # the original error is what the caller sees
+                pass
+        self._release(list(self._views))
 
     # -- helpers ----------------------------------------------------------- #
     def _read_group(self, names: Sequence[str]) -> None:

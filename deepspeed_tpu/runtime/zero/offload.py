@@ -17,11 +17,28 @@ TPU-native layout:
 
 Leaves are addressed by '/'-joined path keys, the same scheme the checkpoint
 layer uses, so state round-trips through save/load unchanged.
+
+The steady-state step is a THREE-STAGE GROUP PIPELINE (docs/TRAINING.md
+"Offloaded optimizer pipeline"): host-flow leaves are chunked into groups
+(``leaf_groups()``, the same sub-group sizing the NVMe swapper uses) and
+``step_groups`` walks them so that while group *g* runs its host kernel,
+group *g+1*'s grad D2H fetch is in flight (the engine keeps every group's
+transfer queued) and group *g-1*'s updated master is already uploading — with
+``PipelinedOptimizerSwapper`` double-buffering the NVMe state reads/writes
+underneath, all four resources (device, D2H/H2D link, host CPU, disk)
+overlap. The host kernel itself fans leaf chunks across a small worker pool
+(``host_workers``): the native OpenMP kernels run under ctypes (GIL
+released) and numpy's vectorized inner loops release the GIL too, and every
+kernel is elementwise, so chunked execution is bit-identical to serial.
+This module is a jaxlint JL007 hot path: it never touches device arrays —
+the engine owns the single drain point — so every numpy conversion here
+carries an explicit dtype.
 """
 
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -59,6 +76,10 @@ def _host_kernel(optimizer) -> Tuple[str, Any]:
 _STATE_KEYS = {"adam": ("exp_avg", "exp_avg_sq"), "lion": ("exp_avg",),
                "adagrad": ("exp_avg_sq",)}
 
+#: leaves larger than this are split into contiguous chunks across the worker
+#: pool; the host kernels are elementwise, so chunking never changes a byte
+_CHUNK_ELEMS = 1 << 21
+
 
 class HostOffloadOptimizer:
     """Owns host-resident master fp32 + optimizer moments for a subset of leaves.
@@ -76,6 +97,18 @@ class HostOffloadOptimizer:
         self._names: List[str] = list(master_leaves)
         self._shapes = {k: v.shape for k, v in master_leaves.items()}
         self.swapper: Optional[PipelinedOptimizerSwapper] = None
+        # pipeline groups: buffer_count leaves per group unless group_size
+        # overrides — the SAME chunks _nvme_groups expands into swap names,
+        # so grad fetch, kernel, and state swap move in lock-step
+        per_group = max(1, int(getattr(offload_cfg, "group_size", 0)
+                               or offload_cfg.buffer_count))
+        self._groups: List[List[str]] = [
+            self._names[i:i + per_group]
+            for i in range(0, len(self._names), per_group)]
+        workers = int(getattr(offload_cfg, "host_workers", 0)) \
+            or min(4, os.cpu_count() or 1)
+        self._workers = max(1, workers)
+        self._kernel_pool = None   # lazy ThreadPoolExecutor
 
         state_keys = _STATE_KEYS[self.kind]
         if not self.nvme:
@@ -109,7 +142,11 @@ class HostOffloadOptimizer:
 
     def step(self, grads: Dict[str, np.ndarray], lr: float,
              grad_scale: float = 1.0) -> Dict[str, np.ndarray]:
-        """In-place optimizer step on host leaves; returns updated master views.
+        """SERIAL in-place optimizer step on host leaves; returns updated
+        master views. This is the pre-pipeline baseline path
+        (``overlap_step: false``): every leaf steps on the caller's thread,
+        one after another. ``step_groups`` runs the identical math through
+        the overlapped group pipeline.
 
         ``grad_scale`` folds gradient clipping (and any loss-scale remainder)
         into the host step without an extra pass.
@@ -139,19 +176,140 @@ class HostOffloadOptimizer:
             for name in {n.split("/", 1)[1] for n in views}:
                 p = views[f"master/{name}"]
                 step_leaf(name, p, [views[f"{sk}/{name}"] for sk in state_keys])
-                updated[name] = np.array(p)  # copy out before buffer reuse
+                updated[name] = np.array(p, np.float32)  # copy before buffer reuse
 
         self.swapper.run(groups, group_step)
         return updated
 
-    def _nvme_groups(self) -> List[List[str]]:
-        """Sub-groups of swap names, ``buffer_count`` leaves per group
-        (parity: stage3 sub_group_size slicing for the optimizer swapper)."""
+    # -- the pipelined step ------------------------------------------------ #
+
+    def leaf_groups(self) -> List[List[str]]:
+        """The pipeline's leaf-group partition (host-flow names, in step
+        order). The engine derives its per-group flat grad layout from this,
+        and ``_nvme_groups`` expands the SAME chunks into swap names."""
+        return [list(g) for g in self._groups]
+
+    def _pool(self):
+        if self._kernel_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._kernel_pool = ThreadPoolExecutor(
+                max_workers=self._workers, thread_name_prefix="dstpu-hostopt")
+        return self._kernel_pool
+
+    def _leaf_tasks(self, p: np.ndarray, g: np.ndarray,
+                    moments: Sequence[np.ndarray], lr: float):
+        """Zero-arg callables stepping contiguous chunks of one flat leaf.
+        The kernels are elementwise, so chunk boundaries never change a
+        byte vs the serial step."""
+        step_num = self.step_num
+        n = p.size
+        if n <= _CHUNK_ELEMS or self._workers <= 1:
+            yield lambda: self.kernel.step(step_num, p, g, *moments, lr=lr)
+            return
+        for lo in range(0, n, _CHUNK_ELEMS):
+            hi = min(n, lo + _CHUNK_ELEMS)
+            yield (lambda lo=lo, hi=hi:
+                   self.kernel.step(step_num, p[lo:hi], g[lo:hi],
+                                    *[m[lo:hi] for m in moments], lr=lr))
+
+    def _run_group_kernel(self, items, lr: float) -> None:
+        """Step every leaf of one group; ``items`` is a list of
+        ``(p_flat, g_flat, moment_flats)``. Chunks fan across the worker
+        pool (ctypes/OpenMP and numpy inner loops both release the GIL)."""
+        tasks = [t for p, g, ms in items for t in self._leaf_tasks(p, g, ms, lr)]
+        if self._workers <= 1 or len(tasks) <= 1:
+            for t in tasks:
+                t()
+            return
+        futs = [self._pool().submit(t) for t in tasks]
+        for f in futs:
+            f.result()
+
+    def step_groups(self, grad_views_for: Callable[[int], Dict[str, np.ndarray]],
+                    lr: float, grad_scale: float = 1.0,
+                    on_group_done: Optional[Callable] = None,
+                    record: Optional[Callable] = None) -> None:
+        """Pipelined host step over ``leaf_groups()``.
+
+        ``grad_views_for(g)`` returns ``{leaf name: fp32 1-D grad}`` for
+        group *g*, blocking only until THAT group's grads are host-resident
+        (the engine keeps every group's D2H queued, so group g+1's fetch is
+        in flight while group g's kernel runs). ``on_group_done(g, masters)``
+        fires the moment group *g*'s update lands; ``masters`` maps leaf name
+        -> fp32 array safe to hand to the upload thread (RAM mode: the stable
+        master storage; NVMe mode: a copy made before the pooled swap buffer
+        is recycled). ``record(phase, seconds)`` accumulates 'fetch' /
+        'kernel' / 'swap' phase timings.
+
+        Identical math to :meth:`step` — the kernels are elementwise and the
+        group/chunk walk covers the same leaves with the same ``step_num``.
+        """
+        perf = time.perf_counter
+        rec = record if record is not None else (lambda phase, s: None)
+        done = on_group_done if on_group_done is not None else (lambda g, m: None)
+        if not self._groups:
+            return
+        self.step_num += 1
         state_keys = _STATE_KEYS[self.kind]
-        per_group = max(1, self.cfg.buffer_count)
+
+        def leaf_item(p, moments, g):
+            g = np.ascontiguousarray(g.reshape(-1), np.float32)
+            if grad_scale != 1.0:
+                g = g * np.float32(grad_scale)
+            return (p.reshape(-1), g, [m.reshape(-1) for m in moments])
+
+        if not self.nvme:
+            for gi, names in enumerate(self._groups):
+                t0 = perf()
+                grads = grad_views_for(gi)
+                t1 = perf()
+                self._run_group_kernel(
+                    [leaf_item(self.master[n],
+                               [self.moments[sk][n] for sk in state_keys],
+                               grads[n]) for n in names], lr)
+                t2 = perf()
+                rec("fetch", t1 - t0)
+                rec("kernel", t2 - t1)
+                done(gi, {n: self.master[n] for n in names})
+            return
+
+        # NVMe: the double-buffered state swapper composes underneath — its
+        # sub-groups are the SAME leaf groups, so while group g's kernel
+        # runs, g+1's state read AND grad D2H are both in flight and g-1's
+        # state write drains on the third AIO handle.
+        counter = {"g": 0, "inside": 0.0}
+        t_run0 = perf()
+
+        def step_fn(views: Dict[str, np.ndarray]):
+            gi = counter["g"]
+            counter["g"] += 1
+            names = self._groups[gi]
+            t0 = perf()
+            grads = grad_views_for(gi)
+            t1 = perf()
+            self._run_group_kernel(
+                [leaf_item(views[f"master/{n}"],
+                           [views[f"{sk}/{n}"] for sk in state_keys],
+                           grads[n]) for n in names], lr)
+            # copy out before the pooled swap buffer is reused downstream
+            masters = {n: np.array(views[f"master/{n}"], np.float32)
+                       for n in names}
+            t2 = perf()
+            rec("fetch", t1 - t0)
+            rec("kernel", t2 - t1)
+            counter["inside"] += t2 - t0
+            done(gi, masters)
+
+        self.swapper.run(self._nvme_groups(), step_fn)
+        rec("swap", (perf() - t_run0) - counter["inside"])
+
+    def _nvme_groups(self) -> List[List[str]]:
+        """Sub-groups of swap names — the pipeline's ``leaf_groups()``
+        expanded to master+moment keys (parity: stage3 sub_group_size
+        slicing for the optimizer swapper)."""
+        state_keys = _STATE_KEYS[self.kind]
         groups = []
-        for i in range(0, len(self._names), per_group):
-            chunk = self._names[i:i + per_group]
+        for chunk in self._groups:
             group = []
             for name in chunk:
                 group.append(f"master/{name}")
@@ -208,6 +366,9 @@ class HostOffloadOptimizer:
             self.step_num = int(step_num)
 
     def close(self):
+        if self._kernel_pool is not None:
+            self._kernel_pool.shutdown(wait=True)
+            self._kernel_pool = None
         if self.swapper is not None:
             self.swapper.close()
 

@@ -18,4 +18,8 @@ export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 timeout -k 10 300 python benchmarks/serving_bench.py --steady-state \
     --seqs 4 --prompt 16 --gen 24 || exit 1
 
-timeout -k 10 300 python benchmarks/train_bench.py --smoke
+timeout -k 10 300 python benchmarks/train_bench.py --smoke || exit 1
+
+# offloaded-optimizer pipeline leg: serial vs overlapped host step through
+# the same engine, gating byte-identical loss streams + zero warm compiles
+timeout -k 10 300 python benchmarks/train_bench.py --smoke --offload

@@ -549,6 +549,50 @@ def test_jl007_shipped_config_covers_training_engine():
     hot = raw["rules"]["JL007"]["options"]["hot_paths"]
     assert "deepspeed_tpu/runtime/engine.py" in hot
     assert any("inference/v2" in p for p in hot)
+    # the offloaded optimizer pipeline is a hot path too: a stray blocking
+    # fetch there re-serialises the fetch/step/upload overlap
+    assert "deepspeed_tpu/runtime/zero/offload.py" in hot
+
+
+def test_jl007_offload_module_fetch_flagged():
+    # a dtype-less np.array/np.asarray in the offload hot path (e.g. the
+    # swap-buffer copy-out) must fire under the SHIPPED hot_paths
+    raw = _repo_config()
+    cfg = LintConfig(rules={"JL007": RuleSettings(
+        options=raw["rules"]["JL007"]["options"])})
+    src = textwrap.dedent("""
+        import numpy as np
+
+        def group_step(views, updated, name):
+            updated[name] = np.array(views[name])
+    """)
+    findings = lint_text(src, path="deepspeed_tpu/runtime/zero/offload.py",
+                         config=cfg)
+    assert rules_of(findings) == ["JL007"]
+
+
+def test_jl007_offload_module_discipline_clean():
+    # the module's actual discipline: host-only numpy with explicit dtypes
+    # (the engine owns the single drain point; offload.py never sees a
+    # device array)
+    raw = _repo_config()
+    cfg = LintConfig(rules={"JL007": RuleSettings(
+        options=raw["rules"]["JL007"]["options"])})
+    src = textwrap.dedent("""
+        import numpy as np
+
+        def step_leaf(grads, name, grad_scale):
+            g = np.ascontiguousarray(grads[name].reshape(-1), np.float32)
+            if grad_scale != 1.0:
+                g = g * np.float32(grad_scale)
+            return g
+
+        def copy_out(views, name):
+            return np.array(views[name], np.float32)
+    """)
+    findings = lint_text(src, path="deepspeed_tpu/runtime/zero/offload.py",
+                         config=cfg)
+    assert findings == []
 
 
 def test_jl007_training_engine_path_flagged():

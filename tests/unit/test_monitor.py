@@ -81,3 +81,52 @@ def test_engine_writes_monitor_events(tmp_path):
     with open(loss_file) as f:
         rows = list(csv.reader(f))
     assert len(rows) == 3  # flush materialised step 2 as well
+
+
+def test_offload_pipeline_stats_counters_and_events():
+    from deepspeed_tpu.monitor import OffloadPipelineStats
+
+    st = OffloadPipelineStats()
+    # the add() phase contract shared with HostOffloadOptimizer.step_groups
+    st.add("fetch", 0.001)
+    st.add("kernel", 0.004)
+    st.add("upload", 0.002)
+    st.add("swap", 0.010)
+    st.record_step(groups=2, depth_sum=1)
+    st.add("kernel", 0.004)
+    st.record_step(groups=2)
+    assert st.steps == 2 and st.groups == 4
+    assert st.kernel_ms == pytest.approx(8.0)
+    ev = dict((name, val) for name, val, _ in st.events(16))
+    assert ev["train/offload/kernel_ms_per_group"] == pytest.approx(2.0)
+    assert ev["train/offload/swap_ms_per_step"] == pytest.approx(5.0)
+    assert ev["train/offload/groups_per_step"] == pytest.approx(2.0)
+    with pytest.raises(KeyError):
+        st.add("bogus_phase", 0.1)   # typos must not accumulate silently
+    st.reset()
+    assert st.steps == 0 and st.kernel_ms == 0.0 and st.upload_depth_sum == 0
+
+
+def test_engine_emits_offload_events_at_print_boundary(tmp_path):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+    model = GPT2LMHead(GPT2Config.tiny())
+    cfg = {"train_batch_size": 8, "steps_per_print": 1,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 1,
+                                 "offload_optimizer": {"device": "cpu"}},
+           "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                           "job_name": "off_job"}}
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+    batch = {"input_ids": np.zeros((8, 16), np.int32)}
+    engine.train_batch(batch)
+    engine.train_batch(batch)
+    engine.drain_metrics()
+    kernel_file = os.path.join(str(tmp_path), "off_job",
+                               "train_offload_kernel_ms_per_group.csv")
+    assert os.path.exists(kernel_file)
+    with open(kernel_file) as f:
+        rows = list(csv.reader(f))
+    assert len(rows) >= 2   # header + at least one printed boundary
+    engine.destroy()

@@ -18,6 +18,19 @@ from deepspeed_tpu.runtime.swap_tensor import (OptimizerStateSwapper,
                                                SwapBufferPool)
 
 
+def _host_offload(leaves, **cfg_kw):
+    """A HostOffloadOptimizer over the given fp32 leaves (cpu mode unless
+    device= says otherwise)."""
+    from deepspeed_tpu.config import OffloadDeviceEnum, OffloadOptimizerConfig
+    from deepspeed_tpu.ops.adam import FusedAdam
+    from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+    cfg_kw.setdefault("device", OffloadDeviceEnum.cpu)
+    cfg = OffloadOptimizerConfig(**cfg_kw)
+    return HostOffloadOptimizer(FusedAdam(lr=1e-2, weight_decay=0.01),
+                                {k: np.asarray(v, np.float32)
+                                 for k, v in leaves.items()}, cfg)
+
+
 # --------------------------------------------------------------------------- #
 # swapper units
 # --------------------------------------------------------------------------- #
@@ -72,6 +85,153 @@ def test_pipelined_swapper_groups(tmp_path, pipeline):
     for i in range(6):
         np.testing.assert_allclose(final[f"t{i}"], arrays[f"t{i}"] + 10.0)
     sw.close()
+
+
+# --------------------------------------------------------------------------- #
+# swapper failure paths: errors surface, buffers return to the pool
+# --------------------------------------------------------------------------- #
+
+def _registered_pipelined(tmp_path, n=6, **kw):
+    kw.setdefault("pipeline_read", True)
+    kw.setdefault("pipeline_write", True)
+    sw = PipelinedOptimizerSwapper(str(tmp_path / "swap"), **kw)
+    for i in range(n):
+        sw.register(f"t{i}", np.full(100 + i, float(i), np.float32))
+    return sw, [[f"t{2 * i}", f"t{2 * i + 1}"] for i in range(n // 2)]
+
+
+def test_swap_in_submit_failure_releases_buffers(tmp_path):
+    sw = OptimizerStateSwapper(str(tmp_path / "swap"))
+    sw.register("a", np.zeros(64, np.float32))
+    sw.register("b", np.zeros(64, np.float32))
+    calls = {"n": 0}
+
+    def failing_pread(view, path):
+        calls["n"] += 1
+        return 0 if calls["n"] == 1 else -5   # second submit fails
+
+    sw.handle.async_pread = failing_pread
+    with pytest.raises(OSError):
+        sw.swap_in(["a", "b"])
+    # the first submit's buffer (and the failed one's) went back to the pool
+    assert sw.pool.outstanding == 0 and not sw._views
+    sw.close()
+
+
+def test_swap_in_wait_failure_releases_buffers(tmp_path):
+    sw = OptimizerStateSwapper(str(tmp_path / "swap"))
+    sw.register("a", np.zeros(64, np.float32))
+    sw.handle.wait = lambda: -9
+    with pytest.raises(OSError):
+        sw.swap_in(["a"])
+    assert sw.pool.outstanding == 0
+    sw.close()
+
+
+def test_pipelined_run_read_failure_surfaces(tmp_path):
+    sw, groups = _registered_pipelined(tmp_path)
+    sw._read_handle.async_pread = lambda view, path: -5
+    with pytest.raises(OSError):
+        sw.run(groups, lambda views: None)
+    assert sw.pool.outstanding == 0 and not sw._views
+    sw.close()
+
+
+def test_pipelined_run_write_failure_surfaces(tmp_path):
+    sw, groups = _registered_pipelined(tmp_path)
+    sw._write_handle.async_pwrite = lambda view, path: -7
+    stepped = []
+    with pytest.raises(OSError):
+        sw.run(groups, lambda views: stepped.append(sorted(views)))
+    assert stepped  # the failure came from the write stage, after a step
+    assert sw.pool.outstanding == 0 and not sw._views
+    sw.close()
+
+
+def test_pipelined_run_stepfn_abort_returns_buffers(tmp_path):
+    # an exception out of step_fn mid-pipeline (with group g+1's reads
+    # already in flight and g-1's writes draining) must propagate AND leave
+    # the pool at zero outstanding
+    sw, groups = _registered_pipelined(tmp_path)
+    count = {"n": 0}
+
+    def step(views):
+        count["n"] += 1
+        if count["n"] == 2:
+            raise RuntimeError("boom mid-pipeline")
+        for v in views.values():
+            v += 1.0
+
+    with pytest.raises(RuntimeError, match="boom"):
+        sw.run(groups, step)
+    assert sw.pool.outstanding == 0 and not sw._views
+    # the swapper is reusable after the abort
+    seen = []
+    sw.run(groups, lambda views: seen.extend(sorted(views)))
+    assert seen == [n for g in groups for n in g]
+    assert sw.pool.outstanding == 0
+    sw.close()
+
+
+# --------------------------------------------------------------------------- #
+# pipelined host step: grouping, chunked kernel, byte equality
+# --------------------------------------------------------------------------- #
+
+def test_leaf_groups_sizing_and_nvme_expansion():
+    leaves = {f"l{i}": np.zeros(37 + i, np.float32) for i in range(5)}
+    off = _host_offload(leaves, group_size=2)
+    groups = off.leaf_groups()
+    assert [len(g) for g in groups] == [2, 2, 1]
+    assert [n for g in groups for n in g] == list(leaves)
+    # _nvme_groups expands the SAME chunks into master+moment swap names
+    swap_groups = off._nvme_groups()
+    assert [len(g) for g in swap_groups] == [6, 6, 3]   # adam: 3 names/leaf
+    assert swap_groups[0][:3] == ["master/l0", "exp_avg/l0", "exp_avg_sq/l0"]
+    off.close()
+    # group_size=0 falls back to buffer_count (the NVMe sub-group sizing)
+    off2 = _host_offload(leaves, buffer_count=3)
+    assert [len(g) for g in off2.leaf_groups()] == [3, 2]
+    off2.close()
+
+
+def test_step_groups_matches_serial_step_bytes(monkeypatch):
+    """The pipelined walk (worker pool + forced leaf chunking) must be
+    bit-identical to the serial ``step`` — the kernels are elementwise."""
+    from deepspeed_tpu.runtime.zero import offload as off_mod
+    rng = np.random.default_rng(1)
+    leaves = {f"l{i}": rng.standard_normal(137 + 31 * i).astype(np.float32)
+              for i in range(5)}
+    a = _host_offload(leaves)                          # serial baseline
+    b = _host_offload(leaves, host_workers=3, group_size=2)
+    monkeypatch.setattr(off_mod, "_CHUNK_ELEMS", 32)   # force many chunks
+    phases = []
+    for step in range(3):
+        g = {k: (rng.standard_normal(v.shape) * 0.1).astype(np.float32)
+             for k, v in leaves.items()}
+        a.step({k: v.copy() for k, v in g.items()}, lr=1e-2)
+        done = {}
+        b.step_groups(
+            lambda gi: {k: g[k].copy() for k in b.leaf_groups()[gi]},
+            lr=1e-2,
+            on_group_done=lambda gi, m: done.update(m),
+            record=lambda phase, s: phases.append(phase))
+        assert set(done) == set(leaves)   # every leaf reported upstream
+    assert a.step_num == b.step_num == 3
+    for k in leaves:
+        np.testing.assert_array_equal(a.master[k], b.master[k])
+        for sk in ("exp_avg", "exp_avg_sq"):
+            np.testing.assert_array_equal(a.moments[sk][k], b.moments[sk][k])
+    assert "fetch" in phases and "kernel" in phases
+    a.close()
+    b.close()
+    assert b._kernel_pool is None   # close() tears the worker pool down
+
+
+def test_delayed_update_config_alias():
+    from deepspeed_tpu.config import DeepSpeedTPUConfig
+    c = DeepSpeedTPUConfig.load({"zero_optimization": {"offload_optimizer": {
+        "device": "cpu", "delayed_update": True}}})
+    assert c.zero_optimization.offload_optimizer.delayed_param_update
 
 
 # --------------------------------------------------------------------------- #
@@ -192,6 +352,97 @@ def test_offload_checkpoint_interchange(tmp_path):
     l3 = [float(eng_off2.train_batch(b)) for b in batches[3:]]
     l_plain2 = [float(eng_plain.train_batch(b)) for b in batches[3:]]
     np.testing.assert_allclose(l3, l_plain2, rtol=2e-3, atol=2e-3)
+
+
+def test_overlap_step_matches_serial_engine_bytes(tmp_path):
+    """The SAME device program runs under both orchestrations (overlap_step
+    is host-side only), and the host kernels are elementwise — so the loss
+    stream and the final masters must be byte-identical between the pre-PR
+    serial step, the cpu pipeline, and the nvme pipeline."""
+    model, batches = _model_and_batches()
+    eng_s, l_s = _run(model, batches, _config(offload={
+        "device": "cpu", "overlap_step": False, "buffer_count": 3}))
+    eng_p, l_p = _run(model, batches, _config(offload={
+        "device": "cpu", "buffer_count": 3}))
+    eng_n, l_n = _run(model, batches, _config(offload={
+        "device": "nvme", "nvme_path": str(tmp_path), "buffer_count": 3,
+        "pipeline_read": True, "pipeline_write": True}))
+    assert l_s == l_p == l_n
+    m_s, _ = eng_s._offload.state_leaves()
+    m_p, _ = eng_p._offload.state_leaves()
+    m_n, _ = eng_n._offload.state_leaves()
+    for k in m_s:
+        np.testing.assert_array_equal(m_s[k], m_p[k])
+        np.testing.assert_array_equal(m_s[k], m_n[k])
+    for e in (eng_s, eng_p, eng_n):
+        e.destroy()
+
+
+def test_offload_engine_groups_align_with_optimizer():
+    model, batches = _model_and_batches(steps=1)
+    eng, _ = _run(model, batches, _config(offload={"device": "cpu",
+                                                   "group_size": 4}))
+    assert eng._offload_groups == eng._offload.leaf_groups()
+    assert len(eng._offload_group_meta) == len(eng._offload_groups)
+    for names, meta in zip(eng._offload_groups, eng._offload_group_meta):
+        assert [m[0] for m in meta] == names
+        off = 0
+        for _, o, n, shape in meta:   # offsets tile the group flat exactly
+            assert o == off and n == int(np.prod(shape))
+            off += n
+    eng.destroy()
+
+
+def test_offload_ckpt_state_batches_drains(monkeypatch):
+    """Regression: the checkpoint view used one fetch_to_host PER LEAF for
+    the device-flow masters (a full link round trip each); it must be a
+    bounded number of tree-level drains."""
+    model, batches = _model_and_batches(steps=2)
+    eng, _ = _run(model, batches,
+                  _config(offload={"device": "cpu", "ratio": 0.5}))
+    assert len(eng._offload_dev_names) > 2   # per-leaf would exceed the bound
+    import deepspeed_tpu.runtime.engine as engine_mod
+    real = engine_mod.fetch_to_host
+    calls = []
+
+    def counting(tree):
+        calls.append(tree)
+        return real(tree)
+
+    monkeypatch.setattr(engine_mod, "fetch_to_host", counting)
+    st = eng._offload_ckpt_state()
+    assert set(st["master"]) == set(eng._offload_dev_names) | \
+        set(eng._offload_host_names)
+    assert len(calls) <= 2   # one for the master dict, one for the opt tree
+    eng.destroy()
+
+
+def test_offload_pipeline_stats_recorded():
+    from deepspeed_tpu.monitor import OffloadPipelineStats
+    model, batches = _model_and_batches(steps=3)
+    eng, _ = _run(model, batches, _config(offload={"device": "cpu",
+                                                   "buffer_count": 3}))
+    st = eng.offload_stats
+    assert isinstance(st, OffloadPipelineStats)
+    n_groups = len(eng._offload_groups)
+    assert st.steps == len(batches)
+    assert st.groups == st.steps * n_groups
+    assert st.kernel_ms > 0.0
+    names = [e[0] for e in st.events(0)]
+    assert "train/offload/kernel_ms_per_group" in names
+    assert "train/offload/swap_ms_per_step" in names
+    st.reset()
+    assert st.steps == 0 and st.kernel_ms == 0.0
+    eng.destroy()
+
+
+def test_offload_worker_pools_torn_down_on_destroy():
+    model, batches = _model_and_batches(steps=2)
+    eng, _ = _run(model, batches, _config(offload={"device": "cpu"}))
+    off = eng._offload
+    eng.destroy()
+    assert eng._offload_upload_pool is None
+    assert off._kernel_pool is None
 
 
 def test_offload_rejects_unsupported_optimizer():
