@@ -69,6 +69,11 @@ def initialize(args=None,
     # import + config validation first: no side effects (init_distributed) before
     # anything that can raise
     from deepspeed_tpu.runtime.engine import DeepSpeedTPUEngine
+    from deepspeed_tpu.utils import fault_injection
+
+    # arm the deterministic fault plan, if any (no-op unless $DSTPU_FAULTS is
+    # set) — the kill-and-resume bench drives subprocess workers through this
+    fault_injection.install_from_env()
 
     config = DeepSpeedTPUConfig.load(config if config is not None else config_params)
     comm.init_distributed()

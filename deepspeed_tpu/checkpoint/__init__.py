@@ -1,12 +1,17 @@
 """Checkpointing (parity: reference ``deepspeed/checkpoint/`` + engine save/load)."""
 
 from deepspeed_tpu.checkpoint.state import (
+    CheckpointCorrupt,
     save_engine_checkpoint,
     load_engine_checkpoint,
     read_latest_tag,
+    find_resume_tag,
+    resolve_load_tag,
+    tag_problem,
     flatten_tree,
     unflatten_into,
 )
+from deepspeed_tpu.checkpoint.rolling import RollingCheckpointer
 from deepspeed_tpu.checkpoint.engine import (
     CheckpointEngine,
     NativeCheckpointEngine,

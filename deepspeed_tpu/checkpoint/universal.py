@@ -32,7 +32,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from deepspeed_tpu.checkpoint.state import (CLIENT_FILE, MODEL_FILE, OPTIM_FILE,
-                                            read_latest_tag)
+                                            resolve_load_tag)
 from deepspeed_tpu.utils.logging import log_dist
 
 META_FILE = "universal_meta.json"
@@ -52,9 +52,11 @@ def ds_to_universal(ckpt_dir: str, out_dir: str, tag: Optional[str] = None) -> s
     Parity: ``ds_to_universal.py main()`` — but single-pass, since shards are
     already merged in our layout.
     """
-    tag = tag or read_latest_tag(ckpt_dir)
-    if tag is None:
-        raise FileNotFoundError(f"no 'latest' in {ckpt_dir}; pass tag")
+    # same torn-checkpoint discipline as every load path: tag=None resolves
+    # to the newest COMPLETE tag (a `latest` left pointing at a mid-write
+    # casualty falls back instead of crashing inside np.load), an explicit
+    # torn tag raises CheckpointCorrupt with the reason
+    tag = resolve_load_tag(ckpt_dir, tag)
     src = os.path.join(ckpt_dir, tag)
     model = dict(np.load(os.path.join(src, MODEL_FILE)))
     optim = dict(np.load(os.path.join(src, OPTIM_FILE)))

@@ -251,6 +251,12 @@ class OffloadOptimizerConfig(ConfigModel):
     # the NVMe swapper uses, so grad fetches, kernel runs, and state swaps
     # all move through the pipeline in lock-step groups).
     group_size: int = 0
+    # NVMe IO failure discipline (docs/ELASTICITY.md): bounded retries per
+    # failed read/write (then the error SURFACES at the step), and a deadline
+    # on AIO waits (0 = no deadline) so a dead disk hangs the step with a
+    # clean IOTimeout instead of forever.
+    io_retries: int = 2
+    io_timeout_s: float = 0.0
 
     _aliases = {"delayed_update": "delayed_param_update"}
 
@@ -595,6 +601,32 @@ class TrainPipelineConfig(ConfigModel):
 
 
 @dataclass
+class RollingCheckpointConfig(ConfigModel):
+    """Continuous rolling checkpoints on a step cadence (the spot/preemptible
+    resume story, docs/ELASTICITY.md). No direct reference analog — the
+    reference leaves the save cadence to user training loops; here the engine
+    owns it so the cadence interleaves correctly with the async step loop
+    (metric drain) and the offload pipeline (upload-lane quiesce)."""
+
+    # save every N global steps through the configured checkpoint engine
+    # (0 = disabled). Pair with ``engine: "async"`` so only the device
+    # snapshot runs on the step loop's critical path.
+    every_n_steps: int = 0
+    # retention: newest K rolling tags survive pruning (the tag ``latest``
+    # points at is never pruned)
+    keep_last: int = 2
+    # where the rolling tags live; REQUIRED when every_n_steps > 0
+    save_dir: str = ""
+    # bounded writer lag/backpressure: at most this many snapshots may be
+    # queued-but-uncommitted before the NEXT save blocks until the oldest
+    # commit lands — the queue can never grow without bound when the disk
+    # is slower than the cadence
+    max_pending: int = 1
+    # tag names: f"{tag_prefix}{global_step}"
+    tag_prefix: str = "rolling_step"
+
+
+@dataclass
 class CheckpointConfig(ConfigModel):
     """Parity: ``checkpoint`` block (``runtime/config.py`` checkpoint section) +
     checkpoint-engine choice (``runtime/checkpoint_engine/``)."""
@@ -606,6 +638,15 @@ class CheckpointConfig(ConfigModel):
     engine: str = "native"  # native | async
     # writer threads for the async engine (ignored by the native engine)
     writers: int = 2
+    # bounded retry budget per checkpoint file write (transient IO failures
+    # recover; the budget exhausting surfaces the error at commit)
+    writer_retries: int = 2
+    writer_backoff_s: float = 0.05
+    # checksum shards against the tag's manifest on every load (the
+    # ``verify=True`` path; per-call override via load_checkpoint(verify=))
+    verify_load: bool = False
+    rolling: RollingCheckpointConfig = field(
+        default_factory=RollingCheckpointConfig)
 
 
 # --------------------------------------------------------------------------- #

@@ -5,9 +5,10 @@ loop's (``training.TrainPipelineStats``)."""
 from deepspeed_tpu.monitor.monitor import (CsvMonitor, Monitor, MonitorMaster,
                                            TensorBoardMonitor, WandbMonitor)
 from deepspeed_tpu.monitor.serving import PipelineStats
-from deepspeed_tpu.monitor.training import (OffloadPipelineStats,
+from deepspeed_tpu.monitor.training import (CheckpointStats,
+                                            OffloadPipelineStats,
                                             TrainPipelineStats)
 
 __all__ = ["Monitor", "MonitorMaster", "TensorBoardMonitor", "WandbMonitor",
            "CsvMonitor", "PipelineStats", "TrainPipelineStats",
-           "OffloadPipelineStats"]
+           "OffloadPipelineStats", "CheckpointStats"]

@@ -104,6 +104,73 @@ class TrainPipelineStats:
 
 
 @dataclass
+class CheckpointStats:
+    """Rolling-checkpoint observability (``checkpoint/rolling.py`` +
+    ``save_checkpoint``; emitted at print boundaries beside
+    TrainPipelineStats as ``train/ckpt/*``).
+
+    Phase semantics (per save):
+
+    - ``snapshot``: device->host materialisation of the state flats — the
+      ONLY phase on the step loop's critical path when the async engine
+      writes. Growing snapshot time means the state grew or the transfer
+      link is contended, not that the disk is slow.
+    - ``commit``: writer drain + manifest + ``latest`` flip, on the
+      background committer (async engine) or inline (native engine).
+    - ``backpressure``: host time the step loop blocked because
+      ``rolling.max_pending`` snapshots were still uncommitted — nonzero
+      means the disk/writers cannot keep up with the cadence (raise
+      ``every_n_steps``, add writers, or accept the stall).
+    - ``queue_depth``: checkpoint-engine writer queue occupancy sampled at
+      each save submit.
+    - ``retries``: cumulative bounded-retry count from the writer path
+      (``CheckpointEngine.retries``).
+    - ``pruned``: rolling tags deleted by retention.
+    """
+
+    saves: int = 0
+    snapshot_ms: float = 0.0
+    commit_ms: float = 0.0
+    backpressure_ms: float = 0.0
+    queue_depth_sum: int = 0
+    retries: int = 0
+    pruned: int = 0
+
+    def record_save(self, snapshot_s: float, backpressure_s: float = 0.0,
+                    queue_depth: int = 0) -> None:
+        self.saves += 1
+        self.snapshot_ms += 1e3 * snapshot_s
+        self.backpressure_ms += 1e3 * backpressure_s
+        self.queue_depth_sum += int(queue_depth)
+
+    def record_commit(self, commit_s: float, pruned: int = 0) -> None:
+        self.commit_ms += 1e3 * commit_s
+        self.pruned += int(pruned)
+
+    def reset(self) -> None:
+        self.saves = 0
+        self.snapshot_ms = 0.0
+        self.commit_ms = 0.0
+        self.backpressure_ms = 0.0
+        self.queue_depth_sum = 0
+        self.retries = 0
+        self.pruned = 0
+
+    def events(self, step: int = 0) -> List[Event]:
+        n = max(1, self.saves)
+        return [
+            ("train/ckpt/saves", float(self.saves), step),
+            ("train/ckpt/snapshot_ms_per_save", self.snapshot_ms / n, step),
+            ("train/ckpt/commit_ms_per_save", self.commit_ms / n, step),
+            ("train/ckpt/backpressure_ms_per_save",
+             self.backpressure_ms / n, step),
+            ("train/ckpt/writer_queue_depth", self.queue_depth_sum / n, step),
+            ("train/ckpt/retries", float(self.retries), step),
+            ("train/ckpt/pruned_tags", float(self.pruned), step),
+        ]
+
+
+@dataclass
 class OffloadPipelineStats:
     """Phase counters for the offloaded optimizer's fetch/step/upload group
     pipeline (``runtime/zero/offload.py step_groups`` + the engine's upload

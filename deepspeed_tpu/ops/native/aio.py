@@ -20,6 +20,7 @@ from typing import List, Optional
 import numpy as np
 
 from deepspeed_tpu.ops.native.builder import load_native
+from deepspeed_tpu.utils import fault_injection
 
 AIO_DEFAULT_DICT = {
     "block_size": 1 << 20,
@@ -145,11 +146,17 @@ class AsyncIOHandle:
     write = sync_pwrite
 
     def wait(self) -> int:
+        # the injected completion failure lands only AFTER the real drain:
+        # whatever action fires (errno, raise, stall, kill), every in-flight
+        # request has retired and the pinned buffers are released first, so
+        # caller recovery paths never recycle memory a request still targets
+        # (the fault_injection docstring's "real wait still runs" contract)
         if self._handle is not None:
             # Buffers must stay pinned until the C++ pool retires every chunk.
             rc = self._lib.ds_aio_wait(self._handle)
             self._keepalive.clear()
-            return rc
+            inj_rc = fault_injection.maybe_rc("aio.wait")
+            return inj_rc if inj_rc < 0 else rc
         completed = 0
         err = 0
         for fut in self._futures:
@@ -160,6 +167,9 @@ class AsyncIOHandle:
                 err = getattr(e, "errno", None) or 1
         self._futures.clear()
         self._keepalive.clear()
+        inj_rc = fault_injection.maybe_rc("aio.wait")
+        if inj_rc < 0:
+            return inj_rc
         return -err if err else completed
 
     def inflight(self) -> int:
@@ -182,6 +192,12 @@ class AsyncIOHandle:
     # -- internals --------------------------------------------------------- #
     def _submit(self, buffer: np.ndarray, path: str, file_offset: int,
                 is_read: bool) -> int:
+        # injected submit failure: a clean negative rc BEFORE the request is
+        # queued or the buffer pinned — exactly the shape a real submit
+        # rejection has, so caller recovery paths see the true contract
+        rc = fault_injection.maybe_rc("aio.read" if is_read else "aio.write")
+        if rc < 0:
+            return rc
         view = _as_byte_view(buffer, for_read=is_read)
         if self._handle is not None:
             ptr = view.ctypes.data_as(ctypes.c_void_p)

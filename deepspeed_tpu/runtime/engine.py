@@ -43,6 +43,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import deepspeed_tpu.comm as dist
 from deepspeed_tpu.comm.mesh import BATCH_AXES, MeshTopology, build_topology, get_topology, set_topology
 from deepspeed_tpu.config import DeepSpeedTPUConfig
+from deepspeed_tpu.utils import fault_injection
 from deepspeed_tpu.ops import TPUOptimizer, OptaxWrapper, build_optimizer
 from deepspeed_tpu.runtime.lr_schedules import build_lr_schedule
 from deepspeed_tpu.runtime.loss_scaler import (has_overflow, make_loss_scale_state,
@@ -224,11 +225,22 @@ class DeepSpeedTPUEngine:
         self._pending_metrics: deque = deque()
 
         # -- monitor (parity: MonitorMaster wiring, engine.py:249) ---------
-        from deepspeed_tpu.monitor import (MonitorMaster, OffloadPipelineStats,
+        from deepspeed_tpu.monitor import (CheckpointStats, MonitorMaster,
+                                           OffloadPipelineStats,
                                            TrainPipelineStats)
         self.monitor = MonitorMaster(self.config)
         self.train_stats = TrainPipelineStats()
         self.offload_stats = OffloadPipelineStats()
+        self.ckpt_stats = CheckpointStats()
+
+        # -- rolling checkpoints (preemption tolerance, docs/ELASTICITY.md):
+        # the engine owns the cadence so saves interleave correctly with the
+        # deferred metric drain and the offload pipeline's quiesce points
+        self._rolling = None
+        if self.config.checkpoint.rolling.every_n_steps > 0:
+            from deepspeed_tpu.checkpoint.rolling import RollingCheckpointer
+            self._rolling = RollingCheckpointer(
+                self, self.config.checkpoint.rolling, stats=self.ckpt_stats)
 
         # -- progressive layer drop (parity: engine hook :1812) ------------
         self.progressive_layer_drop = None
@@ -706,25 +718,32 @@ class DeepSpeedTPUEngine:
                 "scaler": self.state["scaler"], "skipped": self.state["skipped"]}
 
     def _load_checkpoint_offload(self, load_dir, tag, load_optimizer_states=True,
-                                 load_module_only=False):
+                                 load_module_only=False, verify=False):
         from deepspeed_tpu.checkpoint import state as ck
         import json
         # a pending DPU host step mutates the same master arrays the load is
         # about to overwrite (and would merge stale values after the load)
         self._drain_offload()
-        tag = tag or ck.read_latest_tag(load_dir)
-        if tag is None:
-            raise FileNotFoundError(f"no 'latest' file in {load_dir}")
+        need_optim = load_optimizer_states and not load_module_only
+        # one checksum pass per shard: explicit tags verify at load, a
+        # tag=None scan verifies candidates in find_resume_tag (so bit-rot
+        # in the newest tag falls back instead of surfacing) and skips the
+        # redundant re-verify at load
+        scan_verify = verify and tag is None
+        tag = ck.resolve_load_tag(load_dir, tag, need_optim=need_optim,
+                                  verify=scan_verify)
+        verify = verify and not scan_verify
         ckpt_dir = os.path.join(load_dir, tag)
         cke = self._checkpoint_engine()
-        model_flat = cke.load(os.path.join(ckpt_dir, ck.MODEL_FILE))
+        model_flat = ck._load_verified(cke, ckpt_dir, ck.MODEL_FILE, verify)
         dev_names, host_names = self._offload_dev_names, self._offload_host_names
         master_sh = self._state_shardings["master"]
         self.state["master"] = {
             k: jax.device_put(model_flat[k], master_sh[k]) for k in dev_names}
         self._offload.load_master_leaves({k: model_flat[k] for k in host_names})
         if load_optimizer_states and not load_module_only:
-            optim_flat = cke.load(os.path.join(ckpt_dir, ck.OPTIM_FILE))
+            optim_flat = ck._load_verified(cke, ckpt_dir, ck.OPTIM_FILE,
+                                           verify)
             dev_opt = fetch_to_host(self.state["opt"])
             new_opt, host_moments = {}, {}
             for key, val in dev_opt.items():
@@ -1062,6 +1081,9 @@ class DeepSpeedTPUEngine:
         one runs. ``wall_clock_breakdown`` restores the fully synchronous
         reference loop."""
         from deepspeed_tpu.runtime.data_pipeline import StagedBatch
+        # mid-run preemption point: the --preempt bench kills here, modelling
+        # a spot-VM SIGTERM landing between (or inside) steps
+        fault_injection.maybe_fail("step.kill")
         perf = time.perf_counter
         t0 = perf()
         queue_depth = 0
@@ -1195,6 +1217,12 @@ class DeepSpeedTPUEngine:
             (self.global_steps, self.global_samples, metrics))
         self._drain_metric_queue(
             0 if self.config.wall_clock_breakdown else 1)
+        if self._rolling is not None:
+            # after the counters: a tag named rolling_step{N} holds the state
+            # AFTER step N. save() drains the metric queue first (checkpoint
+            # boundary) and quiesces the offload pipeline via
+            # _offload_ckpt_state before snapshotting host masters.
+            self._rolling.maybe_save()
 
     def drain_metrics(self):
         """Flush every deferred metric entry (blocks on the newest dispatched
@@ -1237,6 +1265,8 @@ class DeepSpeedTPUEngine:
                 if self._offload is not None and self.offload_stats.steps:
                     self.monitor.write_events(
                         self.offload_stats.events(samples))
+                if self.ckpt_stats.saves:
+                    self.monitor.write_events(self.ckpt_stats.events(samples))
         if printing:
             loss = float(vals["loss"]) if "loss" in vals else float("nan")
             lr = float(vals["lr"])
@@ -1364,7 +1394,8 @@ class DeepSpeedTPUEngine:
         state = self._offload_ckpt_state() if self._offload is not None else self.state
         save_engine_checkpoint(save_dir, tag, state, client_state,
                                save_latest=save_latest,
-                               ckpt_engine=self._checkpoint_engine())
+                               ckpt_engine=self._checkpoint_engine(),
+                               stats=self.ckpt_stats)
         return True
 
     def _checkpoint_engine(self):
@@ -1372,19 +1403,28 @@ class DeepSpeedTPUEngine:
         _configure_checkpointing engine.py:912 picking Torch vs Nebula)."""
         if getattr(self, "_ckpt_engine", None) is None:
             from deepspeed_tpu.checkpoint.engine import build_checkpoint_engine
+            ck = self.config.checkpoint
             self._ckpt_engine = build_checkpoint_engine(
-                self.config.checkpoint.engine,
-                config_params={"writers": self.config.checkpoint.writers})
+                ck.engine,
+                config_params={"writers": ck.writers,
+                               "writer_retries": ck.writer_retries,
+                               "writer_backoff_s": ck.writer_backoff_s})
         return self._ckpt_engine
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True,
-                        load_module_only: bool = False):
+                        load_module_only: bool = False,
+                        verify: Optional[bool] = None):
+        """``verify=True`` checksums every loaded shard against the tag's
+        manifest (default: ``config.checkpoint.verify_load``). ``tag=None``
+        resumes from the newest COMPLETE tag, skipping torn ones."""
         from deepspeed_tpu.checkpoint.state import load_engine_checkpoint
         if self.state is None:
             raise RuntimeError("engine state not initialised; pass model_parameters "
                                "or run a batch before load_checkpoint")
+        if verify is None:
+            verify = self.config.checkpoint.verify_load
         # flush metrics of the pre-load stream, and drop staged batches: the
         # step counter is about to move, invalidating schedule-keyed staging
         self.drain_metrics()
@@ -1401,7 +1441,7 @@ class DeepSpeedTPUEngine:
         if self._offload is not None:
             load_dir_, client_state = self._load_checkpoint_offload(
                 load_dir, tag, load_optimizer_states=load_optimizer_states,
-                load_module_only=load_module_only)
+                load_module_only=load_module_only, verify=verify)
             self.global_steps = int(client_state.get("global_steps", 0))
             self.global_samples = int(client_state.get("global_samples", 0))
             self.micro_steps = int(client_state.get("micro_steps", 0))
@@ -1415,7 +1455,7 @@ class DeepSpeedTPUEngine:
             load_dir, tag, self.state, self._state_shardings,
             load_optimizer_states=load_optimizer_states,
             load_module_only=load_module_only, params_builder=params_builder,
-            ckpt_engine=self._checkpoint_engine())
+            ckpt_engine=self._checkpoint_engine(), verify=verify)
         self.state = state
         self.global_steps = int(client_state.get("global_steps", 0))
         self.global_samples = int(client_state.get("global_samples", 0))
@@ -1429,6 +1469,17 @@ class DeepSpeedTPUEngine:
         pools/swap files, and monitor writers."""
         self._reset_data_iterator()
         self.drain_metrics()
+        rolling_err = None
+        if self._rolling is not None:
+            # BEFORE the checkpoint engine closes: queued rolling commits
+            # need live writer threads to drain against. A surfaced commit
+            # error must not abort the rest of the teardown — pools, AIO
+            # handles and writers still have to close — so it re-raises
+            # only after everything below ran
+            try:
+                self._rolling.close()
+            except BaseException as e:
+                rolling_err = e
         if self._offload is not None:
             self._drain_offload()
             if self._offload_executor is not None:
@@ -1441,10 +1492,19 @@ class DeepSpeedTPUEngine:
         if getattr(self, "_ckpt_engine", None) is not None:
             close = getattr(self._ckpt_engine, "close", None)
             if close is not None:
-                close()
+                # a failed bare-save writer surfaces here; like the rolling
+                # error it must not abort the remaining teardown or shadow
+                # the (earlier, more specific) rolling-commit failure
+                try:
+                    close()
+                except BaseException as e:
+                    if rolling_err is None:
+                        rolling_err = e
         close = getattr(self.monitor, "close", None)
         if close is not None:
             close()
+        if rolling_err is not None:
+            raise rolling_err
 
     # ------------------------------------------------------------------ #
     # property surface (parity: engine.py:469-870 accessors)

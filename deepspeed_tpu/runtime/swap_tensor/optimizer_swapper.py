@@ -19,6 +19,7 @@ import numpy as np
 from deepspeed_tpu.ops.native.aio import AsyncIOHandle
 from deepspeed_tpu.runtime.swap_tensor.buffer_pool import SwapBufferPool
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.resilience import DeferredCall, IOTimeout, retry_call
 
 
 @dataclass
@@ -43,7 +44,8 @@ class OptimizerStateSwapper:
     """
 
     def __init__(self, swap_dir: str, aio_config: Optional[dict] = None,
-                 max_pooled_buffers: int = 16):
+                 max_pooled_buffers: int = 16, io_retries: int = 2,
+                 io_timeout_s: float = 0.0):
         os.makedirs(swap_dir, exist_ok=True)
         self.swap_dir = swap_dir
         aio = dict(aio_config or {})
@@ -58,6 +60,55 @@ class OptimizerStateSwapper:
         self.meta: Dict[str, SwappedTensorMeta] = {}
         self._views: Dict[str, np.ndarray] = {}   # name -> typed view
         self._buffers: Dict[str, np.ndarray] = {}  # name -> raw pooled buffer
+        # IO failure discipline (docs/ELASTICITY.md): transient failures get
+        # io_retries bounded re-attempts with backoff, then SURFACE; waits get
+        # an io_timeout_s deadline (0 = none) so a dead disk raises IOTimeout
+        # instead of hanging the step forever
+        self.io_attempts = 1 + max(0, int(aio.get("io_retries", io_retries)))
+        self.io_timeout_s = float(aio.get("io_timeout_s", io_timeout_s))
+        #: cumulative retries taken (observability; never resets)
+        self.io_retries_taken = 0
+        # stragglers: DeferredCall-wrapped waits that timed out — the IO is
+        # STILL RUNNING on its thread, so a buffer release must re-join them
+        # first (recycled memory must never be a live DMA target)
+        self._stragglers: List[DeferredCall] = []
+
+    # -- IO discipline helpers --------------------------------------------- #
+    def _count_retry(self, attempt, exc) -> None:
+        self.io_retries_taken += 1
+
+    def _retry(self, fn, describe: str):
+        # IOTimeout subclasses OSError (via TimeoutError) but must NOT be
+        # retried: the timed-out wait is STILL RUNNING, and re-submitting the
+        # same names would claim fresh pool buffers while the straggler DMAs
+        # into the old ones — it surfaces to the except-IOTimeout paths
+        return retry_call(fn, attempts=self.io_attempts,
+                          retry_on=(OSError,), no_retry_on=(IOTimeout,),
+                          describe=describe, on_retry=self._count_retry)
+
+    def _wait(self, handle: AsyncIOHandle, describe: str) -> int:
+        """``handle.wait()`` under the deadline. On timeout the real wait keeps
+        running on its thread; it is recorded as a straggler (``_join_
+        stragglers`` re-joins it before any buffer recycles) and IOTimeout
+        SURFACES to the caller."""
+        if self.io_timeout_s <= 0:
+            return handle.wait()
+        call = DeferredCall(handle.wait, describe=describe)
+        try:
+            return call.result(self.io_timeout_s)
+        except IOTimeout:
+            self._stragglers.append(call)
+            raise
+
+    def _join_stragglers(self) -> None:
+        """Block until every timed-out wait actually retires (no deadline:
+        correctness over promptness — buffers are about to be recycled)."""
+        stragglers, self._stragglers = self._stragglers, []
+        for call in stragglers:
+            try:
+                call.result(None)
+            except Exception:   # the IOTimeout already surfaced to the caller
+                pass
 
     # -- registration ----------------------------------------------------- #
     def register(self, name: str, array: np.ndarray) -> SwappedTensorMeta:
@@ -66,9 +117,13 @@ class OptimizerStateSwapper:
                                  dtype=np.dtype(array.dtype),
                                  path=os.path.join(self.swap_dir, f"{safe}.swp"))
         arr = np.ascontiguousarray(array)
-        rc = self.handle.sync_pwrite(arr, meta.path)
-        if rc != 0:
-            raise OSError(-rc, f"swap register write failed for {meta.path}")
+
+        def _once():
+            rc = self.handle.sync_pwrite(arr, meta.path)
+            if rc != 0:
+                raise OSError(-rc, f"swap register write failed for {meta.path}")
+
+        self._retry(_once, f"register {name}")
         self.meta[name] = meta
         return meta
 
@@ -77,23 +132,51 @@ class OptimizerStateSwapper:
 
     # -- sync swap --------------------------------------------------------- #
     def swap_in(self, names: Sequence[str]) -> Dict[str, np.ndarray]:
-        """A failed submit or read surfaces HERE (never swallowed), and the
-        failed call releases every buffer it claimed — ``pool.outstanding``
-        is back where it started after an aborted swap-in."""
-        self._submit_reads(names)
-        n = self.handle.wait()
-        if n < 0:
+        """A failed submit or read gets ``io_retries`` bounded re-attempts,
+        then surfaces HERE (never swallowed) — and the failed call releases
+        every buffer it claimed — ``pool.outstanding`` is back where it
+        started after an aborted swap-in."""
+
+        def _attempt():
+            self._submit_reads(names)
+            try:
+                n = self._wait(self.handle, "swap-in wait")
+            except IOTimeout:
+                raise   # the outer handler releases AFTER the straggler joins
+            except BaseException:
+                # a wait that RAISES (not just a negative rc) has still
+                # drained the handle — release the claimed buffers so the
+                # failed attempt leaves the pool at baseline
+                self._release(names)
+                raise
+            if n < 0:
+                self._release(names)
+                raise OSError(-n, "swap-in read failed")
+
+        try:
+            self._retry(_attempt, "swap-in")
+        except IOTimeout:
+            # the straggling wait may still DMA into the claimed buffers:
+            # join it for real before handing them back to the pool
+            self._join_stragglers()
             self._release(names)
-            raise OSError(-n, "swap-in read failed")
+            raise
         return {name: self._views[name] for name in names}
 
     def swap_out(self, names: Optional[Sequence[str]] = None) -> None:
         names = list(self._views) if names is None else list(names)
-        try:
+
+        def _attempt():
             self._submit_writes(names)
-            n = self.handle.wait()
+            n = self._wait(self.handle, "swap-out wait")
             if n < 0:
                 raise OSError(-n, "swap-out write failed")
+
+        try:
+            self._retry(_attempt, "swap-out")
+        except IOTimeout:
+            self._join_stragglers()
+            raise
         finally:
             # release even on failure: the swap files may be torn, but the
             # pooled buffers must not leak (outstanding back to baseline)
@@ -109,7 +192,15 @@ class OptimizerStateSwapper:
             view = self.pool.view(buf, meta.shape, meta.dtype)
             self._buffers[name] = buf
             self._views[name] = view
-            rc = handle.async_pread(view, meta.path)
+            try:
+                rc = handle.async_pread(view, meta.path)
+            except BaseException:
+                # a submit that RAISES (not just a negative rc) must leave
+                # the pool at baseline too — same drain-then-release path
+                if submitted:
+                    handle.wait()
+                self._release(submitted + [name])
+                raise
             if rc != 0:
                 # drain whatever this call already queued before releasing its
                 # buffers — in-flight reads must not land in recycled memory
@@ -123,7 +214,11 @@ class OptimizerStateSwapper:
         handle = handle or self.handle
         for name in names:
             meta = self.meta[name]
-            rc = handle.async_pwrite(self._views[name], meta.path)
+            try:
+                rc = handle.async_pwrite(self._views[name], meta.path)
+            except BaseException:
+                handle.wait()   # drain earlier submits; caller releases
+                raise
             if rc != 0:
                 handle.wait()   # drain earlier submits; caller releases
                 raise OSError(-rc, f"swap-out submit failed for {meta.path}")
@@ -143,9 +238,13 @@ class OptimizerStateSwapper:
                 out[name] = np.array(self._views[name])
                 continue
             arr = np.empty(meta.shape, meta.dtype)
-            rc = self.handle.sync_pread(arr, meta.path)
-            if rc != 0:
-                raise OSError(-rc, f"swap read_all failed for {meta.path}")
+
+            def _once(arr=arr, meta=meta):
+                rc = self.handle.sync_pread(arr, meta.path)
+                if rc != 0:
+                    raise OSError(-rc, f"swap read_all failed for {meta.path}")
+
+            self._retry(_once, f"read_all {name}")
             out[name] = arr
         return out
 
@@ -154,9 +253,14 @@ class OptimizerStateSwapper:
         meta = self.meta[name]
         if tuple(array.shape) != meta.shape:
             raise ValueError(f"swap write shape mismatch for {name}")
-        rc = self.handle.sync_pwrite(np.ascontiguousarray(array, meta.dtype), meta.path)
-        if rc != 0:
-            raise OSError(-rc, f"swap write failed for {meta.path}")
+        arr = np.ascontiguousarray(array, meta.dtype)
+
+        def _once():
+            rc = self.handle.sync_pwrite(arr, meta.path)
+            if rc != 0:
+                raise OSError(-rc, f"swap write failed for {meta.path}")
+
+        self._retry(_once, f"write {name}")
 
     def close(self):
         self.handle.close()
@@ -173,8 +277,10 @@ class PipelinedOptimizerSwapper(OptimizerStateSwapper):
 
     def __init__(self, swap_dir: str, aio_config: Optional[dict] = None,
                  max_pooled_buffers: int = 16, pipeline_read: bool = True,
-                 pipeline_write: bool = True):
-        super().__init__(swap_dir, aio_config, max_pooled_buffers)
+                 pipeline_write: bool = True, io_retries: int = 2,
+                 io_timeout_s: float = 0.0):
+        super().__init__(swap_dir, aio_config, max_pooled_buffers,
+                         io_retries=io_retries, io_timeout_s=io_timeout_s)
         self.pipeline_read = pipeline_read
         self.pipeline_write = pipeline_write
         aio = dict(aio_config or {})
@@ -207,7 +313,7 @@ class PipelinedOptimizerSwapper(OptimizerStateSwapper):
                     self._prefetch_group(groups[i + 1])
                 step_fn({name: self._views[name] for name in group})
                 if inflight_writes:
-                    n = self._write_handle.wait()
+                    n = self._wait(self._write_handle, "pipelined swap-out")
                     if n < 0:
                         raise OSError(-n, "pipelined swap-out failed")
                     self._release(inflight_writes)
@@ -218,11 +324,11 @@ class PipelinedOptimizerSwapper(OptimizerStateSwapper):
                 else:
                     self._write_group_sync(group)
                 if self.pipeline_read and i + 1 < len(groups):
-                    n = self._read_handle.wait()
+                    n = self._wait(self._read_handle, "pipelined swap-in")
                     if n < 0:
                         raise OSError(-n, "pipelined swap-in failed")
             if inflight_writes:
-                n = self._write_handle.wait()
+                n = self._wait(self._write_handle, "pipelined swap-out")
                 if n < 0:
                     raise OSError(-n, "pipelined swap-out failed")
                 self._release(inflight_writes)
@@ -232,7 +338,10 @@ class PipelinedOptimizerSwapper(OptimizerStateSwapper):
 
     def _abort(self) -> None:
         """Drain in-flight IO on every handle and release every held buffer
-        (the views' swap files may be torn — the error already surfaced)."""
+        (the views' swap files may be torn — the error already surfaced).
+        Timed-out waits are re-joined FIRST: their IO may still be running
+        against buffers this abort is about to hand back to the pool."""
+        self._join_stragglers()
         for handle in {id(h): h for h in
                        (self.handle, self._read_handle, self._write_handle)
                        }.values():
@@ -245,7 +354,7 @@ class PipelinedOptimizerSwapper(OptimizerStateSwapper):
     # -- helpers ----------------------------------------------------------- #
     def _read_group(self, names: Sequence[str]) -> None:
         self._submit_reads(names, handle=self._read_handle)
-        n = self._read_handle.wait()
+        n = self._wait(self._read_handle, "swap-in wait")
         if n < 0:
             raise OSError(-n, "swap-in read failed")
 
@@ -254,7 +363,7 @@ class PipelinedOptimizerSwapper(OptimizerStateSwapper):
 
     def _write_group_sync(self, names: Sequence[str]) -> None:
         self._submit_writes(names, handle=self._write_handle)
-        n = self._write_handle.wait()
+        n = self._wait(self._write_handle, "swap-out write")
         if n < 0:
             raise OSError(-n, "swap-out write failed")
         self._release(names)

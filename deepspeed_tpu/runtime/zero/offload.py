@@ -127,7 +127,9 @@ class HostOffloadOptimizer:
             swap_dir,
             pipeline_read=offload_cfg.pipeline_read,
             pipeline_write=offload_cfg.pipeline_write,
-            max_pooled_buffers=max(4, 2 * offload_cfg.buffer_count * (1 + len(state_keys))))
+            max_pooled_buffers=max(4, 2 * offload_cfg.buffer_count * (1 + len(state_keys))),
+            io_retries=offload_cfg.io_retries,
+            io_timeout_s=offload_cfg.io_timeout_s)
         self.master = None
         self.moments = None
         for k, v in master_leaves.items():
@@ -326,8 +328,14 @@ class HostOffloadOptimizer:
         """(master, moments) in one pass — one NVMe read of the swap state."""
         state_keys = _STATE_KEYS[self.kind]
         if not self.nvme:
-            return dict(self.master), {sk: dict(self.moments[sk])
-                                       for sk in state_keys}
+            # frozen COPIES, not the live arrays: host Adam mutates master/
+            # moments in place, and callers hand these leaves to background
+            # checkpoint writers (or bench snapshot/restore) that must not
+            # observe the next step's values
+            return ({k: np.array(v, np.float32) for k, v in self.master.items()},
+                    {sk: {k: np.array(v, np.float32)
+                          for k, v in self.moments[sk].items()}
+                     for sk in state_keys})
         all_t = self.swapper.read_all()
         master = {k[len("master/"):]: v for k, v in all_t.items()
                   if k.startswith("master/")}

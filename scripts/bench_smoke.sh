@@ -22,4 +22,10 @@ timeout -k 10 300 python benchmarks/train_bench.py --smoke || exit 1
 
 # offloaded-optimizer pipeline leg: serial vs overlapped host step through
 # the same engine, gating byte-identical loss streams + zero warm compiles
-timeout -k 10 300 python benchmarks/train_bench.py --smoke --offload
+timeout -k 10 300 python benchmarks/train_bench.py --smoke --offload || exit 1
+
+# preemption-tolerance leg (docs/ELASTICITY.md): kill a subprocess run at a
+# non-checkpoint step AND mid-checkpoint-write, resume each onto a different
+# simulated device count, gating byte-identical resumed loss streams + torn
+# checkpoint fallback + zero post-resume-warmup compiles
+timeout -k 10 300 python benchmarks/train_bench.py --smoke --preempt

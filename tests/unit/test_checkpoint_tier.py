@@ -5,6 +5,7 @@ zero stages, universal checkpoint reshape (DistributedFixture: save at one
 world size, load at another), consolidation without accelerators.
 """
 
+import json
 import os
 
 import numpy as np
@@ -78,6 +79,42 @@ def test_async_engine_snapshot_isolation(tmp_path):
     np.testing.assert_array_equal(np.load(str(tmp_path / "x.npz"))["a"],
                                   np.zeros(1000, np.float32))
     eng.close()
+
+
+def test_async_engine_bare_save_after_tagged_commit_drains(tmp_path):
+    """commit() ends the create() scope: a later bare save() (no create)
+    must land under the None bucket and drain at ANY commit — not file
+    under the stale committed tag whose bucket no future commit pops."""
+    eng = AsyncCheckpointEngine(max_workers=1)
+    eng.create("t1")
+    eng.save({"a": np.ones(8, np.float32)}, str(tmp_path / "a.npz"))
+    assert eng.commit("t1")
+    eng.save({"b": np.full(8, 2.0, np.float32)}, str(tmp_path / "b.npz"))
+    assert eng.commit("anything")   # must drain the bare save
+    np.testing.assert_array_equal(np.load(str(tmp_path / "b.npz"))["b"],
+                                  np.full(8, 2.0, np.float32))
+    eng.close()
+
+
+def test_offload_state_leaves_never_alias_live_arrays():
+    """The checkpoint view of an offload optimizer must be frozen COPIES:
+    host Adam mutates master/moments in place while a queued rolling writer
+    serializes (and checksums) the snapshot — an aliased leaf is the silent
+    torn-checkpoint case the manifest exists to catch."""
+    from deepspeed_tpu.config import OffloadDeviceEnum, OffloadOptimizerConfig
+    from deepspeed_tpu.ops.adam import FusedAdam
+    from deepspeed_tpu.runtime.zero.offload import HostOffloadOptimizer
+    ho = HostOffloadOptimizer(
+        FusedAdam(lr=1e-2, weight_decay=0.01),
+        {"w": np.arange(8, dtype=np.float32)},
+        OffloadOptimizerConfig(device=OffloadDeviceEnum.cpu))
+    master, moments = ho.state_leaves()
+    ho.master["w"] += 100.0   # the racing host step
+    for sk in moments:
+        ho.moments[sk]["w"] += 100.0
+    np.testing.assert_array_equal(master["w"], np.arange(8, dtype=np.float32))
+    for sk in moments:
+        np.testing.assert_array_equal(moments[sk]["w"], np.zeros(8, np.float32))
 
 
 def test_async_engine_in_training(tmp_path):
@@ -246,3 +283,309 @@ def test_zero_to_fp32(tmp_path):
     import torch
     tsd = torch.load(out, map_location="cpu")
     assert any("." in k for k in tsd)  # torch key convention
+
+
+# --------------------------------------------------------------------------- #
+# torn / partially-written checkpoints (ISSUE 6 hardening)
+# --------------------------------------------------------------------------- #
+
+def _save_two_tags(tmp_path):
+    """Two complete checkpoints (c1 older, c2 newer) from a live engine."""
+    model, batches = _model_and_batches()
+    eng = _engine(model)
+    eng.train_batch(batches[0])
+    eng.save_checkpoint(str(tmp_path), tag="c1")
+    eng.train_batch(batches[1])
+    eng.save_checkpoint(str(tmp_path), tag="c2")
+    return eng, model, batches
+
+
+def test_corrupt_latest_falls_back_to_newest_complete(tmp_path):
+    from deepspeed_tpu.checkpoint.state import find_resume_tag
+    eng, model, batches = _save_two_tags(tmp_path)
+    with open(str(tmp_path / "latest"), "w") as f:
+        f.write("no_such_tag")        # latest points into the void
+    assert find_resume_tag(str(tmp_path)) == "c2"
+    eng2 = _engine(model)
+    eng2.train_batch(batches[0])
+    eng2.load_checkpoint(str(tmp_path))   # tag=None resume path
+    assert eng2.global_steps == 2
+    eng.destroy()
+
+
+def test_missing_shard_skips_to_older_complete_tag(tmp_path):
+    from deepspeed_tpu.checkpoint.state import find_resume_tag, tag_problem
+    eng, model, batches = _save_two_tags(tmp_path)
+    os.remove(str(tmp_path / "c2" / "optim_states.npz"))
+    assert "missing optim_states.npz" in tag_problem(str(tmp_path), "c2")
+    assert find_resume_tag(str(tmp_path)) == "c1"
+    eng2 = _engine(model)
+    eng2.train_batch(batches[0])
+    eng2.load_checkpoint(str(tmp_path))
+    assert eng2.global_steps == 1         # resumed from c1, with a warning
+    eng.destroy()
+
+
+def test_truncated_npz_detected_and_skipped(tmp_path):
+    from deepspeed_tpu.checkpoint.state import find_resume_tag, tag_problem
+    eng, model, batches = _save_two_tags(tmp_path)
+    path = str(tmp_path / "c2" / "model_states.npz")
+    full = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(full[:len(full) // 2])    # crash mid-write: no zip directory
+    assert "truncated/corrupt" in tag_problem(str(tmp_path), "c2")
+    assert find_resume_tag(str(tmp_path)) == "c1"
+    eng.destroy()
+
+
+def test_missing_or_torn_client_state_marks_tag_torn(tmp_path):
+    """A crash between the npz writes and the counters file must not produce
+    a tag that silently resumes at global_steps=0 (missing json) or dies in
+    json parsing (torn json) — both are torn tags, skipped on scan."""
+    from deepspeed_tpu.checkpoint.state import find_resume_tag, tag_problem
+    eng, model, batches = _save_two_tags(tmp_path)
+    os.remove(str(tmp_path / "c2" / "client_state.json"))
+    assert "missing client_state.json" in tag_problem(str(tmp_path), "c2")
+    assert find_resume_tag(str(tmp_path)) == "c1"
+    with open(str(tmp_path / "c2" / "client_state.json"), "w") as f:
+        f.write('{"global_steps": 2')   # crash mid-dump
+    assert "truncated/corrupt client_state.json" in tag_problem(
+        str(tmp_path), "c2")
+    eng2 = _engine(model)
+    eng2.train_batch(batches[0])
+    eng2.load_checkpoint(str(tmp_path))
+    assert eng2.global_steps == 1        # resumed from c1, counters intact
+    eng.destroy()
+
+
+def test_monotonic_latest_ignores_non_step_tag_digits(tmp_path):
+    """Arbitrary trailing digits in a user tag are NOT step numbers: a
+    date-suffixed tag must not freeze the monotonic guard, and only a
+    genuinely newer step-tag blocks a rolling flip."""
+    from deepspeed_tpu.checkpoint.state import read_latest_tag, write_latest_tag
+    write_latest_tag(str(tmp_path), "run_20260803")
+    write_latest_tag(str(tmp_path), "rolling_step48", monotonic=True)
+    assert read_latest_tag(str(tmp_path)) == "rolling_step48"
+    # a genuinely newer step-numbered latest still blocks older commits
+    write_latest_tag(str(tmp_path), "global_step50")
+    write_latest_tag(str(tmp_path), "rolling_step49", monotonic=True)
+    assert read_latest_tag(str(tmp_path)) == "global_step50"
+
+
+def test_ds_to_universal_skips_torn_latest(tmp_path):
+    """tag=None conversion follows the same torn-checkpoint discipline as
+    the load paths: a `latest` pointing at a mid-write casualty falls back
+    to the newest complete tag instead of crashing inside np.load."""
+    from deepspeed_tpu.checkpoint.universal import ds_to_universal
+    eng, model, batches = _save_two_tags(tmp_path)
+    path = str(tmp_path / "c2" / "model_states.npz")
+    full = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(full[:len(full) // 2])
+    out = ds_to_universal(str(tmp_path), str(tmp_path / "uni"))
+    meta = json.load(open(os.path.join(out, "universal_meta.json")))
+    assert meta["client_state"]["global_steps"] == 1   # converted c1
+    eng.destroy()
+
+
+def test_verify_scan_falls_back_past_checksum_corrupt_newest(tmp_path):
+    """tag=None + verify: bit-rot in the newest tag (valid npz, bad crc)
+    must fall back to an older verified-complete tag, not kill the resume."""
+    from deepspeed_tpu.checkpoint.state import find_resume_tag
+    eng, model, batches = _save_two_tags(tmp_path)
+    path = str(tmp_path / "c2" / "model_states.npz")
+    flat = dict(np.load(path))
+    key = sorted(flat)[0]
+    flat[key] = flat[key] + 1.0
+    np.savez(path.replace(".npz", ""), **flat)
+    assert find_resume_tag(str(tmp_path), verify=True) == "c1"
+    eng2 = _engine(model, {"checkpoint": {"verify_load": True}})
+    eng2.train_batch(batches[0])
+    eng2.load_checkpoint(str(tmp_path))   # tag=None, verify_load on
+    assert eng2.global_steps == 1
+    eng.destroy()
+
+
+def test_explicit_torn_tag_raises_checkpoint_corrupt(tmp_path):
+    from deepspeed_tpu.checkpoint import CheckpointCorrupt
+    eng, model, batches = _save_two_tags(tmp_path)
+    os.remove(str(tmp_path / "c2" / "model_states.npz"))
+    eng2 = _engine(model)
+    eng2.train_batch(batches[0])
+    # an EXPLICITLY requested torn tag must raise with the reason, not
+    # silently fall back to some other tag
+    with pytest.raises(CheckpointCorrupt, match="missing model_states.npz"):
+        eng2.load_checkpoint(str(tmp_path), tag="c2")
+    eng.destroy()
+
+
+def test_verified_load_catches_checksum_mismatch(tmp_path):
+    from deepspeed_tpu.checkpoint import CheckpointCorrupt
+    eng, model, batches = _save_two_tags(tmp_path)
+    # bit-rot one array in c2 AFTER its manifest was written: the file stays
+    # a valid npz, only a verified load can tell
+    path = str(tmp_path / "c2" / "model_states.npz")
+    flat = dict(np.load(path))
+    key = sorted(flat)[0]
+    flat[key] = flat[key] + 1.0
+    np.savez(path.replace(".npz", ""), **flat)
+    eng2 = _engine(model)
+    eng2.train_batch(batches[0])
+    with pytest.raises(CheckpointCorrupt, match="checksum mismatch"):
+        eng2.load_checkpoint(str(tmp_path), tag="c2", verify=True)
+    # without verify the rotten bytes load silently — the knob has teeth
+    eng2.load_checkpoint(str(tmp_path), tag="c2", verify=False)
+    # and config.checkpoint.verify_load=True is the default-on switch
+    eng3 = _engine(model, {"checkpoint": {"verify_load": True}})
+    eng3.train_batch(batches[0])
+    with pytest.raises(CheckpointCorrupt, match="checksum mismatch"):
+        eng3.load_checkpoint(str(tmp_path), tag="c2")
+    eng.destroy()
+
+
+def test_pre_manifest_checkpoint_still_loads(tmp_path):
+    """Checkpoints written before the manifest format stay loadable (with
+    verify falling back to npz integrity only)."""
+    eng, model, batches = _save_two_tags(tmp_path)
+    os.remove(str(tmp_path / "c2" / "manifest.json"))
+    eng2 = _engine(model)
+    eng2.train_batch(batches[0])
+    eng2.load_checkpoint(str(tmp_path), tag="c2", verify=True)
+    assert eng2.global_steps == 2
+    eng.destroy()
+
+
+# --------------------------------------------------------------------------- #
+# universal checkpoint: reshard round-trips + engine-state restore (ISSUE 6)
+# --------------------------------------------------------------------------- #
+
+def _train_engine(model, batches, n, cfg_extra=None, mesh=None):
+    eng = _engine(model, cfg_extra, mesh=mesh)
+    for b in batches[:n]:
+        eng.train_batch(b)
+    return eng
+
+
+def test_universal_reshard_n_m_n_byte_identical(eight_devices, tmp_path):
+    """Save at fsdp=8 -> universal -> load at data=8 -> save -> universal:
+    every parameter and optimizer fragment must round-trip byte-identical
+    (resharding is lossless; n_embd=32 is NOT divisible by 8 evenly across
+    heads*layers shapes, so padding paths are exercised too)."""
+    model, batches = _model_and_batches()
+    eng = _train_engine(model, batches, 2, mesh={"data": 1, "fsdp": 8})
+    eng.save_checkpoint(str(tmp_path / "ck_n"), tag="t")
+    ds_to_universal(str(tmp_path / "ck_n"), str(tmp_path / "uni_n"), tag="t")
+
+    eng2 = _train_engine(model, batches, 1,
+                         {"checkpoint": {"load_universal": True}},
+                         mesh={"data": 8, "fsdp": 1})
+    eng2.load_checkpoint(str(tmp_path / "uni_n"))
+    eng2.save_checkpoint(str(tmp_path / "ck_m"), tag="t")
+    ds_to_universal(str(tmp_path / "ck_m"), str(tmp_path / "uni_m"), tag="t")
+
+    # and back to the original topology
+    eng3 = _train_engine(model, batches, 1,
+                         {"checkpoint": {"load_universal": True}},
+                         mesh={"data": 1, "fsdp": 8})
+    eng3.load_checkpoint(str(tmp_path / "uni_m"))
+    eng3.save_checkpoint(str(tmp_path / "ck_n2"), tag="t")
+    ds_to_universal(str(tmp_path / "ck_n2"), str(tmp_path / "uni_n2"), tag="t")
+
+    m_n, o_n, _ = load_universal(str(tmp_path / "uni_n"))
+    for uni in ("uni_m", "uni_n2"):
+        m_x, o_x, _ = load_universal(str(tmp_path / uni))
+        assert sorted(m_x) == sorted(m_n)
+        for k in m_n:
+            assert m_x[k].dtype == m_n[k].dtype
+            np.testing.assert_array_equal(m_x[k], m_n[k])
+        assert sorted(o_x) == sorted(o_n)
+        for k in o_n:
+            np.testing.assert_array_equal(np.asarray(o_x[k]),
+                                          np.asarray(o_n[k]))
+
+
+def test_universal_reshard_odd_world_size(eight_devices, tmp_path):
+    """2x4 (data x fsdp) -> universal -> 8x1: a non-power-of-two-per-axis
+    layout with padding must still round-trip byte-identical."""
+    model, batches = _model_and_batches()
+    eng = _train_engine(model, batches, 2, mesh={"data": 2, "fsdp": 4})
+    eng.save_checkpoint(str(tmp_path / "ck"), tag="t")
+    ds_to_universal(str(tmp_path / "ck"), str(tmp_path / "uni"), tag="t")
+
+    eng2 = _train_engine(model, batches, 1,
+                         {"checkpoint": {"load_universal": True}},
+                         mesh={"data": 8, "fsdp": 1})
+    eng2.load_checkpoint(str(tmp_path / "uni"))
+    eng2.save_checkpoint(str(tmp_path / "ck2"), tag="t")
+    ds_to_universal(str(tmp_path / "ck2"), str(tmp_path / "uni2"), tag="t")
+    m1, o1, _ = load_universal(str(tmp_path / "uni"))
+    m2, o2, _ = load_universal(str(tmp_path / "uni2"))
+    for k in m1:
+        np.testing.assert_array_equal(m2[k], m1[k])
+    for k in o1:
+        np.testing.assert_array_equal(np.asarray(o2[k]), np.asarray(o1[k]))
+    # the continued streams agree across the reshard
+    l1 = [float(eng.train_batch(b)) for b in batches[2:]]
+    l2 = [float(eng2.train_batch(b)) for b in batches[2:]]
+    np.testing.assert_allclose(l1, l2, rtol=2e-3, atol=2e-3)
+
+
+def test_universal_covers_offloaded_master_and_opt_states(tmp_path):
+    """An offload_optimizer engine's checkpoint converts to universal with
+    the HOST-resident masters and moments intact, byte-identical to the live
+    offload state."""
+    model, batches = _model_and_batches()
+    eng = _engine(model, {"zero_optimization": {
+        "stage": 1, "offload_optimizer": {"device": "cpu"}}})
+    for b in batches[:2]:
+        eng.train_batch(b)
+    eng.save_checkpoint(str(tmp_path / "ck"), tag="t")
+    ds_to_universal(str(tmp_path / "ck"), str(tmp_path / "uni"), tag="t")
+    master, optim, meta = load_universal(str(tmp_path / "uni"))
+
+    host_master, moments = eng._offload.state_leaves()
+    assert host_master          # the offload flow actually owns leaves
+    for k, v in host_master.items():
+        np.testing.assert_array_equal(master[k], np.asarray(v, np.float32))
+    for sk, leaves in moments.items():
+        for k, v in leaves.items():
+            np.testing.assert_array_equal(optim[f"opt/{sk}/{k}"],
+                                          np.asarray(v, np.float32))
+    assert int(np.asarray(optim["opt/step"])) == eng._offload.step_num
+
+    # loading universal INTO an offload engine is explicitly unsupported
+    from deepspeed_tpu.checkpoint import load_universal_into_engine
+    with pytest.raises(NotImplementedError, match="offload"):
+        load_universal_into_engine(eng, str(tmp_path / "uni"))
+    eng.destroy()
+
+
+def test_load_universal_restores_counters_lr_and_scaler(eight_devices,
+                                                        tmp_path):
+    import jax as _jax
+    model, batches = _model_and_batches()
+    sched = {"scheduler": {"type": "WarmupLR",
+                           "params": {"warmup_min_lr": 0.0,
+                                      "warmup_max_lr": 1e-2,
+                                      "warmup_num_steps": 10}}}
+    eng = _train_engine(model, batches, 3, sched, mesh={"data": 1, "fsdp": 8})
+    # perturb the loss-scaler state so restoration is observable
+    sh = eng._state_shardings["scaler"]
+    eng.state["scaler"]["scale"] = _jax.device_put(
+        np.asarray(2048.0, eng.state["scaler"]["scale"].dtype), sh["scale"])
+    eng.state["scaler"]["growth_tracker"] = _jax.device_put(
+        np.asarray(7, eng.state["scaler"]["growth_tracker"].dtype),
+        sh["growth_tracker"])
+    eng.save_checkpoint(str(tmp_path / "ck"), tag="t")
+    ds_to_universal(str(tmp_path / "ck"), str(tmp_path / "uni"), tag="t")
+
+    eng2 = _train_engine(model, batches, 1, dict(
+        sched, **{"checkpoint": {"load_universal": True}}),
+        mesh={"data": 8, "fsdp": 1})
+    eng2.load_checkpoint(str(tmp_path / "uni"))
+    assert eng2.global_steps == 3
+    assert int(eng2.state["step"]) == int(eng.state["step"])
+    # LR schedule position restored: both engines report the same lr
+    assert eng2.get_lr() == eng.get_lr()
+    assert eng2.cur_scale == 2048.0
+    assert int(eng2.state["scaler"]["growth_tracker"]) == 7

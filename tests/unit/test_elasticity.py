@@ -83,3 +83,91 @@ def test_validate_elastic_nodes():
         validate_elastic_nodes(1, 2, 8)
     with pytest.raises(ElasticityError):
         validate_elastic_nodes(9, 2, 8)
+
+
+# --------------------------------------------------------------------------- #
+# DSElasticAgent: checkpoint-based recovery wiring (ISSUE 6)
+# --------------------------------------------------------------------------- #
+
+def test_agent_legacy_run_fn_signature_unchanged():
+    """Without ckpt_dir the agent calls run_fn with the original 4 kwargs —
+    existing supervisors keep working."""
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    seen = []
+
+    def run_fn(world_size, micro_batch, gas, resume):
+        seen.append((world_size, micro_batch, gas, resume))
+
+    rec = DSElasticAgent(
+        {"elasticity": {"enabled": True, "max_train_batch_size": 32,
+                        "micro_batch_sizes": [4, 8], "min_gpus": 1,
+                        "max_gpus": 8}},
+        run_fn, device_counts=[4]).run()
+    assert len(seen) == 1 and seen[0][0] == 4 and seen[0][3] is False
+    assert rec.resume_from is None
+
+
+def test_agent_restart_resumes_from_newest_complete_checkpoint(tmp_path):
+    """A run that dies mid-training restarts at the next world size with
+    ``resume_from`` pointing at a universal conversion of the newest COMPLETE
+    tag — torn tags (a death mid-checkpoint-write) are skipped."""
+    import json
+    import numpy as np
+    from deepspeed_tpu.checkpoint.state import (commit_checkpoint,
+                                                write_checkpoint_files)
+    from deepspeed_tpu.checkpoint.engine import NativeCheckpointEngine
+    from deepspeed_tpu.checkpoint.universal import META_FILE, load_universal
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+    ckpt_dir = str(tmp_path / "ck")
+    eng = NativeCheckpointEngine()
+    flat = {"w": np.arange(8, dtype=np.float32)}
+    # complete tag at step 3 ...
+    files = write_checkpoint_files(eng, ckpt_dir, "rolling_step3", flat, flat,
+                                   {"global_steps": 3})
+    commit_checkpoint(eng, ckpt_dir, "rolling_step3", files)
+    # ... and a TORN tag at step 6 (no manifest, missing optim shard)
+    import os as _os
+    _os.makedirs(_os.path.join(ckpt_dir, "rolling_step6"), exist_ok=True)
+    np.savez(_os.path.join(ckpt_dir, "rolling_step6", "model_states"), **flat)
+
+    calls = []
+
+    def run_fn(world_size, micro_batch, gas, resume, resume_from):
+        calls.append((world_size, resume, resume_from))
+        if len(calls) == 1:
+            raise RuntimeError("preempted")   # first run dies mid-training
+
+    agent = DSElasticAgent(
+        {"elasticity": {"enabled": True, "max_train_batch_size": 32,
+                        "micro_batch_sizes": [4, 8], "min_gpus": 1,
+                        "max_gpus": 8}},
+        run_fn, device_counts=[4, 2], max_restarts=2, ckpt_dir=ckpt_dir)
+    rec = agent.run()
+    assert [c[:2] for c in calls] == [(4, False), (2, True)]
+    assert calls[0][2] is None
+    resume_from = calls[1][2]
+    assert resume_from is not None and "rolling_step3" in resume_from
+    # the conversion is a loadable universal checkpoint of the COMPLETE tag
+    master, optim, meta = load_universal(resume_from)
+    np.testing.assert_array_equal(master["w"], flat["w"])
+    assert meta["source_tag"] == "rolling_step3"
+    assert rec.world_size == 2 and rec.resume_from == resume_from
+
+
+def test_agent_restart_without_any_checkpoint_starts_from_scratch(tmp_path):
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    calls = []
+
+    def run_fn(world_size, micro_batch, gas, resume, resume_from):
+        calls.append((resume, resume_from))
+        if len(calls) == 1:
+            raise RuntimeError("died before the first checkpoint")
+
+    DSElasticAgent(
+        {"elasticity": {"enabled": True, "max_train_batch_size": 32,
+                        "micro_batch_sizes": [4, 8], "min_gpus": 1,
+                        "max_gpus": 8}},
+        run_fn, device_counts=[4, 2], max_restarts=1,
+        ckpt_dir=str(tmp_path / "empty")).run()
+    assert calls == [(False, None), (True, None)]

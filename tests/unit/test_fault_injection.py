@@ -1,0 +1,388 @@
+"""Fault-injection suite (ISSUE 6): every injected failure either recovers
+via bounded retry or surfaces as a clean exception with all pooled buffers
+released — no hangs, no silent corruption.
+
+Covers the harness itself (deterministic seeding, the plan grammar, env
+arming), the checkpoint writer sites (crash -> retry recovery / budget
+exhaustion surfacing at commit; stall -> commit ordering still holds), the
+AIO sites through the NVMe swapper (submit errno, wait errno, stall +
+io_timeout_s), and the elastic agent's restart site.
+"""
+
+import errno
+import os
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.utils import fault_injection as fi
+from deepspeed_tpu.utils.resilience import (DeferredCall, IOTimeout,
+                                            retry_call)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+# --------------------------------------------------------------------------- #
+# the harness
+# --------------------------------------------------------------------------- #
+
+def test_parse_plan_grammar():
+    inj = fi.parse_plan(
+        "ckpt.writer:at=3:action=kill;aio.read:every=5:action=errno:errno=5;"
+        "ckpt.stall:at=1:action=stall:delay_s=0.5", seed=7)
+    specs = {s.site: s for group in inj._specs.values() for s in group}
+    assert specs["ckpt.writer"].at == 3
+    assert specs["ckpt.writer"].action == "kill"
+    assert specs["aio.read"].every == 5
+    assert specs["aio.read"].action == "errno"
+    assert specs["aio.read"].errno == 5
+    assert specs["ckpt.stall"].delay_s == 0.5
+    with pytest.raises(ValueError, match="unknown fault-spec key"):
+        fi.parse_plan("x:bogus=1")
+    with pytest.raises(ValueError, match="unknown fault action"):
+        fi.parse_plan("x:action=explode")
+
+
+def test_at_and_every_triggers():
+    fi.install(fi.parse_plan("s:at=2;t:every=3"))
+    hits = [bool(fi.active().hit("s")) for _ in range(4)]
+    assert hits == [False, True, False, False]
+    hits = [bool(fi.active().hit("t")) for _ in range(7)]
+    assert hits == [False, False, True, False, False, True, False]
+
+
+def test_seeded_probability_is_deterministic_and_keyed():
+    a = fi.FaultSpec(site="s", p=0.5)
+    fires_a = [a.should_fire(h, seed=42) for h in range(1, 200)]
+    b = fi.FaultSpec(site="s", p=0.5)
+    fires_b = [b.should_fire(h, seed=42) for h in range(1, 200)]
+    # same (seed, site, hit) key -> identical decisions, replayable runs
+    assert fires_a == fires_b
+    assert any(fires_a) and not all(fires_a)
+    c = fi.FaultSpec(site="s", p=0.5)
+    assert [c.should_fire(h, seed=43) for h in range(1, 200)] != fires_a
+
+
+def test_max_fires_bounds_firings():
+    fi.install(fi.FaultInjector([fi.FaultSpec(site="s", every=1,
+                                              max_fires=2)]))
+    fired = [bool(fi.active().hit("s")) for _ in range(5)]
+    assert fired == [True, True, False, False, False]
+
+
+def test_maybe_fail_raises_injected_oserror():
+    fi.install(fi.parse_plan("s:at=1:errno=28"))
+    with pytest.raises(fi.InjectedFault) as ei:
+        fi.maybe_fail("s")
+    assert isinstance(ei.value, OSError)   # IO-shaped retry policies catch it
+    assert ei.value.errno == 28
+    fi.maybe_fail("s")   # hit 2: no fire
+
+
+def test_maybe_rc_returns_negative_errno():
+    fi.install(fi.parse_plan("s:at=1:action=errno:errno=5"))
+    assert fi.maybe_rc("s") == -5
+    assert fi.maybe_rc("s") == 0
+
+
+def test_inactive_sites_are_free():
+    assert fi.active() is None
+    fi.maybe_fail("anything")           # no injector: pure no-op
+    assert fi.maybe_rc("anything") == 0
+
+
+def test_install_from_env(monkeypatch):
+    monkeypatch.setenv("DSTPU_FAULTS", "s:at=1")
+    monkeypatch.setenv("DSTPU_SEED", "9")
+    inj = fi.install_from_env()
+    assert inj is not None and inj.seed == 9
+    # idempotent: an already-installed injector wins
+    assert fi.install_from_env() is inj
+    fi.clear()
+    monkeypatch.setenv("DSTPU_FAULTS", "")
+    assert fi.install_from_env() is None
+
+
+# --------------------------------------------------------------------------- #
+# resilience primitives
+# --------------------------------------------------------------------------- #
+
+def test_retry_call_bounded_and_surfacing():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError(5, "transient")
+        return "ok"
+
+    retried = []
+    assert retry_call(flaky, attempts=3, backoff_s=0.001,
+                      on_retry=lambda a, e: retried.append(a)) == "ok"
+    assert len(calls) == 3 and retried == [1, 2]
+
+    calls.clear()
+    with pytest.raises(OSError):        # budget exhausted -> surfaces
+        retry_call(flaky, attempts=2, backoff_s=0.001)
+    assert len(calls) == 2
+
+    with pytest.raises(ValueError):     # non-retry_on exceptions pass through
+        retry_call(lambda: (_ for _ in ()).throw(ValueError("nope")),
+                   attempts=3, backoff_s=0.001)
+
+
+def test_deferred_call_timeout_then_rejoin():
+    release = []
+
+    def slow():
+        while not release:
+            time.sleep(0.005)
+        return 41
+
+    call = DeferredCall(slow, describe="slow io")
+    with pytest.raises(IOTimeout, match="slow io"):
+        call.result(0.02)
+    assert not call.done                # still running after the timeout
+    release.append(1)
+    assert call.result(None) == 41      # re-join retires it for real
+    assert call.done
+
+
+# --------------------------------------------------------------------------- #
+# checkpoint writer sites
+# --------------------------------------------------------------------------- #
+
+def test_writer_crash_recovers_via_bounded_retry(tmp_path):
+    from deepspeed_tpu.checkpoint.engine import build_checkpoint_engine
+    fi.install(fi.parse_plan("ckpt.writer:at=1"))   # first attempt fails
+    eng = build_checkpoint_engine("native",
+                                  {"writer_retries": 2,
+                                   "writer_backoff_s": 0.001})
+    eng.save({"a": np.arange(8, dtype=np.float32)}, str(tmp_path / "x.npz"))
+    assert eng.retries == 1                         # observable in stats
+    np.testing.assert_array_equal(np.load(str(tmp_path / "x.npz"))["a"],
+                                  np.arange(8, dtype=np.float32))
+
+
+def test_writer_crash_budget_exhaustion_surfaces_at_commit(tmp_path):
+    from deepspeed_tpu.checkpoint.engine import build_checkpoint_engine
+    fi.install(fi.parse_plan("ckpt.writer:every=1"))   # every attempt fails
+    eng = build_checkpoint_engine("async", {"writer_retries": 1,
+                                            "writer_backoff_s": 0.001})
+    eng.save({"a": np.zeros(4, np.float32)}, str(tmp_path / "x.npz"))
+    with pytest.raises(fi.InjectedFault):
+        eng.commit("t")                                # never swallowed
+    assert not os.path.exists(str(tmp_path / "x.npz"))
+    assert not any(".tmp" in f for f in os.listdir(str(tmp_path)))
+    eng.close()
+
+
+def test_writer_stall_keeps_commit_ordering(tmp_path):
+    """A slow writer (injected stall) must not let ``latest`` flip early."""
+    from deepspeed_tpu.checkpoint.engine import build_checkpoint_engine
+    from deepspeed_tpu.checkpoint.state import (commit_checkpoint,
+                                                write_checkpoint_files)
+    fi.install(fi.parse_plan("ckpt.stall:every=1:action=stall:delay_s=0.1"))
+    eng = build_checkpoint_engine("async")
+    flat = {"a": np.arange(16, dtype=np.float32)}
+    files = write_checkpoint_files(eng, str(tmp_path), "t1", flat, flat,
+                                   {"global_steps": 1})
+    commit_checkpoint(eng, str(tmp_path), "t1", files)
+    # commit returned -> files durable BEFORE latest flipped
+    assert open(str(tmp_path / "latest")).read() == "t1"
+    for fname in ("model_states.npz", "optim_states.npz"):
+        np.testing.assert_array_equal(
+            np.load(str(tmp_path / "t1" / fname))["a"], flat["a"])
+    eng.close()
+
+
+# --------------------------------------------------------------------------- #
+# AIO sites through the NVMe swapper
+# --------------------------------------------------------------------------- #
+
+def _swapper(tmp_path, cls=None, **kw):
+    from deepspeed_tpu.runtime.swap_tensor import (OptimizerStateSwapper,
+                                                   PipelinedOptimizerSwapper)
+    cls = cls or OptimizerStateSwapper
+    sw = cls(str(tmp_path / "swap"), **kw)
+    for i in range(4):
+        sw.register(f"t{i}", np.full(64, float(i), np.float32))
+    return sw
+
+
+def test_aio_read_error_retries_then_recovers(tmp_path):
+    sw = _swapper(tmp_path, io_retries=2)
+    fi.install(fi.parse_plan("aio.read:at=1:action=errno:errno=5"))
+    base = sw.pool.outstanding
+    views = sw.swap_in(["t0", "t1"])           # first submit fails, retry wins
+    np.testing.assert_array_equal(views["t0"], np.full(64, 0.0, np.float32))
+    assert sw.io_retries_taken == 1
+    sw.swap_out(["t0", "t1"])
+    assert sw.pool.outstanding == base
+    sw.close()
+
+
+def test_aio_read_error_exhaustion_surfaces_with_pool_at_baseline(tmp_path):
+    sw = _swapper(tmp_path, io_retries=1)
+    fi.install(fi.parse_plan("aio.read:every=1:action=errno:errno=5"))
+    base = sw.pool.outstanding
+    with pytest.raises(OSError):
+        sw.swap_in(["t0", "t1"])
+    assert sw.pool.outstanding == base       # nothing leaked
+    sw.close()
+
+
+def test_aio_read_raise_retries_then_recovers_pool_at_baseline(tmp_path):
+    """A submit that RAISES (action=raise, not the rc contract) must release
+    the attempt's claimed buffers before surfacing, so the retry re-claims
+    cleanly instead of orphaning them."""
+    sw = _swapper(tmp_path, io_retries=2)
+    fi.install(fi.parse_plan("aio.read:at=1:action=raise"))
+    base = sw.pool.outstanding
+    views = sw.swap_in(["t0", "t1"])           # first submit raises, retry wins
+    np.testing.assert_array_equal(views["t1"], np.full(64, 1.0, np.float32))
+    sw.swap_out(["t0", "t1"])
+    assert sw.pool.outstanding == base
+    sw.close()
+
+
+def test_aio_read_raise_exhaustion_surfaces_with_pool_at_baseline(tmp_path):
+    sw = _swapper(tmp_path, io_retries=1)
+    fi.install(fi.parse_plan("aio.read:every=1:action=raise"))
+    base = sw.pool.outstanding
+    with pytest.raises(fi.InjectedFault):
+        sw.swap_in(["t0", "t1"])
+    assert sw.pool.outstanding == base       # nothing leaked
+    sw.close()
+
+
+def test_aio_write_raise_releases_pool_after_drain(tmp_path):
+    sw = _swapper(tmp_path, io_retries=0)
+    fi.install(fi.parse_plan("aio.write:at=2:action=raise"))
+    base = sw.pool.outstanding
+    sw.swap_in(["t0", "t1"])
+    with pytest.raises(fi.InjectedFault):
+        sw.swap_out(["t0", "t1"])            # 2nd submit raises mid-batch
+    assert sw.handle.inflight() == 0         # earlier submit drained first
+    assert sw.pool.outstanding == base
+    sw.close()
+
+
+def test_aio_write_error_in_pipelined_run_aborts_clean(tmp_path):
+    from deepspeed_tpu.runtime.swap_tensor import PipelinedOptimizerSwapper
+    sw = _swapper(tmp_path, cls=PipelinedOptimizerSwapper, io_retries=0)
+    fi.install(fi.parse_plan("aio.write:at=2:action=errno:errno=28"))
+    base = sw.pool.outstanding
+    with pytest.raises(OSError):
+        sw.run([["t0", "t1"], ["t2", "t3"]], lambda views: None)
+    assert sw.pool.outstanding == base
+    sw.close()
+
+
+def test_aio_wait_error_surfaces_after_real_drain(tmp_path):
+    sw = _swapper(tmp_path, io_retries=0)
+    fi.install(fi.parse_plan("aio.wait:at=1:action=errno:errno=5"))
+    base = sw.pool.outstanding
+    with pytest.raises(OSError):
+        sw.swap_in(["t0"])
+    # the REAL wait ran first (buffers coherent), then the injected rc landed
+    assert sw.handle.inflight() == 0
+    assert sw.pool.outstanding == base
+    sw.close()
+
+
+def test_aio_stall_with_io_timeout_raises_clean_iotimeout(tmp_path):
+    """A stalled wait under ``io_timeout_s`` surfaces IOTimeout; the
+    straggling IO is re-joined before buffers recycle (pool at baseline)."""
+    sw = _swapper(tmp_path, io_retries=0, io_timeout_s=0.05)
+    fi.install(fi.parse_plan("aio.wait:at=1:action=stall:delay_s=0.3"))
+    base = sw.pool.outstanding
+    t0 = time.perf_counter()
+    with pytest.raises(IOTimeout):
+        sw.swap_in(["t0", "t1"])
+    assert time.perf_counter() - t0 < 5.0      # no hang
+    assert sw.pool.outstanding == base       # joined stragglers, released
+    assert not sw._stragglers
+    # the swapper is still usable after the timeout surfaced
+    fi.clear()
+    views = sw.swap_in(["t0"])
+    np.testing.assert_array_equal(views["t0"], np.full(64, 0.0, np.float32))
+    sw.swap_out(["t0"])
+    sw.close()
+
+
+def test_pipelined_stall_timeout_aborts_with_pool_at_baseline(tmp_path):
+    from deepspeed_tpu.runtime.swap_tensor import PipelinedOptimizerSwapper
+    sw = _swapper(tmp_path, cls=PipelinedOptimizerSwapper, io_retries=0,
+                  io_timeout_s=0.05)
+    fi.install(fi.parse_plan("aio.wait:at=2:action=stall:delay_s=0.3"))
+    base = sw.pool.outstanding
+    with pytest.raises(IOTimeout):
+        sw.run([["t0", "t1"], ["t2", "t3"]], lambda views: None)
+    assert sw.pool.outstanding == base
+    assert not sw._stragglers
+    sw.close()
+
+
+# --------------------------------------------------------------------------- #
+# elastic agent restart site
+# --------------------------------------------------------------------------- #
+
+def test_agent_run_site_consumes_restart_budget():
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    fi.install(fi.parse_plan("agent.run:at=1:errno=104"))   # first start dies
+    runs = []
+
+    def run_fn(world_size, micro_batch, gas, resume):
+        runs.append((world_size, resume))
+
+    agent = DSElasticAgent(
+        {"elasticity": {"enabled": True, "max_train_batch_size": 32,
+                        "micro_batch_sizes": [4, 8], "min_gpus": 1,
+                        "max_gpus": 8}},
+        run_fn, device_counts=[4, 2], max_restarts=2)
+    rec = agent.run()
+    assert runs == [(2, True)]              # restarted on the next membership
+    assert rec.restarts == 1
+    assert agent.records[0].error and "InjectedFault" in agent.records[0].error
+
+
+def test_io_timeout_is_never_retried(tmp_path):
+    """IOTimeout IS an OSError (via TimeoutError), but the retry wrapper must
+    NOT re-run a timed-out attempt: the straggling wait is still running, and
+    a re-submit would claim fresh buffers while the old ones are still DMA
+    targets. It surfaces immediately, once."""
+    sw = _swapper(tmp_path, io_retries=3, io_timeout_s=0.05)
+    fi.install(fi.parse_plan("aio.wait:every=1:action=stall:delay_s=0.3"))
+    base = sw.pool.outstanding
+    reads_before = fi.active().hits("aio.read")
+    with pytest.raises(IOTimeout):
+        sw.swap_in(["t0", "t1"])
+    # exactly ONE attempt: no retry, no re-submitted reads, no retry count
+    assert fi.active().hits("aio.read") == reads_before + 2
+    assert sw.io_retries_taken == 0
+    assert sw.pool.outstanding == base
+    sw.close()
+
+
+def test_aio_wait_raise_action_lands_after_drain(tmp_path):
+    """action=raise on aio.wait: the real drain runs first, so the handle's
+    pinned buffers are released before the injected failure surfaces."""
+    sw = _swapper(tmp_path, io_retries=0)
+    fi.install(fi.parse_plan("aio.wait:at=1"))     # default action=raise
+    base = sw.pool.outstanding
+    with pytest.raises(fi.InjectedFault):
+        sw.swap_in(["t0"])
+    assert sw.handle.inflight() == 0               # drained, not pinned
+    assert sw.pool.outstanding == base
+    fi.clear()
+    views = sw.swap_in(["t0"])                     # handle still coherent
+    np.testing.assert_array_equal(views["t0"], np.full(64, 0.0, np.float32))
+    sw.swap_out(["t0"])
+    sw.close()

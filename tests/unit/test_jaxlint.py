@@ -552,6 +552,9 @@ def test_jl007_shipped_config_covers_training_engine():
     # the offloaded optimizer pipeline is a hot path too: a stray blocking
     # fetch there re-serialises the fetch/step/upload overlap
     assert "deepspeed_tpu/runtime/zero/offload.py" in hot
+    # the rolling-checkpoint snapshot runs ON the step loop's critical path:
+    # every device fetch there must route through the policed drain point
+    assert "deepspeed_tpu/checkpoint/rolling.py" in hot
 
 
 def test_jl007_offload_module_fetch_flagged():

@@ -1,0 +1,326 @@
+"""Rolling-checkpoint tests (ISSUE 6 tentpole): cadence, commit ordering,
+backpressure, retention, shutdown flush, and resume-from-newest-complete.
+"""
+
+import csv
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint.state import (find_resume_tag, read_latest_tag,
+                                            tag_problem)
+from deepspeed_tpu.config import ConfigError
+
+
+def _mlp_engine(save_dir, every=2, keep_last=2, max_pending=1, extra=None,
+                writers=2):
+    import jax.numpy as jnp
+
+    def model(params, b):
+        pred = jnp.tanh(b["x"] @ params["w"])
+        return jnp.mean((pred - b["y"]) ** 2)
+
+    rng = np.random.default_rng(0)
+    params = {"w": rng.standard_normal((16, 4)).astype(np.float32) * 0.1}
+    cfg = {"train_batch_size": 8, "steps_per_print": 0,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+           "checkpoint": {"engine": "async", "writers": writers,
+                          "rolling": {"every_n_steps": every,
+                                      "save_dir": str(save_dir),
+                                      "keep_last": keep_last,
+                                      "max_pending": max_pending}}}
+    if extra:
+        cfg.update(extra)
+    engine, *_ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                          config=cfg)
+    return engine
+
+
+def _batch(step):
+    rng = np.random.default_rng(100 + step)
+    return {"x": rng.standard_normal((8, 16)).astype(np.float32),
+            "y": rng.standard_normal((8, 4)).astype(np.float32)}
+
+
+def test_rolling_cadence_and_latest_ordering(tmp_path):
+    eng = _mlp_engine(tmp_path, every=2, keep_last=8)
+    for step in range(5):
+        eng.train_batch(_batch(step))
+    eng._rolling.flush()
+    # saves at steps 2 and 4; each complete with a manifest; latest = newest
+    for tag in ("rolling_step2", "rolling_step4"):
+        assert tag_problem(str(tmp_path), tag, verify=True) is None
+    assert read_latest_tag(str(tmp_path)) == "rolling_step4"
+    assert eng._rolling.saves == 2
+    # a resumed engine picks the newest complete tag and continues at step 4
+    eng2 = _mlp_engine(tmp_path / "other", every=0)
+    eng2.train_batch(_batch(0))
+    eng2.load_checkpoint(str(tmp_path))
+    assert eng2.global_steps == 4
+    eng.destroy()
+    eng2.destroy()
+
+
+def test_rolling_resumed_stream_matches_uninterrupted(tmp_path):
+    """The property the whole subsystem exists for, in-process: losses after
+    a resume from a rolling tag equal the uninterrupted run's."""
+    eng = _mlp_engine(tmp_path / "a", every=3, keep_last=8)
+    uninterrupted = [float(eng.train_batch(_batch(s))) for s in range(6)]
+    eng.destroy()
+
+    eng2 = _mlp_engine(tmp_path / "b", every=3, keep_last=8)
+    eng2.train_batch(_batch(0))   # initialise jits
+    eng2.load_checkpoint(str(tmp_path / "a"), tag="rolling_step3",
+                         verify=True)
+    resumed = [float(eng2.train_batch(_batch(s))) for s in range(3, 6)]
+    assert resumed == uninterrupted[3:]
+    eng2.destroy()
+
+
+def test_rolling_retention_prunes_but_never_latest(tmp_path):
+    eng = _mlp_engine(tmp_path, every=1, keep_last=2)
+    for step in range(5):
+        eng.train_batch(_batch(step))
+    eng._rolling.flush()
+    tags = sorted(d for d in os.listdir(str(tmp_path))
+                  if d.startswith("rolling_step"))
+    # keep_last=2 -> newest two survive; latest points at the newest
+    assert tags == ["rolling_step4", "rolling_step5"]
+    assert read_latest_tag(str(tmp_path)) == "rolling_step5"
+    assert eng.ckpt_stats.pruned == 3
+    eng.destroy()
+
+
+def test_rolling_user_tags_never_pruned(tmp_path):
+    eng = _mlp_engine(tmp_path, every=1, keep_last=1)
+    eng.train_batch(_batch(0))
+    eng.save_checkpoint(str(tmp_path), tag="user_milestone")
+    for step in range(1, 4):
+        eng.train_batch(_batch(step))
+    eng._rolling.flush()
+    assert os.path.isdir(str(tmp_path / "user_milestone"))   # retention skips
+    assert tag_problem(str(tmp_path), "user_milestone") is None
+    eng.destroy()
+
+
+def test_rolling_backpressure_bounds_writer_lag(tmp_path, monkeypatch):
+    """With a committer slower than the cadence, at most ``max_pending``
+    snapshots may be queued-but-uncommitted; the next save BLOCKS (charged to
+    backpressure) instead of growing the queue."""
+    from deepspeed_tpu.checkpoint import rolling as rolling_mod
+
+    real_commit = rolling_mod.commit_checkpoint
+    gate = threading.Event()
+    committed = []
+
+    def slow_commit(*a, **k):
+        gate.wait(5.0)
+        committed.append(a[2])
+        return real_commit(*a, **k)
+
+    monkeypatch.setattr(rolling_mod, "commit_checkpoint", slow_commit)
+    eng = _mlp_engine(tmp_path, every=1, keep_last=8, max_pending=1)
+    eng.train_batch(_batch(0))   # save 1 queues; committer blocks on gate
+
+    t = threading.Thread(target=lambda: eng.train_batch(_batch(1)))
+    t.start()
+    # save 2 must be BLOCKED in backpressure (queue full), not queued deeper
+    time.sleep(0.3)
+    assert t.is_alive()
+    assert eng._rolling._jobs.qsize() <= 1
+    gate.set()
+    t.join(10.0)
+    assert not t.is_alive()
+    eng._rolling.flush()
+    assert committed == ["rolling_step1", "rolling_step2"]   # FIFO tag order
+    assert eng.ckpt_stats.backpressure_ms > 0.0
+    eng.destroy()
+
+
+def test_rolling_commit_failure_surfaces_at_next_save(tmp_path, monkeypatch):
+    from deepspeed_tpu.checkpoint import rolling as rolling_mod
+
+    def exploding_commit(*a, **k):
+        raise OSError(28, "disk full")
+
+    monkeypatch.setattr(rolling_mod, "commit_checkpoint", exploding_commit)
+    eng = _mlp_engine(tmp_path, every=1)
+    eng.train_batch(_batch(0))       # save 1: commit fails on the committer
+    eng._rolling._jobs.join()        # let the failure land
+    with pytest.raises(OSError, match="disk full"):
+        eng.train_batch(_batch(1))   # surfaces at the NEXT save — never lost
+    monkeypatch.undo()
+    eng.destroy()
+
+
+def test_destroy_surfaces_commit_error_after_full_teardown(tmp_path,
+                                                           monkeypatch):
+    """A commit error pending at destroy() must surface — but only AFTER the
+    rest of the teardown ran (writers closed, committer stopped): a raising
+    close must not leak a live committer that can still flip `latest`."""
+    from deepspeed_tpu.checkpoint import rolling as rolling_mod
+
+    def exploding_commit(*a, **k):
+        raise OSError(28, "disk full")
+
+    monkeypatch.setattr(rolling_mod, "commit_checkpoint", exploding_commit)
+    eng = _mlp_engine(tmp_path, every=1)
+    eng.train_batch(_batch(0))       # save 1: commit fails on the committer
+    eng._rolling._jobs.join()
+    rolling = eng._rolling
+    with pytest.raises(OSError, match="disk full"):
+        eng.destroy()
+    assert rolling._committer is None            # committer actually stopped
+    assert eng._ckpt_engine._closed              # teardown past the raise ran
+    eng.destroy()                                # idempotent, no re-raise
+
+
+def test_destroy_flushes_inflight_rolling_writes(tmp_path, monkeypatch):
+    """engine.destroy() with a SLOW writer: in-flight rolling writers must
+    finish and commit before the checkpoint engine closes (the satellite's
+    regression case)."""
+    from deepspeed_tpu.checkpoint import engine as ckpt_engine_mod
+
+    real = ckpt_engine_mod._atomic_savez
+
+    def slow_savez(path, state_dict):
+        time.sleep(0.2)
+        real(path, state_dict)
+
+    monkeypatch.setattr(ckpt_engine_mod, "_atomic_savez", slow_savez)
+    eng = _mlp_engine(tmp_path, every=1)
+    eng.train_batch(_batch(0))
+    eng.destroy()   # must block on the slow writers, then commit
+    assert tag_problem(str(tmp_path), "rolling_step1", verify=True) is None
+    assert read_latest_tag(str(tmp_path)) == "rolling_step1"
+
+
+def test_async_engine_atexit_flush_is_registered(tmp_path):
+    """The async engine's atexit hook is the destroy()-never-ran safety net;
+    close() unregisters it (no double flush, no leak)."""
+    import atexit
+    from unittest import mock
+    from deepspeed_tpu.checkpoint.engine import AsyncCheckpointEngine
+
+    with mock.patch.object(atexit, "register") as reg, \
+            mock.patch.object(atexit, "unregister") as unreg:
+        eng = AsyncCheckpointEngine()
+        reg.assert_called_once_with(eng._atexit_flush)
+        eng.save({"a": np.zeros(4, np.float32)}, str(tmp_path / "x.npz"))
+        eng.close()
+        unreg.assert_called_once_with(eng._atexit_flush)
+    assert os.path.exists(str(tmp_path / "x.npz"))   # close drained the write
+    # _atexit_flush itself never raises, even after close
+    eng._atexit_flush()
+
+
+def test_rolling_config_requires_save_dir():
+    import jax.numpy as jnp
+    with pytest.raises(ConfigError, match="save_dir"):
+        _mlp_engine("", every=2)
+
+
+def test_rolling_disabled_by_default(tmp_path):
+    eng = _mlp_engine(tmp_path, every=0)
+    eng.train_batch(_batch(0))
+    assert eng._rolling is None
+    assert not any(d.startswith("rolling") for d in os.listdir(str(tmp_path)))
+    eng.destroy()
+
+
+def test_ckpt_stats_emitted_at_print_boundary(tmp_path):
+    """``train/ckpt/*`` events land beside TrainPipelineStats at print
+    boundaries (the monitor satellite)."""
+    eng = _mlp_engine(
+        tmp_path / "ck", every=1,
+        extra={"steps_per_print": 1,
+               "csv_monitor": {"enabled": True,
+                               "output_path": str(tmp_path / "mon"),
+                               "job_name": "ckpt_job"}})
+    eng.train_batch(_batch(0))
+    eng.train_batch(_batch(1))
+    eng.drain_metrics()
+    eng._rolling.flush()
+    eng.train_batch(_batch(2))
+    eng.drain_metrics()
+    snap_file = os.path.join(str(tmp_path / "mon"), "ckpt_job",
+                             "train_ckpt_snapshot_ms_per_save.csv")
+    assert os.path.exists(snap_file)
+    with open(snap_file) as f:
+        rows = list(csv.reader(f))
+    assert len(rows) >= 2
+    assert float(rows[1][1]) >= 0.0
+    saves_file = os.path.join(str(tmp_path / "mon"), "ckpt_job",
+                              "train_ckpt_saves.csv")
+    with open(saves_file) as f:
+        rows = list(csv.reader(f))
+    assert float(rows[-1][1]) >= 1.0
+    eng.destroy()
+
+
+def test_ckpt_stats_counters_and_events():
+    from deepspeed_tpu.monitor import CheckpointStats
+    st = CheckpointStats()
+    st.record_save(snapshot_s=0.002, backpressure_s=0.001, queue_depth=3)
+    st.record_commit(commit_s=0.004, pruned=2)
+    st.record_save(snapshot_s=0.004)
+    st.retries = 5
+    ev = {name: val for name, val, _ in st.events(7)}
+    assert ev["train/ckpt/saves"] == 2.0
+    assert ev["train/ckpt/snapshot_ms_per_save"] == pytest.approx(3.0)
+    assert ev["train/ckpt/commit_ms_per_save"] == pytest.approx(2.0)
+    assert ev["train/ckpt/backpressure_ms_per_save"] == pytest.approx(0.5)
+    assert ev["train/ckpt/writer_queue_depth"] == pytest.approx(1.5)
+    assert ev["train/ckpt/retries"] == 5.0
+    assert ev["train/ckpt/pruned_tags"] == 2.0
+    st.reset()
+    assert st.saves == 0 and st.snapshot_ms == 0.0 and st.retries == 0
+
+
+def test_latest_never_rolls_backwards_past_user_save(tmp_path):
+    """A queued rolling commit finishing AFTER an inline user save must not
+    flip ``latest`` back to the older rolling tag (the committer's flips are
+    monotonic); un-numbered user tags always win the flip."""
+    from deepspeed_tpu.checkpoint.state import write_latest_tag
+    # direct semantics: monotonic flip refuses to go backwards...
+    write_latest_tag(str(tmp_path), "global_step7")
+    write_latest_tag(str(tmp_path), "rolling_step6", monotonic=True)
+    assert read_latest_tag(str(tmp_path)) == "global_step7"
+    # ...but moves forward, and non-monotonic (user) flips always land
+    write_latest_tag(str(tmp_path), "rolling_step9", monotonic=True)
+    assert read_latest_tag(str(tmp_path)) == "rolling_step9"
+    write_latest_tag(str(tmp_path), "best_model")
+    assert read_latest_tag(str(tmp_path)) == "best_model"
+
+    # end to end: a user save at step 2 lands while rolling_step1's commit is
+    # stuck in the queue; when the committer catches up, latest must still
+    # name the newer user tag
+    import threading as _th
+    from deepspeed_tpu.checkpoint import rolling as rolling_mod
+    real_commit = rolling_mod.commit_checkpoint
+    gate = _th.Event()
+
+    def slow_commit(*a, **k):
+        gate.wait(5.0)
+        return real_commit(*a, **k)
+
+    import unittest.mock as mock
+    with mock.patch.object(rolling_mod, "commit_checkpoint", slow_commit):
+        eng = _mlp_engine(tmp_path / "run", every=1, max_pending=2)
+        eng.train_batch(_batch(0))            # rolling_step1 queued, stuck
+        eng.train_batch(_batch(1))            # step 2...
+        eng.save_checkpoint(str(tmp_path / "run"), tag="global_step2")
+        assert read_latest_tag(str(tmp_path / "run")) == "global_step2"
+        gate.set()
+        eng._rolling.flush()
+    # rolling_step1 committed late — complete, but latest never rolled back
+    # to it (a same-step tag may legitimately win the flip; both hold the
+    # state after step 2)
+    assert tag_problem(str(tmp_path / "run"), "rolling_step1") is None
+    assert read_latest_tag(str(tmp_path / "run")) in ("global_step2",
+                                                      "rolling_step2")
+    eng.destroy()
