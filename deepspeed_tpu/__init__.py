@@ -74,6 +74,10 @@ def initialize(args=None,
     # arm the deterministic fault plan, if any (no-op unless $DSTPU_FAULTS is
     # set) — the kill-and-resume bench drives subprocess workers through this
     fault_injection.install_from_env()
+    # arm span tracing from $DSTPU_TRACE (no-op unless set; config.monitor.
+    # trace reaches the same tracer through the engine) — docs/OBSERVABILITY.md
+    from deepspeed_tpu.monitor import trace as _trace
+    _trace.install_from_env()
 
     config = DeepSpeedTPUConfig.load(config if config is not None else config_params)
     comm.init_distributed()

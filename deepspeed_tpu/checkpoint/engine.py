@@ -28,6 +28,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from deepspeed_tpu.monitor.trace import tracer as _tracer
 from deepspeed_tpu.utils import fault_injection
 from deepspeed_tpu.utils.logging import logger
 from deepspeed_tpu.utils.resilience import retry_call
@@ -65,12 +66,16 @@ class CheckpointEngine:
             self.retries += 1
 
         from deepspeed_tpu.checkpoint.state import checksum_flat
-        crc = checksum_flat(state_dict)
-        retry_call(lambda: _atomic_savez(path, state_dict),
-                   attempts=self.writer_attempts,
-                   backoff_s=self.writer_backoff_s,
-                   retry_on=(OSError,), describe=f"checkpoint write {path}",
-                   on_retry=bump)
+        # one span per shard write on the WRITER's track (threads
+        # 'ckpt-writer_*' for the async engine; the caller's otherwise) —
+        # slow disks and retry storms become visible lanes, not mystery gaps
+        with _tracer.span("ckpt/write", file=os.path.basename(path)):
+            crc = checksum_flat(state_dict)
+            retry_call(lambda: _atomic_savez(path, state_dict),
+                       attempts=self.writer_attempts,
+                       backoff_s=self.writer_backoff_s,
+                       retry_on=(OSError,), describe=f"checkpoint write {path}",
+                       on_retry=bump)
         with self._ck_lock:
             self._checksums[path] = crc
 

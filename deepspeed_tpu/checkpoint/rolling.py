@@ -45,6 +45,7 @@ from deepspeed_tpu.checkpoint.state import (commit_checkpoint,
                                             read_latest_tag,
                                             snapshot_state_flats,
                                             write_checkpoint_files)
+from deepspeed_tpu.monitor.trace import tracer as _tracer
 from deepspeed_tpu.utils.logging import logger
 
 if TYPE_CHECKING:  # pragma: no cover - typing only
@@ -134,6 +135,15 @@ class RollingCheckpointer:
             self.stats.record_save(snapshot_s=t1 - t0, backpressure_s=t2 - t_acq,
                                    queue_depth=cke.queue_depth())
             self.stats.retries = cke.retries
+        if _tracer.enabled:
+            # the step loop's view of this save on the ckpt track: the
+            # snapshot (the only phase on the critical path under the async
+            # engine) and any committer backpressure, from the SAME perf
+            # pairs the CheckpointStats aggregates
+            _tracer.add("ckpt/snapshot", t0, t1, lane="ckpt", tag=tag)
+            _tracer.add("ckpt/backpressure", t_acq, t2, lane="ckpt", tag=tag)
+            _tracer.counter("ckpt/writer_queue_depth", cke.queue_depth(),
+                            lane="ckpt")
         self.saves += 1
         return tag
 
@@ -175,9 +185,11 @@ class RollingCheckpointer:
                 # monotonic: an inline user save may have flipped `latest`
                 # to a NEWER step while this tag waited in the queue — the
                 # background commit must never roll the resume point back
-                commit_checkpoint(cke, self.cfg.save_dir, tag, files,
-                                  save_latest=True, monotonic=True)
-                pruned = self._prune(committed=tag)
+                with _tracer.span("ckpt/commit", tag=tag):
+                    commit_checkpoint(cke, self.cfg.save_dir, tag, files,
+                                      save_latest=True, monotonic=True)
+                with _tracer.span("ckpt/prune"):
+                    pruned = self._prune(committed=tag)
                 if self.stats is not None:
                     # host-only IO timing: the committer never touches device
                     # arrays, so there is no dispatch to sync before the clock

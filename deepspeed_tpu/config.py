@@ -413,6 +413,31 @@ class CSVConfig(ConfigModel):
 
 
 @dataclass
+class TraceConfig(ConfigModel):
+    """Span tracing (``monitor/trace.py``, docs/OBSERVABILITY.md): a
+    Perfetto-exportable timeline across the train/serve/offload/checkpoint
+    pipelines plus a crash flight recorder. No direct reference analog — the
+    reference leans on torch.profiler; here the async pipelines carry their
+    own zero-sync span instrumentation. Also armable without config via the
+    ``DSTPU_TRACE=<dir>`` env var (subprocess benches)."""
+
+    enabled: bool = False
+    # where trace_{pid}.json / trace_crash.json land; nonempty implies enabled
+    dir: str = ""
+    # spans retained per thread — bounded memory AND the flight-recorder
+    # window a crash dump preserves
+    ring_size: int = 16384
+
+
+@dataclass
+class MonitorConfig(ConfigModel):
+    """Monitor-subsystem knobs beyond the per-backend sections (which stay
+    top-level for reference parity: ``tensorboard``/``wandb``/``csv_monitor``)."""
+
+    trace: TraceConfig = field(default_factory=TraceConfig)
+
+
+@dataclass
 class FlopsProfilerConfig(ConfigModel):
     """Parity: ``profiling/config.py`` ``DeepSpeedFlopsProfilerConfig``."""
 
@@ -690,6 +715,7 @@ class DeepSpeedTPUConfig(ConfigModel):
     tensorboard: TensorBoardConfig = field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = field(default_factory=WandbConfig)
     csv_monitor: CSVConfig = field(default_factory=CSVConfig)
+    monitor: MonitorConfig = field(default_factory=MonitorConfig)
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
     elasticity: ElasticityConfig = field(default_factory=ElasticityConfig)
     autotuning: AutotuningConfig = field(default_factory=AutotuningConfig)

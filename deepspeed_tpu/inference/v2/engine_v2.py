@@ -41,11 +41,14 @@ from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
 from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache, KVCacheConfig
 from deepspeed_tpu.inference.v2.ragged_model import adapt_model, build_ragged_forward
 from deepspeed_tpu.inference.v2.scheduler import DynamicSplitFuseScheduler
+from deepspeed_tpu.monitor.trace import install_from_env as _trace_from_env
+from deepspeed_tpu.monitor.trace import tracer as _tracer
 from deepspeed_tpu.utils.caching import LRUCache, next_pow2
 from deepspeed_tpu.utils.logging import log_dist
 
 
 import functools
+import time as _time
 
 
 def fetch_to_host(arr) -> np.ndarray:
@@ -57,8 +60,18 @@ def fetch_to_host(arr) -> np.ndarray:
     through one function lets jaxlint rule JL007 statically police the hot
     path for stray blocking fetches (an accidental ``np.asarray(logits)``
     re-introduces the [S, V] per-step transfer this engine exists to avoid).
+
+    Under tracing the drain records a ``serve/drain/fetch_to_host`` span, so
+    host-sync cost on the serving path is always attributed by name
+    (docs/OBSERVABILITY.md).
     """
-    return np.asarray(arr)  # jaxlint: disable=JL007 -- the intentional drain
+    if not _tracer.enabled:
+        return np.asarray(arr)  # jaxlint: disable=JL007 -- the intentional drain
+    t0 = _time.perf_counter()
+    out = np.asarray(arr)  # jaxlint: disable=JL007 -- the intentional drain
+    _tracer.add("serve/drain/fetch_to_host", t0, _time.perf_counter(),
+                lane="serve/drain")
+    return out
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4))
@@ -230,6 +243,9 @@ class InferenceEngineV2:
         # write_monitor_events emits them
         from deepspeed_tpu.monitor.serving import PipelineStats
         self.pipeline_stats = PipelineStats()
+        # serving runs don't pass through deepspeed_tpu.initialize — arm the
+        # span tracer from $DSTPU_TRACE here (no-op when unset/armed)
+        _trace_from_env()
         log_dist(f"engine_v2: family={family} tp={eff_tp} blocks={nb}+scratch "
                  f"block_size={kv_cfg.block_size} budget={sm.max_ragged_batch_size}",
                  ranks=[0])

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deepspeed_tpu.inference.v2.engine_v2 import fetch_to_host
+from deepspeed_tpu.monitor.trace import tracer as _tracer
 
 
 class DecodePipeline:
@@ -183,10 +184,12 @@ class DecodePipeline:
                 # rows retired THIS step still had token j drained + recorded
                 drained_tokens = int(live.sum())
                 cb_s = 0.0
+                tc = tc2 = t2
                 if on_tokens is not None:
                     tc = perf()
                     stop = on_tokens(j, uids, out[j])
-                    cb_s = perf() - tc   # callback cost -> bubble, not build
+                    tc2 = perf()
+                    cb_s = tc2 - tc      # callback cost -> bubble, not build
                     for u in (stop or ()):
                         # uids not in THIS run (already retired, foreign) are
                         # ignored rather than aborting a healthy burst
@@ -203,6 +206,30 @@ class DecodePipeline:
                                build_s=(t3 - t2) - cb_s, wall_s=t3 - t0,
                                fetch_bytes=row.nbytes,
                                live_tokens=drained_tokens)
+                if _tracer.enabled:
+                    # timeline view of the SAME per-step phase measurements
+                    # the stats aggregate (docs/OBSERVABILITY.md): zero-sync,
+                    # perf_counter pairs already taken above. The stats
+                    # charge callback time to bubble, not build — so the
+                    # build span excludes the callback window too (emitted
+                    # as its own serve/decode/callback span)
+                    _tracer.add("serve/decode/dispatch", t0, t1,
+                                lane="serve/decode", step=j)
+                    _tracer.add("serve/decode/drain", t1, t2,
+                                lane="serve/decode", step=j)
+                    if on_tokens is not None:
+                        _tracer.add("serve/decode/build", t2, tc,
+                                    lane="serve/decode", step=j)
+                        _tracer.add("serve/decode/callback", tc, tc2,
+                                    lane="serve/decode", step=j)
+                        _tracer.add("serve/decode/build", tc2, t3,
+                                    lane="serve/decode", step=j)
+                    else:
+                        _tracer.add("serve/decode/build", t2, t3,
+                                    lane="serve/decode", step=j)
+                    _tracer.add("serve/decode/step", t0, t3,
+                                lane="serve/decode", step=j,
+                                live=drained_tokens)
         except BaseException:
             # an escaping on_tokens (or interrupt) must not leave sequence
             # state desynchronized from the KV already written: settle every

@@ -1,14 +1,16 @@
-"""Monitoring backends (parity: ``deepspeed/monitor/``) plus the serving
-pipeline's per-step counters (``serving.PipelineStats``) and the training
-loop's (``training.TrainPipelineStats``)."""
+"""Monitoring backends (parity: ``deepspeed/monitor/``), the per-subsystem
+pipeline counters (``serving.PipelineStats`` / ``training.*Stats``), and the
+span tracer (``trace.tracer`` — the Perfetto-exportable timeline the counters
+are per-window aggregations of; docs/OBSERVABILITY.md)."""
 
 from deepspeed_tpu.monitor.monitor import (CsvMonitor, Monitor, MonitorMaster,
                                            TensorBoardMonitor, WandbMonitor)
 from deepspeed_tpu.monitor.serving import PipelineStats
+from deepspeed_tpu.monitor.trace import Tracer, tracer
 from deepspeed_tpu.monitor.training import (CheckpointStats,
                                             OffloadPipelineStats,
                                             TrainPipelineStats)
 
 __all__ = ["Monitor", "MonitorMaster", "TensorBoardMonitor", "WandbMonitor",
            "CsvMonitor", "PipelineStats", "TrainPipelineStats",
-           "OffloadPipelineStats", "CheckpointStats"]
+           "OffloadPipelineStats", "CheckpointStats", "Tracer", "tracer"]

@@ -10,6 +10,12 @@ and this module turns the totals into ``monitor/`` events
 (``MonitorMaster.write_events`` ``(name, value, step)`` shape, the same
 contract ``PrefixCacheStats.events`` follows).
 
+These counters are per-window aggregations over the SAME measured intervals
+the span tracer records as ``serve/decode/*`` timeline spans
+(``monitor/trace.py``, docs/OBSERVABILITY.md): the pipeline takes one set of
+``perf_counter`` pairs per step and feeds both, so the dashboard numbers and
+the Perfetto trace can never disagree about what was measured.
+
 Phase semantics (per step):
 
 - ``dispatch``: host time spent enqueueing the fused decode program (jax

@@ -1,0 +1,428 @@
+"""Unified span tracing: one Perfetto-exportable timeline across every lane.
+
+PRs 3-6 turned the hot paths into overlapped async pipelines (double-buffered
+decode, multi-step train dispatch, fetch/step/upload offload groups, rolling
+async checkpoints), but observability stayed flat ``(name, value, step)``
+aggregates — you could see that a bubble existed, never *where* it sat
+relative to a dispatch, a D2H drain, upload-lane work, or a committer stall.
+This module is the timeline: every pipeline lane records **spans** (named
+intervals with monotonic-clock endpoints) into a per-thread preallocated ring
+buffer, and an exporter writes Chrome-trace/Perfetto JSON where each lane
+(step loop, prefetch producer, host-Adam workers, upload lane, AIO swapper,
+checkpoint writers, committer) is its own named track — the overlap structure
+becomes visually auditable in https://ui.perfetto.dev.
+
+Design constraints (the regimes PRs 3-6 gated must survive tracing ON):
+
+- **zero device syncs**: spans only ever read ``time.perf_counter()``; no
+  recording path touches a jax array. jaxlint JL008 statically polices that
+  span context managers in hot-path modules never *enclose* a blocking fetch
+  outside the policed drain names, so tracing can't quietly reintroduce the
+  per-step host sync the async loops removed.
+- **no allocation-heavy formatting on the hot path**: a record is one small
+  tuple stored into a preallocated slot (``ring[i % cap] = rec``); names are
+  interned literals at the call sites; all JSON formatting happens at export
+  time, off the steady-state loop.
+- **bounded memory**: each thread keeps only the newest ``ring_size`` spans.
+  That bound is also the **flight recorder** — after a crash the rings hold
+  the final steps' timeline, dumped to ``trace_crash.json`` by the
+  fault-injection kill/raise hooks and fatal engine teardown (and the normal
+  rings export from an atexit hook), so a preempted or wedged run leaves a
+  readable timeline (pairs with ``train_bench.py --preempt``).
+- **true no-op when disabled**: ``add()`` is a two-instruction early return
+  and ``span()`` hands back a shared no-op context manager; hot-path call
+  sites additionally guard on ``tracer.enabled`` so disabled runs don't even
+  stamp clocks for the trace.
+
+Two recording APIs, matching two call-site shapes:
+
+- ``tracer.add(name, t0, t1, lane=..., **args)`` — record a COMPLETED span
+  from ``perf_counter`` timestamps the call site already took for its stats
+  counters. This is the hot-path form: the five ``monitor/`` stat classes
+  and the tracer aggregate the *same* measured intervals (one clock, one
+  measurement — the stats are per-window aggregations of exactly the spans
+  the timeline shows, not a parallel set of hand-rolled timers).
+- ``with tracer.span(name, lane=..., **args):`` — context-manager form for
+  worker lanes (producers, writers, committers, kernel chunks) where the
+  span IS the timing.
+
+``instant(name)`` marks a point event (faults, admissions); ``counter(name,
+value)`` records a Perfetto counter track sample (queue depths).
+
+Tracks: by default a span lands on its recording THREAD's track (threads in
+this tree are descriptively named: ``dstpu-prefetch``, ``dstpu-hostopt_*``,
+``dstpu-offload-upload``, ``ckpt-writer_*``, ``dstpu-ckpt-commit``). A
+``lane="train/step"`` argument overrides the track name — used by the main
+thread, which multiplexes several logical lanes (dispatch/drain phases,
+checkpoint snapshots) that should render as their own rows. Lanes are scoped
+per thread (two threads recording the same lane name get two tracks), so B/E
+nesting within a track is always well-formed.
+
+Enable via ``DSTPU_TRACE=<dir>`` (arms in ``deepspeed_tpu.initialize`` and
+the v2 inference engine) or ``config.monitor.trace`` — docs/OBSERVABILITY.md
+walks the taxonomy, Perfetto workflow, and overhead numbers.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+_ENV_VAR = "DSTPU_TRACE"
+_ENV_RING = "DSTPU_TRACE_RING"
+
+#: default spans retained per thread (the flight-recorder window)
+DEFAULT_RING_SIZE = 16384
+
+# record kinds (Chrome trace phase at export: span -> B/E pair)
+_SPAN, _INSTANT, _COUNTER = "X", "i", "C"
+
+
+#: dead threads' rings retained for export/crash dumps (a finished prefetch
+#: producer's spans must still reach the timeline) — beyond this, the OLDEST
+#: dead rings are pruned at ring registration so thread churn (per-epoch
+#: producers, rebuilt writer pools) cannot grow memory without bound
+MAX_DEAD_RINGS = 32
+
+
+class _Ring:
+    """One thread's preallocated record ring. Single writer (the owning
+    thread), lock-free: ``buf[idx % cap] = rec; idx += 1``. Readers (export)
+    snapshot racily — a slot is either an old record or a new one, never a
+    torn value (CPython list-slot stores are atomic)."""
+
+    __slots__ = ("buf", "idx", "cap", "thread_name", "thread_id", "thread")
+
+    def __init__(self, cap: int, thread: threading.Thread):
+        self.buf: List[Optional[tuple]] = [None] * cap
+        self.idx = 0
+        self.cap = cap
+        self.thread_name = thread.name
+        self.thread_id = thread.ident or 0
+        self.thread = thread   # liveness probe for dead-ring pruning
+
+    def add(self, rec: tuple) -> None:
+        self.buf[self.idx % self.cap] = rec
+        self.idx += 1
+
+    def snapshot(self) -> List[tuple]:
+        """Records in insertion order, oldest kept first (newest ``cap``)."""
+        n = self.idx
+        if n <= self.cap:
+            return [r for r in self.buf[:n] if r is not None]
+        i = n % self.cap
+        return [r for r in self.buf[i:] + self.buf[:i] if r is not None]
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager handed out while tracing is
+    disabled — zero per-call allocation on the disabled path."""
+
+    __slots__ = ()
+    seconds = 0.0
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class Span:
+    """Context manager recording one interval on exit; ``.seconds`` is valid
+    after exit (call sites may feed it to their stats counters)."""
+
+    __slots__ = ("_tracer", "name", "lane", "args", "t0", "t1")
+
+    def __init__(self, tracer: "Tracer", name: str, lane: Optional[str],
+                 args: Optional[dict]):
+        self._tracer = tracer
+        self.name = name
+        self.lane = lane
+        self.args = args
+        self.t0 = 0.0
+        self.t1 = 0.0
+
+    def __enter__(self) -> "Span":
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.t1 = time.perf_counter()
+        self._tracer._record((_SPAN, self.name, self.t0, self.t1, self.lane,
+                              self.args))
+        return False
+
+    @property
+    def seconds(self) -> float:
+        return self.t1 - self.t0
+
+
+class Tracer:
+    """The process-wide tracer (module singleton: :data:`tracer`)."""
+
+    def __init__(self):
+        self.enabled = False
+        self.trace_dir = ""
+        self.ring_size = DEFAULT_RING_SIZE
+        self._rings: List[_Ring] = []
+        self._local = threading.local()
+        self._reg_lock = threading.Lock()
+        self._atexit_installed = False
+        self._crash_path: Optional[str] = None
+
+    # ------------------------------------------------------------------ #
+    # configuration
+    # ------------------------------------------------------------------ #
+
+    def configure(self, trace_dir: str = "", enabled: Optional[bool] = None,
+                  ring_size: Optional[int] = None) -> "Tracer":
+        """Enable (or reconfigure) tracing. ``trace_dir`` nonempty implies
+        enabled and is where the exporter + flight recorder write; an empty
+        dir with ``enabled=True`` records rings without an export target
+        (tests, in-process overhead measurement)."""
+        if trace_dir:
+            self.trace_dir = trace_dir
+        if ring_size:
+            self.ring_size = max(16, int(ring_size))
+        if enabled is None:
+            enabled = bool(trace_dir) or self.enabled
+        self.enabled = bool(enabled)
+        if self.enabled and not self._atexit_installed:
+            self._atexit_installed = True
+            atexit.register(self._atexit_export)
+        return self
+
+    def reset(self) -> None:
+        """Drop every ring and disable (tests). Threads re-register their
+        rings lazily on the next record."""
+        with self._reg_lock:
+            self._rings = []
+        self._local = threading.local()
+        self.enabled = False
+        self.trace_dir = ""
+        self._crash_path = None
+
+    # ------------------------------------------------------------------ #
+    # recording
+    # ------------------------------------------------------------------ #
+
+    def _ring(self) -> _Ring:
+        ring = getattr(self._local, "ring", None)
+        if ring is None:
+            ring = _Ring(self.ring_size, threading.current_thread())
+            self._local.ring = ring
+            with self._reg_lock:
+                # registration is the rare, already-locked path: prune the
+                # OLDEST dead rings beyond the retention bound here so
+                # thread churn never grows the registry without bound
+                dead = [r for r in self._rings if not r.thread.is_alive()]
+                if len(dead) > MAX_DEAD_RINGS:
+                    drop = set(map(id, dead[:len(dead) - MAX_DEAD_RINGS]))
+                    self._rings = [r for r in self._rings
+                                   if id(r) not in drop]
+                self._rings.append(ring)
+        return ring
+
+    def _record(self, rec: tuple) -> None:
+        if self.enabled:
+            self._ring().add(rec)
+
+    def add(self, name: str, t0: float, t1: float, lane: Optional[str] = None,
+            **args: Any) -> None:
+        """Record a completed span from ``time.perf_counter()`` endpoints the
+        call site already measured (the zero-extra-clock hot-path form)."""
+        if not self.enabled:
+            return
+        self._ring().add((_SPAN, name, t0, t1, lane, args or None))
+
+    def span(self, name: str, lane: Optional[str] = None, **args: Any):
+        """Context manager recording ``name`` over the with-body. Returns a
+        shared no-op when disabled."""
+        if not self.enabled:
+            return _NOOP
+        return Span(self, name, lane, args or None)
+
+    def instant(self, name: str, lane: Optional[str] = None, **args: Any) -> None:
+        if not self.enabled:
+            return
+        now = time.perf_counter()
+        self._ring().add((_INSTANT, name, now, now, lane, args or None))
+
+    def counter(self, name: str, value: float, lane: Optional[str] = None) -> None:
+        if not self.enabled:
+            return
+        now = time.perf_counter()
+        self._ring().add((_COUNTER, name, now, now, lane,
+                          {"value": float(value)}))
+
+    # ------------------------------------------------------------------ #
+    # aggregation (the stats classes' view of the same measurements)
+    # ------------------------------------------------------------------ #
+
+    def summary(self) -> Dict[str, Tuple[int, float]]:
+        """``{span name: (count, total seconds)}`` over everything currently
+        retained — the derived-aggregation view the monitor stat classes
+        mirror per window (tests cross-check the two against each other)."""
+        out: Dict[str, Tuple[int, float]] = {}
+        with self._reg_lock:
+            rings = list(self._rings)
+        for ring in rings:
+            for rec in ring.snapshot():
+                if rec[0] != _SPAN:
+                    continue
+                _, name, t0, t1, _, _ = rec
+                cnt, tot = out.get(name, (0, 0.0))
+                out[name] = (cnt + 1, tot + (t1 - t0))
+        return out
+
+    # ------------------------------------------------------------------ #
+    # export
+    # ------------------------------------------------------------------ #
+
+    def _events(self) -> List[dict]:
+        """Chrome-trace event list: metadata naming each track, then B/E
+        pairs (plus instants/counters), globally sorted so every track's
+        stack nests. Tie rules at equal ts: E closes before B opens, longer
+        B's open first (outer before inner), and record order breaks the
+        remaining ties — zero-duration spans (coarse perf_counter ticks)
+        get an epsilon-long E so a span's end can never sort ahead of its
+        own begin."""
+        pid = os.getpid()
+        with self._reg_lock:
+            rings = list(self._rings)
+        tids: Dict[Tuple[int, Optional[str]], int] = {}
+        meta: List[dict] = [{"ph": "M", "name": "process_name", "pid": pid,
+                             "tid": 0, "args": {"name": "deepspeed_tpu"}}]
+        body: List[Tuple[float, int, float, int, dict]] = []
+
+        def tid_for(ring: _Ring, lane: Optional[str]) -> int:
+            key = (ring.thread_id, lane)
+            tid = tids.get(key)
+            if tid is None:
+                tid = len(tids) + 1
+                tids[key] = tid
+                meta.append({"ph": "M", "name": "thread_name", "pid": pid,
+                             "tid": tid,
+                             "args": {"name": lane or ring.thread_name}})
+            return tid
+
+        idx = 0
+        for ring in rings:
+            for rec in ring.snapshot():
+                kind, name, t0, t1, lane, args = rec
+                tid = tid_for(ring, lane)
+                ts0 = t0 * 1e6
+                if kind == _SPAN:
+                    # coarse clocks can stamp t1 == t0; the E must still
+                    # land strictly after its own B
+                    if t1 <= t0:
+                        t1 = t0 + 1e-9
+                    dur = t1 - t0
+                    b = {"ph": "B", "name": name, "pid": pid, "tid": tid,
+                         "ts": ts0}
+                    if args:
+                        b["args"] = args
+                    # equal (ts, dur) B's: LATER record first — a nested CM
+                    # records the inner span before the outer, so record
+                    # order descending puts the outer's B ahead
+                    body.append((ts0, 1, -dur, -idx, b))
+                    # equal-ts E's: earlier record first (inner closed first)
+                    body.append((t1 * 1e6, 0, 0.0, idx,
+                                 {"ph": "E", "name": name, "pid": pid,
+                                  "tid": tid, "ts": t1 * 1e6}))
+                elif kind == _INSTANT:
+                    ev = {"ph": "i", "s": "t", "name": name, "pid": pid,
+                          "tid": tid, "ts": ts0}
+                    if args:
+                        ev["args"] = args
+                    body.append((ts0, 2, 0.0, idx, ev))
+                else:  # counter
+                    body.append((ts0, 2, 0.0, idx,
+                                 {"ph": "C", "name": name, "pid": pid,
+                                  "tid": tid, "ts": ts0, "args": args or {}}))
+                idx += 1
+        body.sort(key=lambda item: item[:4])
+        return meta + [ev for _, _, _, _, ev in body]
+
+    def export(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the Chrome-trace JSON; returns the path (None when tracing
+        is disabled or there is nowhere to write). Idempotent — call at
+        teardown and from atexit; later calls overwrite with a superset."""
+        if not self.enabled and path is None:
+            return None
+        if path is None:
+            if not self.trace_dir:
+                return None
+            path = os.path.join(self.trace_dir, f"trace_{os.getpid()}.json")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"traceEvents": self._events(),
+                       "displayTimeUnit": "ms"}, f)
+        os.replace(tmp, path)
+        return path
+
+    def crash_dump(self, reason: str = "") -> Optional[str]:
+        """Flight-recorder dump: write the retained rings to
+        ``trace_crash.json`` in the trace dir. Called on injected kills
+        (BEFORE ``os._exit``, which skips atexit), on :class:`InjectedFault`
+        raises, and on fatal engine teardown. First reason wins — a cascade
+        of secondary failures must not overwrite the original timeline."""
+        if not self.enabled or not self.trace_dir:
+            return None
+        if self._crash_path is not None:
+            return self._crash_path
+        path = os.path.join(self.trace_dir, "trace_crash.json")
+        try:
+            events = self._events()
+            if reason:
+                events.append({"ph": "i", "s": "g", "name": f"crash: {reason}",
+                               "pid": os.getpid(), "tid": 0,
+                               "ts": time.perf_counter() * 1e6})
+            os.makedirs(self.trace_dir, exist_ok=True)
+            with open(path, "w") as f:
+                json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        except Exception as e:  # a failing dump must never mask the crash
+            logger.warning(f"trace crash dump failed: {type(e).__name__}: {e}")
+            return None
+        self._crash_path = path
+        logger.warning(f"flight recorder dumped to {path}"
+                       + (f" ({reason})" if reason else ""))
+        return path
+
+    def _atexit_export(self) -> None:
+        try:
+            self.export()
+        except Exception as e:  # pragma: no cover - depends on dying disk
+            logger.warning(f"trace export at exit failed: "
+                           f"{type(e).__name__}: {e}")
+
+
+#: the process-wide tracer every instrumentation site records through
+tracer = Tracer()
+
+
+def install_from_env() -> Tracer:
+    """Arm the tracer from ``$DSTPU_TRACE`` (a directory; no-op when unset).
+    Called by ``deepspeed_tpu.initialize`` and the v2 inference engine so
+    subprocess benches trace without touching user code; idempotent — an
+    already-configured tracer wins."""
+    if tracer.enabled:
+        return tracer
+    trace_dir = os.environ.get(_ENV_VAR, "").strip()
+    if trace_dir:
+        ring = int(os.environ.get(_ENV_RING, "0") or 0)
+        tracer.configure(trace_dir=trace_dir,
+                         ring_size=ring or None)
+        logger.info(f"span tracing ARMED from ${_ENV_VAR}: {trace_dir}")
+    return tracer

@@ -11,6 +11,13 @@ totals into ``monitor/`` events (``MonitorMaster.write_events``
 ``(name, value, step)`` shape — the same contract ``PipelineStats`` and
 ``PrefixCacheStats`` follow on the serving side).
 
+Every stat class here aggregates the SAME measured intervals the span
+tracer records as timeline spans (``train/step/*``, ``train/offload/*``,
+``ckpt/*`` — ``monitor/trace.py``, docs/OBSERVABILITY.md): one set of
+``perf_counter`` pairs per site feeds both the window aggregate and the
+Perfetto track, so a dashboard number always has a matching span to zoom
+into.
+
 Phase semantics (per step):
 
 - ``enqueue_wait``: host time blocked on the prefetch queue. Unlike every

@@ -248,6 +248,39 @@ class FlopsProfiler:
         self.started = False
 
     # -------------------------------------------------------------- #
+    # monitor events (the profile lands in the same sink as the
+    # pipeline stats — train/flops/* beside train/pipeline/*)
+    # -------------------------------------------------------------- #
+
+    def events(self, step: int = 0, top_modules: int = 8):
+        """Monitor-ready ``(name, value, step)`` tuples: end-to-end totals
+        plus the ``top_modules`` heaviest modules by MACs (leaf attribution,
+        mirroring the printed table). Call BEFORE ``end_profile`` (which
+        drops the per-module tree). The engine routes these through
+        ``MonitorMaster`` at the profile step, so flops sit next to the
+        pipeline phase stats in every backend instead of print-only."""
+        ev = [
+            ("train/flops/params", float(self.total_params), step),
+            ("train/flops/macs", float(self.total_macs), step),
+            ("train/flops/flops", float(self.get_total_flops()), step),
+        ]
+        if self.xla_flops is not None:
+            ev.append(("train/flops/flops_xla", float(self.xla_flops), step))
+        if self.latency_s:
+            ev.append(("train/flops/latency_ms", self.latency_s * 1e3, step))
+            flops = self.xla_flops or self.total_flops_analytic
+            if flops:
+                ev.append(("train/flops/achieved_tflops",
+                           flops / self.latency_s / 1e12, step))
+        ranked = sorted((p for p in self.modules.values()
+                         if p.path != "<root>" and (p.macs or p.flops)),
+                        key=lambda p: (-p.macs, -p.flops, p.path))
+        for prof in ranked[:max(0, int(top_modules))]:
+            ev.append((f"train/flops/module/{prof.path}",
+                       float(prof.flops), step))
+        return ev
+
+    # -------------------------------------------------------------- #
     # report
     # -------------------------------------------------------------- #
 

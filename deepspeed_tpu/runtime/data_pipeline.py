@@ -37,6 +37,8 @@ from typing import Any, Callable, Iterable, Optional
 
 import numpy as np
 
+from deepspeed_tpu.monitor.trace import tracer as _tracer
+
 
 def as_host_tree(batch):
     """Materialise every leaf of a batch tree as a numpy array.
@@ -217,7 +219,12 @@ class PrefetchLoader:
             for batch in self.loader:
                 if self._stop.is_set():
                     return
-                staged = self.prepare(batch, self._next_step)
+                # the producer's staging work on its own timeline track
+                # (thread 'dstpu-prefetch'): overlap with the consumer's
+                # train/step spans is the whole point of this thread
+                with _tracer.span("train/prefetch/stage",
+                                  step=self._next_step):
+                    staged = self.prepare(batch, self._next_step)
                 self._next_step += 1
                 if not self._put(_Item(batch=staged)):
                     return

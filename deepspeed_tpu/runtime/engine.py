@@ -50,6 +50,7 @@ from deepspeed_tpu.runtime.loss_scaler import (has_overflow, make_loss_scale_sta
                                                update_loss_scale)
 from deepspeed_tpu.runtime.zero.partition import ZeroPartitioner
 from deepspeed_tpu.runtime.dataloader import DeepSpeedTPUDataLoader
+from deepspeed_tpu.monitor.trace import tracer as _tracer
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER,
                                        STEP_GLOBAL_TIMER, SynchronizedWallClockTimer,
@@ -73,8 +74,18 @@ def fetch_to_host(tree):
     ``float(metrics["loss"])`` right after dispatch re-serialises the whole
     loop (the exact regression class the pre-PR ``_after_step`` was). Same
     pattern as ``inference/v2/engine_v2.fetch_to_host``.
+
+    Under tracing the drain records a ``train/drain/fetch_to_host`` span, so
+    host-sync cost is ALWAYS attributed on the timeline — whatever code path
+    forced the materialisation, the stall shows up here by name.
     """
-    return jax.device_get(tree)  # jaxlint: disable=JL007 -- the intentional drain
+    if not _tracer.enabled:
+        return jax.device_get(tree)  # jaxlint: disable=JL007 -- the intentional drain
+    t0 = time.perf_counter()
+    out = jax.device_get(tree)  # jaxlint: disable=JL007 -- the intentional drain
+    _tracer.add("train/drain/fetch_to_host", t0, time.perf_counter(),
+                lane="train/drain")
+    return out
 
 
 def _extract_apply_fn(model: Any) -> Callable:
@@ -232,6 +243,12 @@ class DeepSpeedTPUEngine:
         self.train_stats = TrainPipelineStats()
         self.offload_stats = OffloadPipelineStats()
         self.ckpt_stats = CheckpointStats()
+        # span tracing (docs/OBSERVABILITY.md): config-reachable alongside
+        # the DSTPU_TRACE env path initialize() arms
+        tc = self.config.monitor.trace
+        if tc.enabled or tc.dir:
+            _tracer.configure(trace_dir=tc.dir, enabled=True,
+                              ring_size=tc.ring_size)
 
         # -- rolling checkpoints (preemption tolerance, docs/ELASTICITY.md):
         # the engine owns the cadence so saves interleave correctly with the
@@ -625,6 +642,13 @@ class DeepSpeedTPUEngine:
             stats.add("kernel", t2 - t1)
             stats.add("upload", t3 - t2)
             stats.record_step(groups=len(meta_groups), depth_sum=0)
+            if _tracer.enabled:
+                _tracer.add("train/offload/fetch", t0, t1,
+                            lane="train/offload")
+                _tracer.add("train/offload/kernel", t1, t2,
+                            lane="train/offload")
+                _tracer.add("train/offload/upload", t2, t3,
+                            lane="train/offload")
             return out
 
         # queue EVERY group's D2H now: the per-group drain below then blocks
@@ -650,7 +674,10 @@ class DeepSpeedTPUEngine:
                 [np.asarray(masters[k], np.float32).reshape(-1)
                  for k, _, _, _ in meta_groups[gi]]).astype(wire)
             dev = jax.device_put(flat, repl)   # async H2D dispatch
-            stats.add("upload", perf() - t0)
+            t1 = perf()
+            stats.add("upload", t1 - t0)
+            _tracer.add("train/offload/upload", t0, t1,
+                        lane="train/offload/upload", group=gi)
             return dev
 
         def on_group_done(gi, masters):
@@ -1134,6 +1161,7 @@ class DeepSpeedTPUEngine:
                 self._run_flops_profile(raw)
         self.tput_timer.start()
         self.timers(STEP_GLOBAL_TIMER).start()
+        step_no = self.global_steps   # _after_step bumps it before t4
         staged = batch if prefetched else self._prepare_batch(batch,
                                                               self.global_steps)
         t2 = perf()
@@ -1157,6 +1185,25 @@ class DeepSpeedTPUEngine:
             build_s=(t2 - t1) + (0.0 if prefetched else (t1 - t0)),
             dispatch_s=t3 - t2, drain_s=t4 - t3, wall_s=t4 - t0,
             queue_depth=queue_depth, prefetched=prefetched)
+        if _tracer.enabled:
+            # the SAME perf pairs the stats aggregated, as timeline spans
+            # (phases nested under one step span on the train/step track).
+            # Inline staging counts t0..t1 (batch fetch) into build_s, so
+            # the span must cover it too — stats and spans never diverge
+            if prefetched:
+                _tracer.add("train/step/dequeue_wait", t0, t1,
+                            lane="train/step", step=step_no)
+            _tracer.add("train/step/host_build", t1 if prefetched else t0,
+                        t2, lane="train/step", step=step_no)
+            _tracer.add("train/step/dispatch", t2, t3, lane="train/step",
+                        step=step_no)
+            _tracer.add("train/step/drain", t3, t4, lane="train/step",
+                        step=step_no)
+            _tracer.add("train/step", t0, t4, lane="train/step", step=step_no,
+                        prefetched=prefetched)
+            if queue_depth:
+                _tracer.counter("train/prefetch/queue_depth", queue_depth,
+                                lane="train/step")
         return metrics["loss"]
 
     def train_steps(self, n_steps: int, data_iter=None) -> np.ndarray:
@@ -1193,6 +1240,13 @@ class DeepSpeedTPUEngine:
                                  top_modules=fp_cfg.top_modules,
                                  detailed=fp_cfg.detailed,
                                  output_file=fp_cfg.output_file)
+        if self.monitor.enabled:
+            # flops land in the SAME sink as the pipeline stats (train/flops/*)
+            # instead of print-only — dashboards see model cost next to the
+            # step-loop phase breakdown
+            self.monitor.write_events(
+                prof.events(step=self.global_samples,
+                            top_modules=max(1, fp_cfg.top_modules)))
         prof.end_profile()
         self.flops_profiler = prof
 
@@ -1392,10 +1446,11 @@ class DeepSpeedTPUEngine:
             "skipped_steps": self.get_skipped_steps(),
         })
         state = self._offload_ckpt_state() if self._offload is not None else self.state
-        save_engine_checkpoint(save_dir, tag, state, client_state,
-                               save_latest=save_latest,
-                               ckpt_engine=self._checkpoint_engine(),
-                               stats=self.ckpt_stats)
+        with _tracer.span("ckpt/save", lane="ckpt", tag=tag):
+            save_engine_checkpoint(save_dir, tag, state, client_state,
+                                   save_latest=save_latest,
+                                   ckpt_engine=self._checkpoint_engine(),
+                                   stats=self.ckpt_stats)
         return True
 
     def _checkpoint_engine(self):
@@ -1504,7 +1559,12 @@ class DeepSpeedTPUEngine:
         if close is not None:
             close()
         if rolling_err is not None:
+            # fatal teardown: leave the flight-recorder timeline next to the
+            # surfaced error before re-raising (a commit failure's postmortem
+            # needs the spans that led up to it)
+            _tracer.crash_dump(f"engine destroy: {type(rolling_err).__name__}")
             raise rolling_err
+        _tracer.export()
 
     # ------------------------------------------------------------------ #
     # property surface (parity: engine.py:469-870 accessors)

@@ -16,6 +16,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from deepspeed_tpu.monitor.trace import tracer as _tracer
 from deepspeed_tpu.ops.native.aio import AsyncIOHandle
 from deepspeed_tpu.runtime.swap_tensor.buffer_pool import SwapBufferPool
 from deepspeed_tpu.utils.logging import logger
@@ -90,15 +91,18 @@ class OptimizerStateSwapper:
         """``handle.wait()`` under the deadline. On timeout the real wait keeps
         running on its thread; it is recorded as a straggler (``_join_
         stragglers`` re-joins it before any buffer recycles) and IOTimeout
-        SURFACES to the caller."""
-        if self.io_timeout_s <= 0:
-            return handle.wait()
-        call = DeferredCall(handle.wait, describe=describe)
-        try:
-            return call.result(self.io_timeout_s)
-        except IOTimeout:
-            self._stragglers.append(call)
-            raise
+        SURFACES to the caller. Each wait records an ``aio/wait`` span —
+        the swapper's disk stalls get their own timeline track instead of
+        silently widening whatever phase happened to contain them."""
+        with _tracer.span("aio/wait", lane="aio", op=describe):
+            if self.io_timeout_s <= 0:
+                return handle.wait()
+            call = DeferredCall(handle.wait, describe=describe)
+            try:
+                return call.result(self.io_timeout_s)
+            except IOTimeout:
+                self._stragglers.append(call)
+                raise
 
     def _join_stragglers(self) -> None:
         """Block until every timed-out wait actually retires (no deadline:

@@ -44,6 +44,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from deepspeed_tpu.config import OffloadDeviceEnum, OffloadOptimizerConfig
+from deepspeed_tpu.monitor.trace import tracer as _tracer
 from deepspeed_tpu.ops.native.cpu_optimizer import HostAdam, HostAdagrad, HostLion
 from deepspeed_tpu.runtime.swap_tensor import PipelinedOptimizerSwapper
 from deepspeed_tpu.utils.logging import logger
@@ -217,15 +218,22 @@ class HostOffloadOptimizer:
     def _run_group_kernel(self, items, lr: float) -> None:
         """Step every leaf of one group; ``items`` is a list of
         ``(p_flat, g_flat, moment_flats)``. Chunks fan across the worker
-        pool (ctypes/OpenMP and numpy inner loops both release the GIL)."""
+        pool (ctypes/OpenMP and numpy inner loops both release the GIL).
+        Each chunk records a span on ITS worker's track (threads
+        ``dstpu-hostopt_*``), so the fan-out is visible on the timeline."""
         tasks = [t for p, g, ms in items for t in self._leaf_tasks(p, g, ms, lr)]
         if self._workers <= 1 or len(tasks) <= 1:
             for t in tasks:
                 t()
             return
-        futs = [self._pool().submit(t) for t in tasks]
+        futs = [self._pool().submit(self._traced_task, t) for t in tasks]
         for f in futs:
             f.result()
+
+    @staticmethod
+    def _traced_task(task) -> None:
+        with _tracer.span("train/offload/kernel_chunk"):
+            task()
 
     def step_groups(self, grad_views_for: Callable[[int], Dict[str, np.ndarray]],
                     lr: float, grad_scale: float = 1.0,
@@ -272,6 +280,11 @@ class HostOffloadOptimizer:
                 t2 = perf()
                 rec("fetch", t1 - t0)
                 rec("kernel", t2 - t1)
+                if _tracer.enabled:
+                    _tracer.add("train/offload/fetch", t0, t1,
+                                lane="train/offload", group=gi)
+                    _tracer.add("train/offload/kernel", t1, t2,
+                                lane="train/offload", group=gi)
                 done(gi, {n: self.master[n] for n in names})
             return
 
@@ -299,6 +312,11 @@ class HostOffloadOptimizer:
             t2 = perf()
             rec("fetch", t1 - t0)
             rec("kernel", t2 - t1)
+            if _tracer.enabled:
+                _tracer.add("train/offload/fetch", t0, t1,
+                            lane="train/offload", group=gi)
+                _tracer.add("train/offload/kernel", t1, t2,
+                            lane="train/offload", group=gi)
             counter["inside"] += t2 - t0
             done(gi, masters)
 

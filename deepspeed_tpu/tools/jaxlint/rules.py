@@ -10,6 +10,7 @@ JL004  Python control flow on tracer values inside a jitted body
 JL005  PartitionSpec/collective axis names no Mesh declares
 JL006  raw imports that bypass the ``utils/jax_compat`` shim layer
 JL007  blocking host fetches inside configured hot-path modules
+JL008  tracer spans enclosing a blocking fetch in hot-path modules
 ====== ==============================================================
 
 Rules are registered in ``RULE_REGISTRY`` via ``@register``; adding a rule is
@@ -673,3 +674,106 @@ class CompatShimBypass(Rule):
                     self.rule_id, mod.path, node.lineno, node.col_offset,
                     f"{bad} bypasses the version shims — use "
                     f"deepspeed_tpu.utils.jax_compat.{fix}")
+
+
+# --------------------------------------------------------------------------- #
+# JL008 — tracer span enclosing a blocking fetch
+# --------------------------------------------------------------------------- #
+
+@register
+class SpanEnclosedBlockingFetch(Rule):
+    """``with tracer.span(...)`` bodies in hot-path modules must not contain
+    a blocking device->host fetch outside the policed drain names.
+
+    The span tracer (``monitor/trace.py``) exists to make the async
+    pipelines' overlap auditable WITHOUT perturbing it: spans read only
+    ``perf_counter``. The failure mode this rule guards is instrumentation
+    drift — someone wraps a phase in a span and, "while they're in there",
+    materialises a value for the span's args or a log line. That quietly
+    reintroduces the per-step host sync the pipelines removed, and the
+    timeline then *hides* the regression (the sync cost is inside a
+    legitimate-looking span). Flagged inside span bodies, same fetch
+    heuristics as JL007: ``jax.device_get``, single-arg ``np.asarray``/
+    ``np.array`` without a dtype, ``.item()``/``.tolist()``. Calls whose
+    final name segment is a policed drain (``drain_calls``, default
+    ``fetch_to_host``) are allowed — attributing the drain is exactly what
+    spans are for. Nested function/lambda bodies are skipped (work submitted
+    to an executor from inside a span is not synchronously enclosed)."""
+
+    rule_id = "JL008"
+    summary = "tracer span encloses a blocking host fetch"
+    default_options = {
+        # path substrings whose modules are policed; empty = rule inert
+        "hot_paths": [],
+        # call names (final segment) that ARE the sanctioned drain points
+        "drain_calls": ["fetch_to_host"],
+        # zero-arg methods that force a device->host transfer
+        "fetch_methods": ["item", "tolist"],
+    }
+
+    def _span_withs(self, mod: SourceModule) -> Iterator[ast.With]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            for item in node.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Call) \
+                        and call_name(ce).split(".")[-1] == "span":
+                    yield node
+                    break
+
+    @staticmethod
+    def _body_nodes(with_node: ast.With) -> List[ast.AST]:
+        """Nodes lexically inside the with-body, not descending into nested
+        function/class/lambda scopes (their execution isn't enclosed)."""
+        out: List[ast.AST] = []
+        stack: List[ast.AST] = list(with_node.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef, ast.Lambda)):
+                continue
+            out.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    def check(self, mod, options):
+        norm = mod.path.replace("\\", "/")
+        if not any(pat in norm for pat in options["hot_paths"]):
+            return
+        drains = set(options["drain_calls"])
+        fetch_methods = set(options["fetch_methods"])
+        for with_node in self._span_withs(mod):
+            for node in self._body_nodes(with_node):
+                if not isinstance(node, ast.Call):
+                    continue
+                raw = call_name(node)
+                if raw.split(".")[-1] in drains:
+                    continue
+                name = mod.resolve(raw)
+                msg = None
+                if name == "jax.device_get":
+                    msg = ("jax.device_get() inside a tracer span — the span "
+                           "would hide a hot-path host sync; route through "
+                           "the policed drain (fetch_to_host) or move the "
+                           "fetch out of the span")
+                elif name in {"numpy.asarray", "numpy.array"}:
+                    has_dtype = (len(node.args) > 1
+                                 or any(kw.arg == "dtype"
+                                        for kw in node.keywords))
+                    if len(node.args) == 1 and not has_dtype:
+                        msg = (f"{unparse(node.func)}(x) with no dtype inside "
+                               "a tracer span may be a blocking device fetch "
+                               "— drain through fetch_to_host (outside the "
+                               "span) or give a host conversion an explicit "
+                               "dtype")
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in fetch_methods
+                      and not node.args and not node.keywords
+                      and not isinstance(node.func.value, ast.Constant)):
+                    msg = (f".{node.func.attr}() inside a tracer span forces "
+                           "a device->host transfer — move it out of the "
+                           "span or route through the policed drain")
+                if msg:
+                    yield Finding(self.rule_id, mod.path, node.lineno,
+                                  node.col_offset, msg)

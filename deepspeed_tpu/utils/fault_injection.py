@@ -188,6 +188,21 @@ def install_from_env() -> Optional[FaultInjector]:
     return inj
 
 
+def _flight_record(site: str, action: str) -> None:
+    """Dump the span tracer's flight recorder before a fault surfaces.
+
+    ``action=kill`` dies via ``os._exit`` — no atexit, no finally — so the
+    ONLY postmortem timeline a preempted run can leave is written here,
+    first. ``action=raise`` dumps too: an InjectedFault may unwind through
+    teardown paths that never reach a clean export. No-op (and never
+    raising) when tracing is off — the kill must stay a kill."""
+    try:
+        from deepspeed_tpu.monitor.trace import tracer
+        tracer.crash_dump(f"injected {action} at {site}")
+    except Exception:   # pragma: no cover - the fault must still fire
+        pass
+
+
 def _execute(spec: FaultSpec, site: str):
     if spec.action == "stall":
         logger.warning(f"fault injection: stalling {spec.delay_s}s at {site}")
@@ -195,10 +210,12 @@ def _execute(spec: FaultSpec, site: str):
         return None
     if spec.action == "kill":
         logger.warning(f"fault injection: killing process at {site}")
+        _flight_record(site, "kill")
         # SIGTERM-style: no atexit, no finally blocks — the preempted-VM model
         os._exit(KILL_EXIT_CODE)
     if spec.action == "errno":
         return -abs(spec.errno)
+    _flight_record(site, "raise")
     raise InjectedFault(spec.errno, f"injected fault at {site}")
 
 

@@ -3,6 +3,14 @@
 Parity: reference ``deepspeed/utils/timer.py`` (``SynchronizedWallClockTimer``,
 ``ThroughputTimer``). On TPU, "synchronized" means blocking on device arrays
 (``jax.block_until_ready``) instead of CUDA events.
+
+Timers are span-emitting: every ``Timer.stop()`` records a
+``timer/<name>`` span through ``monitor/trace.py`` when tracing is armed
+(docs/OBSERVABILITY.md), so ``wall_clock_breakdown`` intervals appear on the
+same Perfetto timeline as the pipeline lanes instead of only in log lines.
+Intervals are stamped with ``time.perf_counter()`` (monotonic — wall-clock
+steps from NTP can't produce negative breakdown numbers, and the stamps
+share the tracer's clock domain).
 """
 
 from __future__ import annotations
@@ -10,6 +18,7 @@ from __future__ import annotations
 import time
 from typing import Any, Dict, List, Optional
 
+from deepspeed_tpu.monitor.trace import tracer as _tracer
 from deepspeed_tpu.utils.logging import log_dist
 
 FORWARD_MICRO_TIMER = "fwd_microstep"
@@ -76,17 +85,23 @@ class Timer:
 
     def start(self, sync_obj: Any = None):
         _sync_point(sync_obj, self.sync)
-        self._start = time.time()
+        self._start = time.perf_counter()
         self.started = True
 
     def stop(self, record: bool = True, sync_obj: Any = None):
         if not self.started:
             return
         _sync_point(sync_obj, self.sync)
-        dt = time.time() - self._start
+        end = time.perf_counter()
+        dt = end - self._start
         self._elapsed += dt
         if record:
             self._record.append(dt)
+        if _tracer.enabled:
+            # span-emitting mode: the timed interval lands on the caller's
+            # timeline track as timer/<name> (zero-sync — the sync point
+            # above ran only if the timer itself opted in)
+            _tracer.add("timer/" + self.name, self._start, end)
         self.started = False
 
     def reset(self):
@@ -95,7 +110,7 @@ class Timer:
         self._record.clear()
 
     def elapsed(self, reset: bool = True) -> float:
-        now = time.time()
+        now = time.perf_counter()
         out = self._elapsed
         if self.started:
             out += now - self._start
@@ -154,7 +169,7 @@ class ThroughputTimer:
         self.logging = logging_fn or (lambda msg: log_dist(msg, ranks=[0]))
 
     def start(self):
-        self._start = time.time()
+        self._start = time.perf_counter()
         self.started = True
 
     def stop(self, global_step: bool = True, report_speed: bool = True, sync_obj: Any = None):
@@ -165,7 +180,7 @@ class ThroughputTimer:
             self.step_count += 1
         if self.step_count > self.start_step:
             _sync_point(sync_obj, self.sync)
-            self.total_elapsed_time += time.time() - self._start
+            self.total_elapsed_time += time.perf_counter() - self._start
             if report_speed and self.steps_per_output and self.step_count % self.steps_per_output == 0:
                 self.logging(
                     f"step={self.step_count}, samples/sec={self.avg_samples_per_sec():.2f}")

@@ -10,10 +10,20 @@
 # at these sizes are smoke, not signal — real numbers come from the full legs
 # (docs/SERVING.md, docs/TRAINING.md). tier1.sh invokes this NON-FATALLY
 # after pytest.
+#
+# Every leg runs with span tracing ON (DSTPU_TRACE -> docs/OBSERVABILITY.md),
+# so the byte-equality / zero-recompile gates double as "tracing changes
+# nothing" gates; trace_check.py then validates the emitted timelines —
+# Chrome-trace schema, four subsystems on distinct tracks, and the --preempt
+# kill's flight-recorder dump.
 set -o pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+TRACE_DIR="$(mktemp -d "${TMPDIR:-/tmp}/dstpu_trace.XXXXXX")"
+trap 'rm -rf "$TRACE_DIR"' EXIT
+export DSTPU_TRACE="$TRACE_DIR"
 
 timeout -k 10 300 python benchmarks/serving_bench.py --steady-state \
     --seqs 4 --prompt 16 --gen 24 || exit 1
@@ -27,5 +37,17 @@ timeout -k 10 300 python benchmarks/train_bench.py --smoke --offload || exit 1
 # preemption-tolerance leg (docs/ELASTICITY.md): kill a subprocess run at a
 # non-checkpoint step AND mid-checkpoint-write, resume each onto a different
 # simulated device count, gating byte-identical resumed loss streams + torn
-# checkpoint fallback + zero post-resume-warmup compiles
-timeout -k 10 300 python benchmarks/train_bench.py --smoke --preempt
+# checkpoint fallback + zero post-resume-warmup compiles. The kills also
+# exercise the tracer's flight recorder (trace_crash.json).
+timeout -k 10 300 python benchmarks/train_bench.py --smoke --preempt || exit 1
+
+# tracer-overhead leg: trace-off vs trace-on through the same pipelined
+# loop; correctness gates here, the <=5% bar runs full-size (BENCH_r10)
+timeout -k 10 300 python benchmarks/train_bench.py --smoke --trace-overhead \
+    || exit 1
+
+# the timelines the legs above emitted: schema-valid, spans from the train
+# pipeline, decode pipeline, checkpoint, and offload subsystems on distinct
+# tracks, plus a parseable flight-recorder dump from the --preempt kills
+timeout -k 10 120 python scripts/trace_check.py "$TRACE_DIR" \
+    --require train serve ckpt train/offload --expect-crash || exit 1

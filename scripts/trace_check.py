@@ -1,0 +1,211 @@
+#!/usr/bin/env python
+"""Validate emitted span traces against the Chrome-trace schema.
+
+Run over a trace file or a ``DSTPU_TRACE`` directory (every ``trace*.json``
+inside)::
+
+    python scripts/trace_check.py <file-or-dir> \
+        [--require train serve ckpt train/offload] [--expect-crash]
+
+Checks per file:
+
+- the JSON parses and carries a ``traceEvents`` list;
+- every event has the required keys (``ph``/``name``/``pid``/``tid``, plus
+  ``ts`` for non-metadata events) with sane types;
+- per (pid, tid) track: timestamps are MONOTONIC (non-decreasing) and every
+  ``B`` has a matching ``E`` (same name, LIFO order) — i.e. spans nest;
+- counter events carry numeric args.
+
+``--require <prefix>...`` additionally asserts (across ALL checked files
+together) that each prefix matches at least one span, and that the matched
+spans cover at least as many DISTINCT tracks as there are prefixes — the
+"spans from N subsystems on distinct tracks" acceptance gate.
+
+``--expect-crash`` asserts a parseable ``trace_crash.json`` (the flight
+recorder's dump) exists in the directory and contains at least one span.
+
+Exit 0 on success; 1 with a per-file error listing otherwise. Invoked
+non-fatally from ``scripts/bench_smoke.sh`` after the traced bench legs
+(docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Set, Tuple
+
+
+def check_events(events: list, errors: List[str], src: str = "") -> Dict[Tuple[int, int], str]:
+    """Schema + B/E + monotonicity checks over one event list. Returns the
+    track-name map {(pid, tid): name} for subsystem coverage checks."""
+    if not isinstance(events, list):
+        errors.append(f"{src}: traceEvents is not a list")
+        return {}
+    tracks: Dict[Tuple[int, int], str] = {}
+    stacks: Dict[Tuple[int, int], List[str]] = {}
+    last_ts: Dict[Tuple[int, int], float] = {}
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            errors.append(f"{src}: event #{i} is not an object")
+            continue
+        for key in ("ph", "name", "pid", "tid"):
+            if key not in ev:
+                errors.append(f"{src}: event #{i} missing required key '{key}'")
+        ph = ev.get("ph")
+        tid_key = (ev.get("pid", 0), ev.get("tid", 0))
+        if ph == "M":
+            if ev.get("name") == "thread_name":
+                tracks[tid_key] = str(ev.get("args", {}).get("name", ""))
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            errors.append(f"{src}: event #{i} ({ev.get('name')!r}) has no "
+                          "numeric 'ts'")
+            continue
+        prev = last_ts.get(tid_key)
+        if prev is not None and ts < prev:
+            errors.append(f"{src}: track {tid_key} ts not monotonic at event "
+                          f"#{i} ({ev.get('name')!r}): {ts} < {prev}")
+        last_ts[tid_key] = ts
+        if ph == "B":
+            stacks.setdefault(tid_key, []).append(str(ev.get("name")))
+        elif ph == "E":
+            stack = stacks.setdefault(tid_key, [])
+            if not stack:
+                errors.append(f"{src}: track {tid_key} has 'E' "
+                              f"({ev.get('name')!r}) with no open 'B'")
+            elif stack[-1] != ev.get("name"):
+                errors.append(f"{src}: track {tid_key} 'E' {ev.get('name')!r} "
+                              f"does not match open 'B' {stack[-1]!r}")
+            else:
+                stack.pop()
+        elif ph == "C":
+            args = ev.get("args", {})
+            if not args or not all(isinstance(v, (int, float))
+                                   for v in args.values()):
+                errors.append(f"{src}: counter #{i} ({ev.get('name')!r}) "
+                              "lacks numeric args")
+        elif ph not in ("i", "X"):
+            errors.append(f"{src}: event #{i} has unknown phase {ph!r}")
+    for tid_key, stack in stacks.items():
+        if stack:
+            errors.append(f"{src}: track {tid_key} left unmatched 'B' events: "
+                          f"{stack}")
+    return tracks
+
+
+def span_names_by_track(events: list, tracks: Dict[Tuple[int, int], str]
+                        ) -> Dict[Tuple[int, int], Set[str]]:
+    out: Dict[Tuple[int, int], Set[str]] = {}
+    for ev in events:
+        if isinstance(ev, dict) and ev.get("ph") in ("B", "X"):
+            key = (ev.get("pid", 0), ev.get("tid", 0))
+            out.setdefault(key, set()).add(str(ev.get("name")))
+    return out
+
+
+def check_file(path: str, errors: List[str]):
+    """Returns (events, tracks) or ([], {}) after recording errors."""
+    src = os.path.basename(path)
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        errors.append(f"{src}: unreadable/unparseable: {e}")
+        return [], {}
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        errors.append(f"{src}: missing top-level 'traceEvents'")
+        return [], {}
+    events = doc["traceEvents"]
+    tracks = check_events(events, errors, src=src)
+    return events, tracks
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("target", help="a trace JSON file or a directory of them")
+    ap.add_argument("--require", nargs="*", default=[],
+                    help="span-name/track prefixes that must each be present, "
+                         "on at least as many distinct tracks as prefixes")
+    ap.add_argument("--expect-crash", action="store_true",
+                    help="require a parseable trace_crash.json in the dir")
+    ap.add_argument("--min-spans", type=int, default=1,
+                    help="minimum total spans across the checked files")
+    args = ap.parse_args()
+
+    if os.path.isdir(args.target):
+        paths = sorted(glob.glob(os.path.join(args.target, "trace*.json")))
+        crash = os.path.join(args.target, "trace_crash.json")
+    else:
+        paths = [args.target]
+        crash = os.path.join(os.path.dirname(args.target) or ".",
+                             "trace_crash.json")
+    if not paths:
+        print(f"trace_check: no trace*.json under {args.target}")
+        return 1
+
+    errors: List[str] = []
+    total_spans = 0
+    # (file, pid, tid) -> set of span names; track names per the same key
+    span_map: Dict[Tuple[str, int, int], Set[str]] = {}
+    track_names: Dict[Tuple[str, int, int], str] = {}
+    for path in paths:
+        events, tracks = check_file(path, errors)
+        by_track = span_names_by_track(events, tracks)
+        for (pid, tid), names in by_track.items():
+            key = (path, pid, tid)
+            span_map[key] = names
+            track_names[key] = tracks.get((pid, tid), "")
+            total_spans += len(names)
+
+    if total_spans < args.min_spans:
+        errors.append(f"only {total_spans} distinct span names across "
+                      f"{len(paths)} file(s); expected >= {args.min_spans}")
+
+    if args.require:
+        matched_tracks: Set[Tuple[str, int, int]] = set()
+        for prefix in args.require:
+            hits = {key for key, names in span_map.items()
+                    if any(n.startswith(prefix) for n in names)
+                    or track_names.get(key, "").startswith(prefix)}
+            if not hits:
+                errors.append(f"required subsystem prefix {prefix!r} matched "
+                              "no spans in any checked trace")
+            matched_tracks |= hits
+        if len(matched_tracks) < len(args.require):
+            errors.append(
+                f"required subsystems span only {len(matched_tracks)} "
+                f"distinct tracks; expected >= {len(args.require)}")
+
+    if args.expect_crash:
+        if not os.path.exists(crash):
+            errors.append(f"--expect-crash: {crash} does not exist")
+        else:
+            crash_errors: List[str] = []
+            events, _ = check_file(crash, crash_errors)
+            n_spans = sum(1 for ev in events
+                          if isinstance(ev, dict) and ev.get("ph") == "B")
+            if crash_errors:
+                errors.extend(crash_errors)
+            elif n_spans == 0:
+                errors.append(f"{os.path.basename(crash)}: flight recorder "
+                              "dump contains no spans")
+
+    if errors:
+        for err in errors:
+            print(f"trace_check: {err}")
+        print(f"trace_check: FAIL ({len(errors)} error(s) across "
+              f"{len(paths)} file(s))")
+        return 1
+    print(f"trace_check: OK — {len(paths)} file(s), {total_spans} distinct "
+          f"span names, {len(span_map)} tracks"
+          + (", crash dump present" if args.expect_crash else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -318,3 +318,35 @@ def test_second_engine_hits_persistent_cache(tmp_path):
         jax.config.update("jax_compilation_cache_dir", prior_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           prior_min)
+
+
+def test_pipeline_traced_run_byte_identical_with_serve_spans(warm_engine):
+    """Span tracing ON must not change a single token or add a compile, and
+    must leave serve/decode/* spans whose per-step count matches the stats
+    (docs/OBSERVABILITY.md — one set of perf pairs feeds both)."""
+    from deepspeed_tpu.monitor.trace import tracer
+    N = 6
+    e = warm_engine
+    e.put([0, 1, 2], PROMPTS)
+    pipe = e.decode_pipeline([0, 1, 2])
+    ref = pipe.run(N)
+    e.flush([0, 1, 2])
+
+    tracer.reset()
+    tracer.configure(enabled=True, ring_size=1024)
+    try:
+        e.put([0, 1, 2], PROMPTS)
+        c0 = e.compiles
+        e.pipeline_stats.reset()
+        pipe = e.decode_pipeline([0, 1, 2])
+        got = pipe.run(N)
+        assert e.compiles == c0                       # no traced recompiles
+        assert np.array_equal(got, ref)               # byte-identical stream
+        summary = tracer.summary()
+        assert summary["serve/decode/step"][0] == e.pipeline_stats.steps == N
+        assert summary["serve/decode/dispatch"][0] == N
+        # the drain spans attribute the policed fetch_to_host by name
+        assert "serve/drain/fetch_to_host" in summary
+        e.flush([0, 1, 2])
+    finally:
+        tracer.reset()

@@ -75,3 +75,56 @@ def test_engine_flops_profiler_hook(tmp_path):
     with open(out) as f:
         txt = f.read()
     assert "MACs" in txt
+
+
+def test_events_monitor_shape():
+    """``events()`` turns the profile into monitor-ready tuples: totals plus
+    the heaviest modules by MACs under ``train/flops/*`` (ISSUE 7: flops
+    land in the same sink as the pipeline stats, not print-only)."""
+    model = TwoLayer()
+    x = jnp.zeros((4, 16))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    prof = FlopsProfiler()
+    prof.start_profile(model, variables, x)
+    ev = prof.events(step=64, top_modules=2)
+    named = {name: value for name, value, _ in ev}
+    assert all(name.startswith("train/flops/") for name in named)
+    assert all(step == 64 for _, _, step in ev)
+    assert named["train/flops/macs"] == prof.get_total_macs()
+    assert named["train/flops/params"] == prof.get_total_params()
+    mods = [n for n in named if n.startswith("train/flops/module/")]
+    assert len(mods) == 2
+    # ranked by MACs: fc1 (4*32*16) outweighs fc2 (4*8*32)
+    assert "train/flops/module/fc1" in mods
+    prof.end_profile()
+
+
+def test_engine_routes_flops_events_to_monitor(tmp_path):
+    """The engine's profile step writes train/flops/* through MonitorMaster —
+    the per-module summary sits beside the pipeline stats in the CSV sink."""
+    import csv
+    import os
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+    model = GPT2LMHead(GPT2Config.tiny())
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+           "flops_profiler": {"enabled": True, "profile_step": 1,
+                              "output_file": str(tmp_path / "p.txt"),
+                              "top_modules": 3},
+           "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                           "job_name": "flops_job"}}
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+    batch = {"input_ids": np.zeros((8, 16), np.int32)}
+    engine.train_batch(batch)
+    job = tmp_path / "flops_job"
+    macs_file = job / "train_flops_macs.csv"
+    assert macs_file.exists()
+    with open(macs_file) as f:
+        rows = list(csv.reader(f))
+    assert len(rows) == 2 and float(rows[1][1]) > 0
+    assert any(p.name.startswith("train_flops_module_")
+               for p in job.iterdir())
+    engine.destroy()

@@ -25,9 +25,9 @@ def rules_of(findings):
     return [f.rule for f in findings]
 
 
-def test_registry_has_all_seven_rules():
+def test_registry_has_all_eight_rules():
     assert set(RULE_REGISTRY) == {"JL001", "JL002", "JL003", "JL004",
-                                  "JL005", "JL006", "JL007"}
+                                  "JL005", "JL006", "JL007", "JL008"}
 
 
 # --------------------------------------------------------------------------- #
@@ -803,3 +803,108 @@ def test_repo_tree_is_clean():
     if not os.path.isdir(pkg) or not os.path.isfile(cfg_path):
         pytest.skip("source tree layout not available")
     assert jaxlint_main([pkg, "--config", cfg_path]) == 0
+
+
+# --------------------------------------------------------------------------- #
+# JL008 — tracer span enclosing a blocking fetch
+# --------------------------------------------------------------------------- #
+
+_JL008_OPTS = {"JL008": {"hot_paths": ["pkg/"]}}
+
+
+def test_jl008_flags_device_get_inside_span():
+    findings = lint("""
+        import jax
+        from deepspeed_tpu.monitor.trace import tracer
+
+        def drain(arr):
+            with tracer.span("train/step/drain"):
+                vals = jax.device_get(arr)
+            return vals
+        """, **_JL008_OPTS)
+    assert "JL008" in rules_of(findings)
+
+
+def test_jl008_flags_bare_asarray_and_item_inside_span():
+    findings = lint("""
+        import numpy as np
+        from deepspeed_tpu.monitor.trace import tracer
+
+        def leak(arr, metrics):
+            with tracer.span("serve/decode/step", step=1):
+                row = np.asarray(arr)
+                loss = metrics.item()
+            return row, loss
+        """, **_JL008_OPTS)
+    assert rules_of(findings).count("JL008") == 2
+
+
+def test_jl008_policed_drain_inside_span_is_clean():
+    # attributing the sanctioned drain's cost is exactly what spans are FOR
+    findings = lint("""
+        from deepspeed_tpu.monitor.trace import tracer
+        from pkg.engine import fetch_to_host
+
+        def drain(tree):
+            with tracer.span("train/drain"):
+                vals = fetch_to_host(tree)
+            return vals
+        """, **_JL008_OPTS)
+    assert "JL008" not in rules_of(findings)
+
+
+def test_jl008_host_conversions_and_fetch_outside_span_clean():
+    findings = lint("""
+        import jax
+        import numpy as np
+        from deepspeed_tpu.monitor.trace import tracer
+
+        def stage(batch, arr):
+            host = np.asarray(batch, np.float32)   # dtype'd: host-side
+            with tracer.span("train/prefetch/stage"):
+                out = host * 2
+            vals = jax.device_get(arr)             # outside the span
+            return out, vals
+        """, **_JL008_OPTS)
+    assert "JL008" not in rules_of(findings)
+
+
+def test_jl008_nested_function_inside_span_not_enclosed():
+    # work SUBMITTED from inside a span isn't synchronously enclosed by it
+    findings = lint("""
+        import jax
+        from deepspeed_tpu.monitor.trace import tracer
+
+        def schedule(pool, arr):
+            with tracer.span("ckpt/submit"):
+                def write():
+                    return jax.device_get(arr)
+                fut = pool.submit(write)
+            return fut
+        """, **_JL008_OPTS)
+    assert "JL008" not in rules_of(findings)
+
+
+def test_jl008_inert_without_hot_path_config():
+    findings = lint("""
+        import jax
+        from deepspeed_tpu.monitor.trace import tracer
+
+        def drain(arr):
+            with tracer.span("x"):
+                return jax.device_get(arr)
+        """)
+    assert "JL008" not in rules_of(findings)
+
+
+def test_jl008_shipped_config_covers_traced_modules():
+    raw = _repo_config()
+    opts = raw["rules"]["JL008"]["options"]
+    hot = opts["hot_paths"]
+    # every JL007 hot path stays policed under spans too...
+    for p in raw["rules"]["JL007"]["options"]["hot_paths"]:
+        assert p in hot
+    # ...plus the span-instrumented lanes JL007 does not police
+    assert "deepspeed_tpu/runtime/data_pipeline.py" in hot
+    assert any("swap_tensor" in p for p in hot)
+    assert opts["drain_calls"] == ["fetch_to_host"]

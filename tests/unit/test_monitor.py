@@ -107,6 +107,107 @@ def test_offload_pipeline_stats_counters_and_events():
     assert st.steps == 0 and st.kernel_ms == 0.0 and st.upload_depth_sum == 0
 
 
+def test_monitor_master_rank0_gating(tmp_path, monkeypatch):
+    """Only the process-rank-0 host writes; other ranks fan out nothing."""
+    import deepspeed_tpu.comm as dist
+    monkeypatch.setattr(dist, "get_rank", lambda: 1)
+    cfg = _cfg(tmp_path)
+    master = MonitorMaster(cfg)
+    assert master.enabled          # backends exist; the GATE is per-write
+    master.write_events([("Train/Samples/train_loss", 2.0, 1)])
+    assert not os.path.exists(os.path.join(str(tmp_path), "job",
+                                           "Train_Samples_train_loss.csv"))
+
+
+def test_tensorboard_degrades_without_wheel(tmp_path, monkeypatch):
+    """An enabled tensorboard config on a box without the wheel must degrade
+    to a disabled backend (warning, no raise) — the least-tested path in the
+    module and exactly what this container exercises in prod."""
+    import sys
+    # None in sys.modules makes `from torch.utils.tensorboard import ...`
+    # raise ImportError deterministically, wheel or no wheel
+    monkeypatch.setitem(sys.modules, "torch", None)
+    monkeypatch.setitem(sys.modules, "torch.utils.tensorboard", None)
+    cfg = _cfg(tmp_path, tensorboard={"enabled": True,
+                                      "output_path": str(tmp_path),
+                                      "job_name": "tb"})
+    tb = TensorBoardMonitor(cfg.tensorboard)
+    assert not tb.enabled
+    tb.write_events([("x", 1.0, 1)])   # disabled backend: no-op, no raise
+    tb.close()                         # close on a degraded backend: no-op
+    # the master stays usable through its OTHER backends
+    master = MonitorMaster(cfg)
+    assert master.enabled and not master.tb_monitor.enabled
+    master.write_events([("Train/Samples/train_loss", 1.0, 1)])
+    assert os.path.exists(os.path.join(str(tmp_path), "job",
+                                       "Train_Samples_train_loss.csv"))
+
+
+def test_monitor_master_fanout_ordering(tmp_path):
+    """Backends receive the SAME event list, in tb -> wandb -> csv order,
+    with intra-list event order preserved."""
+    cfg = _cfg(tmp_path)
+    master = MonitorMaster(cfg)
+    calls = []
+
+    class Recorder:
+        def __init__(self, name):
+            self.name = name
+            self.enabled = True
+
+        def write_events(self, events):
+            calls.append((self.name, list(events)))
+
+        def close(self):
+            calls.append((self.name, "closed"))
+
+    master.tb_monitor = Recorder("tb")
+    master.wandb_monitor = Recorder("wandb")
+    master.csv_monitor = Recorder("csv")
+    events = [("a", 1.0, 1), ("b", 2.0, 1), ("a", 3.0, 2)]
+    master.write_events(iter(events))   # an iterator must fan out to ALL
+    assert [name for name, _ in calls] == ["tb", "wandb", "csv"]
+    assert all(got == events for _, got in calls)
+    calls.clear()
+    master.close()
+    assert calls == [("tb", "closed"), ("wandb", "closed"), ("csv", "closed")]
+
+
+def test_monitor_master_close_closes_csv_files(tmp_path):
+    cfg = _cfg(tmp_path)
+    master = MonitorMaster(cfg)
+    master.write_events([("Train/Samples/train_loss", 1.0, 1)])
+    assert master.csv_monitor._files
+    master.close()
+    assert master.csv_monitor._files == {}
+    master.close()   # idempotent
+
+
+def test_engine_destroy_flushes_final_step_without_manual_drain(tmp_path):
+    """The PR 4 footgun, closed: the LAST step's deferred metrics land in
+    the CSV through ``destroy()`` alone — no ``drain_metrics()`` call — and
+    the backend files are closed behind it."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead
+
+    model = GPT2LMHead(GPT2Config.tiny())
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+           "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                           "job_name": "flush_job"}}
+    engine, *_ = deepspeed_tpu.initialize(model=model, config=cfg)
+    batch = {"input_ids": np.zeros((8, 16), np.int32)}
+    engine.train_batch(batch)
+    engine.train_batch(batch)
+    loss_file = os.path.join(str(tmp_path), "flush_job",
+                             "Train_Samples_train_loss.csv")
+    engine.destroy()
+    with open(loss_file) as f:
+        rows = list(csv.reader(f))
+    assert len(rows) == 3          # header + BOTH steps (incl. the final one)
+    assert engine.monitor.csv_monitor._files == {}
+
+
 def test_engine_emits_offload_events_at_print_boundary(tmp_path):
     import deepspeed_tpu
     from deepspeed_tpu.models.gpt2 import GPT2Config, GPT2LMHead

@@ -1,0 +1,346 @@
+"""Span tracer tests (``monitor/trace.py`` + ``scripts/trace_check.py``):
+ring bounding, disabled-path no-ops, export schema, lane/thread tracks, the
+flight recorder, timer span mode, and the engine integration
+(docs/OBSERVABILITY.md)."""
+
+import glob
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.monitor.trace import (DEFAULT_RING_SIZE, Tracer, _NOOP,
+                                         install_from_env, tracer)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_trace_check():
+    spec = importlib.util.spec_from_file_location(
+        "trace_check", os.path.join(REPO_ROOT, "scripts", "trace_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+trace_check = _load_trace_check()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    """The module tracer is process-global: isolate every test."""
+    tracer.reset()
+    yield
+    tracer.reset()
+
+
+def _span_events(doc):
+    return [e for e in doc["traceEvents"] if e.get("ph") == "B"]
+
+
+def _validate(path):
+    """Full trace_check schema pass over one file; returns (events, tracks)
+    and asserts no errors."""
+    errors = []
+    events, tracks = trace_check.check_file(path, errors)
+    assert errors == [], errors
+    return events, tracks
+
+
+# --------------------------------------------------------------------------- #
+# disabled path
+# --------------------------------------------------------------------------- #
+
+def test_disabled_tracer_is_noop(tmp_path):
+    assert not tracer.enabled
+    # span() hands back ONE shared no-op CM — no per-call allocation
+    assert tracer.span("x") is _NOOP
+    assert tracer.span("y", lane="l") is _NOOP
+    with tracer.span("x"):
+        pass
+    tracer.add("x", 0.0, 1.0)
+    tracer.instant("x")
+    tracer.counter("x", 1.0)
+    assert tracer.summary() == {}
+    assert tracer.export() is None
+    assert tracer.crash_dump("nope") is None
+    assert not list(tmp_path.iterdir())
+
+
+def test_install_from_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("DSTPU_TRACE", raising=False)
+    assert not install_from_env().enabled
+    monkeypatch.setenv("DSTPU_TRACE", str(tmp_path))
+    monkeypatch.setenv("DSTPU_TRACE_RING", "128")
+    tr = install_from_env()
+    assert tr.enabled and tr.trace_dir == str(tmp_path)
+    assert tr.ring_size == 128
+    # idempotent: a second arm (different env) does not reconfigure
+    monkeypatch.setenv("DSTPU_TRACE", "/nonexistent")
+    assert install_from_env().trace_dir == str(tmp_path)
+
+
+# --------------------------------------------------------------------------- #
+# ring semantics
+# --------------------------------------------------------------------------- #
+
+def test_ring_bounds_memory_keeps_newest(tmp_path):
+    tracer.configure(trace_dir=str(tmp_path), ring_size=16)
+    for i in range(40):
+        tracer.add("s", float(i), float(i) + 0.5, i=i)
+    count, _total = tracer.summary()["s"]
+    assert count == 16
+    path = tracer.export()
+    events, _ = _validate(path)
+    kept = sorted(e["args"]["i"] for e in events if e.get("ph") == "B")
+    assert kept == list(range(24, 40))   # the NEWEST 16 survive
+
+
+def test_ring_size_floor_and_default():
+    t = Tracer()
+    assert t.ring_size == DEFAULT_RING_SIZE
+    t.configure(enabled=True, ring_size=2)
+    assert t.ring_size == 16   # floor: a 2-slot flight recorder records noise
+
+
+# --------------------------------------------------------------------------- #
+# export schema: B/E pairing, nesting, tracks
+# --------------------------------------------------------------------------- #
+
+def test_export_schema_nested_spans_and_threads(tmp_path):
+    tracer.configure(trace_dir=str(tmp_path))
+    with tracer.span("outer", lane="train/step", step=3):
+        with tracer.span("inner", lane="train/step"):
+            time.sleep(0.001)
+    t0 = time.perf_counter()
+    time.sleep(0.001)
+    tracer.add("added", t0, time.perf_counter(), lane="serve/decode")
+    tracer.instant("mark", lane="serve/decode")
+    tracer.counter("depth", 2.0, lane="serve/decode")
+
+    def worker():
+        with tracer.span("work"):
+            time.sleep(0.001)
+
+    th = threading.Thread(target=worker, name="dstpu-worker")
+    th.start()
+    th.join()
+
+    path = tracer.export()
+    events, tracks = _validate(path)   # B/E matched, ts monotonic per track
+    names = {e["name"] for e in _span_events({"traceEvents": events})}
+    assert {"outer", "inner", "added", "work"} <= names
+    # lanes AND the worker thread each get their own named track
+    assert {"train/step", "serve/decode", "dstpu-worker"} <= set(tracks.values())
+    # nesting: B outer precedes B inner, E inner precedes E outer
+    order = [(e["ph"], e["name"]) for e in events
+             if e.get("name") in ("outer", "inner") and e.get("ph") in "BE"]
+    assert order == [("B", "outer"), ("B", "inner"),
+                     ("E", "inner"), ("E", "outer")]
+
+
+def test_same_lane_on_two_threads_gets_two_tracks(tmp_path):
+    tracer.configure(trace_dir=str(tmp_path))
+    barrier = threading.Barrier(2)
+
+    def worker():
+        barrier.wait()
+        # overlapping-in-time spans on the SAME lane name from two threads:
+        # per-thread lane tids keep each track's B/E stack well-formed
+        with tracer.span("chunk", lane="offload/kernel"):
+            time.sleep(0.005)
+
+    threads = [threading.Thread(target=worker) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    path = tracer.export()
+    events, tracks = _validate(path)
+    tids = {e["tid"] for e in events if e.get("ph") == "B"
+            and e["name"] == "chunk"}
+    assert len(tids) == 2
+    assert all(tracks[(os.getpid(), tid)] == "offload/kernel" for tid in tids)
+
+
+def test_trace_check_flags_broken_traces(tmp_path):
+    bad = tmp_path / "trace_bad.json"
+    bad.write_text(json.dumps({"traceEvents": [
+        {"ph": "B", "name": "a", "pid": 1, "tid": 1, "ts": 10.0},
+        {"ph": "E", "name": "MISMATCH", "pid": 1, "tid": 1, "ts": 11.0},
+        {"ph": "B", "name": "b", "pid": 1, "tid": 1, "ts": 5.0},  # ts goes back
+        {"ph": "B", "name": "unclosed", "pid": 1, "tid": 2, "ts": 1.0},
+    ]}))
+    errors = []
+    trace_check.check_file(str(bad), errors)
+    text = "\n".join(errors)
+    assert "does not match open" in text
+    assert "not monotonic" in text
+    assert "unmatched 'B'" in text
+
+
+# --------------------------------------------------------------------------- #
+# flight recorder
+# --------------------------------------------------------------------------- #
+
+def test_crash_dump_first_reason_wins(tmp_path):
+    tracer.configure(trace_dir=str(tmp_path))
+    with tracer.span("final/step"):
+        pass
+    p1 = tracer.crash_dump("first")
+    p2 = tracer.crash_dump("second")
+    assert p1 == p2 == str(tmp_path / "trace_crash.json")
+    events, _ = _validate(p1)
+    names = {e["name"] for e in events}
+    assert "final/step" in names
+    assert "crash: first" in names and "crash: second" not in names
+
+
+def test_injected_fault_dumps_flight_recorder(tmp_path):
+    from deepspeed_tpu.utils import fault_injection as fi
+    tracer.configure(trace_dir=str(tmp_path))
+    with tracer.span("train/step", step=7):
+        pass
+    fi.install(fi.parse_plan("unit.site:at=1:action=raise"))
+    try:
+        with pytest.raises(fi.InjectedFault):
+            fi.maybe_fail("unit.site")
+    finally:
+        fi.clear()
+    crash = tmp_path / "trace_crash.json"
+    assert crash.exists()
+    events, _ = _validate(str(crash))
+    names = {e["name"] for e in events}
+    assert "train/step" in names                      # the final steps' spans
+    assert any(n.startswith("crash: injected raise at unit.site")
+               for n in names)
+
+
+def test_injected_fault_without_tracing_still_raises(tmp_path):
+    from deepspeed_tpu.utils import fault_injection as fi
+    fi.install(fi.parse_plan("unit.site2:at=1:action=raise"))
+    try:
+        with pytest.raises(fi.InjectedFault):
+            fi.maybe_fail("unit.site2")
+    finally:
+        fi.clear()
+    assert not (tmp_path / "trace_crash.json").exists()
+
+
+# --------------------------------------------------------------------------- #
+# timer span mode
+# --------------------------------------------------------------------------- #
+
+def test_timer_emits_spans_when_tracing(tmp_path):
+    from deepspeed_tpu.utils.timer import Timer
+    t = Timer("fwd")
+    t.start()
+    t.stop()
+    assert tracer.summary() == {}          # disabled: no span
+    tracer.configure(trace_dir=str(tmp_path))
+    t.reset()
+    t.start()
+    time.sleep(0.001)
+    t.stop()
+    count, total = tracer.summary()["timer/fwd"]
+    assert count == 1 and total > 0
+    # the span and the timer measured the SAME interval, same clock
+    assert total == pytest.approx(t.elapsed(reset=False), rel=1e-6)
+
+
+# --------------------------------------------------------------------------- #
+# engine integration: config-armed tracing, zero behavior change
+# --------------------------------------------------------------------------- #
+
+def _tiny_engine(cfg_extra):
+    import deepspeed_tpu
+    import jax.numpy as jnp
+
+    def model(params, b):
+        return jnp.mean((b["x"] @ params["w"]) ** 2)
+
+    params = {"w": np.ones((4, 2), np.float32)}
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}}
+    cfg.update(cfg_extra)
+    engine, *_ = deepspeed_tpu.initialize(model=model, model_parameters=params,
+                                          config=cfg)
+    return engine
+
+
+def test_engine_traces_train_steps_and_exports(tmp_path):
+    engine = _tiny_engine({"monitor": {"trace": {"dir": str(tmp_path),
+                                                 "ring_size": 512}}})
+    assert tracer.enabled and tracer.trace_dir == str(tmp_path)
+    batch = {"x": np.ones((8, 4), np.float32)}
+    for _ in range(3):
+        engine.train_batch(batch)
+    # the stats are per-window aggregations of the SAME measured intervals
+    # the timeline shows: counts must agree
+    summary = tracer.summary()
+    assert summary["train/step"][0] == engine.train_stats.steps == 3
+    assert summary["train/step/dispatch"][0] == 3
+    assert summary["train/step"][1] >= summary["train/step/dispatch"][1]
+    engine.destroy()   # exports
+    files = glob.glob(str(tmp_path / "trace_*.json"))
+    assert files
+    events, tracks = _validate(files[0])
+    assert "train/step" in set(tracks.values())
+
+
+def test_engine_tracing_does_not_change_loss_stream(tmp_path):
+    batch = {"x": np.linspace(0, 1, 32, dtype=np.float32).reshape(8, 4)}
+    plain = _tiny_engine({})
+    losses_plain = [float(plain.train_batch(batch)) for _ in range(3)]
+    plain.destroy()
+    tracer.reset()
+    traced = _tiny_engine({"monitor": {"trace": {"dir": str(tmp_path)}}})
+    losses_traced = [float(traced.train_batch(batch)) for _ in range(3)]
+    compiles0 = traced.compiles
+    traced.train_batch(batch)
+    assert traced.compiles == compiles0   # tracing adds no recompiles
+    traced.destroy()
+    assert losses_traced == losses_plain   # byte-identical stream
+
+
+def test_zero_duration_span_exports_valid_pairs(tmp_path):
+    """Coarse perf_counter ticks can stamp t1 == t0; the export must still
+    emit the span's B strictly before its own E (review finding: a
+    degenerate span used to sort E-before-B and fail trace_check)."""
+    tracer.configure(trace_dir=str(tmp_path))
+    t = time.perf_counter()
+    tracer.add("zero/a", t, t, lane="l")
+    tracer.add("zero/b", t, t, lane="l")       # sibling at the same tick
+    with tracer.span("zero/outer", lane="l"):  # nested CMs, possibly 0-dur
+        with tracer.span("zero/inner", lane="l"):
+            pass
+    path = tracer.export()
+    _validate(path)   # B/E matched + monotonic per track
+
+
+def test_dead_thread_rings_are_bounded():
+    """Thread churn (per-epoch producers, rebuilt pools) must not grow the
+    ring registry without bound; recently-dead threads' spans survive."""
+    from deepspeed_tpu.monitor.trace import MAX_DEAD_RINGS
+    tracer.configure(enabled=True, ring_size=16)
+
+    def record(i):
+        tracer.add(f"churn/{i}", 0.0, 1.0)
+
+    n = MAX_DEAD_RINGS + 20
+    for i in range(n):
+        th = threading.Thread(target=record, args=(i,))
+        th.start()
+        th.join()
+    # one more registration triggers the prune sweep
+    tracer.add("main/span", 0.0, 1.0)
+    with tracer._reg_lock:
+        n_rings = len(tracer._rings)
+    assert n_rings <= MAX_DEAD_RINGS + 2   # bound + live main + slack
+    # the NEWEST dead threads' spans are still exportable
+    assert f"churn/{n - 1}" in tracer.summary()
