@@ -468,6 +468,193 @@ def run_steady_state(on_tpu: bool, seqs: int, prompt: int, gen: int,
     }
 
 
+def build_frontend_engine(on_tpu: bool, pool_blocks: int, ctx: int,
+                          rows: int = 4, block_size: int = 16):
+    """A warmed engine sized so the frontend workload SATURATES the KV pool
+    (the regime preemption policy differentiates in): a deliberately small
+    page pool, the full pow2 decode grid pre-compiled."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        layers, hidden, heads, vocab = 12, 1536, 12, 32000
+    else:
+        layers, hidden, heads, vocab = 2, 64, 4, 256
+    cfg = LlamaConfig(vocab_size=vocab, hidden_size=hidden,
+                      intermediate_size=hidden * 4, num_hidden_layers=layers,
+                      num_attention_heads=heads, num_key_value_heads=heads,
+                      max_position_embeddings=ctx,
+                      dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    model = LlamaForCausalLM(cfg)
+    params = jax.jit(model.init)(
+        jax.random.PRNGKey(0),
+        {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+    econf = {"state_manager": {"max_tracked_sequences": 4 * rows,
+                               "max_ragged_sequence_count": rows,
+                               "max_ragged_batch_size": 128 + rows,
+                               "prefill_chunk_size": 32,
+                               "max_context": ctx},
+             "kv_cache": {"block_size": block_size,
+                          "num_blocks": pool_blocks},
+             "compile": {"warmup": True}}
+    if not on_tpu:
+        econf["dtype"] = jnp.float32
+    engine = InferenceEngineV2(model=model, model_parameters=params,
+                               config=econf)
+    return engine, vocab
+
+
+def _frontend_classes():
+    # interactive outranks batch; its SLOs are meaningful on this box, batch
+    # SLOs are loose (batch work tolerates preemption — that is the point)
+    return [{"name": "interactive", "priority": 2,
+             "ttft_slo_ms": 2500.0, "tbt_slo_ms": 400.0},
+            {"name": "batch", "priority": 0,
+             "ttft_slo_ms": 60000.0, "tbt_slo_ms": 20000.0}]
+
+
+def _forced_preempt_cycle(engine, frontend, vocab, rng):
+    """One deterministic preempt-offload-restore cycle, step()-driven (no
+    thread): two batch requests decode until their KV growth leaves too
+    little pool for an interactive arrival, which preempts one. Returns
+    (ok, detail)."""
+    lows = [frontend.submit(rng.randint(0, vocab,
+                                        size=(24,)).astype(np.int32),
+                            priority="batch", max_new_tokens=48)
+            for _ in range(2)]
+    for _ in range(40):                      # let batch KV grow into the pool
+        frontend.step()
+        if engine.scheduler.available_blocks < 8:
+            break
+    h_hi = frontend.submit(rng.randint(0, vocab, size=(96,)).astype(np.int32),
+                           priority="interactive", max_new_tokens=8)
+    for _ in range(300):
+        if h_hi.finished and all(h.finished for h in lows):
+            break
+        frontend.step()
+    ok = (h_hi.status == "finished"
+          and all(h.status == "finished" for h in lows)
+          and frontend.stats.preemptions >= 1
+          and frontend.stats.restores >= 1
+          and frontend.stats.offload_bytes > 0)
+    return ok, {"preemptions": frontend.stats.preemptions,
+                "restores": frontend.stats.restores,
+                "offload_bytes": frontend.stats.offload_bytes,
+                "lo_tokens": [len(h.tokens) for h in lows],
+                "hi_tokens": len(h_hi.tokens)}
+
+
+def run_frontend(on_tpu: bool, smoke: bool, rate: float, duration: float,
+                 seed: int = 0, reps: int = 3):
+    """The SLO-aware frontend leg (docs/SERVING.md "Frontend"): a seeded
+    Poisson mixed-priority workload replayed identically against each
+    preemption policy on ONE warmed engine, gating
+
+      - byte-equality: every completed stream == a direct decode_pipeline
+        run of the same prompt (offload + reject-only modes; recompute
+        victims legitimately re-prefill through a different kernel path),
+      - zero engine compiles during every timed phase (the pow2 grid +
+        warmed page round-trip absorb admission, preemption and restore),
+      - one forced preempt-offload-restore cycle (deterministic, pre-replay),
+      - goodput-under-SLO: median over ``reps`` replays, offload >=
+        recompute and >= reject-only (full runs only; the default rate
+        clearly OVERSUBSCRIBES the pool — token demand ~1.7x measured
+        capacity — so every rep runs in the triage regime preemption policy
+        exists for, and requests unfinished at the drain deadline are
+        cancelled, scoring zero).
+
+    Smoke runs the offload mode only, one rep (<60 s on a 2-core CPU box)."""
+    from deepspeed_tpu.inference.v2.serving import (PoissonLoadGen,
+                                                    WorkloadComponent,
+                                                    goodput_report, replay)
+    engine, vocab = build_frontend_engine(on_tpu, pool_blocks=14, ctx=160)
+    mix = [WorkloadComponent("interactive", 4.0, [16, 32], [8, 16, 24]),
+           WorkloadComponent("batch", 1.0, [48], [96])]
+    arrivals = PoissonLoadGen(rate=rate, mix=mix, vocab=vocab,
+                              seed=seed).arrivals(duration=duration)
+    modes = ["offload"] if smoke else ["offload", "recompute", "none"]
+    if smoke:
+        reps = 1
+    results = {m: [] for m in modes}
+    forced = None
+    ok = True
+    # reps interleave the modes (off/rec/none, off/rec/none, ...) so slow
+    # drift on a shared box lands on every mode, not one — the same
+    # alternation discipline the trace-overhead bench uses
+    for r in range(reps):
+        for mode in modes:
+            serving = {"classes": _frontend_classes(), "decode_slice": 4,
+                       "preemption": mode, "idle_wait_s": 0.002}
+            fe = engine.serving_frontend(config=serving)
+            c0 = engine.compiles
+            if mode == "offload" and r == 0:
+                rng = np.random.RandomState(seed + 1)
+                f_ok, forced = _forced_preempt_cycle(engine, fe, vocab, rng)
+                forced["ok"] = f_ok
+            t0 = time.time()
+            fe.start()
+            handles = replay(fe, arrivals)
+            fe.drain(timeout=2.5 * duration)
+            wall = time.time() - t0
+            fe.close()           # past-deadline stragglers cancel: 0 goodput
+            compiles = engine.compiles - c0
+            rep = goodput_report(handles, wall)
+            # byte-equality: finished streams vs direct pipeline runs of the
+            # same prompts on the same engine (preempt-offloaded included)
+            finished = [h for h in handles if h.status == "finished"]
+            check = finished[:24] if smoke else finished[:48]
+            preempted_checked = equal = skipped = 0
+            for h in check:
+                if mode == "recompute" and h.preemptions:
+                    skipped += 1
+                    continue
+                engine._put_nofetch([77_000 + h.uid], [h.prompt])
+                out = engine.decode_pipeline(
+                    [77_000 + h.uid]).run(len(h.tokens))
+                engine.flush([77_000 + h.uid])
+                if [int(t) for t in out[0]] == h.tokens:
+                    equal += 1
+                    preempted_checked += bool(h.preemptions)
+            checked = len(check) - skipped
+            out = {
+                "leg": "frontend", "mode": mode, "rep": r, "rate": rate,
+                "duration": duration, "arrivals": len(arrivals),
+                "preemptions": fe.stats.preemptions,
+                "recompute_preemptions": fe.stats.recompute_preemptions,
+                "restores": fe.stats.restores,
+                "offload_bytes": fe.stats.offload_bytes,
+                "forced_cycle": forced if (mode == "offload" and r == 0)
+                else None,
+                "streams_checked": checked,
+                "streams_equal": equal,
+                "preempted_streams_checked": preempted_checked,
+                "outputs_equal": equal == checked,
+                "compiles_during_timed": compiles,
+                **rep,
+            }
+            results[mode].append(out)
+            print(json.dumps(out), flush=True)
+            if mode != "recompute" and not out["outputs_equal"]:
+                ok = False
+            if compiles != 0:
+                ok = False
+    if not forced["ok"]:
+        print(json.dumps({"gate": "forced_preempt_offload_restore",
+                          "ok": False}), flush=True)
+        ok = False
+    if not smoke:
+        med = {m: float(np.median([x["goodput_tokens_per_sec"]
+                                   for x in results[m]])) for m in modes}
+        gate = med["offload"] >= med["recompute"] \
+            and med["offload"] >= med["none"]
+        print(json.dumps({"gate": "goodput_under_slo", "ok": gate,
+                          "median_goodput": med, "reps": reps}), flush=True)
+        ok = ok and gate
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seqs", type=int, default=32)
@@ -495,6 +682,22 @@ def main():
                          "sweep: a fixed decode set through the pre-pipeline "
                          "per-token loop vs the async double-buffered "
                          "DecodePipeline, with a byte-identical-greedy gate")
+    ap.add_argument("--frontend", action="store_true",
+                    help="run the SLO-aware frontend leg: a seeded Poisson "
+                         "mixed-priority workload against each preemption "
+                         "policy (offload / recompute / reject-only) on one "
+                         "warmed engine, gating byte-equality, zero timed "
+                         "compiles and goodput-under-SLO")
+    ap.add_argument("--smoke", action="store_true",
+                    help="frontend leg: offload mode only, a few dozen "
+                         "arrivals, correctness gates (<60 s; no goodput "
+                         "comparison)")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="frontend leg: Poisson arrivals/sec (default: an "
+                         "oversubscribing 36/s full, 10/s smoke)")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="frontend leg: replays per mode; the goodput gate "
+                         "compares medians (smoke always runs 1)")
     ap.add_argument("--requests", type=int, default=16,
                     help="shared-prefix leg: number of requests")
     ap.add_argument("--prefix", type=int, default=256,
@@ -508,6 +711,12 @@ def main():
     from deepspeed_tpu.utils.compile_cache import setup_compile_cache
     setup_compile_cache(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    if args.frontend:
+        rate = args.rate or (10.0 if args.smoke else 36.0)
+        dur = 4.0 if args.smoke else min(args.duration, 15.0)
+        ok = run_frontend(on_tpu, args.smoke, rate=rate, duration=dur,
+                          reps=args.reps)
+        sys.exit(0 if ok else 1)
     if args.shared_prefix:
         out = run_shared_prefix(on_tpu, args.requests, args.prefix, args.tail,
                                 gen=min(args.gen, 16))

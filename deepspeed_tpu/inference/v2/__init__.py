@@ -7,9 +7,16 @@ the ``ragged/`` KV subsystem, and the Dynamic SplitFuse scheduling described in
 
 from deepspeed_tpu.inference.v2.config_v2 import (CompileConfig,
                                                   PrefixCacheConfig,
-                                                  RaggedInferenceEngineConfig)
+                                                  PriorityClassConfig,
+                                                  RaggedInferenceEngineConfig,
+                                                  ServingConfig)
 from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
                                                   fetch_to_host)
 from deepspeed_tpu.inference.v2.pipeline import DecodePipeline
 from deepspeed_tpu.inference.v2.prefix_cache import (PrefixCacheStats,
                                                      RadixPrefixCache)
+
+# the serving frontend (inference/v2/serving/) is imported lazily via
+# engine.serving_frontend() — keeping `import deepspeed_tpu.inference.v2`
+# light; `from deepspeed_tpu.inference.v2.serving import ServingFrontend`
+# is the direct path.

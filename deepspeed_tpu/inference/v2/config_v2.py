@@ -178,6 +178,101 @@ class CompileConfig:
 
 
 @dataclass
+class PriorityClassConfig:
+    """One tenant priority class for the serving frontend
+    (``inference/v2/serving/``): a strict-priority level plus the latency
+    SLOs admission plans against. ``priority`` is higher-wins; ``ttft_slo_ms``
+    bounds time-to-first-token (arrival -> first streamed token) and
+    ``tbt_slo_ms`` bounds time-between-tokens — the two numbers
+    goodput-under-SLO is gated on (docs/SERVING.md "Frontend")."""
+    name: str
+    priority: int
+    ttft_slo_ms: float = 2000.0
+    tbt_slo_ms: float = 250.0
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("priority class needs a non-empty name")
+        if self.ttft_slo_ms <= 0 or self.tbt_slo_ms <= 0:
+            raise ValueError(f"class {self.name!r}: SLO targets must be > 0")
+
+
+def _default_classes():
+    return [PriorityClassConfig("interactive", 2, 500.0, 100.0),
+            PriorityClassConfig("standard", 1, 2000.0, 250.0),
+            PriorityClassConfig("batch", 0, 30000.0, 2000.0)]
+
+
+@dataclass
+class ServingConfig:
+    """The SLO-aware serving frontend (``inference/v2/serving/frontend.py``).
+
+    ``classes``: the tenant priority classes (dicts or
+    :class:`PriorityClassConfig`), strict priority between classes, FIFO
+    within one.
+
+    ``decode_slice``: pipeline steps per ``DecodePipeline.run`` burst — the
+    iteration-level continuous-batching grain. Admission, retirement,
+    preemption and restore all happen at slice boundaries; a smaller slice
+    lowers admission latency, a larger one amortises per-run host work.
+
+    ``preemption`` picks what happens to low-priority victims under KV-pool
+    pressure:
+
+    - ``"offload"`` (default): the victim's *private* KV pages (allocator
+      refcount 1 — prefix-cache-shared pages are never touched) round-trip
+      through pinned host buffers (``runtime/swap_tensor/buffer_pool.py``)
+      and are restored byte-identically on readmit; falls back to recompute
+      per victim when ``max_offload_bytes`` is exhausted.
+    - ``"recompute"``: the victim is flushed and re-prefilled from its
+      prompt + generated-so-far tokens on readmit (vLLM's drop-and-recompute
+      baseline).
+    - ``"none"``: reject-only — no preemption; admission turns conservative
+      (a request is admitted only when its full prompt + ``max_new_tokens``
+      KV lifetime is fundable up front) and excess load is held, then shed.
+
+    ``shed_factor``: a queued request is shed once
+    ``elapsed_queue_delay + predicted_prefill + one_slice >
+    ttft_slo_ms * shed_factor`` — it can no longer meet its SLO, so
+    admitting it would burn prefill compute on a guaranteed miss.
+
+    ``max_offload_bytes``: host-buffer capacity for offloaded pages (None =
+    unbounded); ``offload_buffers`` caps the pinned-buffer pool's free list.
+    ``max_queue`` bounds the pending queue (beyond = immediate shed);
+    ``idle_wait_s`` is the engine thread's block interval when idle.
+    """
+    classes: Any = field(default_factory=_default_classes)
+    decode_slice: int = 8
+    preemption: str = "offload"
+    max_offload_bytes: Optional[int] = None
+    offload_buffers: int = 16
+    shed_factor: float = 1.0
+    max_queue: int = 1024
+    idle_wait_s: float = 0.02
+
+    def __post_init__(self):
+        self.classes = [PriorityClassConfig(**c) if isinstance(c, dict) else c
+                        for c in self.classes]
+        names = [c.name for c in self.classes]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate priority class names: {names}")
+        if not self.classes:
+            raise ValueError("serving.classes must name at least one class")
+        if self.preemption not in ("offload", "recompute", "none"):
+            raise ValueError("serving.preemption must be 'offload', "
+                             f"'recompute' or 'none', got {self.preemption!r}")
+        if self.decode_slice < 1:
+            raise ValueError("serving.decode_slice must be >= 1")
+
+    def get_class(self, name: str) -> PriorityClassConfig:
+        for c in self.classes:
+            if c.name == name:
+                return c
+        raise KeyError(f"unknown priority class {name!r}; configured: "
+                       f"{[c.name for c in self.classes]}")
+
+
+@dataclass
 class RaggedInferenceEngineConfig:
     state_manager: DSStateManagerConfig = field(default_factory=DSStateManagerConfig)
     kv_cache: KVCacheSizingConfig = field(default_factory=KVCacheSizingConfig)
@@ -185,6 +280,7 @@ class RaggedInferenceEngineConfig:
     kv_quant: KVQuantConfig = field(default_factory=KVQuantConfig)
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
     compile: CompileConfig = field(default_factory=CompileConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
     tensor_parallel: int = 1
     dtype: Any = jnp.bfloat16
     seed: int = 0
@@ -212,8 +308,11 @@ class RaggedInferenceEngineConfig:
             pc = PrefixCacheConfig(**pc) if isinstance(pc, dict) else pc
             co = d.pop("compile", {})
             co = CompileConfig(**co) if isinstance(co, dict) else co
+            sv = d.pop("serving", {})
+            sv = ServingConfig(**sv) if isinstance(sv, dict) else sv
             cfg = cls(state_manager=sm, kv_cache=kv, quantization=qz,
-                      kv_quant=kq, prefix_cache=pc, compile=co, **d)
+                      kv_quant=kq, prefix_cache=pc, compile=co, serving=sv,
+                      **d)
         if cfg.state_manager.chunk_budget <= 0:
             raise ValueError("max_ragged_batch_size must exceed max_ragged_sequence_count")
         return cfg

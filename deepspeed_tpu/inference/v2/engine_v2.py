@@ -239,6 +239,13 @@ class InferenceEngineV2:
         # compiled single-step fused decode programs (DecodePipeline), keyed
         # by (bucket, do_sample, top_k); one per grid point
         self._step_progs: LRUCache = LRUCache(maxsize=16)
+        # KV page host round-trip programs (gather, scatter) — the serving
+        # frontend's preempt-offload path (serving/kv_offload.py); built
+        # lazily, warmed by warmup() so a mid-steady-state preemption never
+        # observes a compile. _page_buckets tracks the (op, pow2-count)
+        # signatures already compiled (the compiles-counter unit here).
+        self._page_progs = None
+        self._page_buckets: set = set()
         # aggregate double-buffer pipeline timings (monitor/serving.py);
         # write_monitor_events emits them
         from deepspeed_tpu.monitor.serving import PipelineStats
@@ -365,7 +372,15 @@ class InferenceEngineV2:
             arr, row = ref
             by_array.setdefault(id(arr), (arr, []))[1].append((i, row))
         if host_rows:
-            arr = jnp.asarray(np.stack(host_rows))
+            # the re-upload block is BUCKETED too (rows repeat row 0, never
+            # referenced): host-rematerialized sources appear whenever a
+            # preempt-offloaded sequence is restored (serving/kv_offload.py
+            # parks the victim's last logits row on host), and a count-shaped
+            # [n, V] upload would compile a fresh _dev_sample per distinct
+            # restore count — in the middle of the steady state the
+            # zero-compile gate polices. pow2 shapes land in the warmed grid.
+            pad = next_pow2(len(host_rows)) - len(host_rows)
+            arr = jnp.asarray(np.stack(host_rows + [host_rows[0]] * pad))
             by_array[id(arr)] = (arr, [(i, j) for j, i in enumerate(host_idx)])
         n_done = 0
         for arr, pairs in by_array.values():
@@ -504,8 +519,10 @@ class InferenceEngineV2:
         sampled variants compile on first use), fused multistep programs for
         each ``burst_steps`` length across the grid, and the module-level
         bootstrap sampler ``_dev_sample`` over the logits-source shapes the
-        serving loops read (chunk/decode pass outputs and per-bucket fused
-        outputs; host-rematerialized rows are count-shaped and stay cold).
+        serving loops read (chunk/decode pass outputs, per-bucket fused
+        outputs, and pow2-padded host-rematerialized blocks — restore paths
+        re-upload through the same bucket grid). Also warms the KV page
+        offload/restore round-trip pair.
         Each program is executed once over scratch-page-only descriptors —
         real KV state, scheduler state and logits refs are untouched.
 
@@ -540,6 +557,14 @@ class InferenceEngineV2:
                 out_ids, _logits, new_kv = fn(self.weights, self.kv.kv, *args)
                 self.kv.update(new_kv)
                 jax.block_until_ready(out_ids)
+        # the KV page round-trip pair (preempt-offload) over its whole
+        # bucket grid: rare path, but a preemption DURING the timed steady
+        # state must not compile — warm both ops per bucket over the scratch
+        # page (content round-trips to itself)
+        if not self.config.kv_quant.enabled:
+            for b in self.page_buckets:
+                pages = self.fetch_pages([self.scratch_block] * b)
+                self.put_pages(pages, [self.scratch_block] * b)
         # the greedy bootstrap sampler over every logits-source shape a
         # serving loop can hand it: without this, the FIRST pipeline run /
         # burst after startup pays a small-but-real compile (an RTT-bound
@@ -698,6 +723,107 @@ class InferenceEngineV2:
         return self.allocator.free_blocks
 
     # ------------------------------------------------------------------ #
+    # KV page host round-trip (serving preempt-offload; serving/kv_offload)
+    # ------------------------------------------------------------------ #
+
+    def _page_programs(self):
+        """(gather, scatter) jits over the whole pool with a TRACED block-id
+        VECTOR, padded to a pow2 bucket: offloading a victim's whole tail is
+        ONE dispatch + ONE host transfer (and one scatter back on restore),
+        not one per page, and the bucket keying means arbitrary tail lengths
+        reuse ~log2 executables. Pad slots point at the scratch page — reads
+        of it are discarded, writes to it land on the one page no sequence
+        can own. Scatter donates the pool (XLA aliases it in HBM, the same
+        discipline as the pass programs)."""
+        if self._page_progs is None:
+            if self.config.kv_quant.enabled:
+                raise NotImplementedError(
+                    "KV page offload with int8 KV pages is not wired (the "
+                    "tiled scale layout folds the page dim)")
+
+            @jax.jit
+            def _gather(kv, blocks):
+                # page-major on the way out: host slices [i] are contiguous
+                return jnp.moveaxis(jnp.take(kv, blocks, axis=1), 1, 0)
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def _scatter(kv, pages, blocks):
+                return kv.at[:, blocks].set(jnp.moveaxis(pages, 0, 1))
+
+            self._page_progs = (_gather, _scatter)
+        return self._page_progs
+
+    def _page_bucket(self, kind: str, n: int) -> int:
+        """Pad count for a page-op batch; counts the first use of each
+        (op, bucket) signature as a compile (the page jits re-specialize
+        per bucket, unlike the one-signature pass programs)."""
+        b = next_pow2(n)
+        key = (kind, b)
+        if key not in self._page_buckets:
+            self._page_buckets.add(key)
+            self.compiles += 1
+        return b
+
+    @property
+    def page_buckets(self) -> List[int]:
+        """The page-op bucket grid warmup pre-compiles: pow2 up to a whole
+        sequence's block-table length (the largest possible private tail)."""
+        top = next_pow2(self.scheduler.max_blocks)
+        return [1 << i for i in range(top.bit_length())]
+
+    def fetch_pages(self, blocks: Sequence[int]) -> np.ndarray:
+        """KV pages ``[n, L, 2, H_kv, block_size, D]`` fetched to host in
+        one bucketed gather — the offload half of the preempt-offload round
+        trip (serving/kv_offload.py). Rare path (runs only when admission
+        preempts a victim), drained through the policed ``fetch_to_host``
+        like every other v2 fetch."""
+        ids = [int(b) for b in blocks]
+        gather, _ = self._page_programs()
+        bucket = self._page_bucket("gather", len(ids))
+        idx = np.full((bucket,), self.scratch_block, np.int32)
+        idx[:len(ids)] = ids
+        return fetch_to_host(gather(self.kv.kv, jnp.asarray(idx)))[:len(ids)]
+
+    def put_pages(self, pages: np.ndarray, blocks: Sequence[int]) -> None:
+        """Scatter host pages ``[n, ...]`` back into pool slots ``blocks``
+        (one bucketed dispatch) — the restore half. Byte-exact with
+        ``fetch_pages`` (same dtype both ways; pinned by
+        tests/unit/test_serving_frontend.py). Pad slots write zeros into the
+        inert scratch page."""
+        ids = [int(b) for b in blocks]
+        if not ids:
+            return
+        _, scatter = self._page_programs()
+        bucket = self._page_bucket("scatter", len(ids))
+        idx = np.full((bucket,), self.scratch_block, np.int32)
+        idx[:len(ids)] = ids
+        if bucket != len(ids):
+            pages = np.concatenate(
+                [pages, np.zeros((bucket - len(ids),) + pages.shape[1:],
+                                 pages.dtype)])
+        # direct rebind (not kv.update) so JL003 sees the donated pool's
+        # reference replaced before the next pass reads it
+        self.kv.kv = scatter(self.kv.kv,
+                             jnp.asarray(pages, self.kv.kv.dtype),
+                             jnp.asarray(idx))
+
+    def fetch_page(self, block: int) -> np.ndarray:
+        """One KV page ([L, 2, H_kv, block_size, D]) to host."""
+        return self.fetch_pages([block])[0]
+
+    def put_page(self, page: np.ndarray, block: int) -> None:
+        """Scatter one host page back into pool slot ``block``."""
+        self.put_pages(page[None], [block])
+
+    def serving_frontend(self, config=None):
+        """The persistent SLO-aware serving frontend over this engine
+        (``serving/frontend.py``): asyncio-facing ``submit() -> token
+        stream``, multi-tenant admission with priority classes, and
+        KV offload-preemption. ``config`` overrides ``self.config.serving``."""
+        from deepspeed_tpu.inference.v2.serving import ServingFrontend
+        return ServingFrontend(self, config=config)
+
+    # ------------------------------------------------------------------ #
     # prefix-cache support
     # ------------------------------------------------------------------ #
 
@@ -736,8 +862,19 @@ class InferenceEngineV2:
                  top_k: int = 0,
                  eos_token_id: Optional[int] = None) -> List[List[int]]:
         """Generate continuations for a batch of prompts with continuous
-        batching: all sequences advance together; finished ones are flushed and
-        their blocks recycled. Returns full token lists (prompt + generation)."""
+        batching: all sequences advance together; finished ones are flushed
+        and their blocks recycled. Returns full token lists (prompt +
+        generation).
+
+        Steady-state decode runs through ``decode_pipeline`` — the SAME
+        gated hot path the serving frontend drives (fused on-device
+        sampling, bucketed descriptors, one-step-late drain) — in
+        slice-sized runs, retiring EOS'd sequences at each drained step.
+        Greedy streams are byte-identical to the old per-token
+        ``sample_next``/``put`` loop (pinned by
+        tests/unit/test_decode_pipeline.py); sampled streams are valid
+        draws but consume RNG per fused step, so they differ from the old
+        loop's draws (the documented ``decode_steps`` trade)."""
         # fresh uid namespace: never collide with caller-owned put() sequences
         uids: List[int] = []
         nxt = 0
@@ -751,49 +888,33 @@ class InferenceEngineV2:
             raise RuntimeError("cannot schedule: insufficient KV blocks or "
                                "sequence slots")
         self._put_nofetch(uids, [np.asarray(p, np.int32) for p in prompts])
-        if eos_token_id is None:
-            # no early-exit condition: run the fused multi-step device loop
-            # (one host sync per CHUNK tokens); the sub-chunk remainder uses
-            # the per-token path so odd lengths never trigger a fresh
-            # multi-step compile
-            CHUNK = 32
-            done = 0
-            while max_new_tokens - done >= CHUNK:
-                ids = self.decode_steps(uids, CHUNK, do_sample=do_sample,
-                                        temperature=temperature, top_k=top_k)
-                for i, u in enumerate(uids):
-                    outs[idx_of[u]].extend(int(t) for t in ids[i])
-                done += CHUNK
-            rem = max_new_tokens - done
-            for j in range(rem):
-                toks = self.sample_next(uids, do_sample, temperature, top_k)
-                for u, t in zip(uids, toks):
-                    outs[idx_of[u]].append(int(t))
-                if j < rem - 1:  # final token's forward pass is never read
-                    self._put_nofetch(uids, [np.asarray([t], np.int32)
-                                             for t in toks])
-            self.flush(uids)
-            return outs
+        pipe = self.decode_pipeline(uids, do_sample=do_sample,
+                                    temperature=temperature, top_k=top_k)
         live = set(uids)
-        for step in range(max_new_tokens):
-            batch_uids = sorted(live)
-            # on-device sampling: only the token ids cross the host boundary
-            toks = self.sample_next(batch_uids, do_sample, temperature, top_k)
-            next_toks: Dict[int, int] = {}
-            for u, t in zip(batch_uids, toks):
-                t = int(t)
+
+        def on_tokens(j, run_uids, row):
+            stop = []
+            for i, u in enumerate(run_uids):
+                if u not in live:
+                    continue        # retired earlier this run: padding noise
+                t = int(row[i])
                 outs[idx_of[u]].append(t)
                 if eos_token_id is not None and t == eos_token_id:
                     live.discard(u)
-                    self.flush([u])   # recycle KV blocks immediately
-                else:
-                    next_toks[u] = t
-            if not next_toks or step == max_new_tokens - 1:
-                break  # last token's forward pass would never be read
-            self._put_nofetch(sorted(next_toks),
-                              [np.asarray([next_toks[u]], np.int32)
-                               for u in sorted(next_toks)])
-        self.flush(sorted(live))
+                    stop.append(u)
+            return stop
+
+        # slice-sized runs bound the post-EOS overshoot (the device finishes
+        # each in-flight burst; see DecodePipeline.run) to one slice
+        CHUNK = 32
+        done = 0
+        while done < max_new_tokens and pipe.uids:
+            before = set(pipe.uids)
+            pipe.run(min(CHUNK, max_new_tokens - done), on_tokens=on_tokens)
+            done += CHUNK
+            for u in before - set(pipe.uids):
+                self.flush([u])     # EOS'd mid-run: recycle KV blocks now
+        self.flush(pipe.uids)
         return outs
 
 

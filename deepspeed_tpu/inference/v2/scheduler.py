@@ -214,6 +214,60 @@ class DynamicSplitFuseScheduler:
     def has_pending(self) -> bool:
         return any(len(s.pending) > 0 for s in self.seqs.values())
 
+    @property
+    def available_blocks(self) -> int:
+        """Blocks obtainable right now (free list + evictable cached pages) —
+        the capacity number the serving frontend's admission model plans
+        with."""
+        return self._available_blocks()
+
+    def blocks_needed(self, uids: List[int], n_tokens: int) -> int:
+        """Fresh allocator blocks a fused-decode reservation of ``n_tokens``
+        more tokens for every uid would take (``decode_batch``'s per-run
+        ``reserve``) — the serving frontend's per-slice funding check."""
+        return sum(self._new_blocks_needed(self.seqs[u], n_tokens)
+                   for u in uids)
+
+    # ------------------------------------------------------------------ #
+    # preempt-offload support (serving frontend; docs/SERVING.md)
+    # ------------------------------------------------------------------ #
+
+    def private_tail(self, uid: int) -> Tuple[int, List[int]]:
+        """``(kept, tail)``: the maximal *suffix* of ``uid``'s block table
+        held by nobody else (allocator refcount 1) — the pages preemption may
+        offload. Shared pages (radix-tree references, co-holding sequences)
+        are always a prefix here: the tree files/matches whole-block
+        prefixes only, and eviction never touches a page a live sequence
+        holds — so a shared page's content is stable and the sequence simply
+        keeps its references across the preemption."""
+        if self.window is not None:
+            raise NotImplementedError(
+                "preemption with a sliding-window page ring is not wired "
+                "(the logical block list aliases physical pages)")
+        blocks = self.seqs[uid].blocks
+        k = len(blocks)
+        while k > 0 and self.allocator.ref_count(blocks[k - 1]) == 1:
+            k -= 1
+        return k, list(blocks[k:])
+
+    def drop_tail(self, uid: int, kept: int) -> None:
+        """Free the blocks beyond ``kept`` and truncate the block table —
+        the releasing half of a preempt-offload (page CONTENT must already
+        be copied out; ``free`` recycles the ids immediately)."""
+        seq = self.seqs[uid]
+        self.allocator.free(seq.blocks[kept:])
+        del seq.blocks[kept:]
+
+    def grow_tail(self, uid: int, n: int) -> List[int]:
+        """Append ``n`` fresh pages to ``uid``'s block table (LRU-evicting
+        idle cached pages on a shortfall) and return their ids, in order —
+        the restore half: the caller scatters the offloaded page contents
+        into these before the sequence decodes again."""
+        seq = self.seqs[uid]
+        ids = [int(b) for b in self._alloc(n)] if n else []
+        seq.blocks.extend(ids)
+        return ids
+
     # ------------------------------------------------------------------ #
     # multi-step decode support (device-fused token loop)
     # ------------------------------------------------------------------ #

@@ -1,0 +1,32 @@
+"""The SLO-aware serving frontend — PAPER.md layer 6 (MII/FastGen) over
+``InferenceEngineV2``.
+
+Four modules:
+
+- ``frontend.py`` — ``ServingFrontend``: persistent engine thread driving
+  iteration-level continuous batching over ``engine.decode_pipeline``;
+  asyncio-facing ``submit() -> token stream``; cancellation at every
+  lifecycle stage.
+- ``admission.py`` — multi-tenant admission with priority classes: a
+  queue-delay + prefill-cost model decides admit / hold / shed per class
+  SLO, and plans preemption under KV-pool pressure.
+- ``kv_offload.py`` — preempt-by-offload: victims' private KV pages
+  round-trip through pinned host buffers (vLLM swap-out, not
+  drop-and-recompute), byte-identical on restore.
+- ``loadgen.py`` — Poisson open-loop load generator + goodput-under-SLO
+  scoring (``serving_bench.py --frontend`` gates on it).
+
+docs/SERVING.md "Frontend" walks the design; ``serve/frontend/*`` counters
+and ``serve/req/*`` trace lanes make it observable.
+"""
+
+from deepspeed_tpu.inference.v2.serving.admission import (AdmissionController,
+                                                          CostModel)
+from deepspeed_tpu.inference.v2.serving.frontend import (RequestHandle,
+                                                         ServingFrontend)
+from deepspeed_tpu.inference.v2.serving.kv_offload import KVOffloadManager
+from deepspeed_tpu.inference.v2.serving.loadgen import (Arrival,
+                                                        PoissonLoadGen,
+                                                        WorkloadComponent,
+                                                        goodput_report,
+                                                        replay, slo_met)

@@ -1,0 +1,575 @@
+"""SLO-aware serving frontend: the persistent MII/FastGen layer over the v2
+engine.
+
+``ServingFrontend`` turns the batch-script engine into a server: a dedicated
+engine thread (``dstpu-serve``) owns the ``DecodePipeline`` and runs the
+continuous-batching loop; clients — sync threads or asyncio tasks — call
+:meth:`submit` from anywhere and read a token stream off the returned
+:class:`RequestHandle`.
+
+The loop is iteration-level continuous batching at pipeline *run boundaries*
+(Orca's iteration-level scheduling on PR 3's double-buffered hot path): each
+iteration drains control traffic, executes one admission plan
+(``admission.py`` — shed / restore / preempt / admit), runs prefill passes
+for the admitted batch (Dynamic SplitFuse composition, cancellation polled
+at pass boundaries), then drives one ``decode_slice``-step ``run()`` burst.
+Tokens drain one step late (PR 3's overlap discipline); the per-step
+``on_tokens`` callback only stamps clocks, appends ints and feeds stream
+queues — no device fetch, no formatting — so serving adds zero host syncs to
+the gated hot path. Admission and retirement move the live set between pow2
+buckets the engine pre-compiled (``engine.warmup()``), so steady-state
+admission adds ZERO compiles after warmup (gated by
+``serving_bench.py --frontend``).
+
+Under KV-pool pressure the admission plan PREEMPTS low-priority victims by
+offloading their private KV tail to pinned host buffers
+(``kv_offload.py`` — vLLM swap-out, not drop-and-recompute), restoring
+byte-identically on readmit; recompute is the per-victim fallback when host
+capacity is exhausted, and a config-selected baseline. Request lifecycle
+spans (``serve/req/{queued,prefill,decode,preempted,restore}``) land on a
+per-request trace lane and the aggregate counters in
+``monitor/serving.FrontendStats`` (``serve/frontend/*``); docs/SERVING.md
+"Frontend" walks the whole design.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.inference.v2.config_v2 import ServingConfig
+from deepspeed_tpu.inference.v2.serving.admission import AdmissionController
+from deepspeed_tpu.inference.v2.serving.kv_offload import KVOffloadManager
+from deepspeed_tpu.monitor.serving import FrontendStats
+from deepspeed_tpu.monitor.trace import tracer as _tracer
+
+_DONE = object()      # stream sentinel
+
+# request lifecycle states
+QUEUED = "queued"
+PREFILL = "prefill"
+DECODING = "decoding"
+PREEMPTED = "preempted"
+FINISHED = "finished"
+CANCELLED = "cancelled"
+SHED = "shed"
+_TERMINAL = (FINISHED, CANCELLED, SHED)
+
+
+class RequestHandle:
+    """One submitted request: a thread-safe token stream plus lifecycle
+    state. Clients iterate tokens (``for t in handle`` or ``async for t in
+    handle.astream()``), or block for the full result; ``cancel()`` models a
+    client disconnect — the engine thread retires the uid at the next run
+    boundary and releases its KV through ``scheduler.flush``."""
+
+    def __init__(self, uid: int, prompt: np.ndarray, cls, max_new_tokens: int,
+                 eos_token_id: Optional[int], arrival_t: float):
+        self.uid = uid
+        self.prompt = prompt
+        self.cls = cls                      # PriorityClassConfig
+        self.max_new_tokens = max_new_tokens
+        self.eos_token_id = eos_token_id
+        self.arrival_t = arrival_t          # perf_counter at submit
+        self.tokens: List[int] = []
+        self.status = QUEUED
+        self.ttft_ms: Optional[float] = None
+        self.tbt_ms: List[float] = []       # gaps between streamed tokens
+        self.preemptions = 0
+        self._q: "queue.Queue" = queue.Queue()
+        self._cancel = threading.Event()
+        self._finished = threading.Event()
+        # engine-thread bookkeeping (phase stamps for spans + victim order)
+        self.admit_t: Optional[float] = None
+        self.preempt_t: Optional[float] = None
+        self._phase_t0 = arrival_t
+        self._last_emit_t: Optional[float] = None
+        self._resume_tokens: Optional[np.ndarray] = None   # recompute restore
+        self._stop_status = FINISHED            # set on mid-run retirement
+
+    # -- client surface ------------------------------------------------ #
+
+    def cancel(self) -> None:
+        self._cancel.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel.is_set()
+
+    @property
+    def finished(self) -> bool:
+        return self._finished.is_set()
+
+    def __iter__(self):
+        while True:
+            t = self._q.get()
+            if t is _DONE:
+                return
+            yield t
+
+    async def astream(self):
+        """Async token stream (``async for tok in handle.astream()``): each
+        blocking queue read rides the event loop's default executor, so the
+        loop never blocks on the engine thread."""
+        import asyncio
+        loop = asyncio.get_running_loop()
+        while True:
+            t = await loop.run_in_executor(None, self._q.get)
+            if t is _DONE:
+                return
+            yield t
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the request reaches a terminal state; returns the
+        generated tokens (possibly partial for cancelled/shed requests)."""
+        if not self._finished.wait(timeout):
+            raise TimeoutError(f"request {self.uid} still {self.status} "
+                               f"after {timeout}s")
+        return list(self.tokens)
+
+
+class ServingFrontend:
+
+    def __init__(self, engine, config=None):
+        cfg = config if config is not None else engine.config.serving
+        if isinstance(cfg, dict):
+            cfg = ServingConfig(**cfg)
+        if cfg.preemption == "offload" and engine.config.kv_quant.enabled:
+            raise NotImplementedError(
+                "preemption='offload' with int8 KV pages is not wired — "
+                "run preemption='recompute' or 'none'")
+        if cfg.preemption != "none" and engine.scheduler.window is not None:
+            raise NotImplementedError(
+                "preemption with a sliding-window page ring is not wired "
+                "(the logical block list aliases physical pages) — run "
+                "preemption='none'")
+        self.engine = engine
+        self.config = cfg
+        self.stats = FrontendStats([c.name for c in cfg.classes])
+        self.admission = AdmissionController(engine, cfg)
+        self.offload: Optional[KVOffloadManager] = (
+            KVOffloadManager(engine, max_bytes=cfg.max_offload_bytes,
+                             max_buffers=cfg.offload_buffers)
+            if cfg.preemption == "offload" else None)
+        self._pipe = engine.decode_pipeline(())
+        self._ctl: "queue.Queue" = queue.Queue()
+        self._reqs: Dict[int, RequestHandle] = {}       # every non-terminal
+        self._live: Dict[int, RequestHandle] = {}       # in the pipeline
+        self._preempted: Dict[int, RequestHandle] = {}
+        self._run_stopped: List[RequestHandle] = []     # retired mid-run
+        self._uid_iter = itertools.count(1 << 20)       # thread-safe counter
+        # in-flight count bumped in submit() BEFORE the control message is
+        # posted: drain() polling len(_reqs)/_ctl alone races the window
+        # where the engine thread has popped the message but not yet filed
+        # the handle
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._loop_exc: Optional[BaseException] = None
+
+    # ------------------------------------------------------------------ #
+    # client surface (any thread / asyncio)
+    # ------------------------------------------------------------------ #
+
+    def submit(self, prompt: Sequence[int], priority: str = "standard",
+               max_new_tokens: int = 32,
+               eos_token_id: Optional[int] = None) -> RequestHandle:
+        """Enqueue one request; returns immediately with its stream handle.
+        ``priority`` names a configured class; admission decides admit /
+        hold / shed against that class's TTFT/TBT SLOs."""
+        cls = self.config.get_class(priority)
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) < 1:
+            raise ValueError("empty prompt")
+        sm = self.engine.config.state_manager
+        # every run-boundary reservation must fit max_context: a row one
+        # token from its budget still funds a whole slice at run start
+        need = len(prompt) + max_new_tokens + self.config.decode_slice + 1
+        if need > sm.max_context:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
+                f"+ decode_slice ({self.config.decode_slice}) + 1 = {need} "
+                f"exceeds max_context {sm.max_context}")
+        bs = self.engine.kv.config.block_size
+        if -(-need // bs) > self.engine.allocator.total_blocks:
+            # a request whose KV lifetime can NEVER fit the pool would be
+            # admitted optimistically, grow, be preempted, and then wedge
+            # forever un-restorable — reject it up front
+            raise ValueError(
+                f"request needs {-(-need // bs)} KV blocks at its budget but "
+                f"the pool holds {self.engine.allocator.total_blocks}")
+        req = RequestHandle(next(self._uid_iter), prompt, cls,
+                            int(max_new_tokens), eos_token_id,
+                            time.perf_counter())
+        with self._inflight_lock:
+            self._inflight += 1
+        self._ctl.put(("submit", req))
+        return req
+
+    @property
+    def outstanding(self) -> int:
+        """Non-terminal requests (queued + prefilling + decoding +
+        preempted)."""
+        return len(self._reqs)
+
+    def start(self) -> "ServingFrontend":
+        if self._thread is not None:
+            raise RuntimeError("frontend already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="dstpu-serve", daemon=True)
+        self._thread.start()
+        return self
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Wait until every submitted request reaches a terminal state (the
+        loop keeps serving). True = drained; False = timed out."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self._inflight > 0:
+            if self._loop_exc is not None:
+                raise RuntimeError("serving loop died") from self._loop_exc
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.002)
+        return True
+
+    def close(self) -> None:
+        """Stop the engine thread and cancel whatever is still in flight
+        (KV flushed, offload buffers released, streams closed)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        # engine-thread state is safe to touch now (thread joined / never ran)
+        self._drain_control()
+        for req in list(self._reqs.values()):
+            self._teardown(req, CANCELLED)
+        if self.offload is not None:
+            self.offload.close()
+        if self._loop_exc is not None:
+            exc, self._loop_exc = self._loop_exc, None
+            raise RuntimeError("serving loop died") from exc
+
+    def __enter__(self) -> "ServingFrontend":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def write_monitor_events(self, monitor, step: int = 0) -> None:
+        """Emit the ``serve/frontend/*`` counters through a ``monitor/``
+        backend (``MonitorMaster.write_events`` shape)."""
+        monitor.write_events(self.stats.events(step))
+
+    # ------------------------------------------------------------------ #
+    # the engine thread
+    # ------------------------------------------------------------------ #
+
+    def _loop(self) -> None:
+        try:
+            while not self._stop.is_set():
+                if not self.step():
+                    try:                      # idle: block on control traffic
+                        msg = self._ctl.get(timeout=self.config.idle_wait_s)
+                    except queue.Empty:
+                        continue
+                    self._handle(msg)
+        except BaseException as exc:          # surface at drain()/close() —
+            self._loop_exc = exc              # a dead server must not hang
+            for req in list(self._reqs.values()):
+                req._q.put(_DONE)             # unblock stream readers
+                req._finished.set()
+
+    def step(self) -> bool:
+        """ONE frontend iteration: control drain -> cancellation sweep ->
+        admission plan -> prefill -> one decode slice. Public so tests and
+        deterministic bench phases can drive the loop synchronously (no
+        thread); returns False when the iteration found no work (idle)."""
+        self._drain_control()
+        self._sweep_cancels()
+        worked = self._admission_round()
+        if self._pipe.uids:
+            self._decode_slice()
+            worked = True
+        return worked
+
+    def _handle(self, msg) -> None:
+        kind, req = msg
+        if kind == "submit":
+            self._reqs[req.uid] = req
+            self.stats.record_submit(req.cls.name)
+            if not self.admission.enqueue(req):
+                self._finalize(req, SHED)     # queue full: immediate shed
+        # cancellation rides the handle's event (no message): the sweeps /
+        # on_tokens observe it within one iteration, and an idle loop ticks
+        # every idle_wait_s — disconnects are never waited on indefinitely
+
+    def _drain_control(self) -> None:
+        while True:
+            try:
+                self._handle(self._ctl.get_nowait())
+            except queue.Empty:
+                return
+
+    def _sweep_cancels(self) -> None:
+        """Client disconnects for requests NOT currently decoding (those are
+        caught token-by-token in ``_on_tokens``): queued requests leave the
+        admission queue; preempted ones drop their offloaded pages / resume
+        record and flush their kept KV."""
+        for req in list(self._reqs.values()):
+            if req.cancelled and req.status in (QUEUED, PREEMPTED):
+                self._teardown(req, CANCELLED)
+
+    def _teardown(self, req: RequestHandle, status: str) -> None:
+        """Release every resource a request holds in its CURRENT lifecycle
+        stage, then finalize. The one path cancellation, shedding and
+        close-time abandonment all funnel through — the allocator-leak
+        regression test cancels at every stage against this."""
+        uid = req.uid
+        if req.status == QUEUED:
+            self.admission.remove(req)
+        if uid in self._live:
+            self._pipe.retire([uid])
+            del self._live[uid]
+        if uid in self._preempted:
+            del self._preempted[uid]
+            if self.offload is not None and uid in self.offload._recs:
+                self.offload.drop(uid)
+        if uid in self.engine.scheduler.seqs:
+            self.engine.flush([uid])
+        self._finalize(req, status)
+
+    def _finalize(self, req: RequestHandle, status: str) -> None:
+        now = time.perf_counter()
+        if req.status == DECODING:
+            self._span(req, "decode", req._phase_t0, now)
+        req.status = status
+        self._reqs.pop(req.uid, None)
+        if status == FINISHED:
+            slo_met = (req.ttft_ms is not None
+                       and req.ttft_ms <= req.cls.ttft_slo_ms
+                       and (not req.tbt_ms or float(np.percentile(
+                            np.asarray(req.tbt_ms, np.float64), 95))
+                            <= req.cls.tbt_slo_ms))
+            self.stats.record_complete(req.cls.name, req.ttft_ms, req.tbt_ms,
+                                       len(req.tokens), slo_met)
+        elif status == SHED:
+            self.stats.record_shed(req.cls.name)
+            if _tracer.enabled:
+                _tracer.instant("serve/req/shed", lane=f"serve/req/u{req.uid}",
+                                uid=req.uid, cls=req.cls.name)
+        elif status == CANCELLED:
+            self.stats.record_cancel(req.cls.name)
+            if _tracer.enabled:
+                _tracer.instant("serve/req/cancelled",
+                                lane=f"serve/req/u{req.uid}", uid=req.uid)
+        req._q.put(_DONE)
+        req._finished.set()
+        with self._inflight_lock:
+            self._inflight -= 1
+
+    def _span(self, req: RequestHandle, phase: str, t0: float,
+              t1: float) -> None:
+        if _tracer.enabled:
+            _tracer.add(f"serve/req/{phase}", t0, t1,
+                        lane=f"serve/req/u{req.uid}", uid=req.uid,
+                        cls=req.cls.name)
+
+    # ------------------------------------------------------------------ #
+    # admission round: execute the plan
+    # ------------------------------------------------------------------ #
+
+    def _admission_round(self) -> bool:
+        now = time.perf_counter()
+        actions = self.admission.plan(now, self._live, self._preempted,
+                                      self.offload)
+        admitted: List[RequestHandle] = []
+        for kind, req in actions:
+            if kind == "shed":
+                self._finalize(req, SHED)
+            elif kind == "preempt":
+                self._preempt(req)
+            elif kind == "restore":
+                self._restore(req)
+            elif kind == "admit":
+                try:
+                    self.engine.scheduler.add_tokens(req.uid, req.prompt)
+                except RuntimeError:           # capacity raced the plan: hold
+                    self.admission._queues[req.cls.name].appendleft(req)
+                    continue
+                t = time.perf_counter()
+                self._span(req, "queued", req.arrival_t, t)
+                req.status = PREFILL
+                req.admit_t = req._phase_t0 = t
+                self.stats.record_admit(req.cls.name)
+                admitted.append(req)
+        if admitted or self.engine.scheduler.has_pending():
+            self._prefill(admitted)
+        self.stats.queue_depth = self.admission.queued
+        if _tracer.enabled:
+            _tracer.counter("serve/frontend/queue_depth",
+                            self.stats.queue_depth, lane="serve/frontend")
+        return bool(actions)
+
+    def _prefill(self, reqs: List[RequestHandle]) -> None:
+        """Drain the admitted batch's prompt chunks through SplitFuse passes,
+        polling client disconnects at every pass boundary (cancel-mid-prefill
+        retires through ``scheduler.flush`` with partial KV released)."""
+        e = self.engine
+        t0 = time.perf_counter()
+        tokens = sum(len(r.prompt) for r in reqs)
+        while e.scheduler.has_pending():
+            e._run_pass()
+            for req in reqs:
+                if req.cancelled and req.status == PREFILL:
+                    self._teardown(req, CANCELLED)
+        t1 = time.perf_counter()
+        # intentionally async: the EMA cost model wants the loop-observed
+        # prefill cadence (what admission actually waits), not device time
+        self.admission.cost.update_prefill(tokens, t1 - t0)  # jaxlint: disable=JL001
+        for req in reqs:
+            if req.status != PREFILL:
+                continue                       # cancelled mid-prefill
+            self._span(req, "prefill", req._phase_t0, t1)
+            req.status = DECODING
+            req._phase_t0 = t1
+            self._pipe.admit([req.uid])
+            self._live[req.uid] = req
+
+    # ------------------------------------------------------------------ #
+    # preempt / restore
+    # ------------------------------------------------------------------ #
+
+    def _preempt(self, req: RequestHandle) -> None:
+        uid = req.uid
+        now = time.perf_counter()
+        self._span(req, "decode", req._phase_t0, now)
+        self._pipe.retire([uid])
+        self._live.pop(uid, None)
+        kept, tail = self.engine.scheduler.private_tail(uid)
+        if self.offload is not None and self.offload.can_offload(len(tail)):
+            n = self.offload.offload(uid, kept, tail)
+            self.stats.offload_bytes += n
+        else:
+            # recompute preemption (the configured baseline, or the
+            # host-capacity fallback): drop all KV, remember the tokens —
+            # readmission re-prefills prompt + generated-so-far
+            req._resume_tokens = np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int32)])
+            self.engine.flush([uid])
+            self.stats.recompute_preemptions += 1
+        req.status = PREEMPTED
+        req.preempt_t = req._phase_t0 = now
+        req.preemptions += 1
+        self._preempted[uid] = req
+        self.stats.preemptions += 1
+
+    def _restore(self, req: RequestHandle) -> None:
+        uid = req.uid
+        t0 = time.perf_counter()
+        if self.offload is not None and uid in self.offload._recs:
+            self._span(req, "preempted", req._phase_t0, t0)
+            del self._preempted[uid]
+            self.stats.restore_bytes += self.offload.restore(uid)
+        else:
+            try:
+                self.engine.scheduler.add_tokens(uid, req._resume_tokens)
+            except RuntimeError:
+                return              # capacity raced the plan: stay preempted
+            self._span(req, "preempted", req._phase_t0, t0)
+            del self._preempted[uid]
+            req._resume_tokens = None
+            e = self.engine
+            while e.scheduler.has_pending():
+                e._run_pass()
+                if req.cancelled:
+                    break
+            if req.cancelled:
+                self._teardown(req, CANCELLED)
+                return
+        t1 = time.perf_counter()
+        self._span(req, "restore", t0, t1)
+        req.status = DECODING
+        req._phase_t0 = t1
+        self._pipe.admit([uid])
+        self._live[uid] = req
+        self.stats.restores += 1
+
+    # ------------------------------------------------------------------ #
+    # the decode slice
+    # ------------------------------------------------------------------ #
+
+    def _ensure_slice_funded(self) -> None:
+        """Emergency lever when generation-driven KV growth outruns the
+        pool between admission rounds: preempt (or, reject-only, force-shed)
+        the newest lowest-priority live rows until the next slice funds."""
+        while self._live:
+            short = self.admission.slice_shortfall(list(self._live))
+            if short <= 0:
+                return
+            order = {c.name: i for i, c in
+                     enumerate(sorted(self.config.classes,
+                                      key=lambda c: -c.priority))}
+            victim = max(self._live.values(),
+                         key=lambda r: (order[r.cls.name], r.admit_t))
+            if self.config.preemption == "none":
+                self.stats.forced_sheds += 1
+                self._teardown(victim, SHED)
+            else:
+                self._preempt(victim)
+
+    def _on_tokens(self, j: int, uids: List[int], row: np.ndarray):
+        """Per-step drain callback — the serving hot path. Clock stamps,
+        int appends and queue puts only: no device fetch, no formatting
+        (jaxlint JL007/JL008 police the module)."""
+        now = time.perf_counter()
+        stop = None
+        for i, u in enumerate(uids):
+            req = self._live.get(u)
+            if req is None:
+                continue                       # stopped earlier this run
+            t = int(row[i])
+            req.tokens.append(t)
+            req._q.put(t)
+            # TTFT/TBT stamp the moment the token became host-visible — the
+            # client-observed latency the SLOs are defined over; the sync
+            # point is the drain inside pipe.run (fetch_to_host)
+            if req.ttft_ms is None:
+                req.ttft_ms = 1e3 * (now - req.arrival_t)  # jaxlint: disable=JL001
+            else:
+                req.tbt_ms.append(1e3 * (now - req._last_emit_t))  # jaxlint: disable=JL001
+            req._last_emit_t = now
+            done = (len(req.tokens) >= req.max_new_tokens
+                    or (req.eos_token_id is not None
+                        and t == req.eos_token_id))
+            if done or req.cancelled:
+                del self._live[u]
+                self._run_stopped.append(req)
+                req._stop_status = CANCELLED if (req.cancelled and not done) \
+                    else FINISHED
+                if stop is None:
+                    stop = []
+                stop.append(u)
+        return stop
+
+    def _decode_slice(self) -> None:
+        self._ensure_slice_funded()
+        if not self._pipe.uids:
+            return
+        t0 = time.perf_counter()
+        self._pipe.run(self.config.decode_slice, on_tokens=self._on_tokens)
+        # run() drains every step's token row (fetch_to_host), so this wall
+        # time is real work, not enqueue time
+        self.admission.cost.update_decode(time.perf_counter() - t0)  # jaxlint: disable=JL001
+        stopped, self._run_stopped = self._run_stopped, []
+        for req in stopped:
+            # retired mid-run by the callback: the pipeline dropped its refs;
+            # release the KV and close the stream at this run boundary
+            self.engine.flush([req.uid])
+            self._finalize(req, req._stop_status)

@@ -1,0 +1,159 @@
+"""Preempt-by-offload: victim KV pages round-trip through pinned host buffers.
+
+The serving frontend's admission controller (``admission.py``) relieves
+KV-pool pressure by preempting low-priority victims. The cheap way to do that
+is vLLM-style *swap-out*: instead of dropping the victim's KV and re-running
+its whole prefill on readmit (drop-and-recompute — device compute
+proportional to the sequence length), copy the victim's pages to host memory
+and scatter them back when capacity returns (host bandwidth proportional to
+the pages moved — on a TPU host, a PCIe/DMA copy that overlaps poorly-utilised
+link time, not MXU time).
+
+What moves: ONLY the victim's *private tail* — the maximal suffix of its
+block table at allocator refcount 1 (``scheduler.private_tail``).
+Prefix-cache-shared pages (radix-tree references, co-holding sequences) are
+never offloaded: the victim keeps its references across the preemption, the
+refcount keeps the pages allocated, and their content is stable by
+construction (full shared pages are read-only; partial pages are private via
+COW adoption). The refcounted ``BlockedAllocator`` therefore stays exactly
+consistent across offload -> restore -> cancel: offload frees refcount-1
+pages (content copied out first), restore allocates fresh ids and scatters
+the bytes back in the same logical order, cancel releases the host buffers
+and lets ``scheduler.flush`` settle the kept references like any other flush.
+
+Alongside the pages, the victim's *last logits row* is parked on host
+(``engine._materialize``): restore re-seeds ``engine._last_logits`` with it,
+so the decode pipeline's bootstrap sample resumes the stream byte-identically
+(greedy argmax over the identical row). Host staging uses the same
+page-aligned pinned-buffer pool NVMe swapping stages through
+(``runtime/swap_tensor/buffer_pool.py``), so steady-state preemption does
+zero host allocations; ``max_bytes`` caps residency — when exhausted, the
+frontend falls back to recompute-preemption per victim.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deepspeed_tpu.runtime.swap_tensor.buffer_pool import SwapBufferPool
+
+
+@dataclass
+class _OffloadRecord:
+    kept: int                       # shared-prefix blocks the seq still holds
+    bufs: List[np.ndarray]          # one pooled buffer per offloaded page
+    shape: Tuple[int, ...]
+    dtype: "np.dtype"
+    logits: np.ndarray              # last logits row (restore re-seeds it)
+    nbytes: int
+
+
+class KVOffloadManager:
+    """Owns the offloaded-page store for one engine's serving frontend."""
+
+    def __init__(self, engine, max_bytes: Optional[int] = None,
+                 max_buffers: int = 16):
+        self.engine = engine
+        self.max_bytes = max_bytes
+        self.pool = SwapBufferPool(max_buffers)
+        self._recs: Dict[int, _OffloadRecord] = {}
+        self.bytes_held = 0
+        # cumulative counters (FrontendStats mirrors them into serve/frontend)
+        self.offload_bytes_total = 0
+        self.restore_bytes_total = 0
+
+    @property
+    def page_nbytes(self) -> int:
+        # non-quantized pools only (quantized is rejected up front), where
+        # bytes_per_block IS the page payload — one source of size truth
+        return self.engine.kv.config.bytes_per_block()
+
+    @property
+    def uids(self) -> List[int]:
+        return list(self._recs)
+
+    def pages_held(self, uid: int) -> int:
+        return len(self._recs[uid].bufs)
+
+    def can_offload(self, n_pages: int) -> bool:
+        """Would ``n_pages`` more pages fit under ``max_bytes``? The frontend
+        checks this BEFORE preempting, and falls back to recompute-preemption
+        for the victim when host capacity is exhausted."""
+        if self.max_bytes is None:
+            return True
+        return self.bytes_held + n_pages * self.page_nbytes <= self.max_bytes
+
+    # ------------------------------------------------------------------ #
+
+    def offload(self, uid: int, kept: int, tail: List[int]) -> int:
+        """Offload ``tail`` (uid's private-suffix page ids, already split by
+        ``scheduler.private_tail``) to pooled host buffers, free the device
+        pages, and park the last logits row. Returns bytes moved. The
+        sequence descriptor survives with its shared prefix; the uid must
+        already be retired from the decode pipeline."""
+        e = self.engine
+        assert uid not in self._recs, f"uid {uid} already offloaded"
+        # the last logits row first: materializing pops the device ref, so a
+        # failure mid-offload never leaves a dangling ref to a donated array
+        e._materialize([uid])
+        logits = e._last_logits.pop(uid)
+        bufs: List[np.ndarray] = []
+        shape: Tuple[int, ...] = ()
+        dtype = None
+        nbytes = 0
+        if tail:
+            # ONE bucketed gather + ONE host transfer for the whole tail
+            # (engine.fetch_pages) — page content copied out BEFORE the ids
+            # are freed; pinned staging per page so restore can release
+            # buffers back to the pool independent of tail length
+            pages = e.fetch_pages(tail)
+            shape, dtype = pages.shape[1:], pages.dtype
+            per = int(pages[0].nbytes)
+            for i in range(len(tail)):
+                buf = self.pool.get(per)
+                np.copyto(self.pool.view(buf, shape, dtype), pages[i])
+                bufs.append(buf)
+                nbytes += per
+        e.scheduler.drop_tail(uid, kept)
+        e._last_ref.pop(uid, None)
+        self._recs[uid] = _OffloadRecord(kept=kept, bufs=bufs, shape=shape,
+                                         dtype=dtype, logits=logits,
+                                         nbytes=nbytes)
+        self.bytes_held += nbytes
+        self.offload_bytes_total += nbytes
+        return nbytes
+
+    def restore(self, uid: int) -> int:
+        """Scatter the offloaded pages back into fresh pool blocks (appended
+        to the block table in the original logical order), release the host
+        buffers, and re-seed the last-logits row. Returns bytes moved. The
+        caller readmits the uid to the decode pipeline after."""
+        e = self.engine
+        rec = self._recs.pop(uid)
+        ids = e.scheduler.grow_tail(uid, len(rec.bufs))
+        if ids:
+            # ONE bucketed scatter for the whole tail, original logical order
+            e.put_pages(np.stack([self.pool.view(b, rec.shape, rec.dtype)
+                                  for b in rec.bufs]), ids)
+            for buf in rec.bufs:
+                self.pool.put(buf)
+        e._last_logits[uid] = rec.logits
+        self.bytes_held -= rec.nbytes
+        self.restore_bytes_total += rec.nbytes
+        return rec.nbytes
+
+    def drop(self, uid: int) -> None:
+        """Cancel-while-offloaded: release the host buffers; the caller
+        flushes the sequence (its kept shared-prefix references settle
+        through ``scheduler.flush`` like any other flush)."""
+        rec = self._recs.pop(uid)
+        for buf in rec.bufs:
+            self.pool.put(buf)
+        self.bytes_held -= rec.nbytes
+
+    def close(self) -> None:
+        for uid in list(self._recs):
+            self.drop(uid)

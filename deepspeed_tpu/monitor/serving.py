@@ -38,8 +38,9 @@ the per-token critical path.
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass, field
-from typing import List
+from typing import Deque, Dict, List, Optional
 
 from deepspeed_tpu.monitor.monitor import Event
 
@@ -108,3 +109,110 @@ class PipelineStats:
             ("inference/v2/pipeline/fetch_bytes_per_step",
              float(self.fetch_bytes_per_step), step),
         ]
+
+
+#: latency samples retained per class (completed requests only); percentiles
+#: below compute over this sliding window
+SAMPLE_WINDOW = 4096
+
+
+class _ClassCounters:
+    """Per-priority-class frontend counters + bounded latency windows."""
+
+    __slots__ = ("submitted", "admitted", "completed", "shed", "cancelled",
+                 "slo_met", "tokens", "ttft_ms", "tbt_ms")
+
+    def __init__(self):
+        self.submitted = 0
+        self.admitted = 0
+        self.completed = 0
+        self.shed = 0
+        self.cancelled = 0
+        self.slo_met = 0
+        self.tokens = 0
+        self.ttft_ms: Deque[float] = deque(maxlen=SAMPLE_WINDOW)
+        self.tbt_ms: Deque[float] = deque(maxlen=SAMPLE_WINDOW)
+
+
+class FrontendStats:
+    """Aggregate counters for one ``ServingFrontend``
+    (``inference/v2/serving/frontend.py``): per-class TTFT/TBT percentile
+    windows, queue depth, preemption/offload traffic, shed counts — the
+    ``serve/frontend/*`` monitor surface. Mutated only on the frontend's
+    engine thread (single writer); the latency samples come from the SAME
+    ``perf_counter`` stamps the per-request ``serve/req/*`` trace spans are
+    built from, so the dashboard and the timeline can never disagree."""
+
+    def __init__(self, class_names: List[str]):
+        self.classes: Dict[str, _ClassCounters] = {
+            name: _ClassCounters() for name in class_names}
+        self.queue_depth = 0               # gauge: pending after last round
+        self.preemptions = 0               # victims preempted (any mechanism)
+        self.recompute_preemptions = 0     # ... of which fell back to recompute
+        self.restores = 0
+        self.offload_bytes = 0             # KV bytes moved device -> host
+        self.restore_bytes = 0             # KV bytes moved host -> device
+        self.forced_sheds = 0              # reject-only emergency sheds
+
+    # -- recording (engine thread) ------------------------------------- #
+
+    def record_submit(self, cls: str) -> None:
+        self.classes[cls].submitted += 1
+
+    def record_admit(self, cls: str) -> None:
+        self.classes[cls].admitted += 1
+
+    def record_shed(self, cls: str) -> None:
+        self.classes[cls].shed += 1
+
+    def record_cancel(self, cls: str) -> None:
+        self.classes[cls].cancelled += 1
+
+    def record_complete(self, cls: str, ttft_ms: Optional[float],
+                        tbt_ms: List[float], tokens: int,
+                        slo_met: bool) -> None:
+        c = self.classes[cls]
+        c.completed += 1
+        c.tokens += tokens
+        c.slo_met += bool(slo_met)
+        if ttft_ms is not None:
+            c.ttft_ms.append(float(ttft_ms))
+        c.tbt_ms.extend(float(x) for x in tbt_ms)
+
+    # -- reporting ------------------------------------------------------ #
+
+    def events(self, step: int = 0) -> List[Event]:
+        """``serve/frontend/*`` monitor events: global gauges/counters plus
+        per-class completion and latency percentiles (docs/SERVING.md
+        glossary)."""
+        import numpy as np
+        out: List[Event] = [
+            ("serve/frontend/queue_depth", float(self.queue_depth), step),
+            ("serve/frontend/preemptions", float(self.preemptions), step),
+            ("serve/frontend/recompute_preemptions",
+             float(self.recompute_preemptions), step),
+            ("serve/frontend/restores", float(self.restores), step),
+            ("serve/frontend/offload_bytes", float(self.offload_bytes), step),
+            ("serve/frontend/restore_bytes", float(self.restore_bytes), step),
+            ("serve/frontend/forced_sheds", float(self.forced_sheds), step),
+        ]
+        for name, c in self.classes.items():
+            pre = f"serve/frontend/{name}"
+            out += [
+                (f"{pre}/completed", float(c.completed), step),
+                (f"{pre}/shed", float(c.shed), step),
+                (f"{pre}/cancelled", float(c.cancelled), step),
+                (f"{pre}/tokens", float(c.tokens), step),
+                (f"{pre}/slo_met_fraction",
+                 c.slo_met / c.completed if c.completed else 0.0, step),
+            ]
+            for label, win in (("ttft", c.ttft_ms), ("tbt", c.tbt_ms)):
+                if win:
+                    xs = np.asarray(win, np.float64)
+                    out += [
+                        (f"{pre}/{label}_p50_ms",
+                         float(np.percentile(xs, 50)), step),
+                        (f"{pre}/{label}_p95_ms",
+                         float(np.percentile(xs, 95)), step),
+                    ]
+        return out

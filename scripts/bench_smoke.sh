@@ -28,6 +28,13 @@ export DSTPU_TRACE="$TRACE_DIR"
 timeout -k 10 300 python benchmarks/serving_bench.py --steady-state \
     --seqs 4 --prompt 16 --gen 24 || exit 1
 
+# SLO-aware frontend leg (docs/SERVING.md "Frontend"): a few dozen Poisson
+# arrivals against the persistent server, gating stream byte-equality vs
+# direct pipeline runs, zero steady-state compiles, and one forced
+# preempt-offload-restore cycle; emits serve/req per-request trace lanes
+timeout -k 10 300 python benchmarks/serving_bench.py --frontend --smoke \
+    || exit 1
+
 timeout -k 10 300 python benchmarks/train_bench.py --smoke || exit 1
 
 # offloaded-optimizer pipeline leg: serial vs overlapped host step through
@@ -47,7 +54,9 @@ timeout -k 10 300 python benchmarks/train_bench.py --smoke --trace-overhead \
     || exit 1
 
 # the timelines the legs above emitted: schema-valid, spans from the train
-# pipeline, decode pipeline, checkpoint, and offload subsystems on distinct
-# tracks, plus a parseable flight-recorder dump from the --preempt kills
+# pipeline, decode pipeline, serving-frontend request lanes, checkpoint, and
+# offload subsystems on distinct tracks, plus a parseable flight-recorder
+# dump from the --preempt kills
 timeout -k 10 120 python scripts/trace_check.py "$TRACE_DIR" \
-    --require train serve ckpt train/offload --expect-crash || exit 1
+    --require train serve serve/req ckpt train/offload --expect-crash \
+    || exit 1

@@ -350,3 +350,62 @@ def test_pipeline_traced_run_byte_identical_with_serve_spans(warm_engine):
         e.flush([0, 1, 2])
     finally:
         tracer.reset()
+
+
+# --------------------------------------------------------------------------- #
+# generate() routed through the pipeline (the one-off API shares the hot path)
+# --------------------------------------------------------------------------- #
+
+def _old_loop_generate(e, prompts, n, eos=None):
+    """The pre-PR per-token sample_next/put loop generate() used to drive —
+    the byte-equality reference for the pipeline-routed steady state."""
+    uids = list(range(len(prompts)))
+    outs = [list(map(int, p)) for p in prompts]
+    e.put(uids, prompts)
+    live = set(uids)
+    for step in range(n):
+        batch = sorted(live)
+        toks = e.sample_next(batch)
+        nxt = {}
+        for u, t in zip(batch, toks):
+            t = int(t)
+            outs[u].append(t)
+            if eos is not None and t == eos:
+                live.discard(u)
+                e.flush([u])
+            else:
+                nxt[u] = t
+        if not nxt or step == n - 1:
+            break
+        e._put_nofetch(sorted(nxt), [np.asarray([nxt[u]], np.int32)
+                                     for u in sorted(nxt)])
+    e.flush(sorted(live))
+    return outs
+
+
+def test_generate_matches_old_per_token_loop(warm_engine):
+    """generate() now drives decode_pipeline; greedy output must stay byte-
+    identical to the old per-token loop, with and without EOS early-exit,
+    and release every block."""
+    ref_engine = _build_engine()
+    ref = _old_loop_generate(ref_engine, PROMPTS, 9)
+    e = warm_engine
+    free0 = e.free_blocks
+    got = e.generate(PROMPTS, max_new_tokens=9)
+    assert got == ref
+    assert e.free_blocks == free0
+
+    eos = ref[0][len(PROMPTS[0]) + 3]          # stop seq 0 after 4 tokens
+    ref_eos = _old_loop_generate(_build_engine(), PROMPTS, 9, eos=eos)
+    got_eos = e.generate(PROMPTS, max_new_tokens=9, eos_token_id=eos)
+    assert got_eos == ref_eos
+    assert e.free_blocks == free0
+
+
+def test_generate_zero_new_compiles_in_grid(warm_engine):
+    """A warmed engine's generate() (pipeline-routed) builds nothing new for
+    in-grid batch sizes."""
+    e = warm_engine
+    c0 = e.compiles
+    e.generate(PROMPTS, max_new_tokens=5)
+    assert e.compiles == c0

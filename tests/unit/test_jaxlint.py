@@ -908,3 +908,39 @@ def test_jl008_shipped_config_covers_traced_modules():
     assert "deepspeed_tpu/runtime/data_pipeline.py" in hot
     assert any("swap_tensor" in p for p in hot)
     assert opts["drain_calls"] == ["fetch_to_host"]
+
+
+def test_jl007_serving_frontend_path_policed():
+    """The serving subsystem (inference/v2/serving/) is hot-path policed by
+    the SHIPPED config — a stray blocking fetch in the frontend's token
+    callback fires; its actual discipline (host ints, explicit dtypes,
+    engine-owned drain) is clean."""
+    raw = _repo_config()
+    hot = raw["rules"]["JL007"]["options"]["hot_paths"]
+    assert "deepspeed_tpu/inference/v2/serving/" in hot
+    assert "deepspeed_tpu/inference/v2/serving/" in \
+        raw["rules"]["JL008"]["options"]["hot_paths"]
+    cfg = LintConfig(rules={"JL007": RuleSettings(
+        options=raw["rules"]["JL007"]["options"])})
+    src = textwrap.dedent("""
+        import numpy as np
+
+        def _on_tokens(self, j, uids, row):
+            return np.asarray(row).tolist()
+    """)
+    findings = lint_text(
+        src, path="deepspeed_tpu/inference/v2/serving/frontend.py",
+        config=cfg)
+    assert rules_of(findings) == ["JL007", "JL007"]
+    clean = textwrap.dedent("""
+        import numpy as np
+
+        def _on_tokens(self, j, uids, row):
+            out = []
+            for i, u in enumerate(uids):
+                out.append(int(row[i]))
+            return np.asarray(out, np.int32)
+    """)
+    assert lint_text(
+        clean, path="deepspeed_tpu/inference/v2/serving/admission.py",
+        config=cfg) == []
