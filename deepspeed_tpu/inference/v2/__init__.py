@@ -9,14 +9,17 @@ from deepspeed_tpu.inference.v2.config_v2 import (CompileConfig,
                                                   PrefixCacheConfig,
                                                   PriorityClassConfig,
                                                   RaggedInferenceEngineConfig,
-                                                  ServingConfig)
+                                                  ServingConfig,
+                                                  SpecDecodeConfig)
 from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
                                                   fetch_to_host)
 from deepspeed_tpu.inference.v2.pipeline import DecodePipeline
 from deepspeed_tpu.inference.v2.prefix_cache import (PrefixCacheStats,
                                                      RadixPrefixCache)
 
-# the serving frontend (inference/v2/serving/) is imported lazily via
-# engine.serving_frontend() — keeping `import deepspeed_tpu.inference.v2`
-# light; `from deepspeed_tpu.inference.v2.serving import ServingFrontend`
-# is the direct path.
+# the serving frontend (inference/v2/serving/) and the speculative-decode
+# subsystem (inference/v2/spec/) are imported lazily via
+# engine.serving_frontend() / engine.decode_pipeline() — keeping
+# `import deepspeed_tpu.inference.v2` light; the direct paths are
+# `from deepspeed_tpu.inference.v2.serving import ServingFrontend` and
+# `from deepspeed_tpu.inference.v2.spec import SpecDecodePipeline`.

@@ -178,6 +178,61 @@ class CompileConfig:
 
 
 @dataclass
+class SpecDecodeConfig:
+    """Speculative decoding for the steady-state decode path
+    (``inference/v2/spec/``; docs/SERVING.md "Speculative decoding").
+
+    When enabled, ``engine.decode_pipeline`` returns a
+    ``SpecDecodePipeline``: each pipeline step proposes up to ``k`` draft
+    tokens per sequence from its own token history (prompt-lookup / n-gram
+    matching — no second model), verifies them in ONE ragged forward
+    (``ragged_model.build_verify_step``), and emits the accepted prefix plus
+    one greedy bonus token. Greedy speculation is exactness-preserving:
+    token streams are byte-identical to the spec-off pipeline, gated by
+    ``serving_bench.py --spec``.
+
+    ``k``: max draft tokens verified per step — the top rung of the
+    (bucket, k) warmup grid. Prefer ``k + 1`` a POWER OF TWO (3, 7, 15):
+    the chunk kernel's q-block must divide k+1, and an odd k+1 collapses
+    it to 1-row blocks with (k+1)x the grid steps (measured ~2x slower on
+    the bench box — a misaligned k warns below). ``min_match`` /
+    ``max_ngram``: the proposer matches the longest history suffix of
+    length in [min_match, max_ngram] and proposes its continuation; no
+    match proposes nothing and the step degenerates to plain decode for
+    that row. ``adaptive``: per-sequence MIMD k backoff — any reject drops
+    a row's draft budget to accepted + 1 (down to a probe of 1, so
+    re-entering a repetitive span is detected), full accepts double it
+    back toward k; a traced per-row operand, never a recompile.
+
+    Greedy-only: sampled pipelines bypass speculation with a one-time
+    warning. Not wired for sliding-window models (the page ring aliases the
+    K+1-ahead write span) or int8 KV pages."""
+    enabled: bool = False
+    k: int = 3
+    min_match: int = 2
+    max_ngram: int = 4
+    adaptive: bool = True
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(f"spec_decode.k must be >= 1, got {self.k}")
+        if self.enabled and (self.k + 1) & self.k != 0:
+            import warnings
+            warnings.warn(
+                f"spec_decode.k={self.k}: k + 1 is not a power of two, so "
+                "the verify kernel's q-block collapses to 1-row blocks "
+                "(measured ~2x slower) — prefer k in 3, 7, 15, ...",
+                stacklevel=3)
+        if self.min_match < 1:
+            raise ValueError("spec_decode.min_match must be >= 1, got "
+                             f"{self.min_match}")
+        if self.max_ngram < self.min_match:
+            raise ValueError(
+                f"spec_decode.max_ngram ({self.max_ngram}) must be >= "
+                f"min_match ({self.min_match})")
+
+
+@dataclass
 class PriorityClassConfig:
     """One tenant priority class for the serving frontend
     (``inference/v2/serving/``): a strict-priority level plus the latency
@@ -281,6 +336,7 @@ class RaggedInferenceEngineConfig:
     prefix_cache: PrefixCacheConfig = field(default_factory=PrefixCacheConfig)
     compile: CompileConfig = field(default_factory=CompileConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
+    spec_decode: SpecDecodeConfig = field(default_factory=SpecDecodeConfig)
     tensor_parallel: int = 1
     dtype: Any = jnp.bfloat16
     seed: int = 0
@@ -310,9 +366,11 @@ class RaggedInferenceEngineConfig:
             co = CompileConfig(**co) if isinstance(co, dict) else co
             sv = d.pop("serving", {})
             sv = ServingConfig(**sv) if isinstance(sv, dict) else sv
+            sd = d.pop("spec_decode", {})
+            sd = SpecDecodeConfig(**sd) if isinstance(sd, dict) else sd
             cfg = cls(state_manager=sm, kv_cache=kv, quantization=qz,
                       kv_quant=kq, prefix_cache=pc, compile=co, serving=sv,
-                      **d)
+                      spec_decode=sd, **d)
         if cfg.state_manager.chunk_budget <= 0:
             raise ValueError("max_ragged_batch_size must exceed max_ragged_sequence_count")
         return cfg

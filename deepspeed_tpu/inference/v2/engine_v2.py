@@ -204,6 +204,20 @@ class InferenceEngineV2:
         # sliding-window serving (Mistral/Qwen2): the scheduler ring-reuses
         # each sequence's pages beyond the window so KV stays bounded
         self.scheduler.window = self.spec.window
+        if cfg.spec_decode.enabled:
+            if self.spec.window is not None:
+                raise NotImplementedError(
+                    "spec_decode with a sliding-window model is not wired "
+                    "(the page ring aliases the verify step's k+1-ahead "
+                    "write span)")
+            if cfg.kv_quant.enabled:
+                raise NotImplementedError(
+                    "spec_decode with int8 KV pages is not wired (the "
+                    "verify forward's page write does not handle the tiled "
+                    "scale layout)")
+            # the n-gram proposer drafts from each sequence's prompt
+            # history — record it even without a prefix cache
+            self.scheduler.record_history_always = True
 
         if self.spec.alibi and tp > 1:
             # the paged kernels compute ALiBi slopes from shard-LOCAL head
@@ -239,6 +253,10 @@ class InferenceEngineV2:
         # compiled single-step fused decode programs (DecodePipeline), keyed
         # by (bucket, do_sample, top_k); one per grid point
         self._step_progs: LRUCache = LRUCache(maxsize=16)
+        # compiled verify-step programs (spec/pipeline.py), keyed by
+        # (bucket, k) — the speculation grid warmup() pre-compiles
+        self._verify_progs: LRUCache = LRUCache(maxsize=16)
+        self._spec_warned_sampling = False
         # KV page host round-trip programs (gather, scatter) — the serving
         # frontend's preempt-offload path (serving/kv_offload.py); built
         # lazily, warmed by warmup() so a mid-steady-state preemption never
@@ -248,8 +266,10 @@ class InferenceEngineV2:
         self._page_buckets: set = set()
         # aggregate double-buffer pipeline timings (monitor/serving.py);
         # write_monitor_events emits them
-        from deepspeed_tpu.monitor.serving import PipelineStats
+        from deepspeed_tpu.monitor.serving import (PipelineStats,
+                                                   SpecDecodeStats)
         self.pipeline_stats = PipelineStats()
+        self.spec_stats = SpecDecodeStats()
         # serving runs don't pass through deepspeed_tpu.initialize — arm the
         # span tracer from $DSTPU_TRACE here (no-op when unset/armed)
         _trace_from_env()
@@ -487,12 +507,65 @@ class InferenceEngineV2:
         return self._step_progs.get_or_create(
             (bucket, bool(do_sample), int(top_k)), _build)
 
+    @property
+    def spec_k_ladder(self) -> List[int]:
+        """The draft-length grid speculation dispatches over: pow2-minus-1
+        rungs (K+1 a power of two — the chunk kernel's q-block then covers
+        each sequence's rows in ONE block instead of collapsing to 1-row
+        blocks) up to ``config.spec_decode.k``. Each step runs the SMALLEST
+        rung covering its longest draft, so a mostly-unrepetitive batch
+        pays 2-row verifies, not full-k ones; warmup() pre-compiles the
+        whole (bucket, rung) grid."""
+        k = self.config.spec_decode.k
+        ks, v = [], 1
+        while v < k:
+            ks.append(v)
+            v = 2 * v + 1
+        ks.append(k)
+        return sorted(set(ks))
+
+    def _verify_prog(self, bucket: int, k: int):
+        """The fused speculative verify-step program (draft scoring in ONE
+        ragged forward, ragged_model.build_verify_step) for one (bucket, k)
+        grid point — the SpecDecodePipeline's hot program. LRU-cached;
+        warmup() pre-compiles the whole grid."""
+        def _build():
+            from deepspeed_tpu.inference.v2.ragged_model import (
+                build_verify_step)
+            tp = self.topology.tp_world_size
+            fwd = build_verify_step(self.spec, k, mesh=self.topology.mesh,
+                                    tp=tp if tp > 1 else 1)
+            self.compiles += 1
+            return jax.jit(fwd, donate_argnums=(1,))
+
+        return self._verify_progs.get_or_create((bucket, int(k)), _build)
+
     def decode_pipeline(self, uids: Sequence[int], do_sample: bool = False,
                         temperature: float = 1.0, top_k: int = 0):
-        """An async double-buffered decode pipeline over ``uids`` (all must be
-        in steady decode state). See ``pipeline.DecodePipeline``: while the
-        device runs step N, the host drains step N-1's token row and builds
-        step N+1's descriptors; the only per-step transfer is one int32 row."""
+        """The steady-state decode pipeline over ``uids`` (all must be in
+        steady decode state). Default: the async double-buffered
+        ``pipeline.DecodePipeline`` — while the device runs step N, the host
+        drains step N-1's token row and builds step N+1's descriptors; the
+        only per-step transfer is one int32 row.
+
+        With ``config.spec_decode.enabled``, greedy requests get the
+        ``spec.SpecDecodePipeline`` instead (draft-and-verify, variable
+        per-step advance; callers branch their ``on_tokens`` shape on
+        ``pipe.spec``). Speculation is greedy-only for now: ``do_sample``
+        cleanly bypasses it with a one-time warning rather than silently
+        degrading sampled streams."""
+        if self.config.spec_decode.enabled:
+            if do_sample:
+                if not self._spec_warned_sampling:
+                    self._spec_warned_sampling = True
+                    import warnings
+                    warnings.warn(
+                        "spec_decode is greedy-only for now: "
+                        "do_sample=True bypasses speculation and runs the "
+                        "plain DecodePipeline (warned once)", stacklevel=2)
+            else:
+                from deepspeed_tpu.inference.v2.spec import SpecDecodePipeline
+                return SpecDecodePipeline(self, uids)
         from deepspeed_tpu.inference.v2.pipeline import DecodePipeline
         return DecodePipeline(self, uids, do_sample=do_sample,
                               temperature=temperature, top_k=top_k)
@@ -509,7 +582,8 @@ class InferenceEngineV2:
         return [1 << i for i in range(top.bit_length())]
 
     def warmup(self, buckets: Optional[Sequence[int]] = None,
-               burst_steps: Sequence[int] = ()) -> int:
+               burst_steps: Sequence[int] = (),
+               spec_ks: Optional[Sequence[int]] = None) -> int:
         """Pre-compile the serving program set so in-grid traffic never
         observes an XLA compile (and, with a persistent compile cache
         configured, so a future engine start reloads everything from disk).
@@ -531,15 +605,27 @@ class InferenceEngineV2:
 
         Returns the number of ENGINE programs built (``self.compiles``; the
         bootstrap-sampler warms are module-level jits outside the counter).
+
+        ``spec_ks``: draft lengths to warm the speculative verify grid for
+        — one ``build_verify_step`` program per (bucket, k). ``None``
+        defaults to the full ``spec_k_ladder`` when speculation is enabled
+        (so a spec-serving engine's steady state — including the spec-off
+        comparison legs sharing the engine — adds zero timed compiles).
         """
         before = self.compiles
         grid = sorted({next_pow2(int(b)) for b in buckets}) \
             if buckets is not None else self.decode_buckets
+        if spec_ks is None:
+            spec_ks = self.spec_k_ladder \
+                if self.config.spec_decode.enabled else []
+        spec_ks = sorted({int(k) for k in spec_ks})
         # the warmed set must FIT its LRUs, or warmup evicts programs it just
         # built and the zero-compiles invariant silently breaks on first use
         self._step_progs.maxsize = max(self._step_progs.maxsize, len(grid) + 2)
         self._multistep.maxsize = max(self._multistep.maxsize,
                                       len(burst_steps) * len(grid) + 2)
+        self._verify_progs.maxsize = max(self._verify_progs.maxsize,
+                                         len(spec_ks) * len(grid) + 2)
         self._warm_passes()
         mb = self.scheduler.max_blocks
         for b in grid:
@@ -557,6 +643,17 @@ class InferenceEngineV2:
                 out_ids, _logits, new_kv = fn(self.weights, self.kv.kv, *args)
                 self.kv.update(new_kv)
                 jax.block_until_ready(out_ids)
+        # the speculative (bucket, k) verify grid: every program runs once
+        # over all-scratch rows with zero proposed drafts (accept masks and
+        # page writes exercise the same traced shapes live traffic uses)
+        for k in spec_ks:
+            for b in grid:
+                prog = self._verify_prog(b, k)
+                args = self._scratch_verify_args(b, k, mb)
+                _acc, nxt, _fl, new_kv = prog(self.weights, self.kv.kv,
+                                              *args)
+                self.kv.update(new_kv)
+                jax.block_until_ready(nxt)
         # the KV page round-trip pair (preempt-offload) over its whole
         # bucket grid: rare path, but a preemption DURING the timed steady
         # state must not compile — warm both ops per bucket over the scratch
@@ -606,6 +703,18 @@ class InferenceEngineV2:
         bt = np.full((bucket, max_blocks), self.scratch_block, np.int32)
         ctx = np.ones((bucket,), np.int32)
         return ids, pos, bt, ctx, self._rng_key, jnp.float32(1.0)
+
+    def _scratch_verify_args(self, bucket: int, k: int, max_blocks: int):
+        """All-pad-row inputs for a verify-step program (spec decode
+        warmup): every row the inert scratch-page fake sequence, no drafts
+        proposed."""
+        ids = jnp.zeros((bucket,), jnp.int32)
+        draft = np.zeros((bucket, k), np.int32)
+        n_draft = np.zeros((bucket,), np.int32)
+        pos = np.zeros((bucket,), np.int32)
+        bt = np.full((bucket, max_blocks), self.scratch_block, np.int32)
+        ctx = np.ones((bucket,), np.int32)
+        return ids, draft, n_draft, pos, bt, ctx
 
     def _warm_passes(self) -> None:
         """Run the two scheduler-pass programs once on an all-padding batch
@@ -837,6 +946,8 @@ class InferenceEngineV2:
             monitor.write_events(self.prefix_cache.stats.events(step))
         if self.pipeline_stats.steps:
             monitor.write_events(self.pipeline_stats.events(step))
+        if self.spec_stats.steps:
+            monitor.write_events(self.spec_stats.events(step))
 
     # ------------------------------------------------------------------ #
     # continuous-batching generation loop (parity role: MII serving loop)
@@ -868,13 +979,15 @@ class InferenceEngineV2:
 
         Steady-state decode runs through ``decode_pipeline`` — the SAME
         gated hot path the serving frontend drives (fused on-device
-        sampling, bucketed descriptors, one-step-late drain) — in
-        slice-sized runs, retiring EOS'd sequences at each drained step.
-        Greedy streams are byte-identical to the old per-token
-        ``sample_next``/``put`` loop (pinned by
-        tests/unit/test_decode_pipeline.py); sampled streams are valid
-        draws but consume RNG per fused step, so they differ from the old
-        loop's draws (the documented ``decode_steps`` trade)."""
+        sampling, bucketed descriptors, one-step-late drain; with
+        ``spec_decode.enabled`` and greedy requests, the draft-and-verify
+        ``SpecDecodePipeline``) — in slice-sized runs, retiring EOS'd (or
+        budget-complete) sequences at each drained step. Greedy streams are
+        byte-identical to the old per-token ``sample_next``/``put`` loop,
+        spec on or off (pinned by tests/unit/test_decode_pipeline.py and
+        test_spec_decode.py); sampled streams are valid draws but consume
+        RNG per fused step, so they differ from the old loop's draws (the
+        documented ``decode_steps`` trade)."""
         # fresh uid namespace: never collide with caller-owned put() sequences
         uids: List[int] = []
         nxt = 0
@@ -890,30 +1003,68 @@ class InferenceEngineV2:
         self._put_nofetch(uids, [np.asarray(p, np.int32) for p in prompts])
         pipe = self.decode_pipeline(uids, do_sample=do_sample,
                                     temperature=temperature, top_k=top_k)
+        is_spec = getattr(pipe, "spec", False)
         live = set(uids)
+        budget = {u: max_new_tokens for u in uids}
 
         def on_tokens(j, run_uids, row):
             stop = []
             for i, u in enumerate(run_uids):
                 if u not in live:
                     continue        # retired earlier this run: padding noise
-                t = int(row[i])
-                outs[idx_of[u]].append(t)
-                if eos_token_id is not None and t == eos_token_id:
-                    live.discard(u)
-                    stop.append(u)
+                # spec steps emit a variable-length token batch per row;
+                # plain steps one token. Tokens past the budget (a spec
+                # step's in-step overshoot) are discarded — their KV is
+                # stale past the flush below, never read.
+                for t in (row[i] if is_spec else row[i:i + 1]):
+                    t = int(t)
+                    outs[idx_of[u]].append(t)
+                    budget[u] -= 1
+                    done = budget[u] <= 0 or (eos_token_id is not None
+                                              and t == eos_token_id)
+                    if done:
+                        live.discard(u)
+                        stop.append(u)
+                        break
             return stop
 
-        # slice-sized runs bound the post-EOS overshoot (the device finishes
-        # each in-flight burst; see DecodePipeline.run) to one slice
+        # slice-sized runs bound the post-retirement overshoot (the device
+        # finishes each in-flight burst; see DecodePipeline.run) to one
+        # slice; a spec step can emit up to k+1 tokens, so its slice is
+        # correspondingly shorter
         CHUNK = 32
-        done = 0
-        while done < max_new_tokens and pipe.uids:
+        K1 = self.config.spec_decode.k + 1
+        steps = max(1, CHUNK // K1) if is_spec else CHUNK
+        if max_new_tokens <= 0:
+            self.flush(pipe.uids)
+            return outs
+        max_ctx = self.config.state_manager.max_context
+        while pipe.uids:
+            if is_spec:
+                # clamp the verify-run length to the remaining budget AND
+                # the rows' max_context headroom (each verify step reserves
+                # k+1 tokens up front); when even ONE verify step no longer
+                # fits — speculation intrinsically needs k+1 write slots —
+                # degrade the tail to the plain pipeline (bit-identical to
+                # a verify step's row 0) instead of crashing the stream
+                rem = max(budget[u] for u in pipe.uids)
+                cap = min((max_ctx - self.scheduler.seqs[u].seen_tokens - 1)
+                          // K1 for u in pipe.uids)
+                n = min(steps, -(-rem // K1), cap)
+                if n < 1:
+                    uids_left = list(pipe.uids)
+                    pipe.retire(uids_left)
+                    from deepspeed_tpu.inference.v2.pipeline import (
+                        DecodePipeline)
+                    pipe = DecodePipeline(self, uids_left)
+                    is_spec = False
+                    continue
+            else:
+                n = min(steps, max(budget[u] for u in pipe.uids))
             before = set(pipe.uids)
-            pipe.run(min(CHUNK, max_new_tokens - done), on_tokens=on_tokens)
-            done += CHUNK
+            pipe.run(n, on_tokens=on_tokens)
             for u in before - set(pipe.uids):
-                self.flush([u])     # EOS'd mid-run: recycle KV blocks now
+                self.flush([u])     # retired mid-run: recycle KV blocks now
         self.flush(pipe.uids)
         return outs
 

@@ -180,3 +180,15 @@ class DecodeBatch:
         as frozen once dispatched)."""
         self.positions = self.positions + np.int32(n)
         self.ctx_lens = self.ctx_lens + np.int32(n)
+
+    def advance_rows(self, counts: np.ndarray) -> None:
+        """Per-row variable advance (speculative decode: row i emitted
+        ``counts[i]`` tokens this step — accepted draft prefix plus the
+        bonus token; pad-row entries advance inside the scratch page like
+        :meth:`advance`). Same REBIND discipline as ``advance`` — the
+        arrays already uploaded for an in-flight dispatch stay frozen."""
+        counts = np.asarray(counts, np.int32)
+        assert counts.shape == self.positions.shape, \
+            (counts.shape, self.positions.shape)
+        self.positions = self.positions + counts
+        self.ctx_lens = self.ctx_lens + counts

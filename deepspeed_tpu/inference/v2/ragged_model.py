@@ -1274,6 +1274,133 @@ def build_decode_step(spec: RaggedModelSpec, mesh=None, tp: int = 1,
     return fwd
 
 
+def build_verify_step(spec: RaggedModelSpec, k: int, mesh=None,
+                      tp: int = 1) -> Callable:
+    """Speculative-decode verify step: score ``k`` draft tokens per sequence
+    in ONE ragged forward (``inference/v2/spec/``; docs/SERVING.md
+    "Speculative decoding").
+
+    Each sequence contributes K+1 = ``k + 1`` rows — its committed current
+    token (device-resident, sampled by the previous step) followed by the
+    host-proposed draft. Every layer scatters all K+1 rows' K/V into the
+    paged pool (the same flat-scatter the ragged pass uses), then attends
+    with the batched chunk kernel: one slot per sequence, causal by absolute
+    position, so row j sees exactly the frozen prefix plus in-pass rows
+    0..j. That per-row visible set — and the kernel's page-ordered online
+    softmax — is identical to what ``build_decode_step`` computes one token
+    at a time, so for any row whose consumed prefix matches the greedy
+    stream the logits are BIT-EQUAL to sequential decode (the exactness
+    induction the byte-identical bench gate rests on; pinned by
+    tests/unit/test_spec_decode.py).
+
+    The greedy accept mask is computed ON DEVICE: draft token j+1 is
+    accepted iff it equals ``argmax(logits[:, j])`` and every earlier draft
+    was accepted (``n_draft`` bounds per-row proposals — rows past their
+    proposal count never accept, so per-sequence adaptive k rides a traced
+    operand instead of a recompile). The per-step host transfer is ONE
+    int32 ``[2, S]`` row — accept counts and bonus tokens — mirroring the
+    decode pipeline's one-row discipline; the host reconstructs the emitted
+    tokens from the draft it proposed.
+
+    Rejected rows' K/V stays in the pool as stale bytes past the advanced
+    context — never read (every reader is ctx-bounded) and overwritten by
+    the next write at those positions; block-granular reclamation of
+    reserved-but-unused pages is the scheduler's ``rollback_reserved``.
+
+    Returns ``fwd(weights, kv_pages, ids [S], draft [S, k], n_draft [S],
+    positions [S], block_tables [S, MB], ctx [S]) -> (accept_row [2, S]
+    int32, next_ids [S] int32, final_logits [S, V], new_kv)`` where
+    ``accept_row[0]`` counts accepted draft tokens (row i emits
+    ``accept_row[0, i] + 1`` tokens: the accepted prefix plus
+    ``accept_row[1] = next_ids``, the greedy bonus/correction token) and
+    ``final_logits`` predict ``next_ids``'s successor source row (the
+    engine's continuation refs).
+    """
+    H, Hkv, D = spec.num_heads, spec.num_kv_heads, spec.head_dim
+    dtype = spec.dtype
+    K1 = k + 1
+
+    chunk_win = functools.partial(paged_chunk_attention_batched,
+                                  window=spec.window, alibi=spec.alibi)
+
+    def _chunk_attn(q, kv_l, bts, q0s, ctxs):
+        if tp > 1:
+            from jax.sharding import PartitionSpec as P
+            from deepspeed_tpu.comm.mesh import TENSOR_AXIS
+            fn = _tp_wrap(
+                chunk_win, mesh,
+                in_specs=(P(None, None, TENSOR_AXIS, None),
+                          P(None, None, TENSOR_AXIS, None, None),
+                          P(None, None), P(None), P(None)),
+                out_specs=P(None, None, TENSOR_AXIS, None))
+            return fn(q, kv_l, bts, q0s, ctxs)
+        return chunk_win(q, kv_l, bts, q0s, ctxs)
+
+    def fwd(weights, kv_pages, ids, draft, n_draft, positions0,
+            block_tables, ctx0):
+        kv_pages, kv_sc = _kv_unpack(kv_pages)
+        assert kv_sc is None, "spec decode with int8 KV pages is not wired"
+        S = ids.shape[0]
+        L, NB, bs = kv_pages.shape[0], kv_pages.shape[1], kv_pages.shape[4]
+        MB = block_tables.shape[1]
+        kvp0 = kv_pages.reshape(L * NB * 2 * Hkv * bs, D)
+        tokens = jnp.concatenate([ids[:, None], draft], axis=1)    # [S, K1]
+        positions = positions0[:, None] + jnp.arange(K1, dtype=jnp.int32)[None]
+        pos_flat = positions.reshape(-1)
+        # flat pool write destinations for every row: the run's reservation
+        # covers positions0 + K1, so the logical page index is always inside
+        # the table (pad rows' all-scratch tables clamp to the scratch page)
+        page = jnp.take_along_axis(block_tables,
+                                   jnp.minimum(positions // bs, MB - 1),
+                                   axis=1)                          # [S, K1]
+        dest = (page * bs + positions % bs).reshape(-1)
+
+        x = _embed_in(spec, weights, tokens.reshape(-1), pos_flat)
+
+        def layer_fn(carry, scanned):
+            x, kvp = carry
+            w, l = scanned
+
+            def attend(q, k_, v):
+                # write-then-attend (the ragged pass's discipline): all K+1
+                # rows' K/V scatter into the pool, then the chunk kernel
+                # reads pages causally — row j's own token included
+                kvp_ = _kv_page_write(kvp, k_, v,
+                                      _layer_dest(dest, l, NB, bs, L),
+                                      Hkv, bs)
+                kv_l = kvp_.reshape(L * NB, 2, Hkv, bs, D)
+                out = _chunk_attn(q.reshape(S, K1, H, D), kv_l,
+                                  block_tables + l * NB, positions0,
+                                  ctx0 + (K1 - 1))
+                return out.reshape(S * K1, H, D), kvp_
+
+            x, (kvp,) = _transformer_layer(spec, w, x, pos_flat, attend)
+            return (x, kvp), None
+
+        (x, kvp), _ = jax.lax.scan(
+            layer_fn, (x, kvp0),
+            (weights["layers"], jnp.arange(L, dtype=jnp.int32)))
+        new_kv = kvp.reshape(L, NB, 2, Hkv, bs, D)
+
+        x = _norm(x, weights["final_norm"], spec.norm, spec.eps, dtype,
+                  spec.norm_plus_one)
+        logits = _unembed(spec, weights, x).reshape(S, K1, -1)
+        # greedy accept: the SAME argmax _sample_logits greedy runs, so an
+        # accepted token is exactly the token sequential decode would emit
+        pred = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [S, K1]
+        match = (pred[:, :k] == draft) if k else jnp.zeros((S, 0), bool)
+        match = match & (jnp.arange(k, dtype=jnp.int32)[None]
+                         < n_draft[:, None])
+        accept = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+        next_ids = jnp.take_along_axis(pred, accept[:, None], axis=1)[:, 0]
+        final_logits = jnp.take_along_axis(
+            logits, accept[:, None, None], axis=1)[:, 0]           # [S, V]
+        accept_row = jnp.stack([accept, next_ids]).astype(jnp.int32)
+        return accept_row, next_ids, final_logits, new_kv
+
+    return fwd
+
+
 def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
                              mesh=None, tp: int = 1,
                              do_sample: bool = False,

@@ -57,6 +57,10 @@ class DynamicSplitFuseScheduler:
         # is bounded by the window however long it runs (the ZeRO-Inference
         # long-context analog of the reference's sliding cache).
         self.window: Optional[int] = None
+        # record token history even without a prefix cache (set by the
+        # engine when speculative decoding is on: the n-gram proposer drafts
+        # from each sequence's prompt history, spec/proposer.py)
+        self.record_history_always = False
 
     @property
     def _pass_take_cap(self) -> int:
@@ -109,8 +113,9 @@ class DynamicSplitFuseScheduler:
                 raise RuntimeError(
                     f"max_tracked_sequences={self.config.max_tracked_sequences} exceeded")
             seq = self.seqs[uid] = DSSequenceDescriptor(uid=uid)
-        if self._cache_active:
+        if self._cache_active or self.record_history_always:
             seq.record_history(tokens)
+        if self._cache_active:
             if new_seq and len(tokens) > 1:
                 # adopt every cached whole-block prefix: matched pages join
                 # the block table with ZERO prefill scheduled; only the
@@ -322,6 +327,40 @@ class DynamicSplitFuseScheduler:
             # contiguous prefix here (see DSSequenceDescriptor.history_valid)
             seq.history_valid = seq.history_len
         seq.seen_tokens += n_tokens
+
+    def rollback_reserved(self, uid: int) -> List[int]:
+        """Block-granular KV rollback: free every reserved-but-unused
+        trailing block — pages wholly past ``seen_tokens`` — and truncate
+        the block table. Returns the freed ids.
+
+        This is the speculative-decode reject path's reclamation
+        (``spec/pipeline.py``): a verify run reserves KV for full acceptance
+        up front, and a reject-heavy run leaves whole pages the advanced
+        history never reached. Only the FRESH suffix is ever touched:
+        prefix-cache-shared pages and COW-adopted tails all hold tokens
+        within ``seen_tokens`` (the tree files whole-block history prefixes;
+        COW adoption copies a partial page the sequence then fills), so the
+        rollback boundary can never cross a shared or content-bearing page
+        — enforced by the refcount guard below, not just assumed."""
+        if self.window is not None:
+            # ring reuse repeats physical ids in the logical list; there is
+            # no fresh suffix to roll back (and spec decode refuses windowed
+            # models before ever reserving ahead)
+            return []
+        seq = self.seqs[uid]
+        bs = self.cache.config.block_size
+        need = -(-seq.seen_tokens // bs)
+        tail = [int(b) for b in seq.blocks[need:]]
+        if not tail:
+            return []
+        shared = [b for b in tail if self.allocator.ref_count(b) != 1]
+        if shared:
+            raise RuntimeError(
+                f"rollback of sequence {uid} would free shared block(s) "
+                f"{shared} (refcount != 1) — reserved tails must be fresh")
+        self.allocator.free(tail)
+        del seq.blocks[need:]
+        return tail
 
     # ------------------------------------------------------------------ #
     # pass construction

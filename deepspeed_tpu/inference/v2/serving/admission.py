@@ -102,6 +102,17 @@ class AdmissionController:
     def queued(self) -> int:
         return sum(len(q) for q in self._queues.values())
 
+    @property
+    def slice_tokens(self) -> int:
+        """KV tokens one decode slice reserves per row (the per-run
+        ``decode_batch`` reservation the funding math must match): the
+        plain pipeline reserves ``decode_slice + 1``; speculative decoding
+        reserves for FULL acceptance — ``decode_slice * (k + 1) + 1`` —
+        with run-end rollback returning what rejection left unused."""
+        sd = self.engine.config.spec_decode
+        mult = sd.k + 1 if sd.enabled else 1
+        return self.config.decode_slice * mult + 1
+
     def enqueue(self, req) -> bool:
         """False = queue full; the caller sheds the request immediately."""
         if self.queued >= self.config.max_queue:
@@ -173,7 +184,7 @@ class AdmissionController:
         cfg = self.config
         sched = self.engine.scheduler
         sm = self.engine.config.state_manager
-        slice_tokens = cfg.decode_slice + 1
+        slice_tokens = self.slice_tokens
         actions: List[Action] = []
 
         # simulated capacity: every planned action moves these two counters,
@@ -249,5 +260,5 @@ class AdmissionController:
         provide — the frontend's pre-run emergency-preemption trigger (>0
         only when optimistic admission outran generation-driven growth)."""
         need = self.engine.scheduler.blocks_needed(
-            list(live_uids), self.config.decode_slice + 1)
+            list(live_uids), self.slice_tokens)
         return need - self.engine.scheduler.available_blocks

@@ -157,6 +157,9 @@ class ServingFrontend:
                              max_buffers=cfg.offload_buffers)
             if cfg.preemption == "offload" else None)
         self._pipe = engine.decode_pipeline(())
+        # speculative pipeline: steps emit token BATCHES (accepted draft
+        # prefix + bonus) — on_tokens shape and TBT accounting branch on it
+        self._spec = bool(getattr(self._pipe, "spec", False))
         self._ctl: "queue.Queue" = queue.Queue()
         self._reqs: Dict[int, RequestHandle] = {}       # every non-terminal
         self._live: Dict[int, RequestHandle] = {}       # in the pipeline
@@ -190,11 +193,13 @@ class ServingFrontend:
         sm = self.engine.config.state_manager
         # every run-boundary reservation must fit max_context: a row one
         # token from its budget still funds a whole slice at run start
-        need = len(prompt) + max_new_tokens + self.config.decode_slice + 1
+        # (speculative slices reserve decode_slice * (k + 1) + 1)
+        slice_tokens = self.admission.slice_tokens
+        need = len(prompt) + max_new_tokens + slice_tokens
         if need > sm.max_context:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
-                f"+ decode_slice ({self.config.decode_slice}) + 1 = {need} "
+                f"+ slice reservation ({slice_tokens}) = {need} "
                 f"exceeds max_context {sm.max_context}")
         bs = self.engine.kv.config.block_size
         if -(-need // bs) > self.engine.allocator.total_blocks:
@@ -381,6 +386,17 @@ class ServingFrontend:
                         lane=f"serve/req/u{req.uid}", uid=req.uid,
                         cls=req.cls.name)
 
+    def _admit_pipe(self, req: RequestHandle) -> None:
+        """Admit to the decode pipeline; a speculative pipeline gets the
+        request's full prompt + generated history so the n-gram proposer
+        can match across preempt/restore boundaries (the scheduler's
+        recorded history misses device-generated tokens)."""
+        if self._spec:
+            self._pipe.admit([req.uid], histories=[np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int32)])])
+        else:
+            self._pipe.admit([req.uid])
+
     # ------------------------------------------------------------------ #
     # admission round: execute the plan
     # ------------------------------------------------------------------ #
@@ -439,7 +455,7 @@ class ServingFrontend:
             self._span(req, "prefill", req._phase_t0, t1)
             req.status = DECODING
             req._phase_t0 = t1
-            self._pipe.admit([req.uid])
+            self._admit_pipe(req)
             self._live[req.uid] = req
 
     # ------------------------------------------------------------------ #
@@ -497,7 +513,7 @@ class ServingFrontend:
         self._span(req, "restore", t0, t1)
         req.status = DECODING
         req._phase_t0 = t1
-        self._pipe.admit([uid])
+        self._admit_pipe(req)
         self._live[uid] = req
         self.stats.restores += 1
 
@@ -524,38 +540,52 @@ class ServingFrontend:
             else:
                 self._preempt(victim)
 
-    def _on_tokens(self, j: int, uids: List[int], row: np.ndarray):
+    def _on_tokens(self, j: int, uids: List[int], row):
         """Per-step drain callback — the serving hot path. Clock stamps,
         int appends and queue puts only: no device fetch, no formatting
-        (jaxlint JL007/JL008 police the module)."""
+        (jaxlint JL007/JL008 police the module).
+
+        Spec-aware stream accounting: a speculative step delivers each
+        row's token BATCH (accepted draft prefix + bonus) in one drain, so
+        a k-token accept emits k+1 stream tokens from one step. All of a
+        batch becomes host-visible simultaneously — the client-observed
+        latency the SLOs are defined over — so the batch's FIRST token
+        carries the inter-step gap and the rest record 0 ms TBT; tokens
+        past ``max_new_tokens``/EOS within a batch are discarded (in-step
+        overshoot, flushed with the request at the run boundary)."""
         now = time.perf_counter()
         stop = None
         for i, u in enumerate(uids):
             req = self._live.get(u)
             if req is None:
                 continue                       # stopped earlier this run
-            t = int(row[i])
-            req.tokens.append(t)
-            req._q.put(t)
-            # TTFT/TBT stamp the moment the token became host-visible — the
-            # client-observed latency the SLOs are defined over; the sync
-            # point is the drain inside pipe.run (fetch_to_host)
-            if req.ttft_ms is None:
-                req.ttft_ms = 1e3 * (now - req.arrival_t)  # jaxlint: disable=JL001
-            else:
-                req.tbt_ms.append(1e3 * (now - req._last_emit_t))  # jaxlint: disable=JL001
-            req._last_emit_t = now
-            done = (len(req.tokens) >= req.max_new_tokens
-                    or (req.eos_token_id is not None
-                        and t == req.eos_token_id))
-            if done or req.cancelled:
-                del self._live[u]
-                self._run_stopped.append(req)
-                req._stop_status = CANCELLED if (req.cancelled and not done) \
-                    else FINISHED
-                if stop is None:
-                    stop = []
-                stop.append(u)
+            batch = row[i] if self._spec else row[i:i + 1]
+            for bi in range(len(batch)):
+                t = int(batch[bi])
+                req.tokens.append(t)
+                req._q.put(t)
+                # TTFT/TBT stamp the moment the token became host-visible —
+                # the client-observed latency the SLOs are defined over; the
+                # sync point is the drain inside pipe.run (fetch_to_host)
+                if req.ttft_ms is None:
+                    req.ttft_ms = 1e3 * (now - req.arrival_t)  # jaxlint: disable=JL001
+                elif bi == 0:
+                    req.tbt_ms.append(1e3 * (now - req._last_emit_t))  # jaxlint: disable=JL001
+                else:
+                    req.tbt_ms.append(0.0)     # same-drain sibling token
+                req._last_emit_t = now
+                done = (len(req.tokens) >= req.max_new_tokens
+                        or (req.eos_token_id is not None
+                            and t == req.eos_token_id))
+                if done or req.cancelled:
+                    del self._live[u]
+                    self._run_stopped.append(req)
+                    req._stop_status = CANCELLED \
+                        if (req.cancelled and not done) else FINISHED
+                    if stop is None:
+                        stop = []
+                    stop.append(u)
+                    break
         return stop
 
     def _decode_slice(self) -> None:

@@ -111,6 +111,81 @@ class PipelineStats:
         ]
 
 
+@dataclass
+class SpecDecodeStats:
+    """Aggregate counters for one engine's speculative-decode pipelines
+    (``inference/v2/spec/pipeline.py``; cumulative across runs, ``reset()``
+    between measurement windows). Per-window aggregations over the SAME
+    measured intervals the tracer records as ``serve/spec/*`` spans — one
+    set of perf pairs per step feeds both (docs/OBSERVABILITY.md).
+
+    Semantics per verify step: ``proposed`` counts draft tokens offered,
+    ``accepted`` the ones the verify forward confirmed, ``tokens`` what was
+    actually emitted (accepted + one bonus token per live row); the
+    acceptance rate is accepted/proposed and the amortization lever is
+    tokens/steps — how many stream tokens each full-model forward pays for.
+    ``draft_ms`` is host time in the n-gram proposer (the draft-match cost
+    speculation adds to the host loop); ``verify_ms`` covers dispatch +
+    the blocking accept-row drain (the spec step trades PR 3's one-step-late
+    overlap for k-token amortization — the next draft needs this step's
+    accepted tokens, so the drain cannot ride one step behind)."""
+
+    steps: int = 0
+    rows: int = 0                    # live rows scored across steps
+    proposed: int = 0
+    accepted: int = 0
+    tokens: int = 0                  # emitted (accepted + bonus) tokens
+    draft_ms: float = 0.0
+    verify_ms: float = 0.0
+    fetch_bytes: int = 0
+
+    def record_step(self, rows: int, proposed: int, accepted: int,
+                    tokens: int, draft_s: float, verify_s: float,
+                    fetch_bytes: int) -> None:
+        self.steps += 1
+        self.rows += rows
+        self.proposed += proposed
+        self.accepted += accepted
+        self.tokens += tokens
+        self.draft_ms += 1e3 * draft_s
+        self.verify_ms += 1e3 * verify_s
+        self.fetch_bytes += int(fetch_bytes)
+
+    def reset(self) -> None:
+        self.steps = 0
+        self.rows = 0
+        self.proposed = 0
+        self.accepted = 0
+        self.tokens = 0
+        self.draft_ms = 0.0
+        self.verify_ms = 0.0
+        self.fetch_bytes = 0
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0
+
+    @property
+    def tokens_per_step(self) -> float:
+        return self.tokens / self.steps if self.steps else 0.0
+
+    def events(self, step: int = 0) -> List[Event]:
+        """``serve/spec/*`` monitor events (docs/SERVING.md glossary)."""
+        n = max(1, self.steps)
+        return [
+            ("serve/spec/steps", float(self.steps), step),
+            ("serve/spec/proposed", float(self.proposed), step),
+            ("serve/spec/accepted", float(self.accepted), step),
+            ("serve/spec/tokens", float(self.tokens), step),
+            ("serve/spec/acceptance_rate", self.acceptance_rate, step),
+            ("serve/spec/tokens_per_step", self.tokens_per_step, step),
+            ("serve/spec/draft_ms_per_step", self.draft_ms / n, step),
+            ("serve/spec/verify_ms_per_step", self.verify_ms / n, step),
+            ("serve/spec/fetch_bytes_per_step",
+             self.fetch_bytes / n, step),
+        ]
+
+
 #: latency samples retained per class (completed requests only); percentiles
 #: below compute over this sliding window
 SAMPLE_WINDOW = 4096

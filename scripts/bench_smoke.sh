@@ -35,6 +35,15 @@ timeout -k 10 300 python benchmarks/serving_bench.py --steady-state \
 timeout -k 10 300 python benchmarks/serving_bench.py --frontend --smoke \
     || exit 1
 
+# speculative-decoding leg (docs/SERVING.md "Speculative decoding"):
+# spec-off DecodePipeline vs draft-and-verify SpecDecodePipeline on one
+# warmed engine, gating byte-identical greedy streams, zero compiles across
+# the (bucket, k) verify grid, and allocator blocks back to baseline after
+# reject-heavy runs; emits serve/spec trace lanes (smoke: correctness
+# gates only — the >=1.5x repetitive-leg ratio runs full-size, BENCH_r12)
+timeout -k 10 300 python benchmarks/serving_bench.py --spec --smoke \
+    --spec-k 7 || exit 1
+
 timeout -k 10 300 python benchmarks/train_bench.py --smoke || exit 1
 
 # offloaded-optimizer pipeline leg: serial vs overlapped host step through
@@ -54,9 +63,9 @@ timeout -k 10 300 python benchmarks/train_bench.py --smoke --trace-overhead \
     || exit 1
 
 # the timelines the legs above emitted: schema-valid, spans from the train
-# pipeline, decode pipeline, serving-frontend request lanes, checkpoint, and
-# offload subsystems on distinct tracks, plus a parseable flight-recorder
-# dump from the --preempt kills
+# pipeline, decode pipeline, serving-frontend request lanes, speculative
+# decode, checkpoint, and offload subsystems on distinct tracks, plus a
+# parseable flight-recorder dump from the --preempt kills
 timeout -k 10 120 python scripts/trace_check.py "$TRACE_DIR" \
-    --require train serve serve/req ckpt train/offload --expect-crash \
-    || exit 1
+    --require train serve serve/req serve/spec ckpt train/offload \
+    --expect-crash || exit 1

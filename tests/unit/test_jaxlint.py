@@ -944,3 +944,39 @@ def test_jl007_serving_frontend_path_policed():
     assert lint_text(
         clean, path="deepspeed_tpu/inference/v2/serving/admission.py",
         config=cfg) == []
+
+
+def test_jl007_spec_decode_path_policed():
+    """The speculative-decoding subsystem (inference/v2/spec/) is hot-path
+    policed by the SHIPPED config — a stray blocking fetch of the accept
+    row fires; the pipeline's actual discipline (dtype'd host conversions,
+    the engine-owned fetch_to_host drain) is clean."""
+    raw = _repo_config()
+    hot = raw["rules"]["JL007"]["options"]["hot_paths"]
+    assert "deepspeed_tpu/inference/v2/spec/" in hot
+    assert "deepspeed_tpu/inference/v2/spec/" in \
+        raw["rules"]["JL008"]["options"]["hot_paths"]
+    cfg = LintConfig(rules={"JL007": RuleSettings(
+        options=raw["rules"]["JL007"]["options"])})
+    src = textwrap.dedent("""
+        import numpy as np
+
+        def run_step(accept_row):
+            row = np.asarray(accept_row)
+            return row[0].tolist()
+    """)
+    findings = lint_text(
+        src, path="deepspeed_tpu/inference/v2/spec/pipeline.py", config=cfg)
+    assert rules_of(findings) == ["JL007", "JL007"]
+    clean = textwrap.dedent("""
+        import numpy as np
+        from deepspeed_tpu.inference.v2.engine_v2 import fetch_to_host
+
+        def run_step(accept_row, hist):
+            row = fetch_to_host(accept_row)
+            draft = np.asarray(hist, np.int32)
+            return row, draft
+    """)
+    assert lint_text(
+        clean, path="deepspeed_tpu/inference/v2/spec/pipeline.py",
+        config=cfg) == []

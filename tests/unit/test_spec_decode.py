@@ -1,0 +1,646 @@
+"""Speculative decoding subsystem (inference/v2/spec/ + ragged_model.
+build_verify_step + scheduler.rollback_reserved).
+
+The invariant everything hangs on: greedy speculation is EXACTNESS-
+PRESERVING — spec-on token streams are byte-identical to the spec-off
+pipeline (the verify forward's per-row logits are bit-equal to sequential
+decode for any row whose consumed prefix matches the greedy stream), and a
+reject-heavy run returns the refcounted allocator to baseline through
+block-granular rollback. docs/SERVING.md "Speculative decoding" describes
+the design under test.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.config_v2 import (DSStateManagerConfig,
+                                                  SpecDecodeConfig)
+from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+from deepspeed_tpu.inference.v2.pipeline import DecodePipeline
+from deepspeed_tpu.inference.v2.prefix_cache import RadixPrefixCache
+from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.inference.v2.ragged.kv_cache import (BlockedKVCache,
+                                                        KVCacheConfig)
+from deepspeed_tpu.inference.v2.ragged.ragged_batch import DecodeBatch
+from deepspeed_tpu.inference.v2.scheduler import DynamicSplitFuseScheduler
+from deepspeed_tpu.inference.v2.spec import (DraftProposer, NGramProposer,
+                                             SpecDecodePipeline)
+from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+BS = 8
+K = 3           # the shared engines' spec_decode.k (K+1 = 4 pow2)
+
+PROMPTS = [np.array([3, 14, 15, 92, 6], np.int32),
+           np.array([27, 18, 28, 18], np.int32),
+           np.array([31, 41, 59, 26, 53, 58], np.int32)]
+
+
+def _model_and_params(seed=0):
+    cfg = LlamaConfig.tiny(vocab_size=128, max_position_embeddings=256)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(seed),
+                        {"input_ids": jnp.zeros((1, 8), jnp.int32)})["params"]
+    return model, params
+
+
+def _build_engine(spec=True, warmup=False, model_params=None, **spec_kw):
+    model, params = model_params or _model_and_params()
+    econf = {"dtype": jnp.float32,
+             "state_manager": {"max_tracked_sequences": 4,
+                               "max_ragged_sequence_count": 4,
+                               "max_ragged_batch_size": 32,
+                               "max_context": 256},
+             "kv_cache": {"block_size": 16}}
+    if spec:
+        econf["spec_decode"] = {"enabled": True, "k": K, **spec_kw}
+    if warmup:
+        econf["compile"] = {"warmup": True, "warmup_buckets": [1, 2, 4]}
+    return InferenceEngineV2(model=model, model_parameters=params,
+                             config=econf)
+
+
+@pytest.fixture(scope="module")
+def mp():
+    return _model_and_params()
+
+
+@pytest.fixture(scope="module")
+def spec_engine(mp):
+    """One warmed spec engine (k=3, ladder [1, 3]) shared by the read-mostly
+    tests — warmup covers the plain decode grid AND the (bucket, k) verify
+    grid, so in-grid tests can assert zero new compiles."""
+    return _build_engine(warmup=True, model_params=mp)
+
+
+@pytest.fixture(scope="module")
+def ref_engine(mp):
+    """Spec-OFF engine over the same weights: the byte-equality reference."""
+    return _build_engine(spec=False, model_params=mp)
+
+
+class OracleProposer(DraftProposer):
+    """Test proposer that replays known greedy streams: drafts are always
+    correct, so acceptance is total — the upper-bound harness (any draft
+    source is exactness-safe; this one measures the verify step alone)."""
+
+    def __init__(self, prompts, streams):
+        self.fulls = [list(map(int, p)) + list(map(int, s))
+                      for p, s in zip(prompts, streams)]
+
+    def propose(self, history, k):
+        h = [int(t) for t in history]
+        for full in self.fulls:
+            if full[:len(h)] == h:
+                return np.asarray(full[len(h):len(h) + k], np.int32)
+        return np.zeros((0,), np.int32)
+
+
+class GarbageProposer(DraftProposer):
+    """Always proposes out-of-distribution garbage at full k: every draft
+    rejects — the reject-heavy regime the rollback accounting gates on."""
+
+    def propose(self, history, k):
+        return np.full((k,), 1, np.int32) + np.arange(k, dtype=np.int32)
+
+
+# --------------------------------------------------------------------------- #
+# config + ladder
+# --------------------------------------------------------------------------- #
+
+def test_spec_config_validation():
+    assert SpecDecodeConfig().enabled is False
+    with pytest.raises(ValueError):
+        SpecDecodeConfig(k=0)
+    with pytest.raises(ValueError):
+        SpecDecodeConfig(min_match=0)
+    with pytest.raises(ValueError):
+        SpecDecodeConfig(min_match=3, max_ngram=2)
+    from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+    cfg = RaggedInferenceEngineConfig.load(
+        {"spec_decode": {"enabled": True, "k": 7}})
+    assert cfg.spec_decode.enabled and cfg.spec_decode.k == 7
+
+
+def test_spec_k_ladder(spec_engine):
+    # pow2-minus-1 rungs (K+1 a power of two) capped by config k
+    assert spec_engine.spec_k_ladder == [1, 3]
+    spec_engine.config.spec_decode.k = 15
+    try:
+        assert spec_engine.spec_k_ladder == [1, 3, 7, 15]
+    finally:
+        spec_engine.config.spec_decode.k = K
+    # non-pow2 cap keeps its own top rung
+    spec_engine.config.spec_decode.k = 6
+    try:
+        assert spec_engine.spec_k_ladder == [1, 3, 6]
+    finally:
+        spec_engine.config.spec_decode.k = K
+
+
+# --------------------------------------------------------------------------- #
+# proposer
+# --------------------------------------------------------------------------- #
+
+def test_ngram_matches_and_full_continuation_preference():
+    p = NGramProposer(min_match=2, max_ngram=3)
+    # history: ABCD ABCD ABCD AB -> suffix [A, B] recurs; the most recent
+    # occurrence (tail) has no continuation, so an older FULL one wins
+    h = np.asarray(list(np.tile([1, 2, 3, 4], 3)) + [1, 2], np.int32)
+    d = p.propose(h, 4)
+    assert list(d) == [3, 4, 1, 2]
+
+    # longest match first: suffix [9, 1, 2] matches once with continuation
+    h2 = np.asarray([9, 1, 2, 7, 7, 5, 9, 1, 2], np.int32)
+    assert list(p.propose(h2, 2)) == [7, 7]
+
+
+def test_ngram_no_match_and_bounds():
+    p = NGramProposer(min_match=2, max_ngram=4)
+    assert len(p.propose(np.asarray([1, 2, 3, 4], np.int32), 4)) == 0
+    assert len(p.propose(np.asarray([], np.int32), 4)) == 0
+    assert len(p.propose(np.asarray([5, 5, 5], np.int32), 0)) == 0
+    # min_match=1 would match single tokens; min_match=2 must not
+    lone = np.asarray([8, 3, 8], np.int32)
+    assert len(p.propose(lone, 2)) == 0
+    assert list(NGramProposer(1, 1).propose(lone, 1)) == [3]
+    with pytest.raises(ValueError):
+        NGramProposer(min_match=0)
+    with pytest.raises(NotImplementedError):
+        DraftProposer().propose(lone, 1)
+
+
+# --------------------------------------------------------------------------- #
+# scheduler: block-granular rollback (satellite: allocator edge cases)
+# --------------------------------------------------------------------------- #
+
+def _mk_sched(num_blocks=16, prefix_cache=False):
+    cfg = DSStateManagerConfig(max_tracked_sequences=4,
+                               max_ragged_sequence_count=4,
+                               max_ragged_batch_size=32,
+                               max_context=16 * BS,
+                               prefill_chunk_size=8)
+    kv = BlockedKVCache(KVCacheConfig(num_layers=1, num_kv_heads=1,
+                                      head_dim=8, block_size=BS,
+                                      num_blocks=num_blocks,
+                                      dtype=jnp.float32))
+    alloc = BlockedAllocator(num_blocks)
+    cache = RadixPrefixCache(alloc, BS, cow_fn=lambda s, d: None) \
+        if prefix_cache else None
+    sched = DynamicSplitFuseScheduler(cfg, kv, alloc, prefix_cache=cache)
+    return sched, alloc, cache
+
+
+def _drain(sched):
+    while sched.has_pending():
+        sched.complete_pass(sched.schedule_pass())
+
+
+def test_rollback_across_block_boundary():
+    sched, alloc, _ = _mk_sched()
+    sched.add_tokens(1, np.arange(BS + 3, dtype=np.int32))   # 11 -> 2 blocks
+    _drain(sched)
+    free0 = alloc.free_blocks
+    sched.reserve(1, 3 * BS)          # reservation spans 3 more blocks
+    assert alloc.free_blocks == free0 - 3
+    freed = sched.rollback_reserved(1)
+    # seen = 11 -> 2 blocks kept; the 3 reserved-ahead blocks all freed
+    assert len(freed) == 3 and alloc.free_blocks == free0
+    assert len(sched.seqs[1].blocks) == 2
+    sched.flush(1)
+    assert alloc.free_blocks == alloc.total_blocks
+
+
+def test_rollback_to_exact_block_edge():
+    sched, alloc, _ = _mk_sched()
+    sched.add_tokens(2, np.arange(2 * BS, dtype=np.int32))   # exactly 2 blocks
+    _drain(sched)
+    sched.reserve(2, 2 * BS)
+    assert len(sched.seqs[2].blocks) == 4
+    freed = sched.rollback_reserved(2)
+    # seen sits exactly on a block edge: the edge block is KEPT, the two
+    # wholly-unused reserved blocks free
+    assert len(freed) == 2 and len(sched.seqs[2].blocks) == 2
+    assert sched.rollback_reserved(2) == []    # idempotent at baseline
+    sched.flush(2)
+    assert alloc.free_blocks == alloc.total_blocks
+
+
+def test_rollback_shared_tail_guard_raises():
+    sched, alloc, _ = _mk_sched()
+    sched.add_tokens(3, np.arange(BS, dtype=np.int32))
+    _drain(sched)
+    sched.reserve(3, BS)
+    tail_block = sched.seqs[3].blocks[-1]
+    alloc.share([tail_block])          # simulate an (impossible) co-holder
+    with pytest.raises(RuntimeError, match="shared block"):
+        sched.rollback_reserved(3)
+    # guard refused BEFORE mutating: table and refcounts untouched
+    assert sched.seqs[3].blocks[-1] == tail_block
+    assert alloc.ref_count(tail_block) == 2
+    alloc.free([tail_block])
+    sched.flush(3)
+    assert alloc.free_blocks == alloc.total_blocks
+
+
+def test_rollback_of_cow_adopted_tail():
+    """A COW-adopted partial page holds REAL tokens within seen_tokens:
+    rollback must keep it (and the shared full-page prefix) and free only
+    the fresh reserved suffix."""
+    sched, alloc, cache = _mk_sched(prefix_cache=True)
+    toks = np.arange(BS + 4, dtype=np.int32)       # 1 full page + 4 tail
+    sched.add_tokens(10, toks)
+    _drain(sched)
+    sched.flush(10)                                 # pages -> radix tree
+    assert cache.cached_blocks == 2
+    # a second prompt sharing the prefix: full page attaches shared, the
+    # partial tail COW-adopts into a fresh private page
+    sched.add_tokens(11, np.concatenate([toks, np.arange(50, 60,
+                                                         dtype=np.int32)]))
+    seq = sched.seqs[11]
+    assert seq.cached_tokens >= BS
+    _drain(sched)
+    shared0, cow_block = seq.blocks[0], seq.blocks[1]
+    assert alloc.ref_count(shared0) == 2           # tree + this sequence
+    free0 = alloc.free_blocks
+    sched.reserve(11, 2 * BS + 3)
+    freed = sched.rollback_reserved(11)
+    assert alloc.free_blocks == free0 and len(freed) >= 2
+    # the shared prefix page and the COW-adopted content page survived
+    assert seq.blocks[0] == shared0 and seq.blocks[1] == cow_block
+    assert alloc.ref_count(shared0) == 2
+    # the COW page filled to a whole block during prefill and was
+    # eager-inserted into the tree: sequence + tree hold it — and the
+    # rollback (which may only touch refcount-1 FRESH tails) left it alone
+    assert alloc.ref_count(cow_block) == 2
+    sched.flush(11)
+    cache.evict(cache.cached_blocks)
+    assert alloc.free_blocks == alloc.total_blocks
+
+
+def test_advance_rows_rebinds():
+    db = DecodeBatch(uids=[7, 8], bucket=4,
+                     positions=np.array([5, 9, 0, 0], np.int32),
+                     block_tables=np.zeros((4, 2), np.int32),
+                     ctx_lens=np.array([6, 10, 1, 1], np.int32))
+    pos0, ctx0 = db.positions, db.ctx_lens
+    db.advance_rows(np.array([3, 1, 1, 1], np.int32))
+    assert db.positions is not pos0 and db.ctx_lens is not ctx0   # REBIND
+    assert list(db.positions) == [8, 10, 1, 1]
+    assert list(db.ctx_lens) == [9, 11, 2, 2]
+    with pytest.raises(AssertionError):
+        db.advance_rows(np.array([1, 1], np.int32))
+
+
+# --------------------------------------------------------------------------- #
+# correctness: spec stream == plain pipeline stream (greedy, with pads)
+# --------------------------------------------------------------------------- #
+
+def test_spec_stream_matches_plain_pipeline(spec_engine, ref_engine):
+    """3 live rows -> bucket 4 (one pad row): spec-on greedy streams must be
+    byte-identical to the spec-off pipeline, with ZERO new programs after
+    the (bucket, k) grid warmup."""
+    N = 18
+    ref_engine.put([0, 1, 2], PROMPTS)
+    ref = DecodePipeline(ref_engine, [0, 1, 2]).run(N)
+    ref_engine.flush([0, 1, 2])
+
+    e = spec_engine
+    e.put([0, 1, 2], PROMPTS)
+    c0 = e.compiles
+    pipe = e.decode_pipeline([0, 1, 2])
+    assert isinstance(pipe, SpecDecodePipeline) and pipe.spec
+    got = pipe.run(N)
+    assert e.compiles == c0
+    for i in range(3):
+        assert len(got[i]) >= N
+        assert got[i][:N] == list(map(int, ref[i]))
+    e.flush([0, 1, 2])
+    assert e.free_blocks == e.allocator.total_blocks
+
+
+def test_oracle_drafts_accept_fully(spec_engine, ref_engine):
+    """An always-right draft source accepts at full k every step: each
+    verify step emits k+1 tokens per row, and the stream still byte-equals
+    the plain pipeline (exactness is draft-source independent)."""
+    N = 20
+    ref_engine.put([0, 1, 2], PROMPTS)
+    ref = DecodePipeline(ref_engine, [0, 1, 2]).run(N)
+    ref_engine.flush([0, 1, 2])
+
+    e = spec_engine
+    e.put([0, 1, 2], PROMPTS)
+    oracle = OracleProposer(PROMPTS, ref)
+    e.spec_stats.reset()
+    pipe = SpecDecodePipeline(e, [0, 1, 2], proposer=oracle)
+    steps = -(-N // (K + 1))
+    got = pipe.run(steps)
+    st = e.spec_stats
+    assert st.steps == steps
+    assert st.acceptance_rate == 1.0
+    assert st.tokens_per_step == 3 * (K + 1)       # 3 live rows, full accept
+    for i in range(3):
+        assert got[i] == list(map(int, ref[i]))[:len(got[i])]
+        assert len(got[i]) == steps * (K + 1)
+    e.flush([0, 1, 2])
+    assert e.free_blocks == e.allocator.total_blocks
+
+
+def test_reject_heavy_run_returns_allocator_to_baseline(spec_engine):
+    """Garbage drafts reject everywhere: the run still emits one correct
+    token per step (the bonus), reserved-but-unused pages roll back at run
+    end, and a flush returns refcounts/free blocks to baseline."""
+    e = spec_engine
+    total = e.allocator.total_blocks
+    assert e.free_blocks == total
+    e.put([0, 1], PROMPTS[:2])
+    e.spec_stats.reset()
+    pipe = SpecDecodePipeline(e, [0, 1], proposer=GarbageProposer())
+    got = pipe.run(10)
+    st = e.spec_stats
+    assert st.proposed > 0
+    # near-total rejection (a garbage token CAN match argmax by luck —
+    # exactness makes that harmless, so the bound is loose, not exact)
+    assert st.acceptance_rate < 0.3
+    for u in (0, 1):
+        seq = e.scheduler.seqs[u]
+        # post-run block tables hold exactly ceil(seen/bs) pages — every
+        # reserved-ahead page the rejects never reached was freed
+        assert len(seq.blocks) == -(-seq.seen_tokens // 16)
+        assert seq.seen_tokens == len(PROMPTS[u]) + len(got[u])
+    assert all(len(g) >= 10 for g in got)
+    e.flush([0, 1])
+    assert e.free_blocks == total
+    assert len(e.allocator._refs) == 0
+
+
+def test_spec_generate_matches_plain_engine(spec_engine, ref_engine):
+    ref = ref_engine.generate(PROMPTS, max_new_tokens=9)
+    got = spec_engine.generate(PROMPTS, max_new_tokens=9)
+    assert got == ref
+    # EOS early-exit path
+    eos = ref[0][len(PROMPTS[0]) + 3]
+    ref_eos = ref_engine.generate(PROMPTS, max_new_tokens=9, eos_token_id=eos)
+    got_eos = spec_engine.generate(PROMPTS, max_new_tokens=9,
+                                   eos_token_id=eos)
+    assert got_eos == ref_eos
+    assert spec_engine.free_blocks == spec_engine.allocator.total_blocks
+
+
+# --------------------------------------------------------------------------- #
+# satellite: do_sample cleanly bypasses speculation (one-time warning)
+# --------------------------------------------------------------------------- #
+
+def test_do_sample_bypasses_spec_with_one_warning(spec_engine):
+    e = spec_engine
+    e._spec_warned_sampling = False
+    e.put([0], [PROMPTS[0]])
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        pipe = e.decode_pipeline([0], do_sample=True, temperature=0.9)
+        assert isinstance(pipe, DecodePipeline)      # NOT the spec pipeline
+        assert len(w) == 1 and "greedy-only" in str(w[0].message)
+    out = pipe.run(4)                                # sampled decode works
+    assert out.shape == (1, 4)
+    e.flush([0])
+    # second sampled pipeline: NO second warning
+    e.put([0], [PROMPTS[0]])
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        pipe = e.decode_pipeline([0], do_sample=True)
+        assert isinstance(pipe, DecodePipeline)
+        assert len(w) == 0
+    e.flush([0])
+    # the greedy path keeps returning the spec pipeline afterwards
+    e.put([0], [PROMPTS[0]])
+    assert isinstance(e.decode_pipeline([0]), SpecDecodePipeline)
+    e.flush([0])
+
+
+def test_generate_do_sample_bypasses_spec(spec_engine):
+    e = spec_engine
+    e._spec_warned_sampling = False
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        outs = e.generate(PROMPTS[:2], max_new_tokens=5, do_sample=True,
+                          top_k=8)
+        assert [len(o) for o in outs] == [len(p) + 5 for p in PROMPTS[:2]]
+        assert len(w) == 1
+    assert e.free_blocks == e.allocator.total_blocks
+
+
+# --------------------------------------------------------------------------- #
+# mid-run retirement + exception settling
+# --------------------------------------------------------------------------- #
+
+def test_spec_on_tokens_retirement(spec_engine, ref_engine):
+    N = 12
+    ref_engine.put([0, 1], PROMPTS[:2])
+    ref = DecodePipeline(ref_engine, [0, 1]).run(N)
+    ref_engine.flush([0, 1])
+
+    e = spec_engine
+    e.put([0, 1], PROMPTS[:2])
+    pipe = e.decode_pipeline([0, 1])
+    seen = {0: [], 1: []}
+
+    def on_tokens(step, uids, toks):
+        for i, u in enumerate(uids):
+            seen[u].extend(int(t) for t in toks[i])
+        if len(seen[1]) >= 4:
+            return [1]
+        return None
+
+    got = pipe.run(8, on_tokens=on_tokens)
+    assert pipe.uids == [0]
+    # the survivor's stream is untouched by the retirement
+    m0 = min(len(got[0]), N)
+    assert got[0][:m0] == list(map(int, ref[0]))[:m0]
+    # the retired row's recorded span is a prefix of its greedy stream and
+    # its history advanced exactly by it; refs dropped
+    m1 = min(len(got[1]), N)
+    assert got[1][:m1] == list(map(int, ref[1]))[:m1]
+    assert e.scheduler.seqs[1].seen_tokens == len(PROMPTS[1]) + len(got[1])
+    assert 1 not in e._last_ref and 1 not in e._last_logits
+    e.flush([0, 1])
+    assert e.free_blocks == e.allocator.total_blocks
+
+
+def test_spec_on_tokens_exception_settles_state(spec_engine):
+    e = spec_engine
+    e.put([0, 1], PROMPTS[:2])
+    pipe = e.decode_pipeline([0, 1])
+
+    def boom(step, uids, toks):
+        if step == 1:
+            raise RuntimeError("client hung up")
+
+    with pytest.raises(RuntimeError, match="client hung up"):
+        pipe.run(6, on_tokens=boom)
+    assert pipe.uids == []
+    for u in (0, 1):
+        seq = e.scheduler.seqs[u]
+        assert seq.seen_tokens > len(PROMPTS[u])     # drained spans settled
+        assert len(seq.blocks) == -(-seq.seen_tokens // 16)   # rolled back
+        assert u not in e._last_ref and u not in e._last_logits
+    e.flush([0, 1])
+    assert e.free_blocks == e.allocator.total_blocks
+
+
+def test_spec_admit_validation(spec_engine):
+    e = spec_engine
+    with pytest.raises(ValueError, match="not in steady decode state"):
+        SpecDecodePipeline(e, [999])
+    e.put([0], [PROMPTS[0]])
+    pipe = e.decode_pipeline([0])
+    with pytest.raises(ValueError, match="already in the pipeline"):
+        pipe.admit([0])
+    with pytest.raises(ValueError, match="histories must align"):
+        pipe.admit([1], histories=[])
+    e.flush([0])
+
+
+# --------------------------------------------------------------------------- #
+# stats, monitor events, trace lanes
+# --------------------------------------------------------------------------- #
+
+class _CaptureMonitor:
+    def __init__(self):
+        self.events = []
+
+    def write_events(self, event_list):
+        self.events.extend(event_list)
+
+
+def test_spec_stats_and_monitor_events(spec_engine):
+    e = spec_engine
+    e.put([0, 1], PROMPTS[:2])
+    e.spec_stats.reset()
+    e.decode_pipeline([0, 1]).run(5)
+    st = e.spec_stats
+    assert st.steps == 5 and st.tokens >= 10
+    assert st.fetch_bytes > 0 and st.verify_ms > 0
+    mon = _CaptureMonitor()
+    e.write_monitor_events(mon, step=2)
+    names = {n for n, _, _ in mon.events}
+    for f in ("steps", "proposed", "accepted", "tokens", "acceptance_rate",
+              "tokens_per_step", "draft_ms_per_step", "verify_ms_per_step",
+              "fetch_bytes_per_step"):
+        assert f"serve/spec/{f}" in names
+    assert all(s == 2 for _, _, s in mon.events)
+    e.flush([0, 1])
+
+
+def test_spec_traced_run_byte_identical_with_spans(spec_engine, ref_engine):
+    """Tracing ON changes nothing (tokens, compiles) and leaves
+    serve/spec/* spans whose step count matches the stats."""
+    from deepspeed_tpu.monitor.trace import tracer
+    N = 10
+    ref_engine.put([0, 1], PROMPTS[:2])
+    ref = DecodePipeline(ref_engine, [0, 1]).run(N)
+    ref_engine.flush([0, 1])
+
+    e = spec_engine
+    tracer.reset()
+    tracer.configure(enabled=True, ring_size=2048)
+    try:
+        e.put([0, 1], PROMPTS[:2])
+        c0 = e.compiles
+        e.spec_stats.reset()
+        got = e.decode_pipeline([0, 1]).run(6)
+        assert e.compiles == c0
+        for i in range(2):
+            assert got[i] == list(map(int, ref[i]))[:len(got[i])]
+        summary = tracer.summary()
+        assert summary["serve/spec/step"][0] == e.spec_stats.steps == 6
+        assert summary["serve/spec/draft"][0] == 6
+        assert "serve/spec/drain" in summary
+        assert "serve/drain/fetch_to_host" in summary
+        e.flush([0, 1])
+    finally:
+        tracer.reset()
+
+
+# --------------------------------------------------------------------------- #
+# frontend integration: spec-aware stream + TBT accounting
+# --------------------------------------------------------------------------- #
+
+def test_frontend_spec_stream_and_tbt(spec_engine, ref_engine):
+    """The serving frontend on a spec engine: streams stay byte-equal to
+    the plain pipeline, and a k-token accept lands k+1 stream tokens from
+    one step — same-drain siblings record 0 ms TBT."""
+    e = spec_engine
+    N = 12
+    prompt = PROMPTS[0]
+    ref_engine.put([5], [prompt])
+    ref = list(map(int, DecodePipeline(ref_engine, [5]).run(N)[0]))
+    ref_engine.flush([5])
+
+    fe = e.serving_frontend(config={"decode_slice": 4,
+                                    "idle_wait_s": 0.002})
+    assert fe._spec
+    # oracle drafts -> deterministic full acceptance -> k+1-token batches
+    fe._pipe.proposer = OracleProposer([prompt], [ref])
+    h = fe.submit(prompt, max_new_tokens=N)
+    for _ in range(200):
+        if h.finished:
+            break
+        fe.step()
+    assert h.status == "finished"
+    assert h.tokens == ref
+    # spec TBT accounting: batches arrive simultaneously — sibling tokens
+    # after each batch's first record exactly 0.0 ms
+    assert 0.0 in h.tbt_ms
+    assert len(h.tbt_ms) == N - 1
+    fe.close()
+    assert e.free_blocks == e.allocator.total_blocks
+
+
+def test_generate_tight_max_context_degrades_not_crashes(mp):
+    """A max_context sized like the PLAIN path needs (prompt + max_new +
+    slack) must keep working when spec_decode is merely toggled on: the
+    verify step intrinsically reserves k+1 write slots, so near the
+    context ceiling generate() clamps the run length and degrades the tail
+    to the plain pipeline instead of dying in scheduler.reserve."""
+    model, params = mp
+    prompt = PROMPTS[0]                      # 5 tokens
+    max_new = 24
+    ctx = len(prompt) + max_new + 2          # plain fits; spec must adapt
+
+    def build(spec):
+        econf = {"dtype": jnp.float32,
+                 "state_manager": {"max_tracked_sequences": 2,
+                                   "max_ragged_sequence_count": 2,
+                                   "max_ragged_batch_size": 32,
+                                   "max_context": ctx},
+                 "kv_cache": {"block_size": 16}}
+        if spec:
+            econf["spec_decode"] = {"enabled": True, "k": K}
+        return InferenceEngineV2(model=model, model_parameters=params,
+                                 config=econf)
+
+    ref = build(False).generate([prompt], max_new_tokens=max_new)
+    e = build(True)
+    got = e.generate([prompt], max_new_tokens=max_new)
+    assert got == ref
+    assert e.free_blocks == e.allocator.total_blocks
+
+
+def test_spec_window_model_refused(mp):
+    model, params = mp
+    cfg = LlamaConfig.tiny(vocab_size=128, max_position_embeddings=256)
+    cfg.sliding_window = 32
+    wmodel = LlamaForCausalLM(cfg)
+    with pytest.raises(NotImplementedError, match="sliding-window"):
+        InferenceEngineV2(
+            model=wmodel, model_parameters=params,
+            config={"dtype": jnp.float32,
+                    "state_manager": {"max_tracked_sequences": 4,
+                                      "max_ragged_sequence_count": 4,
+                                      "max_ragged_batch_size": 32,
+                                      "max_context": 256},
+                    "kv_cache": {"block_size": 16},
+                    "spec_decode": {"enabled": True, "k": 3}})
