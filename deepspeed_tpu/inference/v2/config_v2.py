@@ -328,6 +328,60 @@ class ServingConfig:
 
 
 @dataclass
+class RouterConfig:
+    """The multi-replica serving router (``inference/v2/serving/router.py``;
+    docs/SERVING.md "Multi-replica & disaggregation"). Cluster-level — it
+    configures a ``ServingRouter`` over N engines, not any single engine.
+
+    ``policy`` picks request placement:
+
+    - ``"cache_aware"`` (default): route to the replica whose radix prefix
+      cache holds the longest cached match for the prompt (the
+      SGLang-RadixAttention trick at cluster scope, read from a shared
+      chain-hash index fed by per-replica insert/evict deltas), scored
+      against load: ``score = cached_tokens - balance * outstanding``.
+    - ``"round_robin"``: placement ignores caches — the bench baseline.
+
+    ``balance`` is the stickiness/balance tradeoff knob: how many cached
+    prompt tokens one outstanding request on a replica outweighs. ``0`` is
+    pure stickiness (hotspot risk); large values degrade to least-loaded.
+
+    ``topology``:
+
+    - ``"colocated"`` (default): every replica runs prefill AND decode.
+    - ``"disaggregated"``: dedicated prefill replicas run SplitFuse passes
+      and hand finished KV to decode replicas over the page fabric
+      (``engine.export_kv``/``import_kv`` — the same bucketed page gather
+      preempt-offload rides), eliminating prefill interference on decode
+      TBT.
+
+    ``federation``: aggregate per-replica admission state (per-class
+    queue-delay EMAs + SLO cost models) into placement — a replica whose
+    predicted TTFT already busts the class SLO is skipped while a cold one
+    absorbs, and the router sheds up front when EVERY candidate is hot
+    (``shed_factor`` scales the SLO bound exactly like
+    ``ServingConfig.shed_factor``)."""
+    policy: str = "cache_aware"
+    balance: float = 32.0
+    topology: str = "colocated"
+    federation: bool = True
+    shed_factor: float = 1.0
+
+    def __post_init__(self):
+        if self.policy not in ("cache_aware", "round_robin"):
+            raise ValueError("router.policy must be 'cache_aware' or "
+                             f"'round_robin', got {self.policy!r}")
+        if self.topology not in ("colocated", "disaggregated"):
+            raise ValueError("router.topology must be 'colocated' or "
+                             f"'disaggregated', got {self.topology!r}")
+        if self.balance < 0:
+            raise ValueError(f"router.balance must be >= 0, got {self.balance}")
+        if self.shed_factor <= 0:
+            raise ValueError("router.shed_factor must be > 0, got "
+                             f"{self.shed_factor}")
+
+
+@dataclass
 class RaggedInferenceEngineConfig:
     state_manager: DSStateManagerConfig = field(default_factory=DSStateManagerConfig)
     kv_cache: KVCacheSizingConfig = field(default_factory=KVCacheSizingConfig)

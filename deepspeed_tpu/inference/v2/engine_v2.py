@@ -916,6 +916,54 @@ class InferenceEngineV2:
                              jnp.asarray(pages, self.kv.kv.dtype),
                              jnp.asarray(idx))
 
+    def export_kv(self, uid: int) -> Tuple[np.ndarray, np.ndarray]:
+        """``(pages, logits)``: the whole logical KV of a fully-prefilled
+        sequence fetched to host in one bucketed gather, plus its last
+        logits row — then the sequence is flushed here. The export half of a
+        cross-engine prefill->decode handoff (serving/cluster.py): the pair
+        is exactly what preempt-offload parks per victim, so ``import_kv``
+        on ANOTHER engine restores it the same way preemption restore does
+        (pages scattered into fresh pool ids, ``_last_logits`` re-seeded for
+        a byte-identical bootstrap sample). With the prefix cache on, the
+        flush returns this sequence's pages to the LOCAL radix tree — the
+        prefill replica stays warm for the next matching prompt."""
+        uid = int(uid)
+        seq = self.scheduler.seqs.get(uid)
+        if seq is None:
+            raise KeyError(f"sequence {uid} is not tracked")
+        if len(seq.pending):
+            raise RuntimeError(f"sequence {uid} still has pending prefill "
+                               "tokens — export_kv needs a drained sequence")
+        self._materialize([uid])
+        logits = self._last_logits.pop(uid)
+        pages = self.fetch_pages(list(seq.blocks))
+        self.flush([uid])
+        return pages, logits
+
+    def import_kv(self, uid: int, tokens: Sequence[int], pages: np.ndarray,
+                  logits: np.ndarray) -> List[int]:
+        """Adopt a sequence whose KV ``pages`` were computed on ANOTHER
+        engine (independent pool, different block ids): allocate fresh pages
+        (``scheduler.adopt_sequence``), scatter the content in with the
+        bucketed ``put_pages`` (byte-exact — the fabric contract
+        tests/unit/test_serving_router.py pins below the router), and
+        re-seed the bootstrap logits row exactly like preemption restore.
+        The sequence is then in steady decode state: ``decode_pipeline`` can
+        admit it directly. Returns the allocated block ids."""
+        uid = int(uid)
+        pages = np.asarray(pages, self.kv.kv.dtype)
+        page_shape = (self.kv.kv.shape[0],) + tuple(self.kv.kv.shape[2:])
+        if tuple(pages.shape[1:]) != page_shape:
+            raise ValueError(
+                f"handoff page shape {tuple(pages.shape[1:])} does not match "
+                f"this engine's KV page layout {page_shape} — cross-engine "
+                "handoff needs an identical model + block_size")
+        ids = self.scheduler.adopt_sequence(uid, tokens, len(pages))
+        if ids:
+            self.put_pages(pages, ids)
+        self._last_logits[uid] = logits
+        return ids
+
     def fetch_page(self, block: int) -> np.ndarray:
         """One KV page ([L, 2, H_kv, block_size, D]) to host."""
         return self.fetch_pages([block])[0]

@@ -30,6 +30,11 @@ Lifecycle:
     interior pages become evictable as their children go.
 
 Everything here is host metadata — the only device work is the COW page copy.
+
+Multi-replica support (``serving/router.py``): every full-block node carries a
+root->path *chain hash* (``chain_hash``); ``add_listener`` feeds insert/evict
+deltas to a cluster-wide prefix index, and ``match_len`` answers the cheap
+"how much of this prompt is cached here" query cache-aware routing scores.
 """
 
 from __future__ import annotations
@@ -42,6 +47,22 @@ import numpy as np
 from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
 
 Event = Tuple[str, float, int]
+
+#: chain hash of the (empty) root path — the seed every token-block chain
+#: hash grows from. The multi-replica router's shared prefix index
+#: (``serving/router.py ClusterPrefixIndex``) walks a request's blocks with
+#: the SAME chain function, so index membership == radix-tree path existence.
+ROOT_CHAIN = 0
+
+
+def chain_hash(parent_chain: int, key: Tuple[int, ...]) -> int:
+    """Chained token-block hash identifying one root->node path (stable
+    within a process — the router and its replicas share one). A node's
+    chain commits to every token block above it, so two trees holding the
+    same chain hold the same cached token prefix (modulo hash collisions,
+    which cost a mis-route, never correctness — routing is a placement
+    hint; the replica's own ``match`` decides what actually attaches)."""
+    return hash((parent_chain, key))
 
 
 @dataclass
@@ -80,7 +101,7 @@ class PrefixCacheStats:
 
 class _RadixNode:
     __slots__ = ("key", "block_id", "parent", "children", "partials",
-                 "last_access")
+                 "last_access", "chain")
 
     def __init__(self, key: Tuple[int, ...], block_id: Optional[int],
                  parent: Optional["_RadixNode"]):
@@ -90,6 +111,9 @@ class _RadixNode:
         self.children: Dict[Tuple[int, ...], _RadixNode] = {}   # full pages
         self.partials: Dict[Tuple[int, ...], _RadixNode] = {}   # partial leaves
         self.last_access = 0
+        # root->node chain hash (chain_hash); None for partial leaves — only
+        # full-block nodes are routable (the router delta feed skips partials)
+        self.chain: Optional[int] = None
 
     @property
     def is_leaf(self) -> bool:
@@ -117,8 +141,15 @@ class RadixPrefixCache:
         # (full-block sharing still works)
         self.cow_fn = cow_fn
         self.root = _RadixNode((), None, None)
+        self.root.chain = ROOT_CHAIN
         self._clock = 0                   # monotonic LRU clock
         self._nodes = 0                   # pages the tree holds references to
+        # delta sinks (serving/router.py ClusterPrefixIndex): called
+        # ``fn("insert"|"evict", chain_hash)`` whenever a full-block node
+        # joins or leaves the tree — the per-replica feed a shared
+        # cluster-wide prefix index is built from. Partial leaves never emit
+        # (not routable: adoption is COW, not sharing).
+        self._listeners: List[Callable[[str, int], None]] = []
         self.stats = PrefixCacheStats()
 
     # ------------------------------------------------------------------ #
@@ -160,6 +191,56 @@ class RadixPrefixCache:
                 yield node
             stack.extend(node.children.values())
             stack.extend(node.partials.values())
+
+    def iter_chains(self):
+        """Chain hashes of every full-block node currently cached (partial
+        leaves excluded — they are not routable). Used by ``add_listener``
+        to replay existing state into a late-registered index."""
+        for node in self._iter_nodes():
+            if node.chain is not None:
+                yield node.chain
+
+    def add_listener(self, fn: Callable[[str, int], None],
+                     replay: bool = True) -> None:
+        """Register a delta sink; ``replay=True`` first emits an ``insert``
+        for every full-block node already in the tree, so an index built
+        after the replica served traffic starts consistent."""
+        if replay:
+            for chain in self.iter_chains():
+                fn("insert", chain)
+        self._listeners.append(fn)
+
+    def remove_listener(self, fn: Callable[[str, int], None]) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
+
+    def _emit(self, op: str, chain: Optional[int]) -> None:
+        if chain is None:
+            return
+        for fn in self._listeners:
+            fn(op, chain)
+
+    def match_len(self, tokens: Sequence[int]) -> int:
+        """Tokens the tree could serve for this prompt RIGHT NOW via
+        full-block sharing — the cheap longest-cached-match query the
+        multi-replica router scores placements with. Pure read: no
+        references taken, no LRU touch, no stats, no COW; capped at
+        ``len(tokens) - 1`` exactly like ``match`` (the last prompt token
+        always prefills fresh)."""
+        tokens = [int(t) for t in np.asarray(tokens, np.int64).reshape(-1)]
+        bs = self.block_size
+        limit = len(tokens) - 1
+        node = self.root
+        i = 0
+        while i + bs <= limit:
+            child = node.children.get(tuple(tokens[i:i + bs]))
+            if child is None:
+                break
+            node = child
+            i += bs
+        return i
 
     def _tick(self) -> int:
         self._clock += 1
@@ -272,9 +353,11 @@ class RadixPrefixCache:
                 # a partial leaf with this key's prefix may exist; it stays —
                 # matches prefer full children, and eviction reclaims it
                 child = _RadixNode(key, blk, node)
+                child.chain = chain_hash(node.chain, key)
                 node.children[key] = child
                 self._nodes += 1
                 self.stats.insertions += 1
+                self._emit("insert", child.chain)
                 if not transfer_refs:
                     self.allocator.share([blk])
                 # transfer_refs: the seq's reference becomes the tree's
@@ -344,6 +427,7 @@ class RadixPrefixCache:
             if victim.key in parent.children \
                     and parent.children[victim.key] is victim:
                 del parent.children[victim.key]
+                self._emit("evict", victim.chain)
             else:
                 del parent.partials[victim.key]
             self.allocator.free([victim.block_id])

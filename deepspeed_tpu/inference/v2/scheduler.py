@@ -263,6 +263,50 @@ class DynamicSplitFuseScheduler:
         self.allocator.free(seq.blocks[kept:])
         del seq.blocks[kept:]
 
+    def adopt_sequence(self, uid: int, tokens: np.ndarray,
+                       n_blocks: int) -> List[int]:
+        """Create a sequence whose KV was computed ELSEWHERE — the import
+        half of a cross-engine prefill->decode handoff (``engine.import_kv``;
+        serving/cluster.py). Allocates ``n_blocks`` fresh pages (LRU-evicting
+        idle cached pages on a shortfall), records the token history, and
+        marks all ``tokens`` as seen — the caller scatters the page CONTENT
+        in (``engine.put_pages``) before the sequence decodes. Returns the
+        allocated ids in logical order, exactly like ``grow_tail``."""
+        if self.window is not None:
+            raise NotImplementedError(
+                "cross-engine KV adoption with a sliding-window page ring "
+                "is not wired (the logical block list aliases physical "
+                "pages)")
+        tokens = np.asarray(tokens, np.int32)
+        if uid in self.seqs:
+            raise ValueError(f"sequence {uid} is already tracked")
+        if len(tokens) < 1:
+            raise ValueError("adopt_sequence needs at least one token")
+        if len(tokens) > self.config.max_context:
+            raise ValueError(f"sequence {uid}: {len(tokens)} tokens > "
+                             f"max_context {self.config.max_context}")
+        bs = self.cache.config.block_size
+        if n_blocks * bs < len(tokens):
+            raise ValueError(
+                f"{n_blocks} pages cannot hold {len(tokens)} tokens at "
+                f"block_size {bs}")
+        if len(self.seqs) >= self.config.max_tracked_sequences:
+            raise RuntimeError(
+                f"max_tracked_sequences={self.config.max_tracked_sequences} "
+                "exceeded")
+        if n_blocks > self.allocator.free_blocks \
+                and n_blocks > self._available_blocks():
+            raise RuntimeError(
+                f"cannot adopt sequence {uid}: needs {n_blocks} KV blocks, "
+                f"{self._available_blocks()} obtainable")
+        seq = self.seqs[uid] = DSSequenceDescriptor(uid=uid)
+        if self._cache_active or self.record_history_always:
+            seq.record_history(tokens)
+        ids = [int(b) for b in self._alloc(n_blocks)] if n_blocks else []
+        seq.blocks.extend(ids)
+        seq.seen_tokens = len(tokens)
+        return ids
+
     def grow_tail(self, uid: int, n: int) -> List[int]:
         """Append ``n`` fresh pages to ``uid``'s block table (LRU-evicting
         idle cached pages on a shortfall) and return their ids, in order —

@@ -1,27 +1,39 @@
-"""The SLO-aware serving frontend — PAPER.md layer 6 (MII/FastGen) over
-``InferenceEngineV2``.
+"""The SLO-aware serving stack — PAPER.md layer 6 (MII/FastGen) over
+``InferenceEngineV2``, from one frontend to an N-replica cluster.
 
-Four modules:
+Six modules:
 
 - ``frontend.py`` — ``ServingFrontend``: persistent engine thread driving
   iteration-level continuous batching over ``engine.decode_pipeline``;
   asyncio-facing ``submit() -> token stream``; cancellation at every
-  lifecycle stage.
+  lifecycle stage; cross-replica handoff adoption (``submit_handoff``).
 - ``admission.py`` — multi-tenant admission with priority classes: a
   queue-delay + prefill-cost model decides admit / hold / shed per class
-  SLO, and plans preemption under KV-pool pressure.
+  SLO, and plans preemption under KV-pool pressure; its per-class
+  queue-delay EMAs are the router's federation signal.
 - ``kv_offload.py`` — preempt-by-offload: victims' private KV pages
   round-trip through pinned host buffers (vLLM swap-out, not
-  drop-and-recompute), byte-identical on restore.
-- ``loadgen.py`` — Poisson open-loop load generator + goodput-under-SLO
-  scoring (``serving_bench.py --frontend`` gates on it).
+  drop-and-recompute), byte-identical on restore; the same bucketed page
+  path is the cluster's cross-engine KV fabric.
+- ``loadgen.py`` — Poisson open-loop load generator (seed-deterministic,
+  shared-prefix mixture components) + goodput-under-SLO scoring.
+- ``cluster.py`` — ``ServingCluster``: N data-parallel replicas (uniform
+  page fabric, replica-labelled monitor surfaces) + ``PrefillWorker``
+  (dedicated SplitFuse prefill under disaggregation).
+- ``router.py`` — ``ServingRouter``: cache-aware routing over a shared
+  radix-prefix chain index, federated SLO admission, disaggregated
+  prefill->decode handoff.
 
-docs/SERVING.md "Frontend" walks the design; ``serve/frontend/*`` counters
-and ``serve/req/*`` trace lanes make it observable.
+docs/SERVING.md ("Frontend", "Multi-replica & disaggregation") walks the
+design; ``serve/frontend/*``, ``serve/router/*`` counters and
+``serve/req/*``, ``serve/router`` trace lanes make it observable.
 """
 
 from deepspeed_tpu.inference.v2.serving.admission import (AdmissionController,
                                                           CostModel)
+from deepspeed_tpu.inference.v2.serving.cluster import (PrefillWorker,
+                                                        Replica,
+                                                        ServingCluster)
 from deepspeed_tpu.inference.v2.serving.frontend import (RequestHandle,
                                                          ServingFrontend)
 from deepspeed_tpu.inference.v2.serving.kv_offload import KVOffloadManager
@@ -30,3 +42,5 @@ from deepspeed_tpu.inference.v2.serving.loadgen import (Arrival,
                                                         WorkloadComponent,
                                                         goodput_report,
                                                         replay, slo_met)
+from deepspeed_tpu.inference.v2.serving.router import (ClusterPrefixIndex,
+                                                       ServingRouter)

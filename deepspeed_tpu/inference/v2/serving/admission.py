@@ -93,6 +93,12 @@ class AdmissionController:
         # one FIFO per class, iterated in strict priority order
         self._order = sorted(config.classes, key=lambda c: -c.priority)
         self._queues: Dict[str, Deque] = {c.name: deque() for c in self._order}
+        # per-class queue-delay EMA (arrival -> admit), updated as plans
+        # admit: the federation signal a multi-replica ServingRouter
+        # aggregates across replicas — a hot replica's rising delay steers
+        # new arrivals to a cold one before the local shed rule ever fires
+        self._qdelay: Dict[str, Optional[float]] = {
+            c.name: None for c in self._order}
 
     # ------------------------------------------------------------------ #
     # queue management (engine thread only)
@@ -165,6 +171,18 @@ class AdmissionController:
         shared-prefix pages only move to the radix tree, where they are
         already counted evictable)."""
         return len(self.engine.scheduler.private_tail(uid)[1])
+
+    def queue_delay_s(self, cls_name: str) -> float:
+        """The class's admitted queue-delay EMA in seconds (0 until the
+        first admission) — read by ``ServingRouter`` for federated
+        placement/shedding; see ``_qdelay`` above."""
+        return self._qdelay.get(cls_name) or 0.0
+
+    def _note_queue_delay(self, cls_name: str, delay_s: float) -> None:
+        a = self.cost.alpha
+        cur = self._qdelay[cls_name]
+        self._qdelay[cls_name] = delay_s if cur is None \
+            else (1 - a) * cur + a * delay_s
 
     def hopeless(self, req, now: float) -> bool:
         """Best-case TTFT already misses the class SLO: shed, don't burn."""
@@ -248,6 +266,10 @@ class AdmissionController:
             if need <= budget:
                 self.remove(req)
                 actions.append(("admit", req))
+                # intentionally async: queue delay is host wall time the
+                # request ALREADY waited (arrival -> this admit), no device
+                # work is being timed
+                self._note_queue_delay(req.cls.name, now - req.arrival_t)  # jaxlint: disable=JL001
                 budget -= need
                 rows_free -= 1
                 slots_free -= 1

@@ -1,0 +1,289 @@
+"""Replica management for multi-replica serving (docs/SERVING.md
+"Multi-replica & disaggregation").
+
+A :class:`ServingCluster` turns N data-parallel ``InferenceEngineV2``
+instances (same model, same weights, independent KV pools) into the replica
+set a :class:`~deepspeed_tpu.inference.v2.serving.router.ServingRouter`
+fronts:
+
+- builds one ``ServingFrontend`` per serving replica from ONE shared
+  ``ServingConfig`` (uniform priority classes — federation compares
+  like-for-like SLO state);
+- labels every replica's monitor surfaces (``FrontendStats.replica`` /
+  ``SpecDecodeStats.replica``) so N frontends fanning into one monitor
+  backend emit ``serve/frontend/<replica>/*`` rows instead of colliding;
+- validates the KV page fabric is uniform (block size + page layout), the
+  precondition for byte-exact cross-engine handoffs
+  (``engine.export_kv``/``import_kv``);
+- under a disaggregated topology, runs a :class:`PrefillWorker` per
+  ``prefill`` replica: queued requests prefill in SplitFuse-composed batches
+  through the engine's scheduler passes, then each finished sequence's KV
+  pages + bootstrap logits row move to a decode replica over the bucketed
+  page gather — the same pinned-host round trip preempt-offload rides
+  (``kv_offload.py``), re-seeding ``_last_logits`` exactly like a
+  preemption restore.
+
+Roles: ``"serve"`` (prefill + decode — the colocated default),
+``"prefill"`` (SplitFuse passes only, no frontend), ``"decode"``
+(handoff-fed decode frontend).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from deepspeed_tpu.monitor.trace import tracer as _tracer
+
+_ROLES = ("serve", "prefill", "decode")
+
+
+class Replica:
+    """One engine (+ its serving frontend, unless role ``prefill``) under a
+    stable name — the unit the router places requests on."""
+
+    def __init__(self, name: str, engine, role: str = "serve",
+                 frontend=None):
+        self.name = name
+        self.engine = engine
+        self.role = role
+        self.frontend = frontend
+
+    def __repr__(self) -> str:
+        return f"Replica({self.name!r}, role={self.role!r})"
+
+
+class ServingCluster:
+
+    def __init__(self, engines: Sequence, serving=None,
+                 roles: Optional[Sequence[str]] = None,
+                 names: Optional[Sequence[str]] = None):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("a cluster needs at least one engine")
+        roles = list(roles) if roles is not None else ["serve"] * len(engines)
+        names = list(names) if names is not None \
+            else [f"r{i}" for i in range(len(engines))]
+        if not (len(engines) == len(roles) == len(names)):
+            raise ValueError(
+                f"engines ({len(engines)}), roles ({len(roles)}) and names "
+                f"({len(names)}) must align")
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate replica names: {names}")
+        bad = [r for r in roles if r not in _ROLES]
+        if bad:
+            raise ValueError(f"unknown replica roles {bad}; valid: {_ROLES}")
+        # the page fabric is only byte-exact between identical layouts:
+        # block size, page shape and dtype must match across every replica
+        ref = engines[0].kv.config
+        for e, name in zip(engines[1:], names[1:]):
+            c = e.kv.config
+            mismatched = [f for f in ("num_layers", "num_kv_heads", "head_dim",
+                                      "block_size", "dtype", "quantized")
+                          if getattr(c, f) != getattr(ref, f)]
+            if mismatched:
+                raise ValueError(
+                    f"replica {name!r} KV layout differs from "
+                    f"{names[0]!r} on {mismatched} — cross-replica KV "
+                    "handoff would not be byte-exact")
+        self.replicas: List[Replica] = []
+        for engine, role, name in zip(engines, roles, names):
+            frontend = None
+            if role != "prefill":
+                frontend = engine.serving_frontend(config=serving)
+                frontend.stats.replica = name
+            engine.spec_stats.replica = name
+            self.replicas.append(Replica(name, engine, role, frontend))
+
+    # ------------------------------------------------------------------ #
+
+    @property
+    def block_size(self) -> int:
+        return self.replicas[0].engine.kv.config.block_size
+
+    @property
+    def frontends(self) -> List[Replica]:
+        return [r for r in self.replicas if r.frontend is not None]
+
+    @property
+    def prefill_replicas(self) -> List[Replica]:
+        return [r for r in self.replicas if r.role == "prefill"]
+
+    @property
+    def decode_replicas(self) -> List[Replica]:
+        """Replicas that can decode handed-off sequences."""
+        return [r for r in self.replicas if r.role == "decode"]
+
+    @property
+    def serve_replicas(self) -> List[Replica]:
+        """Colocated (prefill + decode) replicas."""
+        return [r for r in self.replicas if r.role == "serve"]
+
+    def replica(self, name: str) -> Replica:
+        for r in self.replicas:
+            if r.name == name:
+                return r
+        raise KeyError(f"unknown replica {name!r}; configured: "
+                       f"{[r.name for r in self.replicas]}")
+
+    def start(self) -> "ServingCluster":
+        for r in self.frontends:
+            r.frontend.start()
+        return self
+
+    def close(self) -> None:
+        """Close every frontend; the FIRST replica whose close raises (a
+        died engine thread) is re-raised NAMED after all replicas are torn
+        down — a dead replica must not leave its siblings running."""
+        failed = []
+        for r in self.frontends:
+            try:
+                r.frontend.close()
+            except BaseException as exc:
+                failed.append((r.name, exc))
+        if failed:
+            name, exc = failed[0]
+            raise RuntimeError(f"replica {name!r} failed at close") from exc
+
+
+class PrefillWorker:
+    """Dedicated prefill executor for one ``prefill``-role replica.
+
+    Drains its queue in batches: every queued request's prompt enters the
+    scheduler together, so the SplitFuse passes COMPOSE concurrent prompts
+    (multiple chunk slots per pass — the same batching a colocated frontend
+    gets, without a decode set to interfere with). Each finished sequence is
+    exported (``engine.export_kv``: one bucketed page gather + the bootstrap
+    logits row) and handed to the least-loaded decode replica
+    (``ServingFrontend.submit_handoff``). Client disconnects are polled at
+    pass boundaries exactly like ``ServingFrontend._prefill``.
+
+    A worker that dies surfaces at the ROUTER's ``drain()``/``close()`` with
+    the replica named (``exc``), and every request it still held has its
+    stream closed so clients never hang."""
+
+    def __init__(self, replica: Replica, router):
+        self.replica = replica
+        self.router = router
+        self.q: "queue.Queue" = queue.Queue()
+        self.exc: Optional[BaseException] = None
+        # requests this worker currently owns (popped from the queue, not
+        # yet handed off / finalized) — the crash handler closes exactly
+        # these streams, never one a decode replica already adopted
+        self._owned: Dict[int, object] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def queued(self) -> int:
+        return self.q.qsize()
+
+    def submit(self, req) -> None:
+        self.q.put(req)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"dstpu-prefill-{self.replica.name}",
+            daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        # abandon whatever is still queued: close the streams (cancelled)
+        while True:
+            try:
+                req = self.q.get_nowait()
+            except queue.Empty:
+                break
+            self.router._finalize_external(req, "cancelled")
+
+    # -- the worker thread --------------------------------------------- #
+
+    def _finalize(self, req, status: str) -> None:
+        self._owned.pop(req.uid, None)
+        self.router._finalize_external(req, status)
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    req = self.q.get(timeout=0.02)
+                except queue.Empty:
+                    continue
+                batch = [req]
+                while True:            # batch everything already queued
+                    try:
+                        batch.append(self.q.get_nowait())
+                    except queue.Empty:
+                        break
+                for r in batch:
+                    self._owned[r.uid] = r
+                self._process(batch)
+        except BaseException as exc:   # surface at router drain()/close()
+            self.exc = exc
+            for req in list(self._owned.values()):
+                self._finalize(req, "cancelled")
+
+    def _process(self, batch: List) -> None:
+        e = self.replica.engine
+        pending = list(batch)
+        while pending:
+            live = []
+            while pending:
+                req = pending[0]
+                if req.cancelled:
+                    self._finalize(req, "cancelled")
+                    pending.pop(0)
+                    continue
+                if not e.can_schedule([req.uid], [len(req.prompt)]):
+                    if not live:
+                        # router.submit validated the prompt against the
+                        # pool, so an empty engine always fits one — a
+                        # stuck full pool here is a real bug, not load
+                        raise RuntimeError(
+                            f"prefill replica {self.replica.name!r} cannot "
+                            f"fit prompt of {len(req.prompt)} tokens")
+                    break              # drain what we have, then continue
+                t = time.perf_counter()
+                if _tracer.enabled:
+                    _tracer.add("serve/req/queued", req.arrival_t, t,
+                                lane=f"serve/req/u{req.uid}", uid=req.uid)
+                e.scheduler.add_tokens(req.uid, req.prompt)
+                req.status = "prefill"
+                req._phase_t0 = t
+                live.append(req)
+                pending.pop(0)
+            self._prefill_and_handoff(live)
+
+    def _prefill_and_handoff(self, live: List) -> None:
+        e = self.replica.engine
+        t0 = time.perf_counter()
+        tokens = sum(len(r.prompt) for r in live)
+        while e.scheduler.has_pending():
+            e._run_pass()
+            for req in live:
+                if req.cancelled and req.status == "prefill":
+                    e.flush([req.uid])
+                    self._finalize(req, "cancelled")
+        live = [r for r in live if r.status == "prefill"]
+        t1 = time.perf_counter()
+        if live:
+            # same loop-observed cadence the colocated frontend feeds its
+            # cost model — the router's federation reads this replica's rate
+            self.router._note_prefill(self.replica, tokens, t1 - t0)  # jaxlint: disable=JL001
+        for req in live:
+            if _tracer.enabled:
+                _tracer.add("serve/req/prefill", req._phase_t0, t1,
+                            lane=f"serve/req/u{req.uid}", uid=req.uid)
+            h0 = time.perf_counter()
+            pages, logits = e.export_kv(req.uid)
+            target = self.router._pick_decode()
+            target.frontend.submit_handoff(req, pages, logits)
+            self._owned.pop(req.uid, None)
+            self.router._note_handoff(self.replica, target, req,
+                                      int(pages.nbytes), h0)

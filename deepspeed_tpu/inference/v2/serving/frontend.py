@@ -172,9 +172,13 @@ class ServingFrontend:
         # the handle
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        # cross-replica handoffs awaiting KV import (engine thread only):
+        # (req, pages, logits) tuples held until the pool can fund them
+        self._handoffs: List[tuple] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._loop_exc: Optional[BaseException] = None
+        self._closed = False
 
     # ------------------------------------------------------------------ #
     # client surface (any thread / asyncio)
@@ -186,29 +190,13 @@ class ServingFrontend:
         """Enqueue one request; returns immediately with its stream handle.
         ``priority`` names a configured class; admission decides admit /
         hold / shed against that class's TTFT/TBT SLOs."""
+        if self._closed:
+            raise RuntimeError("frontend is closed")
         cls = self.config.get_class(priority)
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("empty prompt")
-        sm = self.engine.config.state_manager
-        # every run-boundary reservation must fit max_context: a row one
-        # token from its budget still funds a whole slice at run start
-        # (speculative slices reserve decode_slice * (k + 1) + 1)
-        slice_tokens = self.admission.slice_tokens
-        need = len(prompt) + max_new_tokens + slice_tokens
-        if need > sm.max_context:
-            raise ValueError(
-                f"prompt ({len(prompt)}) + max_new_tokens ({max_new_tokens}) "
-                f"+ slice reservation ({slice_tokens}) = {need} "
-                f"exceeds max_context {sm.max_context}")
-        bs = self.engine.kv.config.block_size
-        if -(-need // bs) > self.engine.allocator.total_blocks:
-            # a request whose KV lifetime can NEVER fit the pool would be
-            # admitted optimistically, grow, be preempted, and then wedge
-            # forever un-restorable — reject it up front
-            raise ValueError(
-                f"request needs {-(-need // bs)} KV blocks at its budget but "
-                f"the pool holds {self.engine.allocator.total_blocks}")
+        self.check_budget(len(prompt), int(max_new_tokens))
         req = RequestHandle(next(self._uid_iter), prompt, cls,
                             int(max_new_tokens), eos_token_id,
                             time.perf_counter())
@@ -216,6 +204,54 @@ class ServingFrontend:
             self._inflight += 1
         self._ctl.put(("submit", req))
         return req
+
+    def check_budget(self, n_prompt: int, max_new_tokens: int,
+                     max_context: Optional[int] = None,
+                     total_blocks: Optional[int] = None) -> None:
+        """Raise ValueError unless a request of this shape can EVER be
+        served here: every run-boundary reservation must fit max_context (a
+        row one token from its budget still funds a whole slice at run
+        start; speculative slices reserve ``decode_slice * (k + 1) + 1``),
+        and the full KV lifetime must fit the pool — a request admitted
+        optimistically past it would grow, be preempted, and wedge forever
+        un-restorable. ONE home for the budget math: ``submit`` checks this
+        frontend, and a ``ServingRouter`` passes the WEAKEST decode
+        replica's ``max_context``/``total_blocks`` so a handoff can land on
+        any of them."""
+        sm = self.engine.config.state_manager
+        if max_context is None:
+            max_context = sm.max_context
+        if total_blocks is None:
+            total_blocks = self.engine.allocator.total_blocks
+        slice_tokens = self.admission.slice_tokens
+        need = n_prompt + max_new_tokens + slice_tokens
+        if need > max_context:
+            raise ValueError(
+                f"prompt ({n_prompt}) + max_new_tokens ({max_new_tokens}) "
+                f"+ slice reservation ({slice_tokens}) = {need} "
+                f"exceeds max_context {max_context}")
+        bs = self.engine.kv.config.block_size
+        if -(-need // bs) > total_blocks:
+            raise ValueError(
+                f"request needs {-(-need // bs)} KV blocks at its budget but "
+                f"the pool holds {total_blocks}")
+
+    def submit_handoff(self, req: RequestHandle, pages, logits) -> None:
+        """Adopt a request PREFILLED ON ANOTHER REPLICA — the decode half of
+        the disaggregated prefill/decode topology (``serving/cluster.py``).
+        ``pages``/``logits`` are ``engine.export_kv``'s output from the
+        prefill engine; the engine thread imports them (``engine.import_kv``
+        — fresh pool ids, byte-exact content, re-seeded bootstrap row, the
+        same restore discipline preemption uses) once the pool funds the
+        pages plus a decode slice of growth, then admits the row directly to
+        the decode pipeline. The handle's stream/cancel/result semantics are
+        unchanged: tokens flow on this replica as if it had prefilled
+        locally."""
+        if self._closed:
+            raise RuntimeError("frontend is closed")
+        with self._inflight_lock:
+            self._inflight += 1
+        self._ctl.put(("handoff", (req, pages, logits)))
 
     @property
     def outstanding(self) -> int:
@@ -246,7 +282,13 @@ class ServingFrontend:
 
     def close(self) -> None:
         """Stop the engine thread and cancel whatever is still in flight
-        (KV flushed, offload buffers released, streams closed)."""
+        (KV flushed, offload buffers released, streams closed). Idempotent:
+        double-close and close-before-first-submit are no-ops — a cluster
+        teardown sweeping replicas must never trip over one it (or a test)
+        already closed. A died engine thread still raises, once, with the
+        teardown fully finished first."""
+        if self._closed:
+            return
         self._stop.set()
         if self._thread is not None:
             self._thread.join()
@@ -257,6 +299,7 @@ class ServingFrontend:
             self._teardown(req, CANCELLED)
         if self.offload is not None:
             self.offload.close()
+        self._closed = True
         if self._loop_exc is not None:
             exc, self._loop_exc = self._loop_exc, None
             raise RuntimeError("serving loop died") from exc
@@ -293,24 +336,38 @@ class ServingFrontend:
 
     def step(self) -> bool:
         """ONE frontend iteration: control drain -> cancellation sweep ->
-        admission plan -> prefill -> one decode slice. Public so tests and
-        deterministic bench phases can drive the loop synchronously (no
-        thread); returns False when the iteration found no work (idle)."""
+        handoff imports -> admission plan -> prefill -> one decode slice.
+        Public so tests and deterministic bench phases can drive the loop
+        synchronously (no thread); returns False when the iteration found
+        no work (idle)."""
         self._drain_control()
         self._sweep_cancels()
-        worked = self._admission_round()
+        worked = self._execute_handoffs()
+        worked = self._admission_round() or worked
         if self._pipe.uids:
             self._decode_slice()
             worked = True
         return worked
 
     def _handle(self, msg) -> None:
-        kind, req = msg
+        kind, payload = msg
         if kind == "submit":
+            req = payload
             self._reqs[req.uid] = req
             self.stats.record_submit(req.cls.name)
             if not self.admission.enqueue(req):
                 self._finalize(req, SHED)     # queue full: immediate shed
+        elif kind == "handoff":
+            req, pages, logits = payload
+            self._reqs[req.uid] = req
+            self.stats.record_submit(req.cls.name)
+            if len(self._handoffs) >= self.config.max_queue:
+                # back-pressure: every held handoff pins a full sequence's
+                # KV pages in host memory — past the same bound the local
+                # queue sheds at, shed rather than accumulate without limit
+                self._finalize(req, SHED)
+            else:
+                self._handoffs.append((req, pages, logits))
         # cancellation rides the handle's event (no message): the sweeps /
         # on_tokens observe it within one iteration, and an idle loop ticks
         # every idle_wait_s — disconnects are never waited on indefinitely
@@ -339,6 +396,10 @@ class ServingFrontend:
         uid = req.uid
         if req.status == QUEUED:
             self.admission.remove(req)
+        if self._handoffs:
+            # a handoff still awaiting import holds only host arrays — drop
+            # the record so a later import cannot resurrect a finalized uid
+            self._handoffs = [h for h in self._handoffs if h[0].uid != uid]
         if uid in self._live:
             self._pipe.retire([uid])
             del self._live[uid]
@@ -396,6 +457,67 @@ class ServingFrontend:
                 [req.prompt, np.asarray(req.tokens, np.int32)])])
         else:
             self._pipe.admit([req.uid])
+
+    # ------------------------------------------------------------------ #
+    # cross-replica handoffs (disaggregated prefill/decode)
+    # ------------------------------------------------------------------ #
+
+    def _execute_handoffs(self) -> bool:
+        """Import pending cross-replica handoffs the pool can fund: fresh
+        pages for the KV content plus one decode slice of growth, a decode
+        row and a tracked slot — the same budget math the admission plan
+        simulates, so a handoff never starves the live set's next slice.
+        Unfundable handoffs stay queued and retry next iteration (capacity
+        returns through retirement/preemption like any admission)."""
+        if not self._handoffs:
+            return False
+        sched = self.engine.scheduler
+        sm = self.engine.config.state_manager
+        slice_tokens = self.admission.slice_tokens
+        did = False
+        held = []
+        for rec in self._handoffs:
+            req, pages, logits = rec
+            if req.cancelled:
+                self._finalize(req, CANCELLED)
+                did = True
+                continue
+            need = len(pages) + self.admission._blocks(slice_tokens)
+            if need > self.engine.allocator.total_blocks:
+                # can NEVER fund on this replica (router validation should
+                # have caught it) — shed now rather than hold forever
+                self._finalize(req, SHED)
+                did = True
+                continue
+            budget = sched.available_blocks \
+                - sched.blocks_needed(list(self._live), slice_tokens)
+            if (need > budget
+                    or len(self._live) >= sm.max_ragged_sequence_count
+                    or len(sched.seqs) >= sm.max_tracked_sequences):
+                held.append(rec)
+                continue
+            t0 = time.perf_counter()
+            try:
+                self.engine.import_kv(req.uid, req.prompt, pages, logits)
+            except (ValueError, RuntimeError) as exc:
+                # a malformed/oversized handoff must close ONE stream, not
+                # kill the replica's serving loop (and every other stream)
+                from deepspeed_tpu.utils.logging import log_dist
+                log_dist(f"handoff import for uid {req.uid} failed: {exc}; "
+                         "shedding the request", ranks=[0])
+                self._finalize(req, SHED)
+                did = True
+                continue
+            t1 = time.perf_counter()
+            self._span(req, "handoff", t0, t1)
+            req.status = DECODING
+            req.admit_t = req._phase_t0 = t1
+            self.stats.record_admit(req.cls.name)
+            self._admit_pipe(req)
+            self._live[req.uid] = req
+            did = True
+        self._handoffs = held
+        return did
 
     # ------------------------------------------------------------------ #
     # admission round: execute the plan

@@ -29,6 +29,15 @@ page-aligned pinned-buffer pool NVMe swapping stages through
 (``runtime/swap_tensor/buffer_pool.py``), so steady-state preemption does
 zero host allocations; ``max_bytes`` caps residency — when exhausted, the
 frontend falls back to recompute-preemption per victim.
+
+The bucketed page round trip this module rides (``engine.fetch_pages`` /
+``put_pages``) doubles as the cluster's KV-TRANSFER FABRIC: the
+disaggregated prefill->decode handoff (``cluster.py``/``router.py``) moves
+a finished sequence's pages + bootstrap logits row between ENGINES with the
+same byte-exact contract — ``engine.export_kv`` is exactly this module's
+offload record shipped to a different pool, and ``engine.import_kv`` is its
+restore (fresh ids, re-seeded ``_last_logits``), tested below the router in
+tests/unit/test_serving_router.py.
 """
 
 from __future__ import annotations

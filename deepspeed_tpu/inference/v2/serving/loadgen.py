@@ -30,11 +30,19 @@ import numpy as np
 class WorkloadComponent:
     """One mixture component: requests of priority class ``cls`` arriving
     with probability ``weight`` (normalised over the mix), drawing prompt
-    and generation lengths uniformly from the given choices."""
+    and generation lengths uniformly from the given choices.
+
+    ``prefix_len > 0`` gives the component a SHARED PREFIX: one token block
+    of that length is drawn per component (seed-keyed, before any arrival —
+    see ``arrivals``) and prepended to every prompt the component emits,
+    ``prompt_lens`` then sizing only the unique tail. This is the traffic
+    shape cache-aware routing exists for (shared system prompts / few-shot
+    templates), and the ``serving_bench.py --router`` workload."""
     cls: str
     weight: float
     prompt_lens: Sequence[int]
     gen_lens: Sequence[int]
+    prefix_len: int = 0
 
 
 @dataclass
@@ -60,10 +68,22 @@ class PoissonLoadGen:
     def arrivals(self, n: Optional[int] = None,
                  duration: Optional[float] = None) -> List[Arrival]:
         """The deterministic arrival schedule: ``n`` requests, or as many as
-        land inside ``duration`` seconds (one of the two must be given)."""
+        land inside ``duration`` seconds (one of the two must be given).
+
+        A pure function of ``(seed, rate, mix, vocab)`` — the per-request
+        ``(class, prompt, arrival, max_new)`` stream is independent of what
+        the arrivals are later scored against, so a router-vs-direct
+        byte-equality gate replays the EXACT same workload on both sides
+        (tests/unit/test_serving_router.py pins this). Shared prefixes are
+        drawn first, in mix order, only for components that declare one —
+        an all-``prefix_len=0`` mix therefore reproduces the pre-prefix
+        stream for a given seed byte-for-byte."""
         if (n is None) == (duration is None):
             raise ValueError("pass exactly one of n / duration")
         rng = np.random.RandomState(self.seed)
+        prefixes = [rng.randint(0, self.vocab,
+                                size=(c.prefix_len,)).astype(np.int32)
+                    if c.prefix_len > 0 else None for c in self.mix]
         w = np.asarray([c.weight for c in self.mix], np.float64)
         w = w / w.sum()
         out: List[Arrival] = []
@@ -74,10 +94,13 @@ class PoissonLoadGen:
                 break
             if n is not None and len(out) >= n:
                 break
-            comp = self.mix[int(rng.choice(len(self.mix), p=w))]
+            ci = int(rng.choice(len(self.mix), p=w))
+            comp = self.mix[ci]
             plen = int(comp.prompt_lens[int(rng.randint(len(comp.prompt_lens)))])
             glen = int(comp.gen_lens[int(rng.randint(len(comp.gen_lens)))])
             prompt = rng.randint(0, self.vocab, size=(plen,)).astype(np.int32)
+            if prefixes[ci] is not None:
+                prompt = np.concatenate([prefixes[ci], prompt])
             out.append(Arrival(t=t, cls=comp.cls, prompt=prompt,
                                max_new_tokens=glen))
         return out
@@ -85,7 +108,8 @@ class PoissonLoadGen:
 
 def replay(frontend, arrivals: Sequence[Arrival], speed: float = 1.0) -> List:
     """Open-loop replay: submit each arrival at its scheduled wall-clock
-    time (divided by ``speed``) against a RUNNING frontend; returns the
+    time (divided by ``speed``) against a RUNNING frontend — or anything
+    with its ``submit`` signature, e.g. a ``ServingRouter`` — returning the
     request handles in arrival order. Late submissions (the loop fell
     behind) fire immediately — open-loop means the generator never waits
     for the server."""

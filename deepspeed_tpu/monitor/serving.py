@@ -138,6 +138,10 @@ class SpecDecodeStats:
     draft_ms: float = 0.0
     verify_ms: float = 0.0
     fetch_bytes: int = 0
+    #: replica label (set by ``serving/cluster.py``): when not None, event
+    #: names become ``serve/spec/<replica>/...`` so N replicas fanning into
+    #: one monitor backend stay distinguishable (never cleared by reset())
+    replica: Optional[str] = None
 
     def record_step(self, rows: int, proposed: int, accepted: int,
                     tokens: int, draft_s: float, verify_s: float,
@@ -170,18 +174,21 @@ class SpecDecodeStats:
         return self.tokens / self.steps if self.steps else 0.0
 
     def events(self, step: int = 0) -> List[Event]:
-        """``serve/spec/*`` monitor events (docs/SERVING.md glossary)."""
+        """``serve/spec/*`` monitor events (docs/SERVING.md glossary);
+        replica-labelled (``serve/spec/<replica>/*``) under a cluster."""
         n = max(1, self.steps)
+        pre = "serve/spec" if self.replica is None \
+            else f"serve/spec/{self.replica}"
         return [
-            ("serve/spec/steps", float(self.steps), step),
-            ("serve/spec/proposed", float(self.proposed), step),
-            ("serve/spec/accepted", float(self.accepted), step),
-            ("serve/spec/tokens", float(self.tokens), step),
-            ("serve/spec/acceptance_rate", self.acceptance_rate, step),
-            ("serve/spec/tokens_per_step", self.tokens_per_step, step),
-            ("serve/spec/draft_ms_per_step", self.draft_ms / n, step),
-            ("serve/spec/verify_ms_per_step", self.verify_ms / n, step),
-            ("serve/spec/fetch_bytes_per_step",
+            (f"{pre}/steps", float(self.steps), step),
+            (f"{pre}/proposed", float(self.proposed), step),
+            (f"{pre}/accepted", float(self.accepted), step),
+            (f"{pre}/tokens", float(self.tokens), step),
+            (f"{pre}/acceptance_rate", self.acceptance_rate, step),
+            (f"{pre}/tokens_per_step", self.tokens_per_step, step),
+            (f"{pre}/draft_ms_per_step", self.draft_ms / n, step),
+            (f"{pre}/verify_ms_per_step", self.verify_ms / n, step),
+            (f"{pre}/fetch_bytes_per_step",
              self.fetch_bytes / n, step),
         ]
 
@@ -216,9 +223,16 @@ class FrontendStats:
     ``serve/frontend/*`` monitor surface. Mutated only on the frontend's
     engine thread (single writer); the latency samples come from the SAME
     ``perf_counter`` stamps the per-request ``serve/req/*`` trace spans are
-    built from, so the dashboard and the timeline can never disagree."""
+    built from, so the dashboard and the timeline can never disagree.
 
-    def __init__(self, class_names: List[str]):
+    ``replica`` (set by ``serving/cluster.py``): when not None, event names
+    become ``serve/frontend/<replica>/...`` — N replicas' frontends fanning
+    into ONE monitor backend (one CSV) previously interleaved
+    indistinguishable rows."""
+
+    def __init__(self, class_names: List[str],
+                 replica: Optional[str] = None):
+        self.replica = replica
         self.classes: Dict[str, _ClassCounters] = {
             name: _ClassCounters() for name in class_names}
         self.queue_depth = 0               # gauge: pending after last round
@@ -259,20 +273,23 @@ class FrontendStats:
     def events(self, step: int = 0) -> List[Event]:
         """``serve/frontend/*`` monitor events: global gauges/counters plus
         per-class completion and latency percentiles (docs/SERVING.md
-        glossary)."""
+        glossary); replica-labelled (``serve/frontend/<replica>/*``) under
+        a cluster."""
         import numpy as np
+        base = "serve/frontend" if self.replica is None \
+            else f"serve/frontend/{self.replica}"
         out: List[Event] = [
-            ("serve/frontend/queue_depth", float(self.queue_depth), step),
-            ("serve/frontend/preemptions", float(self.preemptions), step),
-            ("serve/frontend/recompute_preemptions",
+            (f"{base}/queue_depth", float(self.queue_depth), step),
+            (f"{base}/preemptions", float(self.preemptions), step),
+            (f"{base}/recompute_preemptions",
              float(self.recompute_preemptions), step),
-            ("serve/frontend/restores", float(self.restores), step),
-            ("serve/frontend/offload_bytes", float(self.offload_bytes), step),
-            ("serve/frontend/restore_bytes", float(self.restore_bytes), step),
-            ("serve/frontend/forced_sheds", float(self.forced_sheds), step),
+            (f"{base}/restores", float(self.restores), step),
+            (f"{base}/offload_bytes", float(self.offload_bytes), step),
+            (f"{base}/restore_bytes", float(self.restore_bytes), step),
+            (f"{base}/forced_sheds", float(self.forced_sheds), step),
         ]
         for name, c in self.classes.items():
-            pre = f"serve/frontend/{name}"
+            pre = f"{base}/{name}"
             out += [
                 (f"{pre}/completed", float(c.completed), step),
                 (f"{pre}/shed", float(c.shed), step),
@@ -290,4 +307,69 @@ class FrontendStats:
                         (f"{pre}/{label}_p95_ms",
                          float(np.percentile(xs, 95)), step),
                     ]
+        return out
+
+
+class RouterStats:
+    """Aggregate counters for one ``ServingRouter``
+    (``inference/v2/serving/router.py``) — the ``serve/router/*`` monitor
+    surface. Placement counters (routed per replica, cache-hit blocks,
+    rebalances, router-level sheds) plus the disaggregation handoff traffic,
+    and per-class CLUSTER rollups computed from the registered replicas'
+    :class:`FrontendStats` at ``events()`` time — the cluster-goodput view
+    that no single replica's counters can provide. Placement counters are
+    mutated under the router's lock (submit may be called from any client
+    thread); the rollup only reads."""
+
+    def __init__(self, replica_names: List[str], class_names: List[str]):
+        self.routed: Dict[str, int] = {n: 0 for n in replica_names}
+        self.cache_hit_blocks = 0          # blocks cached at the CHOSEN replica
+        self.cache_hit_requests = 0        # requests routed onto a warm prefix
+        self.rebalances = 0                # cache-best replica overridden
+        self.router_sheds: Dict[str, int] = {c: 0 for c in class_names}
+        self.handoffs = 0                  # prefill->decode sequences moved
+        self.handoff_bytes = 0             # KV bytes over the page fabric
+        self._frontends: List[FrontendStats] = []
+
+    def register_frontend(self, stats: FrontendStats) -> None:
+        self._frontends.append(stats)
+
+    def events(self, step: int = 0) -> List[Event]:
+        """``serve/router/*`` monitor events (docs/SERVING.md "Multi-replica
+        & disaggregation" glossary)."""
+        out: List[Event] = [
+            ("serve/router/routed",
+             float(sum(self.routed.values())), step),
+            ("serve/router/cache_hit_blocks",
+             float(self.cache_hit_blocks), step),
+            ("serve/router/cache_hit_requests",
+             float(self.cache_hit_requests), step),
+            ("serve/router/rebalances", float(self.rebalances), step),
+            ("serve/router/sheds",
+             float(sum(self.router_sheds.values())), step),
+            ("serve/router/handoffs", float(self.handoffs), step),
+            ("serve/router/handoff_bytes", float(self.handoff_bytes), step),
+        ]
+        for name, n in self.routed.items():
+            out.append((f"serve/router/routed/{name}", float(n), step))
+        # per-class cluster rollup: sum over every registered replica
+        for cls in self.router_sheds:
+            completed = shed = tokens = slo = 0
+            for fs in self._frontends:
+                c = fs.classes.get(cls)
+                if c is None:
+                    continue
+                completed += c.completed
+                shed += c.shed
+                tokens += c.tokens
+                slo += c.slo_met
+            shed += self.router_sheds[cls]
+            pre = f"serve/router/{cls}"
+            out += [
+                (f"{pre}/completed", float(completed), step),
+                (f"{pre}/shed", float(shed), step),
+                (f"{pre}/tokens", float(tokens), step),
+                (f"{pre}/slo_met_fraction",
+                 slo / completed if completed else 0.0, step),
+            ]
         return out

@@ -44,6 +44,15 @@ timeout -k 10 300 python benchmarks/serving_bench.py --frontend --smoke \
 timeout -k 10 300 python benchmarks/serving_bench.py --spec --smoke \
     --spec-k 7 || exit 1
 
+# multi-replica router leg (docs/SERVING.md "Multi-replica &
+# disaggregation"): 2 replicas behind a ServingRouter on a seeded
+# shared-prefix Poisson stream, correctness gates only — every checked
+# stream byte-identical to a direct single-frontend run, at least one
+# forced prefill->decode KV handoff over the page fabric, zero
+# steady-state compiles on every replica; emits serve/router trace lanes
+timeout -k 10 300 python benchmarks/serving_bench.py --router --smoke \
+    || exit 1
+
 timeout -k 10 300 python benchmarks/train_bench.py --smoke || exit 1
 
 # offloaded-optimizer pipeline leg: serial vs overlapped host step through
@@ -64,8 +73,9 @@ timeout -k 10 300 python benchmarks/train_bench.py --smoke --trace-overhead \
 
 # the timelines the legs above emitted: schema-valid, spans from the train
 # pipeline, decode pipeline, serving-frontend request lanes, speculative
-# decode, checkpoint, and offload subsystems on distinct tracks, plus a
-# parseable flight-recorder dump from the --preempt kills
+# decode, multi-replica router, checkpoint, and offload subsystems on
+# distinct tracks, plus a parseable flight-recorder dump from the
+# --preempt kills
 timeout -k 10 120 python scripts/trace_check.py "$TRACE_DIR" \
-    --require train serve serve/req serve/spec ckpt train/offload \
-    --expect-crash || exit 1
+    --require train serve serve/req serve/spec serve/router ckpt \
+    train/offload --expect-crash || exit 1

@@ -946,6 +946,33 @@ def test_jl007_serving_frontend_path_policed():
         config=cfg) == []
 
 
+def test_jl007_router_cluster_paths_policed():
+    """The multi-replica router/cluster modules (serving/router.py +
+    serving/cluster.py) are hot-path policed by the SHIPPED config via the
+    serving/ prefix — a stray blocking fetch of handoff pages on the
+    routing path fires; the modules' actual discipline (dtype'd host
+    conversions, the engine-owned export/import drains) is clean."""
+    raw = _repo_config()
+    for rule in ("JL007", "JL008"):
+        hot = raw["rules"][rule]["options"]["hot_paths"]
+        for mod in ("deepspeed_tpu/inference/v2/serving/router.py",
+                    "deepspeed_tpu/inference/v2/serving/cluster.py"):
+            assert any(p in mod for p in hot), (rule, mod)
+    cfg = LintConfig(rules={"JL007": RuleSettings(
+        options=raw["rules"]["JL007"]["options"])})
+    src = textwrap.dedent("""
+        import numpy as np
+
+        def _prefill_and_handoff(self, live):
+            pages = np.asarray(self.engine.kv.kv)
+            return pages.tolist()
+    """)
+    findings = lint_text(
+        src, path="deepspeed_tpu/inference/v2/serving/router.py",
+        config=cfg)
+    assert rules_of(findings) == ["JL007", "JL007"]
+
+
 def test_jl007_spec_decode_path_policed():
     """The speculative-decoding subsystem (inference/v2/spec/) is hot-path
     policed by the SHIPPED config — a stray blocking fetch of the accept

@@ -598,6 +598,48 @@ def test_loop_crash_surfaces_and_unblocks_streams(model_params):
         fe.close()
 
 
+def test_close_idempotent_every_order(model_params):
+    """Double-close and close-before-first-submit are no-ops; submit after
+    close fails loudly instead of queueing into a dead loop."""
+    # close before start, twice
+    fe = _build_engine(model_params).serving_frontend()
+    fe.close()
+    fe.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        fe.submit(np.arange(4, dtype=np.int32), priority="hi")
+    # start -> close -> close, before any submit
+    e = _build_engine(model_params)
+    fe = e.serving_frontend().start()
+    fe.close()
+    fe.close()
+    # normal traffic, then double close: second is a no-op
+    fe = e.serving_frontend().start()
+    h = fe.submit(_prompt(_rng(), 8), priority="hi", max_new_tokens=2)
+    assert h.result(timeout=30.0) is not None
+    fe.close()
+    fe.close()
+    assert e.free_blocks == e.allocator.total_blocks
+
+
+def test_close_after_loop_death_raises_once(model_params):
+    """A died engine thread raises at the FIRST close; the second close is
+    an idempotent no-op (the error was already surfaced)."""
+    e = _build_engine(model_params)
+    fe = e.serving_frontend()
+    boom = RuntimeError("injected")
+
+    def bad_pass():
+        raise boom
+
+    e._run_pass = bad_pass
+    fe.start()
+    h = fe.submit(_prompt(_rng(), 8), priority="hi", max_new_tokens=2)
+    assert h.result(timeout=10.0) == []      # loop died, stream closed
+    with pytest.raises(RuntimeError, match="serving loop died"):
+        fe.close()
+    fe.close()                               # no re-raise, no re-teardown
+
+
 def test_submit_rejects_pool_impossible_request(model_params):
     """A request whose full KV lifetime cannot fit the pool is rejected at
     submit — admitted optimistically it would wedge un-restorable after its
