@@ -1086,6 +1086,197 @@ def run_router(on_tpu: bool, smoke: bool, seed: int = 0, reps: int = 3):
     return ok
 
 
+def _check_chaos_streams(engine, handles, limit, uid_base):
+    """Byte-equality under chaos: finished streams (MIGRATED ones first —
+    they are the point) vs direct decode_pipeline runs of the same prompts
+    on a forced-paged engine. Returns (checked, equal, migrated_checked)."""
+    finished = [h for h in handles if h.status == "finished"]
+    finished.sort(key=lambda h: -h.migrated)
+    check = finished[:limit]
+    equal = migrated = 0
+    for i, h in enumerate(check):
+        uid = uid_base + i
+        engine._put_nofetch([uid], [h.prompt])
+        out = engine.decode_pipeline([uid]).run(len(h.tokens))
+        engine.flush([uid])
+        if [int(t) for t in out[0]] == h.tokens:
+            equal += 1
+            migrated += bool(h.migrated)
+    return len(check), equal, migrated
+
+
+def run_chaos(on_tpu: bool, smoke: bool, seed: int = 0, reps: int = 3):
+    """The fault-tolerance leg (docs/SERVING.md "Failure semantics"),
+    BENCH_r14: N colocated replicas behind a health-monitored
+    ``ServingRouter`` replay a seeded Poisson workload while fault
+    injection KILLS one replica's serving loop (``serve.engine_step.<r>``
+    action=raise) and STALLS another's (action=stall past the down
+    deadline) mid-run. The monitor detects (liveness + progress-stall),
+    fences, migrates every in-flight stream, and auto-rejoins each replica
+    once its thread exits — re-warming off the hot path.
+
+    Gates, every rep:
+
+      - every checked non-shed stream byte-identical to an uninterrupted
+        direct decode_pipeline reference (forced-paged kernel discipline on
+        every engine AND the references, so migration re-prefill is
+        bit-equal — the gate tests exactly what failover changes: WHERE
+        the stream ran);
+      - both injected faults fired AND were detected (>=1 liveness down,
+        >=1 stall down), >=1 request migrated, the faulted replicas
+        rejoined and ended HEALTHY;
+      - ZERO engine compiles on every replica across the chaos replay —
+        including each rejoin's re-warm;
+      - allocator free blocks back to baseline on every replica after the
+        replay (survivors AND rejoined corpses);
+      - with ``DSTPU_TRACE`` set, the injected raise leaves a
+        flight-recorder crash dump (``trace_check --expect-crash`` in
+        bench_smoke validates it).
+
+    Full runs additionally gate goodput-under-SLO against an N-1-replica
+    NO-FAULT floor replayed on the same engines (median over reps):
+    losing-then-healing one replica must degrade gracefully toward the
+    floor, not collapse. Smoke: 2 replicas, one kill + one stall, one rep,
+    correctness gates only (<60 s warm)."""
+    from deepspeed_tpu.inference.v2.serving import (PoissonLoadGen,
+                                                    ServingCluster,
+                                                    ServingRouter,
+                                                    WorkloadComponent,
+                                                    goodput_report, replay)
+    from deepspeed_tpu.utils import fault_injection as fi
+    n_replicas = 2 if smoke else 3
+    engines = []
+    for _ in range(n_replicas):
+        e, vocab = build_frontend_engine(on_tpu, pool_blocks=20, ctx=192)
+        _force_paged(e)
+        engines.append(e)
+    health = {"enabled": True, "interval_s": 0.02,
+              "suspect_after_s": 0.4, "down_after_s": 1.0,
+              "fence_join_s": 0.5, "auto_rejoin": True}
+    # SLOs sized to this box's detection + migration window (the
+    # tight-interactive triage regime is the --frontend leg's subject;
+    # here goodput must track CAPACITY so the N-1 floor comparison
+    # measures graceful degradation, not SLO-accounting artifacts)
+    classes = [{"name": "interactive", "priority": 2,
+                "ttft_slo_ms": 5000.0, "tbt_slo_ms": 1500.0},
+               {"name": "batch", "priority": 0,
+                "ttft_slo_ms": 60000.0, "tbt_slo_ms": 20000.0}]
+    serving = {"classes": classes, "decode_slice": 4,
+               "idle_wait_s": 0.002}
+    rate, duration = (8.0, 3.5) if smoke else (20.0, 12.0)
+    mix = [WorkloadComponent("interactive", 4.0, [16, 32], [8, 16, 24]),
+           WorkloadComponent("batch", 1.0, [48], [64])]
+    arrivals = PoissonLoadGen(rate=rate, mix=mix, vocab=vocab,
+                              seed=seed).arrivals(duration=duration)
+    if smoke:
+        reps = 1
+    # one kill + one stall, aimed at distinct replicas mid-run; `at` counts
+    # the TARGET replica's own loop iterations (replica-scoped sites), so
+    # both fire early enough to leave room for detection + rejoin
+    stall_s = 1.5 if smoke else 2.0
+    plan = (f"serve.engine_step.r0:at=25:action=raise;"
+            f"serve.engine_step.r1:at=60:action=stall:delay_s={stall_s}")
+
+    def replay_once(engine_set, faults):
+        frees = [e.free_blocks for e in engine_set]
+        cluster = ServingCluster(engine_set, serving=serving)
+        rt = ServingRouter(cluster, {"policy": "round_robin",
+                                     "health": health})
+        c0 = [e.compiles for e in engine_set]
+        if faults:
+            fi.install(fi.parse_plan(faults, seed=seed))
+        try:
+            t0 = time.time()
+            rt.start()
+            handles = replay(rt, arrivals)
+            rt.drain(timeout=3.0 * duration + 20.0)
+            rt.health.wait_all_healthy(30.0)
+            wall = time.time() - t0
+            fired = list(fi.active().fired) if faults else []
+        finally:
+            fi.clear()
+        hs = rt.health.stats
+        rt.close()           # past-deadline stragglers cancel: 0 goodput
+        return {
+            "handles": handles, "wall": wall, "fired": fired,
+            "compiles": [e.compiles - c for e, c in zip(engine_set, c0)],
+            "free_ok": [e.free_blocks == f
+                        for e, f in zip(engine_set, frees)],
+            "health": hs, "all_healthy": rt.health.all_healthy(),
+            "report": goodput_report(handles, wall),
+        }
+
+    # untimed warm replay: absorbs every first-serving lazy cost so the
+    # zero-compile gate tests the chaos machinery, not cold starts
+    replay_once(engines, None)
+
+    ok = True
+    chaos_reps, floor_reps = [], []
+    trace_dir = os.environ.get("DSTPU_TRACE", "")
+    for r in range(reps):
+        res = replay_once(engines, plan)
+        hs = res["health"]
+        checked, equal, migrated_checked = _check_chaos_streams(
+            engines[-1], res["handles"], 16 if smoke else 40, 200_000)
+        crash_dump = (os.path.exists(os.path.join(
+            trace_dir, "trace_crash.json")) if trace_dir else None)
+        out = {
+            "leg": "chaos", "rep": r, "replicas": n_replicas,
+            "rate": rate, "duration": duration, "arrivals": len(arrivals),
+            "faults_fired": [f"{site}@{hit}:{act}"
+                             for site, hit, act in res["fired"]],
+            "liveness_downs": hs.liveness_downs,
+            "stall_downs": hs.stall_downs,
+            "migrations": hs.migrations,
+            "salvaged": hs.salvaged,
+            "reprefilled": hs.reprefilled,
+            "migration_sheds": hs.migration_sheds,
+            "rejoins": hs.rejoins,
+            "detect_p95_ms": (round(float(np.percentile(
+                np.asarray(hs.detect_ms, np.float64), 95)), 1)
+                if hs.detect_ms else None),
+            "all_healthy_after": res["all_healthy"],
+            "streams_checked": checked, "streams_equal": equal,
+            "migrated_streams_checked": migrated_checked,
+            "outputs_equal": equal == checked,
+            "compiles_during_timed": res["compiles"],
+            "allocator_at_baseline": res["free_ok"],
+            "flight_recorder_dump": crash_dump,
+            **res["report"],
+        }
+        chaos_reps.append(out)
+        print(json.dumps(out), flush=True)
+        if not out["outputs_equal"] or any(c != 0 for c in res["compiles"]) \
+                or not all(res["free_ok"]) or not res["all_healthy"] \
+                or hs.liveness_downs < 1 or hs.stall_downs < 1 \
+                or hs.migrations < 1 or hs.rejoins < 2:
+            ok = False
+        if crash_dump is False:
+            ok = False
+        if not smoke:
+            floor = replay_once(engines[:-1], None)
+            fout = {"leg": "chaos_floor", "rep": r,
+                    "replicas": n_replicas - 1,
+                    "compiles_during_timed": floor["compiles"],
+                    **floor["report"]}
+            floor_reps.append(fout)
+            print(json.dumps(fout), flush=True)
+            if any(c != 0 for c in floor["compiles"]):
+                ok = False
+    if not smoke:
+        med_chaos = float(np.median([x["goodput_tokens_per_sec"]
+                                     for x in chaos_reps]))
+        med_floor = float(np.median([x["goodput_tokens_per_sec"]
+                                     for x in floor_reps]))
+        gate = med_chaos >= 0.7 * med_floor and med_chaos > 0
+        print(json.dumps({"gate": "chaos_goodput_floor", "ok": bool(gate),
+                          "median_goodput_chaos": med_chaos,
+                          "median_goodput_n_minus_1_floor": med_floor,
+                          "bar": "chaos >= 0.7 x floor"}), flush=True)
+        ok = ok and gate
+    return ok
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seqs", type=int, default=None,
@@ -1133,6 +1324,16 @@ def main():
                          "(handoffs + decode TBT), gating stream "
                          "byte-equality vs direct single-frontend runs and "
                          "zero steady-state compiles per replica")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the fault-tolerance leg: N replicas behind a "
+                         "health-monitored router replay a seeded Poisson "
+                         "workload while injected faults kill one serving "
+                         "loop and stall another — gating byte-identical "
+                         "non-shed streams vs uninterrupted references, "
+                         "detection of both failure modes, zero compiles "
+                         "incl. rejoin re-warm, allocator baseline on every "
+                         "replica, and (full) goodput >= 0.7x an "
+                         "N-1-replica no-fault floor")
     ap.add_argument("--spec", action="store_true",
                     help="run the speculative-decoding leg: spec-off "
                          "DecodePipeline vs draft-and-verify "
@@ -1182,6 +1383,9 @@ def main():
         args.seqs = 32
     if args.prompt is None:
         args.prompt = 128
+    if args.chaos:
+        ok = run_chaos(on_tpu, args.smoke, reps=args.reps)
+        sys.exit(0 if ok else 1)
     if args.router:
         ok = run_router(on_tpu, args.smoke, reps=args.reps)
         sys.exit(0 if ok else 1)

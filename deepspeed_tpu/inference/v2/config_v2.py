@@ -328,6 +328,51 @@ class ServingConfig:
 
 
 @dataclass
+class HealthConfig:
+    """Replica failure detection + self-healing for the multi-replica router
+    (``inference/v2/serving/health.py``; docs/SERVING.md "Failure
+    semantics"). Off by default: a router without health monitoring keeps
+    the PR 10 behavior — a dead replica surfaces NAMED at
+    ``drain()``/``close()`` instead of being failed over.
+
+    When ``enabled``, a ``dstpu-health`` thread polls every ``interval_s``:
+    engine-thread/prefill-worker LIVENESS (a died loop is ``down``
+    immediately) plus a PROGRESS heartbeat — the decode-step counter the
+    pipeline stats already track (and prefill tokens completed) — so a
+    *wedged* replica is detected, not just a dead one. A replica with work
+    in flight whose counters stop moving turns ``suspect`` after
+    ``suspect_after_s`` and ``down`` after ``down_after_s``; detection
+    fences the replica (its loop emits nothing further), migrates every
+    in-flight request to a survivor, and — with ``auto_rejoin`` — rebuilds
+    a frontend on the engine once its old thread has exited, re-warming the
+    pow2 program grids off the hot path (``rejoin_warmup``) before the
+    replica re-enters routing.
+
+    ``fence_join_s`` bounds how long failover waits for the failed engine
+    thread to exit before migrating anyway (streams stay exact either way:
+    migration seals each handle under its emit lock, and a fenced loop
+    drops every later emission)."""
+    enabled: bool = False
+    interval_s: float = 0.05
+    suspect_after_s: float = 1.0
+    down_after_s: float = 3.0
+    fence_join_s: float = 1.0
+    auto_rejoin: bool = True
+    rejoin_warmup: bool = True
+
+    def __post_init__(self):
+        for f in ("interval_s", "suspect_after_s", "down_after_s",
+                  "fence_join_s"):
+            if getattr(self, f) <= 0:
+                raise ValueError(f"health.{f} must be > 0, got "
+                                 f"{getattr(self, f)}")
+        if self.down_after_s < self.suspect_after_s:
+            raise ValueError(
+                f"health.down_after_s ({self.down_after_s}) must be >= "
+                f"suspect_after_s ({self.suspect_after_s})")
+
+
+@dataclass
 class RouterConfig:
     """The multi-replica serving router (``inference/v2/serving/router.py``;
     docs/SERVING.md "Multi-replica & disaggregation"). Cluster-level — it
@@ -360,14 +405,41 @@ class RouterConfig:
     predicted TTFT already busts the class SLO is skipped while a cold one
     absorbs, and the router sheds up front when EVERY candidate is hot
     (``shed_factor`` scales the SLO bound exactly like
-    ``ServingConfig.shed_factor``)."""
+    ``ServingConfig.shed_factor``).
+
+    ``health``: replica failure detection + self-healing
+    (:class:`HealthConfig`; docs/SERVING.md "Failure semantics").
+
+    ``handoff_retries`` / ``handoff_timeout_s`` / ``handoff_backoff_s``:
+    bounded-retry budget for the disaggregated prefill->decode handoff
+    (``utils/resilience.retry_call`` semantics). Each attempt is
+    deadline-wrapped (``IOTimeout`` past ``handoff_timeout_s`` — a wedged
+    decode replica must not stall the prefill worker unboundedly) and
+    re-planned against a DIFFERENT decode replica; a request that exhausts
+    the budget is shed with the error NAMED on its handle
+    (``RequestHandle.error``), never swallowed."""
     policy: str = "cache_aware"
     balance: float = 32.0
     topology: str = "colocated"
     federation: bool = True
     shed_factor: float = 1.0
+    health: Any = field(default_factory=HealthConfig)
+    handoff_retries: int = 3
+    handoff_timeout_s: Optional[float] = 30.0
+    handoff_backoff_s: float = 0.05
 
     def __post_init__(self):
+        if isinstance(self.health, dict):
+            self.health = HealthConfig(**self.health)
+        if self.handoff_retries < 1:
+            raise ValueError("router.handoff_retries must be >= 1, got "
+                             f"{self.handoff_retries}")
+        if self.handoff_timeout_s is not None and self.handoff_timeout_s <= 0:
+            raise ValueError("router.handoff_timeout_s must be > 0 (or "
+                             f"None), got {self.handoff_timeout_s}")
+        if self.handoff_backoff_s < 0:
+            raise ValueError("router.handoff_backoff_s must be >= 0, got "
+                             f"{self.handoff_backoff_s}")
         if self.policy not in ("cache_aware", "round_robin"):
             raise ValueError("router.policy must be 'cache_aware' or "
                              f"'round_robin', got {self.policy!r}")

@@ -44,6 +44,7 @@ from deepspeed_tpu.inference.v2.scheduler import DynamicSplitFuseScheduler
 from deepspeed_tpu.monitor.trace import install_from_env as _trace_from_env
 from deepspeed_tpu.monitor.trace import tracer as _tracer
 from deepspeed_tpu.utils.caching import LRUCache, next_pow2
+from deepspeed_tpu.utils.fault_injection import maybe_fail as _maybe_fail
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -887,6 +888,7 @@ class InferenceEngineV2:
         preempts a victim), drained through the policed ``fetch_to_host``
         like every other v2 fetch."""
         ids = [int(b) for b in blocks]
+        _maybe_fail("serve.kv_fetch")      # chaos site: page-fabric gather
         gather, _ = self._page_programs()
         bucket = self._page_bucket("gather", len(ids))
         idx = np.full((bucket,), self.scratch_block, np.int32)
@@ -902,6 +904,7 @@ class InferenceEngineV2:
         ids = [int(b) for b in blocks]
         if not ids:
             return
+        _maybe_fail("serve.kv_put")        # chaos site: page-fabric scatter
         _, scatter = self._page_programs()
         bucket = self._page_bucket("scatter", len(ids))
         idx = np.full((bucket,), self.scratch_block, np.int32)
@@ -972,13 +975,15 @@ class InferenceEngineV2:
         """Scatter one host page back into pool slot ``block``."""
         self.put_pages(page[None], [block])
 
-    def serving_frontend(self, config=None):
+    def serving_frontend(self, config=None, uid_base: int = 1 << 20):
         """The persistent SLO-aware serving frontend over this engine
         (``serving/frontend.py``): asyncio-facing ``submit() -> token
         stream``, multi-tenant admission with priority classes, and
-        KV offload-preemption. ``config`` overrides ``self.config.serving``."""
+        KV offload-preemption. ``config`` overrides ``self.config.serving``;
+        ``uid_base`` keeps a cluster's frontends in disjoint uid spaces
+        (``serving/cluster.py``)."""
         from deepspeed_tpu.inference.v2.serving import ServingFrontend
-        return ServingFrontend(self, config=config)
+        return ServingFrontend(self, config=config, uid_base=uid_base)
 
     # ------------------------------------------------------------------ #
     # prefix-cache support

@@ -1,7 +1,7 @@
 """The SLO-aware serving stack — PAPER.md layer 6 (MII/FastGen) over
 ``InferenceEngineV2``, from one frontend to an N-replica cluster.
 
-Six modules:
+Seven modules:
 
 - ``frontend.py`` — ``ServingFrontend``: persistent engine thread driving
   iteration-level continuous batching over ``engine.decode_pipeline``;
@@ -23,6 +23,9 @@ Six modules:
 - ``router.py`` — ``ServingRouter``: cache-aware routing over a shared
   radix-prefix chain index, federated SLO admission, disaggregated
   prefill->decode handoff.
+- ``health.py`` — ``HealthMonitor``: replica failure detection (liveness +
+  decode-progress stall deadlines), request failover with KV salvage over
+  the page fabric, self-healing rejoin with off-hot-path re-warm.
 
 docs/SERVING.md ("Frontend", "Multi-replica & disaggregation") walks the
 design; ``serve/frontend/*``, ``serve/router/*`` counters and
@@ -36,6 +39,10 @@ from deepspeed_tpu.inference.v2.serving.cluster import (PrefillWorker,
                                                         ServingCluster)
 from deepspeed_tpu.inference.v2.serving.frontend import (RequestHandle,
                                                          ServingFrontend)
+from deepspeed_tpu.inference.v2.serving.health import (DOWN, DRAINING,
+                                                       HEALTHY, REJOINING,
+                                                       SUSPECT,
+                                                       HealthMonitor)
 from deepspeed_tpu.inference.v2.serving.kv_offload import KVOffloadManager
 from deepspeed_tpu.inference.v2.serving.loadgen import (Arrival,
                                                         PoissonLoadGen,

@@ -30,12 +30,16 @@ Roles: ``"serve"`` (prefill + decode — the colocated default),
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
 from deepspeed_tpu.monitor.trace import tracer as _tracer
+from deepspeed_tpu.utils.fault_injection import maybe_fail
+from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.resilience import call_with_deadline
 
 _ROLES = ("serve", "prefill", "decode")
 
@@ -89,13 +93,24 @@ class ServingCluster:
                     f"{names[0]!r} on {mismatched} — cross-replica KV "
                     "handoff would not be byte-exact")
         self.replicas: List[Replica] = []
+        # disjoint per-frontend uid spaces ((1 << 24)-spaced — 16.7M
+        # requests per frontend lifetime): a request migrated off a failed
+        # replica keeps its uid on the survivor, so two frontends must
+        # never mint the same one; a rejoin-rebuilt frontend draws a FRESH
+        # space (alloc_uid_base) for the same reason
+        self._uid_spaces = itertools.count(1)
         for engine, role, name in zip(engines, roles, names):
             frontend = None
             if role != "prefill":
-                frontend = engine.serving_frontend(config=serving)
+                frontend = engine.serving_frontend(
+                    config=serving, uid_base=self.alloc_uid_base())
                 frontend.stats.replica = name
             engine.spec_stats.replica = name
             self.replicas.append(Replica(name, engine, role, frontend))
+
+    def alloc_uid_base(self) -> int:
+        """A fresh, never-reused uid space for one frontend lifetime."""
+        return (1 << 24) * next(self._uid_spaces)
 
     # ------------------------------------------------------------------ #
 
@@ -129,20 +144,29 @@ class ServingCluster:
                        f"{[r.name for r in self.replicas]}")
 
     def start(self) -> "ServingCluster":
+        """Start every replica frontend (idempotent per frontend — a bench
+        or test may warm frontends before handing the cluster to a
+        router)."""
         for r in self.frontends:
-            r.frontend.start()
+            if r.frontend._thread is None and not r.frontend._closed:
+                r.frontend.start()
         return self
 
-    def close(self) -> None:
+    def close(self, ignore: Sequence[str] = ()) -> None:
         """Close every frontend; the FIRST replica whose close raises (a
         died engine thread) is re-raised NAMED after all replicas are torn
-        down — a dead replica must not leave its siblings running."""
+        down — a dead replica must not leave its siblings running.
+        ``ignore`` names replicas whose failure was already HANDLED (the
+        router's health monitor migrated their requests) — their close
+        still runs, but a died-loop re-raise is suppressed rather than
+        reported twice."""
         failed = []
         for r in self.frontends:
             try:
                 r.frontend.close()
             except BaseException as exc:
-                failed.append((r.name, exc))
+                if r.name not in ignore:
+                    failed.append((r.name, exc))
         if failed:
             name, exc = failed[0]
             raise RuntimeError(f"replica {name!r} failed at close") from exc
@@ -174,14 +198,38 @@ class PrefillWorker:
         # these streams, never one a decode replica already adopted
         self._owned: Dict[int, object] = {}
         self._stop = threading.Event()
+        self._fenced = False
+        self._site = f"serve.prefill_worker.{replica.name}"
         self._thread: Optional[threading.Thread] = None
 
     @property
     def queued(self) -> int:
         return self.q.qsize()
 
+    @property
+    def fenced(self) -> bool:
+        return self._fenced
+
     def submit(self, req) -> None:
+        if self._fenced:
+            raise RuntimeError(
+                f"prefill worker {self.replica.name!r} is fenced")
         self.q.put(req)
+
+    def fence(self) -> None:
+        """Declare this worker DOWN (serving/health.py): even a wedged
+        thread that wakes later bails at the next batch/pass boundary
+        without exporting or handing anything off — its queue and owned
+        requests now belong to the failover migration."""
+        self._fenced = True
+        self._stop.set()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        t = self._thread
+        if t is None:
+            return True
+        t.join(timeout)
+        return not t.is_alive()
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -221,18 +269,37 @@ class PrefillWorker:
                         batch.append(self.q.get_nowait())
                     except queue.Empty:
                         break
+                # own the batch BEFORE the chaos site: a crash (or wedge)
+                # here must leave every popped request reachable by the
+                # failover sweep, never stranded in a dead thread's locals
                 for r in batch:
                     self._owned[r.uid] = r
+                # chaos site (raise = crash this worker, stall = wedge it);
+                # the fence check follows so a stalled thread that wakes
+                # post-failover re-queues the batch untouched and exits
+                maybe_fail(self._site)
+                if self._fenced:
+                    for r in batch:    # migration drains the queue
+                        self._owned.pop(r.uid, None)
+                        self.q.put(r)
+                    return
                 self._process(batch)
         except BaseException as exc:   # surface at router drain()/close()
+            # (or at the health monitor, which migrates _owned instead)
             self.exc = exc
-            for req in list(self._owned.values()):
-                self._finalize(req, "cancelled")
+            if not self.router.health.enabled:
+                for req in list(self._owned.values()):
+                    self._finalize(req, "cancelled")
 
     def _process(self, batch: List) -> None:
         e = self.replica.engine
         pending = list(batch)
         while pending:
+            if self._fenced:
+                for req in pending:    # migration takes them back
+                    self._owned.pop(req.uid, None)
+                    self.q.put(req)
+                return
             live = []
             while pending:
                 req = pending[0]
@@ -280,10 +347,85 @@ class PrefillWorker:
             if _tracer.enabled:
                 _tracer.add("serve/req/prefill", req._phase_t0, t1,
                             lane=f"serve/req/u{req.uid}", uid=req.uid)
-            h0 = time.perf_counter()
-            pages, logits = e.export_kv(req.uid)
-            target = self.router._pick_decode()
-            target.frontend.submit_handoff(req, pages, logits)
+            self._handoff(req)
+
+    def _handoff(self, req) -> None:
+        """Export one prefilled sequence and hand it to a decode replica
+        under the router's bounded retry/timeout budget
+        (``RouterConfig.handoff_retries`` / ``handoff_timeout_s`` /
+        ``handoff_backoff_s``; ``utils/resilience``): each attempt is
+        deadline-wrapped (a wedged decode replica raises
+        :class:`~deepspeed_tpu.utils.resilience.IOTimeout` here instead of
+        stalling this worker unboundedly) and RE-PLANNED against a decode
+        replica the earlier attempts have not seen fail. A request that
+        exhausts the budget is shed with the error NAMED on its handle
+        (``req.error`` — re-raised by ``result()``), never swallowed."""
+        e = self.replica.engine
+        cfg = self.router.config
+        h0 = time.perf_counter()
+        pages, logits = e.export_kv(req.uid)
+        tried: List[str] = []
+        delay = cfg.handoff_backoff_s
+        last: Optional[BaseException] = None
+        for attempt in range(cfg.handoff_retries):
+            try:
+                # prefer a replica earlier attempts have NOT seen fail;
+                # with every one tried (or only one configured), retry the
+                # least-loaded anyway — attempt-scoped faults are transient
+                try:
+                    target = self.router._pick_decode(exclude=tried)
+                except LookupError:
+                    target = self.router._pick_decode()
+            except LookupError as exc:
+                last = exc
+                break
+            # `abandoned` makes a timed-out attempt inert: if the wedged
+            # call wakes after we moved on, it must not ALSO submit — two
+            # replicas serving one stream is worse than a retry. The lock
+            # makes submit-vs-abandon atomic: a late waker either finds
+            # `abandoned` set and raises, or its submit LANDED before the
+            # flag flipped — in which case `submitted` tells this loop the
+            # attempt actually succeeded and there is nothing to retry.
+            state = {"abandoned": False, "submitted": False}
+            state_lock = threading.Lock()
+
+            def _attempt(target=target, state=state):
+                maybe_fail("serve.handoff")
+                maybe_fail(f"serve.handoff.{self.replica.name}")
+                with state_lock:
+                    if state["abandoned"]:
+                        raise RuntimeError("handoff attempt abandoned "
+                                           "after timeout")
+                    target.frontend.submit_handoff(req, pages, logits)
+                    state["submitted"] = True
+
+            try:
+                call_with_deadline(
+                    _attempt, cfg.handoff_timeout_s,
+                    describe=f"handoff uid {req.uid} "
+                             f"{self.replica.name!r}->{target.name!r}")
+            except (OSError, RuntimeError) as exc:   # incl. IOTimeout,
+                with state_lock:                     # InjectedFault, fenced
+                    state["abandoned"] = True
+                    landed = state["submitted"]
+                if not landed:
+                    last = exc
+                    tried.append(target.name)
+                    if attempt < cfg.handoff_retries - 1:
+                        time.sleep(delay)
+                        delay *= 2.0
+                    continue
             self._owned.pop(req.uid, None)
             self.router._note_handoff(self.replica, target, req,
                                       int(pages.nbytes), h0)
+            return
+        err = RuntimeError(
+            f"handoff of request {req.uid} from prefill replica "
+            f"{self.replica.name!r} exhausted its retry budget "
+            f"({cfg.handoff_retries} attempts, tried {tried or 'none'})")
+        err.__cause__ = last
+        req.error = err
+        log_dist(f"{err} — shedding the request", ranks=[0])
+        with self.router._lock:
+            self.router.stats.handoff_failures += 1
+        self._finalize(req, "shed")

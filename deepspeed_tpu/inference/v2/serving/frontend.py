@@ -47,6 +47,7 @@ from deepspeed_tpu.inference.v2.serving.admission import AdmissionController
 from deepspeed_tpu.inference.v2.serving.kv_offload import KVOffloadManager
 from deepspeed_tpu.monitor.serving import FrontendStats
 from deepspeed_tpu.monitor.trace import tracer as _tracer
+from deepspeed_tpu.utils.fault_injection import maybe_fail
 
 _DONE = object()      # stream sentinel
 
@@ -81,9 +82,19 @@ class RequestHandle:
         self.ttft_ms: Optional[float] = None
         self.tbt_ms: List[float] = []       # gaps between streamed tokens
         self.preemptions = 0
+        self.migrated = 0                   # replica-failure migrations
+        #: a named, non-swallowed failure (e.g. an exhausted disaggregated
+        #: handoff retry budget) — re-raised by result()
+        self.error: Optional[BaseException] = None
         self._q: "queue.Queue" = queue.Queue()
         self._cancel = threading.Event()
         self._finished = threading.Event()
+        # migration seal (serving/health.py): emission happens under this
+        # lock, and failover takes it to seal the handle + snapshot
+        # ``tokens`` at one exact instant — the stream a survivor resumes
+        # from can never race a straggling emission off the dead replica
+        self._emit_lock = threading.Lock()
+        self._sealed = False
         # engine-thread bookkeeping (phase stamps for spans + victim order)
         self.admit_t: Optional[float] = None
         self.preempt_t: Optional[float] = None
@@ -126,16 +137,30 @@ class RequestHandle:
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         """Block until the request reaches a terminal state; returns the
-        generated tokens (possibly partial for cancelled/shed requests)."""
+        generated tokens (possibly partial for cancelled/shed requests).
+        A request shed with a NAMED failure (``self.error``, e.g. an
+        exhausted handoff retry budget) re-raises it here — surfaced, never
+        swallowed."""
         if not self._finished.wait(timeout):
             raise TimeoutError(f"request {self.uid} still {self.status} "
                                f"after {timeout}s")
+        if self.error is not None:
+            raise self.error
         return list(self.tokens)
+
+    def _seal(self) -> "np.ndarray":
+        """Seal emission and snapshot ``prompt + tokens`` atomically — the
+        exact resume point a failover migration continues from
+        (serving/health.py). The survivor unseals on adoption."""
+        with self._emit_lock:
+            self._sealed = True
+            return np.concatenate(
+                [self.prompt, np.asarray(self.tokens, np.int32)])
 
 
 class ServingFrontend:
 
-    def __init__(self, engine, config=None):
+    def __init__(self, engine, config=None, uid_base: int = 1 << 20):
         cfg = config if config is not None else engine.config.serving
         if isinstance(cfg, dict):
             cfg = ServingConfig(**cfg)
@@ -165,7 +190,10 @@ class ServingFrontend:
         self._live: Dict[int, RequestHandle] = {}       # in the pipeline
         self._preempted: Dict[int, RequestHandle] = {}
         self._run_stopped: List[RequestHandle] = []     # retired mid-run
-        self._uid_iter = itertools.count(1 << 20)       # thread-safe counter
+        # thread-safe counter; ``uid_base`` keeps a cluster's frontends
+        # (including a rejoin-rebuilt one) in DISJOINT uid spaces so a
+        # migrated request can never collide on its new replica
+        self._uid_iter = itertools.count(int(uid_base))
         # in-flight count bumped in submit() BEFORE the control message is
         # posted: drain() polling len(_reqs)/_ctl alone races the window
         # where the engine thread has popped the message but not yet filed
@@ -179,6 +207,16 @@ class ServingFrontend:
         self._thread: Optional[threading.Thread] = None
         self._loop_exc: Optional[BaseException] = None
         self._closed = False
+        # fenced = declared down by a health monitor: the loop (even a
+        # wedged one that wakes later) must emit nothing further — every
+        # in-flight stream now belongs to the replica it migrated to
+        self._fenced = False
+        # managed = a router health monitor owns this frontend's failure
+        # handling: a crashed loop must NOT close its streams (that would
+        # terminate clients the monitor is about to migrate)
+        self._managed = False
+        self._fault_site = "serve.engine_step"          # set at start()
+        self._close_listeners: List = []                # called at close()
 
     # ------------------------------------------------------------------ #
     # client surface (any thread / asyncio)
@@ -190,8 +228,10 @@ class ServingFrontend:
         """Enqueue one request; returns immediately with its stream handle.
         ``priority`` names a configured class; admission decides admit /
         hold / shed against that class's TTFT/TBT SLOs."""
-        if self._closed:
-            raise RuntimeError("frontend is closed")
+        if self._closed or self._fenced:
+            raise RuntimeError("frontend is closed"
+                               if self._closed else
+                               "frontend is fenced (replica down)")
         cls = self.config.get_class(priority)
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) < 1:
@@ -236,7 +276,8 @@ class ServingFrontend:
                 f"request needs {-(-need // bs)} KV blocks at its budget but "
                 f"the pool holds {total_blocks}")
 
-    def submit_handoff(self, req: RequestHandle, pages, logits) -> None:
+    def submit_handoff(self, req: RequestHandle, pages, logits,
+                       history=None) -> None:
         """Adopt a request PREFILLED ON ANOTHER REPLICA — the decode half of
         the disaggregated prefill/decode topology (``serving/cluster.py``).
         ``pages``/``logits`` are ``engine.export_kv``'s output from the
@@ -246,12 +287,38 @@ class ServingFrontend:
         pages plus a decode slice of growth, then admits the row directly to
         the decode pipeline. The handle's stream/cancel/result semantics are
         unchanged: tokens flow on this replica as if it had prefilled
-        locally."""
-        if self._closed:
-            raise RuntimeError("frontend is closed")
+        locally.
+
+        ``history`` overrides the token record the import is keyed on
+        (default: ``req.prompt``) — a failover SALVAGE of a
+        preempt-offloaded victim (serving/health.py) hands off
+        mid-generation, so its KV covers prompt + generated-so-far."""
+        if self._closed or self._fenced:
+            raise RuntimeError("frontend is closed"
+                               if self._closed else
+                               "frontend is fenced (replica down)")
         with self._inflight_lock:
             self._inflight += 1
-        self._ctl.put(("handoff", (req, pages, logits)))
+        self._ctl.put(("handoff", (req, pages, logits, history)))
+
+    def submit_resume(self, req: RequestHandle, history) -> None:
+        """Adopt a request MIGRATED off a failed replica with no salvageable
+        KV (serving/health.py): ``history`` is the sealed
+        prompt + emitted-tokens snapshot. The engine thread files it as a
+        recompute-preempted victim, so the existing restore path re-prefills
+        the full history (radix-cache matches skip whatever a shared prefix
+        already covers here) and the stream resumes byte-identically from
+        the last emitted token. Raises when this replica cannot EVER fund
+        the request (the caller tries the next survivor)."""
+        if self._closed or self._fenced:
+            raise RuntimeError("frontend is closed"
+                               if self._closed else
+                               "frontend is fenced (replica down)")
+        self.check_budget(len(history),
+                          max(1, req.max_new_tokens - len(req.tokens)))
+        with self._inflight_lock:
+            self._inflight += 1
+        self._ctl.put(("resume", (req, np.asarray(history, np.int32))))
 
     @property
     def outstanding(self) -> int:
@@ -262,11 +329,76 @@ class ServingFrontend:
     def start(self) -> "ServingFrontend":
         if self._thread is not None:
             raise RuntimeError("frontend already started")
+        # replica-scoped fault site (utils/fault_injection.py): a chaos plan
+        # can target ONE replica's loop deterministically
+        if self.stats.replica:
+            self._fault_site = f"serve.engine_step.{self.stats.replica}"
         self._stop.clear()
         self._thread = threading.Thread(target=self._loop,
                                         name="dstpu-serve", daemon=True)
         self._thread.start()
         return self
+
+    def fence(self) -> None:
+        """Declare this frontend DOWN (serving/health.py): stop the loop and
+        guarantee that nothing further is emitted into any stream — even if
+        the engine thread is wedged inside a device call and only wakes
+        later, ``_on_tokens``/``step`` observe the fence and drop
+        everything. Migration then owns the in-flight handles."""
+        self._fenced = True
+        self._stop.set()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait for the engine thread to exit (True = it has; a wedged
+        thread may outlive ``timeout`` — rejoin waits for a real join)."""
+        t = self._thread
+        if t is None:
+            return True
+        t.join(timeout)
+        return not t.is_alive()
+
+    def add_close_listener(self, fn) -> None:
+        """``fn()`` runs at the START of ``close()`` — the router uses this
+        to evict a closed replica's prefix-index entries and stop routing to
+        it (a closed frontend must not keep attracting placements)."""
+        self._close_listeners.append(fn)
+
+    # -- failover support (serving/health.py; fenced/dead frontends only) -- #
+
+    def _scrape_control(self) -> List[tuple]:
+        """Drain the control queue WITHOUT handling (failover only: the
+        loop is fenced or dead, and each undelivered message's request must
+        migrate instead of vanishing)."""
+        out = []
+        while True:
+            try:
+                out.append(self._ctl.get_nowait())
+            except queue.Empty:
+                return out
+
+    def disown(self, req: RequestHandle):
+        """Remove every host-side trace of ``req`` from this fenced/dead
+        frontend — dicts, admission queue, in-flight accounting — WITHOUT
+        touching engine/device state (the dead engine is reclaimed
+        wholesale at rejoin). Returns the request's pending handoff record,
+        if any, so the migration can re-plan it."""
+        uid = req.uid
+        self._reqs.pop(uid, None)
+        self._live.pop(uid, None)
+        self._preempted.pop(uid, None)
+        self.admission.remove(req)
+        rec = None
+        if self._handoffs:
+            kept = []
+            for h in self._handoffs:
+                if h[0].uid == uid:
+                    rec = h
+                else:
+                    kept.append(h)
+            self._handoffs = kept
+        with self._inflight_lock:
+            self._inflight -= 1
+        return rec
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Wait until every submitted request reaches a terminal state (the
@@ -289,9 +421,27 @@ class ServingFrontend:
         teardown fully finished first."""
         if self._closed:
             return
+        for fn in self._close_listeners:
+            fn()
+        self._close_listeners = []
         self._stop.set()
         if self._thread is not None:
-            self._thread.join()
+            # a FENCED frontend may hold a permanently wedged thread (the
+            # stall failure mode the health monitor fences around): close
+            # must not hang the whole cluster teardown on it. Its requests
+            # were already migrated; skip the engine-touching teardown the
+            # wedged thread could still race and leave state to rejoin.
+            self._thread.join(5.0 if self._fenced else None)
+            if self._thread.is_alive():
+                from deepspeed_tpu.utils.logging import log_dist
+                log_dist("frontend close: engine thread still wedged after "
+                         "fence; abandoning it (daemon) without teardown",
+                         ranks=[0])
+                self._closed = True
+                if self._loop_exc is not None:
+                    exc, self._loop_exc = self._loop_exc, None
+                    raise RuntimeError("serving loop died") from exc
+                return
             self._thread = None
         # engine-thread state is safe to touch now (thread joined / never ran)
         self._drain_control()
@@ -323,16 +473,25 @@ class ServingFrontend:
         try:
             while not self._stop.is_set():
                 if not self.step():
+                    if self._fenced:
+                        break                 # failover owns the queue now
                     try:                      # idle: block on control traffic
                         msg = self._ctl.get(timeout=self.config.idle_wait_s)
                     except queue.Empty:
                         continue
+                    if self._fenced:
+                        self._ctl.put(msg)    # failover's scrape owns it
+                        break
                     self._handle(msg)
         except BaseException as exc:          # surface at drain()/close() —
             self._loop_exc = exc              # a dead server must not hang
-            for req in list(self._reqs.values()):
-                req._q.put(_DONE)             # unblock stream readers
-                req._finished.set()
+            if not self._managed:
+                # unmanaged: a dead server must not hang its clients. Under
+                # a router health monitor the streams stay OPEN — failover
+                # migrates them to a survivor (or terminal-states them)
+                for req in list(self._reqs.values()):
+                    req._q.put(_DONE)         # unblock stream readers
+                    req._finished.set()
 
     def step(self) -> bool:
         """ONE frontend iteration: control drain -> cancellation sweep ->
@@ -340,6 +499,12 @@ class ServingFrontend:
         Public so tests and deterministic bench phases can drive the loop
         synchronously (no thread); returns False when the iteration found
         no work (idle)."""
+        # chaos site (raise = crash this loop, stall = wedge it); the fence
+        # check sits AFTER it so a stalled thread that wakes post-failover
+        # bails before touching any state migration already disowned
+        maybe_fail(self._fault_site)
+        if self._fenced:
+            return False
         self._drain_control()
         self._sweep_cancels()
         worked = self._execute_handoffs()
@@ -358,7 +523,10 @@ class ServingFrontend:
             if not self.admission.enqueue(req):
                 self._finalize(req, SHED)     # queue full: immediate shed
         elif kind == "handoff":
-            req, pages, logits = payload
+            req, pages, logits, history = payload
+            with req._emit_lock:
+                req._sealed = False    # adoption: emission is ours now (a
+                # no-op for normal disagg handoffs, which were never sealed)
             self._reqs[req.uid] = req
             self.stats.record_submit(req.cls.name)
             if len(self._handoffs) >= self.config.max_queue:
@@ -367,7 +535,20 @@ class ServingFrontend:
                 # queue sheds at, shed rather than accumulate without limit
                 self._finalize(req, SHED)
             else:
-                self._handoffs.append((req, pages, logits))
+                self._handoffs.append((req, pages, logits, history))
+        elif kind == "resume":
+            # failover migration (serving/health.py): adopt as a
+            # recompute-preempted victim — the restore path re-prefills the
+            # sealed history and the stream resumes from its last token
+            req, history = payload
+            with req._emit_lock:
+                req._sealed = False    # adoption: emission is ours now
+            self._reqs[req.uid] = req
+            self.stats.record_submit(req.cls.name)
+            req._resume_tokens = history
+            req.status = PREEMPTED
+            req.preempt_t = req._phase_t0 = time.perf_counter()
+            self._preempted[req.uid] = req
         # cancellation rides the handle's event (no message): the sweeps /
         # on_tokens observe it within one iteration, and an idle loop ticks
         # every idle_wait_s — disconnects are never waited on indefinitely
@@ -477,7 +658,10 @@ class ServingFrontend:
         did = False
         held = []
         for rec in self._handoffs:
-            req, pages, logits = rec
+            if self._fenced:
+                held.append(rec)
+                continue
+            req, pages, logits, history = rec
             if req.cancelled:
                 self._finalize(req, CANCELLED)
                 did = True
@@ -498,7 +682,10 @@ class ServingFrontend:
                 continue
             t0 = time.perf_counter()
             try:
-                self.engine.import_kv(req.uid, req.prompt, pages, logits)
+                self.engine.import_kv(
+                    req.uid,
+                    req.prompt if history is None else history,
+                    pages, logits)
             except (ValueError, RuntimeError) as exc:
                 # a malformed/oversized handoff must close ONE stream, not
                 # kill the replica's serving loop (and every other stream)
@@ -564,6 +751,8 @@ class ServingFrontend:
         tokens = sum(len(r.prompt) for r in reqs)
         while e.scheduler.has_pending():
             e._run_pass()
+            if self._fenced:
+                return       # fenced mid-prefill: failover owns every handle
             for req in reqs:
                 if req.cancelled and req.status == PREFILL:
                     self._teardown(req, CANCELLED)
@@ -626,15 +815,25 @@ class ServingFrontend:
             e = self.engine
             while e.scheduler.has_pending():
                 e._run_pass()
-                if req.cancelled:
+                if self._fenced or req.cancelled:
                     break
+            if self._fenced:
+                return       # a wedged restore waking post-failover must not
+                # resurrect a handle the migration already re-homed
             if req.cancelled:
                 self._teardown(req, CANCELLED)
                 return
         t1 = time.perf_counter()
+        if self._fenced:
+            return
         self._span(req, "restore", t0, t1)
         req.status = DECODING
         req._phase_t0 = t1
+        if req.admit_t is None:
+            # a failover-migrated request that was still QUEUED on the dead
+            # replica reaches the live set through this path without ever
+            # being admitted — the victim ordering needs a real stamp
+            req.admit_t = t1
         self._admit_pipe(req)
         self._live[uid] = req
         self.stats.restores += 1
@@ -676,41 +875,52 @@ class ServingFrontend:
         past ``max_new_tokens``/EOS within a batch are discarded (in-step
         overshoot, flushed with the request at the run boundary)."""
         now = time.perf_counter()
+        if self._fenced:
+            return list(uids)                  # down: emit nothing, stop all
         stop = None
         for i, u in enumerate(uids):
             req = self._live.get(u)
             if req is None:
                 continue                       # stopped earlier this run
             batch = row[i] if self._spec else row[i:i + 1]
-            for bi in range(len(batch)):
-                t = int(batch[bi])
-                req.tokens.append(t)
-                req._q.put(t)
-                # TTFT/TBT stamp the moment the token became host-visible —
-                # the client-observed latency the SLOs are defined over; the
-                # sync point is the drain inside pipe.run (fetch_to_host)
-                if req.ttft_ms is None:
-                    req.ttft_ms = 1e3 * (now - req.arrival_t)  # jaxlint: disable=JL001
-                elif bi == 0:
-                    req.tbt_ms.append(1e3 * (now - req._last_emit_t))  # jaxlint: disable=JL001
-                else:
-                    req.tbt_ms.append(0.0)     # same-drain sibling token
-                req._last_emit_t = now
-                done = (len(req.tokens) >= req.max_new_tokens
-                        or (req.eos_token_id is not None
-                            and t == req.eos_token_id))
-                if done or req.cancelled:
-                    del self._live[u]
-                    self._run_stopped.append(req)
-                    req._stop_status = CANCELLED \
-                        if (req.cancelled and not done) else FINISHED
-                    if stop is None:
-                        stop = []
-                    stop.append(u)
-                    break
+            # emission rides the handle's seal lock (uncontended except at
+            # the instant a failover migration snapshots the stream): a
+            # sealed handle belongs to another replica now — drop the row
+            with req._emit_lock:
+                if req._sealed:
+                    continue
+                for bi in range(len(batch)):
+                    t = int(batch[bi])
+                    req.tokens.append(t)
+                    req._q.put(t)
+                    # TTFT/TBT stamp the moment the token became
+                    # host-visible — the client-observed latency the SLOs
+                    # are defined over; the sync point is the drain inside
+                    # pipe.run (fetch_to_host)
+                    if req.ttft_ms is None:
+                        req.ttft_ms = 1e3 * (now - req.arrival_t)  # jaxlint: disable=JL001
+                    elif bi == 0:
+                        req.tbt_ms.append(1e3 * (now - req._last_emit_t))  # jaxlint: disable=JL001
+                    else:
+                        req.tbt_ms.append(0.0)  # same-drain sibling token
+                    req._last_emit_t = now
+                    done = (len(req.tokens) >= req.max_new_tokens
+                            or (req.eos_token_id is not None
+                                and t == req.eos_token_id))
+                    if done or req.cancelled:
+                        del self._live[u]
+                        self._run_stopped.append(req)
+                        req._stop_status = CANCELLED \
+                            if (req.cancelled and not done) else FINISHED
+                        if stop is None:
+                            stop = []
+                        stop.append(u)
+                        break
         return stop
 
     def _decode_slice(self) -> None:
+        if self._fenced:
+            return
         self._ensure_slice_funded()
         if not self._pipe.uids:
             return

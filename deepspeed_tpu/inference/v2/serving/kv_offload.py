@@ -154,6 +154,31 @@ class KVOffloadManager:
         self.restore_bytes_total += rec.nbytes
         return rec.nbytes
 
+    def salvageable(self, uid: int) -> bool:
+        """Can ``uid``'s offload record seed a CROSS-REPLICA import? Only
+        when the record covers the sequence's ENTIRE logical KV
+        (``kept == 0`` — no shared-prefix pages were left behind on the
+        now-dead device) is the pinned-host copy a complete handoff
+        payload; a partial record forces re-prefill instead."""
+        rec = self._recs.get(uid)
+        return rec is not None and rec.kept == 0 and bool(rec.bufs)
+
+    def export_record(self, uid: int) -> Tuple[np.ndarray, np.ndarray, int]:
+        """Failover SALVAGE (serving/health.py): turn ``uid``'s offload
+        record into the ``(pages, logits, nbytes)`` payload
+        ``submit_handoff`` ships — the pages this engine's crash stranded
+        in pinned host buffers become a survivor's ``import_kv`` input
+        instead of being recomputed. Copies the pages out, releases the
+        pooled buffers, and drops the record (the dead replica's device
+        pages are unreachable either way)."""
+        assert self.salvageable(uid), f"uid {uid} is not salvageable"
+        rec = self._recs[uid]
+        pages = np.stack([self.pool.view(b, rec.shape, rec.dtype)
+                          for b in rec.bufs])      # stack copies out
+        logits, nbytes = rec.logits, rec.nbytes
+        self.drop(uid)
+        return pages, logits, nbytes
+
     def drop(self, uid: int) -> None:
         """Cancel-while-offloaded: release the host buffers; the caller
         flushes the sequence (its kept shared-prefix references settle

@@ -42,6 +42,18 @@ Observability: ``serve/router/*`` counters (``monitor/serving.RouterStats``
 — placement, cache hits, rebalances, handoff traffic, per-class CLUSTER
 goodput rollups) plus ``serve/router/{route,handoff}`` trace spans on a
 ``serve/router`` lane; replicas' own surfaces carry their replica label.
+
+Fault tolerance (``RouterConfig.health``; docs/SERVING.md "Failure
+semantics"): a :class:`~deepspeed_tpu.inference.v2.serving.health.
+HealthMonitor` walks replicas through ``healthy -> suspect -> down ->
+draining -> rejoining`` — engine-thread/worker liveness plus a decode-step
+progress heartbeat with a stall deadline — fences a failed replica,
+migrates its in-flight requests to survivors (salvaging preempt-offloaded
+KV through the page fabric, re-prefilling sealed histories otherwise), and
+self-heals by rebuilding + re-warming a frontend on the recovered engine.
+Routing never places a request on a non-``healthy`` replica, and a closed
+or crashed replica's prefix-index entries are evicted so stale cache
+affinity cannot keep attracting routes.
 """
 
 from __future__ import annotations
@@ -60,6 +72,7 @@ from deepspeed_tpu.inference.v2.serving.cluster import (PrefillWorker,
                                                         Replica,
                                                         ServingCluster)
 from deepspeed_tpu.inference.v2.serving.frontend import _DONE, RequestHandle
+from deepspeed_tpu.inference.v2.serving.health import HEALTHY, HealthMonitor
 from deepspeed_tpu.monitor.serving import RouterStats
 from deepspeed_tpu.monitor.trace import tracer as _tracer
 
@@ -103,6 +116,27 @@ class ClusterPrefixIndex:
     def chains(self) -> int:
         with self._lock:
             return len(self._chains)
+
+    def drop_replica(self, replica: str) -> int:
+        """Evict EVERY chain entry held by ``replica`` — a closed or failed
+        replica's cached paths must stop attracting routes immediately (its
+        delta feed is gone, so the entries would otherwise stay stale
+        forever). Returns entries dropped."""
+        with self._lock:
+            dropped = 0
+            for chain in list(self._chains):
+                holders = self._chains[chain]
+                if replica in holders:
+                    holders.discard(replica)
+                    dropped += 1
+                    if not holders:
+                        del self._chains[chain]
+            return dropped
+
+    def holders(self, replica: str) -> int:
+        """Entries currently attributed to ``replica`` (tests/stats)."""
+        with self._lock:
+            return sum(1 for h in self._chains.values() if replica in h)
 
     def match(self, tokens: Sequence[int]) -> Dict[str, int]:
         """Per-replica longest cached match, in TOKENS (whole blocks only,
@@ -161,12 +195,14 @@ class ServingRouter:
         # the shared prefix index, fed by every routable replica's radix
         # tree (replicas without a prefix cache simply never match)
         self.index = ClusterPrefixIndex(cluster.block_size)
-        self._listeners: List[Tuple[object, object]] = []
+        self._listeners: List[Tuple[str, object, object]] = []
         for r in self._targets:
-            if r.engine.prefix_cache is not None:
-                fn = self.index.listener(r.name)
-                r.engine.prefix_cache.add_listener(fn)
-                self._listeners.append((r.engine.prefix_cache, fn))
+            self._register_index_listener(r)
+        # a replica frontend closed OUT OF BAND (not through router.close)
+        # must stop attracting routes and drop its index entries — the
+        # listener-lifecycle fix the close-then-route regression test pins
+        for r in cluster.frontends:
+            self._register_close_listener(r)
         # prefill-replica cost models (fed by PrefillWorker measurements —
         # prefill replicas have no frontend, so federation reads these)
         self._prefill_cost: Dict[str, CostModel] = {
@@ -176,9 +212,44 @@ class ServingRouter:
         self._lock = threading.Lock()      # stats + rr counter + inflight
         self._rr = 0
         self._inflight = 0                 # requests held by prefill workers
-        self._uids = itertools.count(1 << 24)   # never collides with
-        # frontends' own 1 << 20 namespace at any realistic request count
+        self._uids = itertools.count(1 << 44)   # never collides with the
+        # frontends' per-replica (1 << 24)-spaced uid bases (cluster.py):
+        # the cluster would need 2^20 frontend lifetimes to reach this
         self._closed = False
+        # replica failure detection / failover / self-healing
+        # (serving/health.py; no thread unless cfg.health.enabled)
+        self.health = HealthMonitor(self, cfg.health)
+        if self.health.enabled:
+            # managed frontends keep streams OPEN across a loop crash — the
+            # monitor migrates them instead of closing them
+            for r in cluster.frontends:
+                r.frontend._managed = True
+
+    def _register_index_listener(self, r: Replica) -> None:
+        if r.engine.prefix_cache is not None:
+            fn = self.index.listener(r.name)
+            r.engine.prefix_cache.add_listener(fn)
+            self._listeners.append((r.name, r.engine.prefix_cache, fn))
+
+    def _register_close_listener(self, r: Replica) -> None:
+        r.frontend.add_close_listener(
+            lambda name=r.name: self._replica_closed(name))
+
+    def _replica_closed(self, name: str) -> None:
+        """A replica frontend is closing (router teardown, an out-of-band
+        close, or a failover fence->close): evict its prefix-index entries
+        and stop feeding them — routing checks keep it out of rotation."""
+        self._drop_replica_routing(name)
+
+    def _drop_replica_routing(self, name: str) -> None:
+        self.index.drop_replica(name)
+        kept = []
+        for rec in self._listeners:
+            if rec[0] == name:
+                rec[1].remove_listener(rec[2])
+            else:
+                kept.append(rec)
+        self._listeners = kept
 
     # ------------------------------------------------------------------ #
     # lifecycle
@@ -188,6 +259,7 @@ class ServingRouter:
         self.cluster.start()
         for w in self._workers.values():
             w.start()
+        self.health.start()
         return self
 
     def __enter__(self) -> "ServingRouter":
@@ -213,8 +285,15 @@ class ServingRouter:
             time.sleep(0.002)
 
     def check_health(self) -> None:
-        """Raise, naming the replica, if any engine thread or prefill
-        worker has died."""
+        """Without a health monitor: raise, naming the replica, if any
+        engine thread or prefill worker has died (the PR 10 contract — a
+        dead replica must not look like a slow drain). With monitoring
+        enabled, failures are HANDLED — detected, fenced, migrated — so
+        this only polls the monitor and re-raises if the monitor itself
+        died."""
+        if self.health.enabled:
+            self.health.check()
+            return
         for r in self.cluster.frontends:
             if r.frontend._loop_exc is not None:
                 raise RuntimeError(
@@ -226,19 +305,28 @@ class ServingRouter:
                     f"replica {name!r} prefill worker died") from w.exc
 
     def close(self) -> None:
-        """Stop the prefill workers, close every replica frontend
-        (cancelling whatever is in flight), and deregister the prefix-index
-        listeners. Idempotent; a died replica re-raises ONCE, named, after
-        the whole cluster is torn down."""
+        """Stop the health monitor and prefill workers, close every replica
+        frontend (cancelling whatever is in flight), and deregister the
+        prefix-index listeners. Idempotent; a died replica re-raises ONCE,
+        named, after the whole cluster is torn down (a failure the health
+        monitor already handled does not re-raise)."""
         if self._closed:
             return
         self._closed = True
+        self.health.close()
         for w in self._workers.values():
             w.close()
-        for cache, fn in self._listeners:
+        for _name, cache, fn in self._listeners:
             cache.remove_listener(fn)
         self._listeners = []
-        self.cluster.close()
+        self.cluster.close(ignore=self.health.handled_replicas())
+
+    def rejoin(self, name: str) -> bool:
+        """Re-admit a drained replica to routing (``serving/health.py``):
+        reset its engine, rebuild its frontend in a fresh uid space, re-warm
+        the program grids off the hot path, replay its radix tree into the
+        prefix index. True once back in rotation."""
+        return self.health.rejoin(name)
 
     # ------------------------------------------------------------------ #
     # client surface
@@ -260,30 +348,50 @@ class ServingRouter:
         t0 = time.perf_counter()
         matches = self.index.match(prompt) \
             if self.config.policy == "cache_aware" else {}
-        target, matched, rebalanced = self._choose(prompt, cls, matches)
-        t1 = time.perf_counter()
-        if target is None:
-            # federated shed: every candidate's predicted TTFT busts the
-            # class SLO — reject before any replica burns prefill on it
-            req = RequestHandle(next(self._uids), prompt, cls,
-                                int(max_new_tokens), eos_token_id, t0)
-            with self._lock:
-                self.stats.router_sheds[cls.name] += 1
-            self._finalize_external(req, "shed")
-            if _tracer.enabled:
-                _tracer.add("serve/router/route", t0, t1,
-                            lane="serve/router", outcome="shed",
-                            cls=cls.name)
-            return req
-        if self.config.topology == "colocated":
-            # submit FIRST: a validation reject must not count as routed
-            handle = target.frontend.submit(prompt, priority=priority,
-                                            max_new_tokens=max_new_tokens,
-                                            eos_token_id=eos_token_id)
-        else:
-            handle = self._submit_disaggregated(target, prompt, cls,
-                                                int(max_new_tokens),
-                                                eos_token_id, t0)
+        excluded: List[str] = []
+        while True:
+            target, matched, rebalanced = self._choose(prompt, cls, matches,
+                                                       exclude=excluded)
+            t1 = time.perf_counter()
+            if target is None:
+                # shed at the router: every candidate's predicted TTFT
+                # busts the class SLO (federation), or no replica is
+                # routable at all — reject before any prefill burns on it
+                req = RequestHandle(next(self._uids), prompt, cls,
+                                    int(max_new_tokens), eos_token_id, t0)
+                with self._lock:
+                    self.stats.router_sheds[cls.name] += 1
+                self._finalize_external(req, "shed")
+                if _tracer.enabled:
+                    _tracer.add("serve/router/route", t0, t1,
+                                lane="serve/router", outcome="shed",
+                                cls=cls.name)
+                return req
+            if self.config.topology == "colocated":
+                # submit FIRST: a validation reject must not count as routed
+                try:
+                    handle = target.frontend.submit(
+                        prompt, priority=priority,
+                        max_new_tokens=max_new_tokens,
+                        eos_token_id=eos_token_id)
+                except RuntimeError:
+                    # the replica went down between _choose and submit (a
+                    # failure race, not a validation reject — those raise
+                    # ValueError): re-route among the survivors
+                    excluded.append(target.name)
+                    continue
+            else:
+                try:
+                    handle = self._submit_disaggregated(target, prompt, cls,
+                                                        int(max_new_tokens),
+                                                        eos_token_id, t0)
+                except RuntimeError:
+                    # the prefill worker was fenced between _choose and
+                    # submit (validation rejects raise ValueError and
+                    # propagate): re-route among the survivors
+                    excluded.append(target.name)
+                    continue
+            break
         with self._lock:
             self.stats.routed[target.name] += 1
             if matched:
@@ -303,12 +411,33 @@ class ServingRouter:
         one ``monitor/`` backend (``MonitorMaster.write_events`` shape) —
         the rows stay distinguishable by construction."""
         monitor.write_events(self.stats.events(step))
+        if self.health.enabled or self.health.stats.migrations:
+            monitor.write_events(self.health.stats.events(step))
         for r in self.cluster.frontends:
             r.frontend.write_monitor_events(monitor, step)
 
     # ------------------------------------------------------------------ #
     # placement
     # ------------------------------------------------------------------ #
+
+    def _routable(self, r: Replica) -> bool:
+        """May a NEW placement land on this replica? Closed/fenced/crashed
+        frontends (and dead prefill workers) are out even without health
+        monitoring — a stale prefix-index hit or round-robin turn must
+        never route onto a corpse; with monitoring, only ``healthy``
+        replicas (not suspect/down/draining/rejoining) take traffic."""
+        if r.role == "prefill":
+            w = self._workers[r.name]
+            if w.exc is not None or w.fenced:
+                return False
+        else:
+            fe = r.frontend
+            if fe is None or fe._closed or fe._fenced \
+                    or fe._loop_exc is not None:
+                return False
+        if self.health.enabled:
+            return self.health.state(r.name) == HEALTHY
+        return True
 
     def _load(self, r: Replica) -> int:
         if r.role == "prefill":
@@ -336,11 +465,15 @@ class ServingRouter:
                 + adm.cost.predicted_ttft_s(prompt_len)
         return pred * 1e3 > cls.ttft_slo_ms * self.config.shed_factor
 
-    def _choose(self, prompt, cls,
-                matches: Dict[str, int]) -> Tuple[Optional[Replica], int, bool]:
+    def _choose(self, prompt, cls, matches: Dict[str, int],
+                exclude: Sequence[str] = ()) \
+            -> Tuple[Optional[Replica], int, bool]:
         """(target, cached tokens there, rebalanced?). ``None`` target =
-        federated shed (every candidate hot)."""
-        cands = self._targets
+        shed (every candidate hot, or no routable replica at all)."""
+        cands = [r for r in self._targets
+                 if r.name not in exclude and self._routable(r)]
+        if not cands:
+            return None, 0, False
         if self.config.policy == "round_robin":
             with self._lock:
                 i = self._rr
@@ -370,10 +503,18 @@ class ServingRouter:
         rebalanced = cache_best[1] > 0 and best[2] is not cache_best[2]
         return best[2], best[1], rebalanced
 
-    def _pick_decode(self) -> Replica:
-        """Least-loaded decode replica — the handoff destination (called by
-        PrefillWorker threads)."""
-        return min(self._decode, key=lambda r: r.frontend._inflight)
+    def _pick_decode(self, exclude: Sequence[str] = ()) -> Replica:
+        """Least-loaded routable decode replica — the handoff destination
+        (called by PrefillWorker threads; ``exclude`` carries targets a
+        retry already saw fail). Raises :class:`LookupError` when no decode
+        replica can take the handoff."""
+        cands = [r for r in self._decode
+                 if r.name not in exclude and self._routable(r)]
+        if not cands:
+            raise LookupError(
+                "no routable decode replica"
+                + (f" (excluded: {list(exclude)})" if exclude else ""))
+        return min(cands, key=lambda r: r.frontend._inflight)
 
     # ------------------------------------------------------------------ #
     # disaggregated path
@@ -404,9 +545,16 @@ class ServingRouter:
                 f"prefill pool holds {target.engine.allocator.total_blocks}")
         req = RequestHandle(next(self._uids), prompt, cls, max_new_tokens,
                             eos_token_id, arrival_t)
+        req._router_counted = True     # in _inflight until handoff or final
         with self._lock:
             self._inflight += 1
-        self._workers[target.name].submit(req)
+        try:
+            self._workers[target.name].submit(req)
+        except RuntimeError:           # worker fenced in the race window:
+            req._router_counted = False   # undo the accounting and let the
+            with self._lock:              # caller re-route
+                self._inflight -= 1
+            raise
         return req
 
     # -- PrefillWorker callbacks ---------------------------------------- #
@@ -418,7 +566,9 @@ class ServingRouter:
     def _note_handoff(self, src: Replica, dst: Replica, req,
                       nbytes: int, t0: float) -> None:
         with self._lock:
-            self._inflight -= 1
+            if getattr(req, "_router_counted", False):
+                req._router_counted = False
+                self._inflight -= 1
             self.stats.handoffs += 1
             self.stats.handoff_bytes += nbytes
         if _tracer.enabled:
@@ -429,10 +579,13 @@ class ServingRouter:
     def _finalize_external(self, req: RequestHandle, status: str) -> None:
         """Terminal-state a handle the router (or a prefill worker) still
         owns: close the stream and release waiters — the RequestHandle
-        contract, preserved outside any frontend."""
+        contract, preserved outside any frontend. A handle counted in the
+        router's in-flight gauge (disaggregated submissions awaiting
+        handoff) leaves it here whatever the terminal status."""
         req.status = status
         req._q.put(_DONE)
         req._finished.set()
-        if status == "cancelled" and req.uid >= (1 << 24):
+        if getattr(req, "_router_counted", False):
+            req._router_counted = False
             with self._lock:
                 self._inflight -= 1

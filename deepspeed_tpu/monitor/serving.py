@@ -310,6 +310,119 @@ class FrontendStats:
         return out
 
 
+#: detection-latency samples retained (sliding window, like SAMPLE_WINDOW)
+_DETECT_WINDOW = 256
+
+
+class HealthStats:
+    """Aggregate counters for one router's ``HealthMonitor``
+    (``inference/v2/serving/health.py``) — the ``serve/health/*`` monitor
+    surface (docs/SERVING.md "Failure semantics"). Per-window aggregations
+    over the SAME ``perf_counter`` stamps the tracer records as
+    ``serve/health/{detect,migrate,rejoin}`` spans — one set of perf pairs
+    feeds both (docs/OBSERVABILITY.md), so the dashboard and the timeline
+    can never disagree about when a failure was detected or how long a
+    rejoin warmup took. Mutated only on the health-monitor thread (single
+    writer); readers see monotone counters."""
+
+    def __init__(self, replica_names: Optional[List[str]] = None):
+        #: replica -> current health state name (gauge-ish, for dashboards)
+        self.states: Dict[str, str] = {
+            n: "healthy" for n in (replica_names or [])}
+        self.transitions: Dict[str, int] = {}   # "suspect->down" -> count
+        self.liveness_downs = 0                 # died loop / worker
+        self.stall_downs = 0                    # wedged: progress deadline
+        self.detect_ms: Deque[float] = deque(maxlen=_DETECT_WINDOW)
+        self.migrations = 0                     # requests moved off a corpse
+        self.salvaged = 0                       # ... via offloaded-KV import
+        self.reprefilled = 0                    # ... via history re-prefill
+        self.salvaged_tokens = 0                # history tokens NOT recomputed
+        self.reprefilled_tokens = 0             # history tokens recomputed
+        self.salvaged_bytes = 0                 # KV bytes imported from host
+        self.migration_sheds = 0                # no survivor could fund it
+        self.migration_cancels = 0              # cancel landed mid-migration
+        self.handoffs_replanned = 0             # queued handoffs re-targeted
+        self.rejoins = 0
+        self.rejoin_warmup_ms = 0.0             # cumulative warmup wall
+
+    # -- recording (health-monitor thread) ------------------------------ #
+
+    def record_transition(self, replica: str, old: str, new: str) -> None:
+        self.states[replica] = new
+        key = f"{old}->{new}"
+        self.transitions[key] = self.transitions.get(key, 0) + 1
+
+    def record_detection(self, kind: str, latency_s: float) -> None:
+        if kind == "stall":
+            self.stall_downs += 1
+        else:
+            self.liveness_downs += 1
+        self.detect_ms.append(1e3 * latency_s)
+
+    def record_migration(self, mode: str, history_tokens: int,
+                         nbytes: int = 0) -> None:
+        self.migrations += 1
+        if mode == "salvage":
+            self.salvaged += 1
+            self.salvaged_tokens += int(history_tokens)
+            self.salvaged_bytes += int(nbytes)
+        else:
+            self.reprefilled += 1
+            self.reprefilled_tokens += int(history_tokens)
+
+    def record_rejoin(self, warmup_s: float) -> None:
+        self.rejoins += 1
+        self.rejoin_warmup_ms += 1e3 * warmup_s
+
+    # -- reporting ------------------------------------------------------- #
+
+    def events(self, step: int = 0) -> List[Event]:
+        """``serve/health/*`` monitor events (docs/SERVING.md glossary).
+        Snapshots the dicts/deque first: a monitor backend reads on a
+        bench/user thread while the health thread inserts first-seen
+        transition keys — iterating the live dict would race."""
+        import numpy as np
+        transitions = dict(self.transitions)
+        states = dict(self.states)
+        detect = list(self.detect_ms)
+        out: List[Event] = [
+            ("serve/health/transitions",
+             float(sum(transitions.values())), step),
+            ("serve/health/liveness_downs", float(self.liveness_downs), step),
+            ("serve/health/stall_downs", float(self.stall_downs), step),
+            ("serve/health/migrations", float(self.migrations), step),
+            ("serve/health/salvaged", float(self.salvaged), step),
+            ("serve/health/reprefilled", float(self.reprefilled), step),
+            ("serve/health/salvaged_tokens",
+             float(self.salvaged_tokens), step),
+            ("serve/health/reprefilled_tokens",
+             float(self.reprefilled_tokens), step),
+            ("serve/health/salvaged_bytes", float(self.salvaged_bytes), step),
+            ("serve/health/migration_sheds",
+             float(self.migration_sheds), step),
+            ("serve/health/migration_cancels",
+             float(self.migration_cancels), step),
+            ("serve/health/handoffs_replanned",
+             float(self.handoffs_replanned), step),
+            ("serve/health/rejoins", float(self.rejoins), step),
+            ("serve/health/rejoin_warmup_ms",
+             float(self.rejoin_warmup_ms), step),
+        ]
+        if detect:
+            xs = np.asarray(detect, np.float64)
+            out.append(("serve/health/detect_p50_ms",
+                        float(np.percentile(xs, 50)), step))
+            out.append(("serve/health/detect_p95_ms",
+                        float(np.percentile(xs, 95)), step))
+        for name, state in states.items():
+            # numeric gauge per replica: healthy=0 suspect=1 down=2
+            # draining=3 rejoining=4 (dashboards can't plot strings)
+            code = {"healthy": 0, "suspect": 1, "down": 2,
+                    "draining": 3, "rejoining": 4}.get(state, -1)
+            out.append((f"serve/health/state/{name}", float(code), step))
+        return out
+
+
 class RouterStats:
     """Aggregate counters for one ``ServingRouter``
     (``inference/v2/serving/router.py``) — the ``serve/router/*`` monitor
@@ -329,6 +442,7 @@ class RouterStats:
         self.router_sheds: Dict[str, int] = {c: 0 for c in class_names}
         self.handoffs = 0                  # prefill->decode sequences moved
         self.handoff_bytes = 0             # KV bytes over the page fabric
+        self.handoff_failures = 0          # retry budgets exhausted (shed)
         self._frontends: List[FrontendStats] = []
 
     def register_frontend(self, stats: FrontendStats) -> None:
@@ -349,6 +463,8 @@ class RouterStats:
              float(sum(self.router_sheds.values())), step),
             ("serve/router/handoffs", float(self.handoffs), step),
             ("serve/router/handoff_bytes", float(self.handoff_bytes), step),
+            ("serve/router/handoff_failures",
+             float(self.handoff_failures), step),
         ]
         for name, n in self.routed.items():
             out.append((f"serve/router/routed/{name}", float(n), step))

@@ -40,6 +40,30 @@ Known sites (grep for ``maybe_fail``/``maybe_rc`` to audit):
                     real wait still runs first so buffers stay coherent)
 ``agent.run``       ``DSElasticAgent`` before each (re)start attempt
 ==================  =========================================================
+
+Serving-side sites (ISSUE 12 — the chaos surface ``serving_bench.py
+--chaos`` replays against; docs/SERVING.md "Failure semantics"):
+
+========================  ===================================================
+``serve.engine_step``     top of ``ServingFrontend.step()`` — ``raise``
+                          crashes the replica's serving loop, ``stall``
+                          wedges it (the health monitor's stall-deadline
+                          case). Replica-scoped form
+                          ``serve.engine_step.<replica>`` (the label a
+                          ``ServingCluster`` assigns) targets ONE replica
+                          deterministically.
+``serve.prefill_worker``  ``PrefillWorker`` batch loop (disaggregated
+                          prefill) — also replica-scoped
+                          (``serve.prefill_worker.<replica>``).
+``serve.handoff``         inside each deadline-wrapped prefill->decode
+                          handoff attempt (``raise`` exhausts the
+                          ``retry_call`` budget; ``stall`` past
+                          ``handoff_timeout_s`` surfaces ``IOTimeout``).
+``serve.kv_fetch``        ``engine.fetch_pages`` (page-fabric gather:
+                          preempt-offload, export_kv).
+``serve.kv_put``          ``engine.put_pages`` (page-fabric scatter:
+                          restore, import_kv).
+========================  ===================================================
 """
 
 from __future__ import annotations

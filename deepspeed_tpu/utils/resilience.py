@@ -59,6 +59,21 @@ def retry_call(fn: Callable[[], Any], *, attempts: int = 3,
             delay *= backoff_mult
 
 
+def call_with_deadline(fn: Callable[[], Any], timeout_s: Optional[float],
+                       describe: str = "") -> Any:
+    """One-shot deadline wrapper: ``fn()`` inline when ``timeout_s`` is
+    None, else through a :class:`DeferredCall` — raising :class:`IOTimeout`
+    past the deadline while the call keeps running on its daemon thread.
+    The serving router's disaggregated handoff path uses this so a wedged
+    decode replica cannot stall a prefill worker unboundedly; callers that
+    may retry elsewhere must make the abandoned call's side effects inert
+    themselves (the handoff path flags the attempt abandoned before
+    retrying against a different replica)."""
+    if timeout_s is None:
+        return fn()
+    return DeferredCall(fn, describe=describe).result(timeout_s)
+
+
 class DeferredCall:
     """Run ``fn()`` on a daemon thread; join with a deadline.
 

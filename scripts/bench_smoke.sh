@@ -53,6 +53,16 @@ timeout -k 10 300 python benchmarks/serving_bench.py --spec --smoke \
 timeout -k 10 300 python benchmarks/serving_bench.py --router --smoke \
     || exit 1
 
+# fault-tolerance leg (docs/SERVING.md "Failure semantics"): 2 replicas
+# behind a health-monitored router replay a seeded Poisson stream while
+# fault injection kills one serving loop and stalls the other — gating
+# byte-identical non-shed streams vs uninterrupted references, detection of
+# both failure modes, migration, self-healing rejoin with zero compiles,
+# and allocator baseline on every replica; the injected raise also leaves
+# the flight-recorder dump trace_check verifies below
+timeout -k 10 300 python benchmarks/serving_bench.py --chaos --smoke \
+    || exit 1
+
 timeout -k 10 300 python benchmarks/train_bench.py --smoke || exit 1
 
 # offloaded-optimizer pipeline leg: serial vs overlapped host step through
@@ -77,5 +87,5 @@ timeout -k 10 300 python benchmarks/train_bench.py --smoke --trace-overhead \
 # distinct tracks, plus a parseable flight-recorder dump from the
 # --preempt kills
 timeout -k 10 120 python scripts/trace_check.py "$TRACE_DIR" \
-    --require train serve serve/req serve/spec serve/router ckpt \
-    train/offload --expect-crash || exit 1
+    --require train serve serve/req serve/spec serve/router serve/health \
+    ckpt train/offload --expect-crash || exit 1

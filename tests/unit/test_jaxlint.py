@@ -973,6 +973,43 @@ def test_jl007_router_cluster_paths_policed():
     assert rules_of(findings) == ["JL007", "JL007"]
 
 
+def test_jl007_health_module_policed():
+    """The failover/health module (serving/health.py) is hot-path policed
+    by the SHIPPED config via the serving/ prefix — a migration that
+    blocking-fetched a dead replica's device pages on the monitor thread
+    fires; the module's actual discipline (host dicts, sealed handles, the
+    engine-owned export/import drains) is clean."""
+    raw = _repo_config()
+    for rule in ("JL007", "JL008"):
+        hot = raw["rules"][rule]["options"]["hot_paths"]
+        assert any(p in "deepspeed_tpu/inference/v2/serving/health.py"
+                   for p in hot), rule
+    cfg = LintConfig(rules={"JL007": RuleSettings(
+        options=raw["rules"]["JL007"]["options"])})
+    src = textwrap.dedent("""
+        import numpy as np
+
+        def _migrate_one(self, replica, fe, req, handoff):
+            pages = np.asarray(replica.engine.kv.kv)
+            return pages.tolist()
+    """)
+    findings = lint_text(
+        src, path="deepspeed_tpu/inference/v2/serving/health.py",
+        config=cfg)
+    assert rules_of(findings) == ["JL007", "JL007"]
+    clean = textwrap.dedent("""
+        import numpy as np
+
+        def _migrate_one(self, replica, fe, req, handoff):
+            history = req._seal()
+            pages, logits, nbytes = fe.offload.export_record(req.uid)
+            return np.asarray(history, np.int32), pages, logits
+    """)
+    assert lint_text(
+        clean, path="deepspeed_tpu/inference/v2/serving/health.py",
+        config=cfg) == []
+
+
 def test_jl007_spec_decode_path_policed():
     """The speculative-decoding subsystem (inference/v2/spec/) is hot-path
     policed by the SHIPPED config — a stray blocking fetch of the accept
