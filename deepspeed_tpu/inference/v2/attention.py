@@ -1,0 +1,208 @@
+"""One attention-kernel interface for the v2 serving stack.
+
+``AttentionKernelSpec`` is the single dispatch surface every v2 device
+program routes its attention through — the ragged paged pass, the packed
+prefill fast path, the fused decode-step/multistep programs, and the
+speculative verify step (``ragged_model.py`` builders). Before it existed,
+each builder picked kernels per call site (window/alibi partials, TP
+shard_map wrapping, int8-scale keyword plumbing) and the engine carried one
+build-time refusal per (feature x feature) pair that had never been wired;
+composing a new pool layout meant touching every site. Now:
+
+- **trace-time dispatch** keys on the pool's dtype at the call: every method
+  takes ``kv_scales=None`` — ``None`` is a bf16/f32 pool, a scale-tile array
+  is an int8 pool and the method routes to the kernel's dequantizing
+  variant. Sliding window and ALiBi are bound once at construction.
+- **build-time capability** lives in ONE table
+  (:meth:`validate_engine_build`): the engine asks it instead of scattering
+  refusals, so what composes (int8 x prefix cache, int8 x spec decode,
+  int8 x page fabric) and what does not (int8 x tensor parallel,
+  spec x sliding window) is decided — and tested — in one place
+  (tests/unit/test_kv_quant_stack.py pins the surviving refusal messages).
+
+int8 write semantics (the invariant the byte gates rest on): quantize-on-
+write is the semantic boundary — every program attends a token through the
+value its int8 page stores. Paths that write-then-attend (ragged pass
+decode rows, spec verify) get this for free; fused paths that attend the
+current token from registers or the side slab pass new K/V through
+``kv_write_dequant`` first (``ops/pallas/paged_attention.py``), so all
+paths agree on the attended VALUES and differ only at cross-kernel
+float-association noise (~1e-7 — the same level the fp16 byte-stream gates
+already tolerate between the chunk/decode/sidebuf kernels).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+from deepspeed_tpu.ops.pallas.flash_attention import flash_attention_packed
+from deepspeed_tpu.ops.pallas.paged_attention import (
+    paged_chunk_attention_batched, paged_decode_attention,
+    paged_decode_attention_sidebuf, paged_decode_attention_step)
+
+_QUANT_TP_MSG = "int8 KV pages + TP not wired"
+
+
+class AttentionKernelSpec:
+    """Kernel dispatch for one model spec on one mesh.
+
+    Construction binds the per-model statics (window, alibi, tp, mesh);
+    each method is called inside a traced program with the per-layer pool
+    view and routes to the right kernel variant. TP wrapping (shard_map on
+    the 'tensor' axis) is applied here — one helper, identical in_specs per
+    kernel shape — so no builder carries its own wrapping."""
+
+    def __init__(self, spec: Any, mesh=None, tp: int = 1):
+        self.spec = spec
+        self.mesh = mesh
+        self.tp = int(tp)
+        self._decode = functools.partial(paged_decode_attention,
+                                         window=spec.window, alibi=spec.alibi)
+        self._chunk = functools.partial(paged_chunk_attention_batched,
+                                        window=spec.window, alibi=spec.alibi)
+        self._step = functools.partial(paged_decode_attention_step,
+                                       window=spec.window, alibi=spec.alibi)
+        self._sidebuf = functools.partial(paged_decode_attention_sidebuf,
+                                          window=spec.window, alibi=spec.alibi)
+        self._packed = functools.partial(flash_attention_packed,
+                                         window=spec.window)
+
+    # ------------------------------------------------------------------ #
+    # build-time capability surface
+    # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def validate_engine_build(spec: Any, cfg: Any) -> None:
+        """THE build-time capability table for the v2 engine: raises the
+        canonical refusal for every (feature x feature) pair the kernel
+        surface cannot carry, in one place. ``spec`` is the adapted
+        :class:`~deepspeed_tpu.inference.v2.ragged_model.RaggedModelSpec``,
+        ``cfg`` the :class:`RaggedInferenceEngineConfig`. What is absent
+        here COMPOSES: int8 KV pages run under the prefix cache, spec
+        decode, preempt-offload and the cross-engine page fabric (the PR
+        that collapsed those three former refusals into this table)."""
+        if cfg.kv_quant.enabled:
+            if cfg.tensor_parallel > 1:
+                raise NotImplementedError(
+                    "kv_quant with tensor_parallel > 1 is not wired")
+            if (spec.head_dim % 128 != 0
+                    or (spec.num_kv_heads * cfg.kv_cache.block_size)
+                    % 128 != 0):
+                raise ValueError(
+                    "kv_quant needs head_dim % 128 == 0 and "
+                    "num_kv_heads * block_size % 128 == 0 (the kernels' "
+                    "scale-tile lane alignment; got head_dim="
+                    f"{spec.head_dim}, num_kv_heads={spec.num_kv_heads}, "
+                    f"block_size={cfg.kv_cache.block_size})")
+        if cfg.prefix_cache.enabled and spec.window is not None:
+            raise NotImplementedError(
+                "prefix_cache with a sliding-window model is not wired: "
+                "the page ring overwrites pages in place, which would rot "
+                "cached content under a live sharer")
+        if cfg.spec_decode.enabled and spec.window is not None:
+            raise NotImplementedError(
+                "spec_decode with a sliding-window model is not wired "
+                "(the page ring aliases the verify step's k+1-ahead "
+                "write span)")
+
+    # ------------------------------------------------------------------ #
+    # trace-time dispatch (called inside jitted programs)
+    # ------------------------------------------------------------------ #
+
+    def _tp_wrap(self, fn, in_specs, out_specs):
+        from deepspeed_tpu.utils.jax_compat import shard_map
+        return shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+    def decode(self, q, kv_l, block_tables, ctx_lens,
+               kv_scales: Optional[Any] = None):
+        """Single-token-per-sequence decode attention (one ctx-bounded
+        query row per sequence) over the per-layer pool view ``kv_l``
+        ([L*NB, 2, Hkv, bs, D]; block tables pre-offset by l*NB)."""
+        if self.tp > 1:
+            assert kv_scales is None, _QUANT_TP_MSG
+            from jax.sharding import PartitionSpec as P
+            from deepspeed_tpu.comm.mesh import TENSOR_AXIS
+            fn = self._tp_wrap(
+                self._decode,
+                in_specs=(P(None, TENSOR_AXIS, None),
+                          P(None, None, TENSOR_AXIS, None, None),
+                          P(None, None), P(None)),
+                out_specs=P(None, TENSOR_AXIS, None))
+            return fn(q, kv_l, block_tables, ctx_lens)
+        if kv_scales is not None:
+            return self._decode(q, kv_l, block_tables, ctx_lens,
+                                kv_scales=kv_scales)
+        return self._decode(q, kv_l, block_tables, ctx_lens)
+
+    def chunk(self, q, kv_l, block_tables, q_starts, ctx_lens,
+              kv_scales: Optional[Any] = None):
+        """Batched prompt-chunk (and spec-verify) flash attention: one slot
+        per chunk, causal by absolute position."""
+        if self.tp > 1:
+            assert kv_scales is None, _QUANT_TP_MSG
+            from jax.sharding import PartitionSpec as P
+            from deepspeed_tpu.comm.mesh import TENSOR_AXIS
+            fn = self._tp_wrap(
+                self._chunk,
+                in_specs=(P(None, None, TENSOR_AXIS, None),
+                          P(None, None, TENSOR_AXIS, None, None),
+                          P(None, None), P(None), P(None)),
+                out_specs=P(None, None, TENSOR_AXIS, None))
+            return fn(q, kv_l, block_tables, q_starts, ctx_lens)
+        if kv_scales is not None:
+            return self._chunk(q, kv_l, block_tables, q_starts, ctx_lens,
+                               kv_scales=kv_scales)
+        return self._chunk(q, kv_l, block_tables, q_starts, ctx_lens)
+
+    def decode_step(self, q, k_new, v_new, kv_l, block_tables, ctx_lens,
+                    kv_scales: Optional[Any] = None):
+        """Fused write+attend decode step (pool aliased through the kernel;
+        new rows scattered after). Returns ``(out, kv_l)`` — with scales,
+        ``(out, kv_l, kv_scales)``. For int8 pools pass ``k_new/v_new``
+        through ``kv_write_dequant`` first (module docstring)."""
+        if self.tp > 1:
+            assert kv_scales is None, _QUANT_TP_MSG
+            from jax.sharding import PartitionSpec as P
+            from deepspeed_tpu.comm.mesh import TENSOR_AXIS
+            fn = self._tp_wrap(
+                self._step,
+                in_specs=(P(None, TENSOR_AXIS, None),
+                          P(None, TENSOR_AXIS, None),
+                          P(None, TENSOR_AXIS, None),
+                          P(None, None, TENSOR_AXIS, None, None),
+                          P(None, None), P(None)),
+                out_specs=(P(None, TENSOR_AXIS, None),
+                           P(None, None, TENSOR_AXIS, None, None)))
+            return fn(q, k_new, v_new, kv_l, block_tables, ctx_lens)
+        if kv_scales is not None:
+            return self._step(q, k_new, v_new, kv_l, block_tables, ctx_lens,
+                              kv_scales=kv_scales)
+        return self._step(q, k_new, v_new, kv_l, block_tables, ctx_lens)
+
+    def sidebuf(self, q, kv_l, block_tables, prefix_lens, side_k, side_v, j,
+                layer_idx, kv_scales: Optional[Any] = None):
+        """Frozen-prefix + side-slab decode attention (the scatter-free
+        multistep schedule). Only reachable at tp == 1 (the multistep
+        builder's side-buffer gate), so no TP wrap. For int8 pools the
+        slab must hold ``kv_write_dequant``'d rows (module docstring)."""
+        assert self.tp == 1, "side-buffer schedule is tp == 1 only"
+        kw = {} if kv_scales is None else dict(kv_scales=kv_scales)
+        return self._sidebuf(q, kv_l, block_tables, prefix_lens,
+                             side_k, side_v, j, layer_idx=layer_idx, **kw)
+
+    def packed(self, q, k, v, seg):
+        """Packed segment-masked prefill flash (no paged reads — the
+        prefill-from-zero fast path)."""
+        if self.tp > 1:
+            from jax.sharding import PartitionSpec as P
+            from deepspeed_tpu.comm.mesh import TENSOR_AXIS
+            fn = self._tp_wrap(
+                self._packed,
+                in_specs=(P(None, TENSOR_AXIS, None),
+                          P(None, TENSOR_AXIS, None),
+                          P(None, TENSOR_AXIS, None), P(None)),
+                out_specs=P(None, TENSOR_AXIS, None))
+            return fn(q, k, v, seg)
+        return self._packed(q, k, v, seg)

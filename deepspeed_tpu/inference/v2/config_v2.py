@@ -77,8 +77,15 @@ class KVQuantConfig:
     ZeRO-Inference's KV quantization strategy, reference README.md:23).
     Pages store int8 values with per-token-head f32 scales (1.6% overhead at
     head_dim 128); the paged kernels dequantize in-flight, halving the
-    page-read stream that bounds large-batch GQA decode. Requires tp == 1,
-    head_dim % 128 == 0 and block_size * kv_heads % 128 == 0."""
+    page-read stream that bounds large-batch GQA decode. A first-class pool
+    layout for the WHOLE v2 serving stack: composes with the prefix cache
+    (COW copies the scale tile with the page), spec decode (the verify step
+    quantizes-on-write), preempt-offload and the cross-engine page fabric
+    (packed value+scale-tile payloads, byte-exact round trips) — see
+    docs/SERVING.md "Quantized KV" for the layout, the write semantics and
+    the byte-vs-rtol gate taxonomy. Requires tp == 1 (the one surviving
+    refusal, raised at engine build), head_dim % 128 == 0 and
+    num_kv_heads * block_size % 128 == 0."""
     enabled: bool = False
     bits: int = 8
 
@@ -206,7 +213,9 @@ class SpecDecodeConfig:
 
     Greedy-only: sampled pipelines bypass speculation with a one-time
     warning. Not wired for sliding-window models (the page ring aliases the
-    K+1-ahead write span) or int8 KV pages."""
+    K+1-ahead write span); int8 KV pages compose — the verify step
+    quantizes-on-write like the decode step (docs/SERVING.md
+    "Quantized KV")."""
     enabled: bool = False
     k: int = 3
     min_match: int = 2
@@ -295,9 +304,19 @@ class ServingConfig:
     unbounded); ``offload_buffers`` caps the pinned-buffer pool's free list.
     ``max_queue`` bounds the pending queue (beyond = immediate shed);
     ``idle_wait_s`` is the engine thread's block interval when idle.
+
+    ``spec``: serve greedy requests through the engine's speculative
+    pipeline when ``spec_decode.enabled`` (default). ``False`` pins this
+    frontend to the plain ``DecodePipeline`` — a per-frontend A/B lever
+    (draft-miss overhead vs k-token amortization), and the discipline the
+    byte-equality bench gates use: spec-on and spec-off greedy streams
+    agree only up to cross-kernel float noise (~1e-4/token argmax flips on
+    a random-init model — docs/SERVING.md "Quantized KV" gate taxonomy),
+    so a replay gated bit-exactly against a plain reference serves plain.
     """
     classes: Any = field(default_factory=_default_classes)
     decode_slice: int = 8
+    spec: bool = True
     preemption: str = "offload"
     max_offload_bytes: Optional[int] = None
     offload_buffers: int = 16

@@ -156,17 +156,12 @@ class InferenceEngineV2:
             # on TPU prefer an explicit num_blocks or memory-fraction sizing
             per_seq = -(-sm.max_context // cfg.kv_cache.block_size)
             nb = per_seq * sm.max_tracked_sequences
-        if cfg.kv_quant.enabled:
-            if tp > 1:
-                raise NotImplementedError(
-                    "kv_quant with tensor_parallel > 1 is not wired")
-            if (self.spec.head_dim % 128 != 0
-                    or cfg.kv_cache.block_size % 128 != 0):
-                raise ValueError(
-                    "kv_quant needs head_dim % 128 == 0 and "
-                    "block_size % 128 == 0 (got head_dim="
-                    f"{self.spec.head_dim}, block_size="
-                    f"{cfg.kv_cache.block_size})")
+        # the ONE build-time capability table (inference/v2/attention.py):
+        # every surviving (feature x feature) refusal raises here; what
+        # does NOT raise composes — int8 KV pages run under the prefix
+        # cache, spec decode, preempt-offload and the page fabric
+        from deepspeed_tpu.inference.v2.attention import AttentionKernelSpec
+        AttentionKernelSpec.validate_engine_build(self.spec, cfg)
         # the pool carries ONE page beyond the allocator's reach: the scratch
         # page backing bucket-padding rows in the fused decode programs (pad
         # rows read/write only it, so padding a batch to its power-of-two
@@ -186,15 +181,9 @@ class InferenceEngineV2:
         self.allocator = BlockedAllocator(nb)
         self.prefix_cache = None
         if cfg.prefix_cache.enabled:
-            if self.spec.window is not None:
-                raise NotImplementedError(
-                    "prefix_cache with a sliding-window model is not wired: "
-                    "the page ring overwrites pages in place, which would rot "
-                    "cached content under a live sharer")
-            if cfg.kv_quant.enabled:
-                raise NotImplementedError(
-                    "prefix_cache with int8 KV pages is not wired (the COW "
-                    "page copy does not handle the tiled scale layout)")
+            # (window refusal raised by validate_engine_build above; int8
+            # pools compose — copy_page COW-copies the scale tile with the
+            # page, tests/unit/test_kv_quant_stack.py)
             from deepspeed_tpu.inference.v2.prefix_cache import RadixPrefixCache
             self.prefix_cache = RadixPrefixCache(
                 self.allocator, kv_cfg.block_size,
@@ -206,16 +195,9 @@ class InferenceEngineV2:
         # each sequence's pages beyond the window so KV stays bounded
         self.scheduler.window = self.spec.window
         if cfg.spec_decode.enabled:
-            if self.spec.window is not None:
-                raise NotImplementedError(
-                    "spec_decode with a sliding-window model is not wired "
-                    "(the page ring aliases the verify step's k+1-ahead "
-                    "write span)")
-            if cfg.kv_quant.enabled:
-                raise NotImplementedError(
-                    "spec_decode with int8 KV pages is not wired (the "
-                    "verify forward's page write does not handle the tiled "
-                    "scale layout)")
+            # (window refusal raised by validate_engine_build above; int8
+            # pools compose — build_verify_step quantizes-on-write and the
+            # chunk kernel dequantizes in-flight)
             # the n-gram proposer drafts from each sequence's prompt
             # history — record it even without a prefix cache
             self.scheduler.record_history_always = True
@@ -655,14 +637,14 @@ class InferenceEngineV2:
                                               *args)
                 self.kv.update(new_kv)
                 jax.block_until_ready(nxt)
-        # the KV page round-trip pair (preempt-offload) over its whole
-        # bucket grid: rare path, but a preemption DURING the timed steady
-        # state must not compile — warm both ops per bucket over the scratch
-        # page (content round-trips to itself)
-        if not self.config.kv_quant.enabled:
-            for b in self.page_buckets:
-                pages = self.fetch_pages([self.scratch_block] * b)
-                self.put_pages(pages, [self.scratch_block] * b)
+        # the KV page round-trip pair (preempt-offload / page fabric) over
+        # its whole bucket grid: rare path, but a preemption DURING the
+        # timed steady state must not compile — warm both ops per bucket
+        # over the scratch page (content round-trips to itself; int8 pools
+        # round-trip their packed values+scale-tile payload the same way)
+        for b in self.page_buckets:
+            pages = self.fetch_pages([self.scratch_block] * b)
+            self.put_pages(pages, [self.scratch_block] * b)
         # the greedy bootstrap sampler over every logits-source shape a
         # serving loop can hand it: without this, the FIRST pipeline run /
         # burst after startup pays a small-but-real compile (an RTT-bound
@@ -844,24 +826,70 @@ class InferenceEngineV2:
         reuse ~log2 executables. Pad slots point at the scratch page — reads
         of it are discarded, writes to it land on the one page no sequence
         can own. Scatter donates the pool (XLA aliases it in HBM, the same
-        discipline as the pass programs)."""
+        discipline as the pass programs). The tree_map'd bodies carry an
+        int8 pool's (values, scale-tiles) tuple leaf-for-leaf — BOTH leaves
+        have the page dim at axis 1, so one dispatch moves a page's bytes
+        AND its scale tile together (the scale-tile fabric invariant every
+        page mover keeps; docs/SERVING.md "Quantized KV")."""
         if self._page_progs is None:
-            if self.config.kv_quant.enabled:
-                raise NotImplementedError(
-                    "KV page offload with int8 KV pages is not wired (the "
-                    "tiled scale layout folds the page dim)")
 
             @jax.jit
             def _gather(kv, blocks):
                 # page-major on the way out: host slices [i] are contiguous
-                return jnp.moveaxis(jnp.take(kv, blocks, axis=1), 1, 0)
+                return jax.tree_util.tree_map(
+                    lambda a: jnp.moveaxis(jnp.take(a, blocks, axis=1),
+                                           1, 0), kv)
 
             @functools.partial(jax.jit, donate_argnums=(0,))
             def _scatter(kv, pages, blocks):
-                return kv.at[:, blocks].set(jnp.moveaxis(pages, 0, 1))
+                return jax.tree_util.tree_map(
+                    lambda a, p: a.at[:, blocks].set(jnp.moveaxis(p, 0, 1)),
+                    kv, pages)
 
             self._page_progs = (_gather, _scatter)
         return self._page_progs
+
+    @property
+    def page_payload_spec(self) -> Tuple[Tuple[int, ...], Any]:
+        """(shape, dtype) of ONE page as it travels the host fabric
+        (offload buffers, export/import handoffs, failover salvage). Plain
+        pools ship the page array itself ([L, 2, H_kv, bs, D], pool
+        dtype); int8 pools ship ONE flat byte row per page — the int8
+        values followed by the f32 scale tile (``bytes_per_block`` bytes)
+        — so every host-side consumer keeps treating a page as one opaque
+        copyable slice."""
+        cfg = self.kv.config
+        if cfg.quantized:
+            return (cfg.bytes_per_block(),), np.uint8
+        # jnp.dtype, not a numpy-name round trip: bf16 pools carry the
+        # ml_dtypes bfloat16 numpy extension dtype
+        return ((cfg.num_layers, 2, cfg.num_kv_heads, cfg.block_size,
+                 cfg.head_dim), jnp.dtype(cfg.dtype))
+
+    def _pack_pages(self, vals: np.ndarray, scales: np.ndarray) -> np.ndarray:
+        """(int8 values [n, L, 2, Hkv, bs, D], f32 scale tiles
+        [n, L, R8, 128]) -> packed [n, bytes_per_block] uint8 rows."""
+        n = vals.shape[0]
+        return np.concatenate(
+            [np.ascontiguousarray(vals).reshape(n, -1).view(np.uint8),
+             np.ascontiguousarray(scales).reshape(n, -1).view(np.uint8)],
+            axis=1)
+
+    def _unpack_pages(self, pages: np.ndarray):
+        """Inverse of :meth:`_pack_pages`: packed uint8 rows -> (values,
+        scale tiles) ready for the tuple-pool scatter."""
+        cfg = self.kv.config
+        n = pages.shape[0]
+        L, Hkv, bs, D = (cfg.num_layers, cfg.num_kv_heads, cfg.block_size,
+                         cfg.head_dim)
+        vbytes = L * 2 * Hkv * bs * D
+        vals = np.ascontiguousarray(pages[:, :vbytes]).view(np.int8)
+        scales = np.ascontiguousarray(pages[:, vbytes:]).view(np.float32)
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            kv_scale_tiles_shape)
+        _, r8, lanes = kv_scale_tiles_shape(1, Hkv, bs)
+        return (vals.reshape(n, L, 2, Hkv, bs, D),
+                scales.reshape(n, L, r8, lanes))
 
     def _page_bucket(self, kind: str, n: int) -> int:
         """Pad count for a page-op batch; counts the first use of each
@@ -882,18 +910,28 @@ class InferenceEngineV2:
         return [1 << i for i in range(top.bit_length())]
 
     def fetch_pages(self, blocks: Sequence[int]) -> np.ndarray:
-        """KV pages ``[n, L, 2, H_kv, block_size, D]`` fetched to host in
-        one bucketed gather — the offload half of the preempt-offload round
-        trip (serving/kv_offload.py). Rare path (runs only when admission
-        preempts a victim), drained through the policed ``fetch_to_host``
-        like every other v2 fetch."""
+        """KV pages fetched to host in one bucketed gather — the offload
+        half of the preempt-offload round trip (serving/kv_offload.py) and
+        the export half of the page fabric. Plain pools return
+        ``[n, L, 2, H_kv, block_size, D]``; int8 pools return packed
+        ``[n, bytes_per_block]`` uint8 rows (values + scale tile per page —
+        :attr:`page_payload_spec`). Rare path (runs only when admission
+        preempts a victim or a handoff exports), drained through the
+        policed ``fetch_to_host`` like every other v2 fetch."""
         ids = [int(b) for b in blocks]
         _maybe_fail("serve.kv_fetch")      # chaos site: page-fabric gather
         gather, _ = self._page_programs()
         bucket = self._page_bucket("gather", len(ids))
         idx = np.full((bucket,), self.scratch_block, np.int32)
         idx[:len(ids)] = ids
-        return fetch_to_host(gather(self.kv.kv, jnp.asarray(idx)))[:len(ids)]
+        res = gather(self.kv.kv, jnp.asarray(idx))
+        if self.kv.config.quantized:
+            # slice the bucket's scratch pad rows off BEFORE packing —
+            # _pack_pages concatenates, and a pow2 bucket can be ~2x n
+            vals, scales = res
+            return self._pack_pages(fetch_to_host(vals)[:len(ids)],
+                                    fetch_to_host(scales)[:len(ids)])
+        return fetch_to_host(res)[:len(ids)]
 
     def put_pages(self, pages: np.ndarray, blocks: Sequence[int]) -> None:
         """Scatter host pages ``[n, ...]`` back into pool slots ``blocks``
@@ -913,11 +951,14 @@ class InferenceEngineV2:
             pages = np.concatenate(
                 [pages, np.zeros((bucket - len(ids),) + pages.shape[1:],
                                  pages.dtype)])
+        if self.kv.config.quantized:
+            vals, scales = self._unpack_pages(np.asarray(pages, np.uint8))
+            payload = (jnp.asarray(vals), jnp.asarray(scales))
+        else:
+            payload = jnp.asarray(pages, self.kv.kv.dtype)
         # direct rebind (not kv.update) so JL003 sees the donated pool's
         # reference replaced before the next pass reads it
-        self.kv.kv = scatter(self.kv.kv,
-                             jnp.asarray(pages, self.kv.kv.dtype),
-                             jnp.asarray(idx))
+        self.kv.kv = scatter(self.kv.kv, payload, jnp.asarray(idx))
 
     def export_kv(self, uid: int) -> Tuple[np.ndarray, np.ndarray]:
         """``(pages, logits)``: the whole logical KV of a fully-prefilled
@@ -954,8 +995,8 @@ class InferenceEngineV2:
         The sequence is then in steady decode state: ``decode_pipeline`` can
         admit it directly. Returns the allocated block ids."""
         uid = int(uid)
-        pages = np.asarray(pages, self.kv.kv.dtype)
-        page_shape = (self.kv.kv.shape[0],) + tuple(self.kv.kv.shape[2:])
+        page_shape, page_dtype = self.page_payload_spec
+        pages = np.asarray(pages, page_dtype)
         if tuple(pages.shape[1:]) != page_shape:
             raise ValueError(
                 f"handoff page shape {tuple(pages.shape[1:])} does not match "
@@ -968,7 +1009,7 @@ class InferenceEngineV2:
         return ids
 
     def fetch_page(self, block: int) -> np.ndarray:
-        """One KV page ([L, 2, H_kv, block_size, D]) to host."""
+        """One KV page (``page_payload_spec``-shaped) to host."""
         return self.fetch_pages([block])[0]
 
     def put_page(self, page: np.ndarray, block: int) -> None:

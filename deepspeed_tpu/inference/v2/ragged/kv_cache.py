@@ -54,9 +54,21 @@ class KVCacheConfig:
         return self.num_blocks * self.block_size
 
     def bytes_per_block(self) -> int:
-        itemsize = 1 if self.quantized else jnp.dtype(self.dtype).itemsize
-        per = self.head_dim * itemsize + (4 if self.quantized else 0)
-        return 2 * self.num_layers * self.block_size * self.num_kv_heads * per
+        """Exact at-rest bytes of one pool block across all layers — for
+        quantized pools this is ALSO the host page-fabric payload size
+        (``engine.page_payload_spec``): int8 values plus the f32 scale tile
+        in its padded DMA layout, one source of size truth for offload
+        capacity accounting and handoff validation."""
+        if self.quantized:
+            from deepspeed_tpu.ops.pallas.paged_attention import (
+                kv_scale_tiles_shape)
+            _, r8, lanes = kv_scale_tiles_shape(1, self.num_kv_heads,
+                                                self.block_size)
+            values = 2 * self.num_kv_heads * self.block_size * self.head_dim
+            return self.num_layers * (values + r8 * lanes * 4)
+        itemsize = jnp.dtype(self.dtype).itemsize
+        return (2 * self.num_layers * self.block_size * self.num_kv_heads
+                * self.head_dim * itemsize)
 
     @classmethod
     def from_memory_budget(cls, num_layers: int, num_kv_heads: int, head_dim: int,
@@ -115,8 +127,10 @@ class BlockedKVCache:
         prefix cache's copy-on-write step when a sequence adopts a
         partially-filled cached page it must keep writing into. One jitted
         program reused for every (src, dst) pair via traced scalar indices.
-        Not valid for quantized pools (the scales' tiled layout folds the
-        page dim; the engine gates prefix_cache + kv_quant off)."""
+        The tree_map'd body carries a quantized pool's (values, scale
+        tiles) tuple leaf-for-leaf — both leaves have the page dim at axis
+        1, so COW adoption copies a page's int8 bytes AND its scale tile
+        together, byte-exactly (tests/unit/test_kv_quant_stack.py)."""
         if self._copy_prog is None:
             import functools
 

@@ -12,10 +12,14 @@ Pass structure (see ``ragged/ragged_batch.py``): tokens = [NC prompt-chunk
 slots | decode rows]. Each layer writes the pass's K/V into the paged cache
 (one flat scatter), then attends:
 
-  - chunk slots -> ``paged_chunk_attention_batched`` (flash over pages for all
+  - chunk slots -> ``AttentionKernelSpec.chunk`` (flash over pages for all
     slots in one kernel, causal by absolute position)
-  - decode rows -> ``paged_decode_attention`` (one token per sequence; the
-    fused multistep loop uses ``paged_decode_attention_step``)
+  - decode rows -> ``AttentionKernelSpec.decode`` (one token per sequence;
+    the fused multistep loop uses ``.decode_step``/``.sidebuf``)
+
+Every builder routes attention through ONE ``AttentionKernelSpec``
+(``inference/v2/attention.py``): kernel variants key on the pool dtype at
+the call (``kv_scales=None`` = bf16/f32 pages), window/alibi/TP bind once.
 
 MoE layers use sort-based grouped GEMM (``jax.lax.ragged_dot`` when available) —
 the TPU analog of the reference's CUTLASS ``moe_gemm`` + moe_scatter/gather
@@ -27,17 +31,13 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.ops.pallas.flash_attention import flash_attention_packed
+from deepspeed_tpu.inference.v2.attention import AttentionKernelSpec
 from deepspeed_tpu.ops.pallas.paged_attention import (
-    _scale_tile_rows, kv_quantize_rows, paged_chunk_attention_batched,
-    paged_decode_attention, paged_decode_attention_sidebuf,
-    paged_decode_attention_step)
+    _scale_tile_rows, kv_quantize_rows, kv_write_dequant)
 
 
 def _kv_unpack(kp):
@@ -743,15 +743,6 @@ PREFILL_PASS_KEYS = (
     "row_seg", "page_ids", "page_rows", "page_fill")
 
 
-def _tp_wrap(fn, mesh, in_specs, out_specs):
-    """shard_map a paged/packed attention kernel over the tensor axis (one
-    helper so the TP wrapping of every kernel variant stays identical)."""
-    from deepspeed_tpu.utils.jax_compat import shard_map
-    return shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                     check_vma=False)
-
-
-
 def build_ragged_forward(spec: RaggedModelSpec,
                          mesh=None,
                          tp: int = 1) -> Callable:
@@ -769,38 +760,7 @@ def build_ragged_forward(spec: RaggedModelSpec,
     hid = spec.hidden_size
     dtype = spec.dtype
 
-    decode_win = functools.partial(paged_decode_attention,
-                                   window=spec.window, alibi=spec.alibi)
-    chunk_win = functools.partial(paged_chunk_attention_batched,
-                                  window=spec.window, alibi=spec.alibi)
-
-    def _decode_attn(q, kv_l, bts, cls_, **sc_kw):
-        if tp > 1:
-            assert not sc_kw, "int8 KV pages + TP not wired"
-            from jax.sharding import PartitionSpec as P
-            from deepspeed_tpu.comm.mesh import TENSOR_AXIS
-            fn = _tp_wrap(
-                decode_win, mesh,
-                in_specs=(P(None, TENSOR_AXIS, None),
-                          P(None, None, TENSOR_AXIS, None, None),
-                          P(None, None), P(None)),
-                out_specs=P(None, TENSOR_AXIS, None))
-            return fn(q, kv_l, bts, cls_)
-        return decode_win(q, kv_l, bts, cls_, **sc_kw)
-
-    def _chunk_attn(q, kv_l, bts, q0s, ctxs, **sc_kw):
-        if tp > 1:
-            assert not sc_kw, "int8 KV pages + TP not wired"
-            from jax.sharding import PartitionSpec as P
-            from deepspeed_tpu.comm.mesh import TENSOR_AXIS
-            fn = _tp_wrap(
-                chunk_win, mesh,
-                in_specs=(P(None, None, TENSOR_AXIS, None),
-                          P(None, None, TENSOR_AXIS, None, None),
-                          P(None, None), P(None), P(None)),
-                out_specs=P(None, None, TENSOR_AXIS, None))
-            return fn(q, kv_l, bts, q0s, ctxs)
-        return chunk_win(q, kv_l, bts, q0s, ctxs, **sc_kw)
+    ak = AttentionKernelSpec(spec, mesh=mesh, tp=tp)
 
     def fwd(weights, kv_pages, b):
         kv_pages, kv_sc = _kv_unpack(kv_pages)
@@ -827,19 +787,18 @@ def build_ragged_forward(spec: RaggedModelSpec,
                 if kvq:
                     kvp_, sc_ = _kv_page_write_quant(kvp, sc, k, v, dest,
                                                      Hkv, bs)
-                    sc_kw = dict(
-                        kv_scales=sc_.reshape(L * NB, r8, 128))
+                    scales = sc_.reshape(L * NB, r8, 128)
                 else:
                     kvp_ = _kv_page_write(kvp, k, v, dest, Hkv, bs)
-                    sc_, sc_kw = sc, {}
+                    sc_, scales = sc, None
                 kv_l = kvp_.reshape(L * NB, 2, Hkv, bs, D)
-                out_c = _chunk_attn(q[:CT].reshape(NC, Cs, H, D), kv_l,
-                                    b["chunk_block_tables"] + l * NB,
-                                    b["chunk_q0"], b["chunk_ctx_lens"],
-                                    **sc_kw)
-                out_d = _decode_attn(q[CT:], kv_l,
-                                     b["decode_block_tables"] + l * NB,
-                                     b["decode_ctx_lens"], **sc_kw)
+                out_c = ak.chunk(q[:CT].reshape(NC, Cs, H, D), kv_l,
+                                 b["chunk_block_tables"] + l * NB,
+                                 b["chunk_q0"], b["chunk_ctx_lens"],
+                                 kv_scales=scales)
+                out_d = ak.decode(q[CT:], kv_l,
+                                  b["decode_block_tables"] + l * NB,
+                                  b["decode_ctx_lens"], kv_scales=scales)
                 return (jnp.concatenate([out_c.reshape(CT, H, D), out_d],
                                         axis=0), kvp_, sc_)
 
@@ -884,21 +843,7 @@ def build_prefill_forward(spec: RaggedModelSpec,
     H, Hkv, D = spec.num_heads, spec.num_kv_heads, spec.head_dim
     dtype = spec.dtype
 
-    packed_win = functools.partial(flash_attention_packed,
-                                   window=spec.window)
-
-    def _packed_attn(q, k, v, seg):
-        if tp > 1:
-            from jax.sharding import PartitionSpec as P
-            from deepspeed_tpu.comm.mesh import TENSOR_AXIS
-            fn = _tp_wrap(
-                packed_win, mesh,
-                in_specs=(P(None, TENSOR_AXIS, None),
-                          P(None, TENSOR_AXIS, None),
-                          P(None, TENSOR_AXIS, None), P(None)),
-                out_specs=P(None, TENSOR_AXIS, None))
-            return fn(q, k, v, seg)
-        return packed_win(q, k, v, seg)
+    ak = AttentionKernelSpec(spec, mesh=mesh, tp=tp)
 
     def fwd(weights, kv_pages, b):
         NC = b["chunk_ntok"].shape[0]
@@ -923,8 +868,10 @@ def build_prefill_forward(spec: RaggedModelSpec,
 
             def attend(q, k, v):
                 # attention reads the PACKED in-flight rows (full precision);
-                # only the page write quantizes
-                out = _packed_attn(q, k, v, seg)
+                # only the page write quantizes — the fast path's packed-vs-
+                # paged variance already makes equality gates force the paged
+                # path, int8 or not (docs/SERVING.md "Quantized KV")
+                out = ak.packed(q, k, v, seg)
                 if kvq:
                     kvp_, sc_ = _kv_page_write_pages_quant(
                         kvp, sc, k, v, l, b["page_ids"],
@@ -998,6 +945,7 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
     while (Cb * Hkv) % 8 != 0:
         Cb += 1
     scale = 1.0 / (D ** 0.5)
+    ak = AttentionKernelSpec(spec, mesh=None, tp=1)
 
     def fwd(weights, kv_pages, ids0, positions0, block_tables, ctx0,
             key, temperature=1.0):
@@ -1019,9 +967,13 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
         # (row cc*Hkv + h): with Hkv second-minor, the per-call reshape to
         # kernel rows relayout-copies the WHOLE buffer at head counts whose
         # (Hkv, D) tile pads (measured: 14 ms/step vs 2.9 at MHA-12 — the
-        # same padded-sublane trap the kv pool layout avoids, kv_cache.py)
-        side_k0 = jnp.zeros((L, S, Cb * Hkv, D), dtype)
-        side_v0 = jnp.zeros((L, S, Cb * Hkv, D), dtype)
+        # same padded-sublane trap the kv pool layout avoids, kv_cache.py).
+        # int8 pools: the slab holds kv_write_dequant'd POOL values, kept
+        # f32 so a bf16 slab round-trip cannot round them away from what
+        # every pool read (int8 * f32 scale, in f32) computes
+        side_dtype = jnp.float32 if kvq else dtype
+        side_k0 = jnp.zeros((L, S, Cb * Hkv, D), side_dtype)
+        side_v0 = jnp.zeros((L, S, Cb * Hkv, D), side_dtype)
 
         def one_pass(x_ids, pos, j, sk_all, sv_all):
             x = _embed_in(spec, weights, x_ids, pos)
@@ -1034,6 +986,16 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
                 w, l = scanned
 
                 def attend(q, k, v):
+                    if kvq:
+                        # int8 pools: the slab holds the rows' POOL values
+                        # (quantize-then-dequantize), so the in-chunk tokens
+                        # are attended at the same values every later
+                        # pool read — and the spec verify's write-then-
+                        # attend — dequantizes; the chunk-end flush
+                        # re-quantizes to the identical int8 bytes
+                        # (kv_write_dequant is value-idempotent)
+                        k = kv_write_dequant(k)
+                        v = kv_write_dequant(v)
                     # step j's rows are the contiguous flat span
                     # [j*Hkv, (j+1)*Hkv)
                     sk_new = jax.lax.dynamic_update_slice(
@@ -1042,19 +1004,14 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
                     sv_new = jax.lax.dynamic_update_slice(
                         sv_all, v[None].astype(sv_all.dtype),
                         (l, 0, j * Hkv, 0))
-                    sc_kw = {}
-                    if kvq:
-                        # the frozen prefix streams int8 (the dominant read);
-                        # the in-chunk side slab stays full precision
-                        sc_kw = dict(kv_scales=sc4)
                     # the WHOLE [L, S, Cb, Hkv, D] stack goes to the kernel,
                     # which BlockSpec-indexes layer l — a dynamic_slice here
                     # would materialise the layer's slab per call (measured
                     # ~150 us/layer of pure copy traffic)
-                    out = paged_decode_attention_sidebuf(
+                    out = ak.sidebuf(
                         q, kvp5, block_tables + l * NB, prefix,
-                        sk_new, sv_new, j, window=spec.window, layer_idx=l,
-                        alibi=spec.alibi, **sc_kw)
+                        sk_new, sv_new, j, layer_idx=l,
+                        kv_scales=sc4 if kvq else None)
                     return out, sk_new, sv_new
 
                 x, (sk_all, sv_all) = _transformer_layer(spec, w, x, pos,
@@ -1307,6 +1264,12 @@ def build_verify_step(spec: RaggedModelSpec, k: int, mesh=None,
     the next write at those positions; block-granular reclamation of
     reserved-but-unused pages is the scheduler's ``rollback_reserved``.
 
+    int8 pools compose: the per-layer write is the quantize-on-write
+    append (``_kv_page_write_quant``) and the chunk kernel dequantizes
+    in-flight, so every in-pass token is attended at its POOL value —
+    the same value sequential decode attends (the ``kv_write_dequant``
+    discipline; docs/SERVING.md "Quantized KV").
+
     Returns ``fwd(weights, kv_pages, ids [S], draft [S, k], n_draft [S],
     positions [S], block_tables [S, MB], ctx [S]) -> (accept_row [2, S]
     int32, next_ids [S] int32, final_logits [S, V], new_kv)`` where
@@ -1320,30 +1283,19 @@ def build_verify_step(spec: RaggedModelSpec, k: int, mesh=None,
     dtype = spec.dtype
     K1 = k + 1
 
-    chunk_win = functools.partial(paged_chunk_attention_batched,
-                                  window=spec.window, alibi=spec.alibi)
-
-    def _chunk_attn(q, kv_l, bts, q0s, ctxs):
-        if tp > 1:
-            from jax.sharding import PartitionSpec as P
-            from deepspeed_tpu.comm.mesh import TENSOR_AXIS
-            fn = _tp_wrap(
-                chunk_win, mesh,
-                in_specs=(P(None, None, TENSOR_AXIS, None),
-                          P(None, None, TENSOR_AXIS, None, None),
-                          P(None, None), P(None), P(None)),
-                out_specs=P(None, None, TENSOR_AXIS, None))
-            return fn(q, kv_l, bts, q0s, ctxs)
-        return chunk_win(q, kv_l, bts, q0s, ctxs)
+    ak = AttentionKernelSpec(spec, mesh=mesh, tp=tp)
 
     def fwd(weights, kv_pages, ids, draft, n_draft, positions0,
             block_tables, ctx0):
         kv_pages, kv_sc = _kv_unpack(kv_pages)
-        assert kv_sc is None, "spec decode with int8 KV pages is not wired"
+        kvq = kv_sc is not None
+        assert not (kvq and tp > 1), "int8 KV pages + TP not wired"
         S = ids.shape[0]
         L, NB, bs = kv_pages.shape[0], kv_pages.shape[1], kv_pages.shape[4]
         MB = block_tables.shape[1]
         kvp0 = kv_pages.reshape(L * NB * 2 * Hkv * bs, D)
+        r8 = _scale_tile_rows(Hkv, bs) if kvq else 0
+        sc0 = kv_sc.reshape(L * NB * r8 * 128) if kvq else None
         tokens = jnp.concatenate([ids[:, None], draft], axis=1)    # [S, K1]
         positions = positions0[:, None] + jnp.arange(K1, dtype=jnp.int32)[None]
         pos_flat = positions.reshape(-1)
@@ -1358,29 +1310,40 @@ def build_verify_step(spec: RaggedModelSpec, k: int, mesh=None,
         x = _embed_in(spec, weights, tokens.reshape(-1), pos_flat)
 
         def layer_fn(carry, scanned):
-            x, kvp = carry
+            x, kvp, sc = carry
             w, l = scanned
 
             def attend(q, k_, v):
                 # write-then-attend (the ragged pass's discipline): all K+1
-                # rows' K/V scatter into the pool, then the chunk kernel
-                # reads pages causally — row j's own token included
-                kvp_ = _kv_page_write(kvp, k_, v,
-                                      _layer_dest(dest, l, NB, bs, L),
-                                      Hkv, bs)
+                # rows' K/V scatter into the pool — quantize-on-write for
+                # int8 pools, the same fused append the decode step runs —
+                # then the chunk kernel reads pages causally (dequantizing
+                # in-flight), row j's own token included: every in-pass
+                # token is attended at its POOL value, exactly what
+                # sequential decode attends (docs/SERVING.md "Quantized KV")
+                dl = _layer_dest(dest, l, NB, bs, L)
+                if kvq:
+                    kvp_, sc_ = _kv_page_write_quant(kvp, sc, k_, v, dl,
+                                                     Hkv, bs)
+                    scales = sc_.reshape(L * NB, r8, 128)
+                else:
+                    kvp_ = _kv_page_write(kvp, k_, v, dl, Hkv, bs)
+                    sc_, scales = sc, None
                 kv_l = kvp_.reshape(L * NB, 2, Hkv, bs, D)
-                out = _chunk_attn(q.reshape(S, K1, H, D), kv_l,
-                                  block_tables + l * NB, positions0,
-                                  ctx0 + (K1 - 1))
-                return out.reshape(S * K1, H, D), kvp_
+                out = ak.chunk(q.reshape(S, K1, H, D), kv_l,
+                               block_tables + l * NB, positions0,
+                               ctx0 + (K1 - 1), kv_scales=scales)
+                return out.reshape(S * K1, H, D), kvp_, sc_
 
-            x, (kvp,) = _transformer_layer(spec, w, x, pos_flat, attend)
-            return (x, kvp), None
+            x, (kvp, sc) = _transformer_layer(spec, w, x, pos_flat, attend)
+            return (x, kvp, sc), None
 
-        (x, kvp), _ = jax.lax.scan(
-            layer_fn, (x, kvp0),
+        (x, kvp, sc), _ = jax.lax.scan(
+            layer_fn, (x, kvp0, sc0),
             (weights["layers"], jnp.arange(L, dtype=jnp.int32)))
         new_kv = kvp.reshape(L, NB, 2, Hkv, bs, D)
+        if kvq:
+            new_kv = (new_kv, sc.reshape(L, NB, r8, 128))
 
         x = _norm(x, weights["final_norm"], spec.norm, spec.eps, dtype,
                   spec.norm_plus_one)
@@ -1412,26 +1375,7 @@ def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
     H, Hkv, D = spec.num_heads, spec.num_kv_heads, spec.head_dim
     dtype = spec.dtype
 
-    step_win = functools.partial(paged_decode_attention_step,
-                                 window=spec.window, alibi=spec.alibi)
-
-    def _decode_step(q, k_new, v_new, kv_l, bts, cls_):
-        if tp > 1:
-            from deepspeed_tpu.utils.jax_compat import shard_map
-            from jax.sharding import PartitionSpec as P
-            from deepspeed_tpu.comm.mesh import TENSOR_AXIS
-            fn = shard_map(
-                step_win, mesh=mesh,
-                in_specs=(P(None, TENSOR_AXIS, None),
-                          P(None, TENSOR_AXIS, None),
-                          P(None, TENSOR_AXIS, None),
-                          P(None, None, TENSOR_AXIS, None, None),
-                          P(None, None), P(None)),
-                out_specs=(P(None, TENSOR_AXIS, None),
-                           P(None, None, TENSOR_AXIS, None, None)),
-                check_vma=False)
-            return fn(q, k_new, v_new, kv_l, bts, cls_)
-        return step_win(q, k_new, v_new, kv_l, bts, cls_)
+    ak = AttentionKernelSpec(spec, mesh=mesh, tp=tp)
 
     def fwd(weights, kv_pages, ids0, positions0, block_tables, ctx0,
             key, temperature=1.0):
@@ -1456,13 +1400,20 @@ def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
 
                 def attend(q, k, v):
                     if kvq:
-                        out, kv5, sc4 = step_win(
+                        # the current token is attended from registers:
+                        # hand the kernel its POOL value (the in-kernel
+                        # re-quantization for the page write is
+                        # value-idempotent) so this path agrees with the
+                        # write-then-attend paths on the attended VALUES
+                        k = kv_write_dequant(k)
+                        v = kv_write_dequant(v)
+                        out, kv5, sc4 = ak.decode_step(
                             q, k, v, kvp.reshape(L * NB, 2, Hkv, bs, D),
                             block_tables + l * NB, ctx,
                             kv_scales=sc.reshape(L * NB, r8, 128))
                         return (out, kv5.reshape(L * NB * 2 * Hkv * bs, D),
                                 sc4.reshape(L * NB * r8 * 128))
-                    out, kv5 = _decode_step(
+                    out, kv5 = ak.decode_step(
                         q, k, v, kvp.reshape(L * NB, 2, Hkv, bs, D),
                         block_tables + l * NB, ctx)
                     return (out, kv5.reshape(L * NB * 2 * Hkv * bs, D), sc)

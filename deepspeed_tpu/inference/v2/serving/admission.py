@@ -114,9 +114,13 @@ class AdmissionController:
         ``decode_batch`` reservation the funding math must match): the
         plain pipeline reserves ``decode_slice + 1``; speculative decoding
         reserves for FULL acceptance — ``decode_slice * (k + 1) + 1`` —
-        with run-end rollback returning what rejection left unused."""
+        with run-end rollback returning what rejection left unused. A
+        frontend pinned to the plain pipeline via ``ServingConfig.spec =
+        False`` funds at the plain rate even on a spec-enabled engine
+        (funding at the spec rate would over-reserve ~(k+1)x and preempt
+        or shed requests the pool can actually serve)."""
         sd = self.engine.config.spec_decode
-        mult = sd.k + 1 if sd.enabled else 1
+        mult = sd.k + 1 if (sd.enabled and self.config.spec) else 1
         return self.config.decode_slice * mult + 1
 
     def enqueue(self, req) -> bool:

@@ -164,10 +164,6 @@ class ServingFrontend:
         cfg = config if config is not None else engine.config.serving
         if isinstance(cfg, dict):
             cfg = ServingConfig(**cfg)
-        if cfg.preemption == "offload" and engine.config.kv_quant.enabled:
-            raise NotImplementedError(
-                "preemption='offload' with int8 KV pages is not wired — "
-                "run preemption='recompute' or 'none'")
         if cfg.preemption != "none" and engine.scheduler.window is not None:
             raise NotImplementedError(
                 "preemption with a sliding-window page ring is not wired "
@@ -176,12 +172,34 @@ class ServingFrontend:
         self.engine = engine
         self.config = cfg
         self.stats = FrontendStats([c.name for c in cfg.classes])
+        # KV-pool gauges (monitor/serving.py): pool dtype + bytes/token are
+        # static facts of the engine build; the capacity doubling an int8
+        # pool buys (same HBM budget -> ~2x+ blocks) is then observable in
+        # the same serve/frontend/* surface the latency counters live on
+        kvc = engine.kv.config
+        import jax.numpy as jnp
+        self.stats.set_kv_pool(
+            dtype_bits=8 if kvc.quantized
+            else 8 * jnp.dtype(kvc.dtype).itemsize,
+            bytes_per_token=kvc.bytes_per_block() / kvc.block_size,
+            pool_tokens=engine.allocator.total_blocks * kvc.block_size,
+            max_context=engine.config.state_manager.max_context,
+            block_size=kvc.block_size)
+        self.stats.kv_free_blocks = engine.allocator.free_blocks
+        self.stats.kv_resident_seqs = len(engine.scheduler.seqs)
         self.admission = AdmissionController(engine, cfg)
         self.offload: Optional[KVOffloadManager] = (
             KVOffloadManager(engine, max_bytes=cfg.max_offload_bytes,
                              max_buffers=cfg.offload_buffers)
             if cfg.preemption == "offload" else None)
-        self._pipe = engine.decode_pipeline(())
+        if cfg.spec:
+            self._pipe = engine.decode_pipeline(())
+        else:
+            # per-frontend spec opt-out (ServingConfig.spec): greedy
+            # serving pinned to the plain pipeline even on a spec-enabled
+            # engine
+            from deepspeed_tpu.inference.v2.pipeline import DecodePipeline
+            self._pipe = DecodePipeline(engine, ())
         # speculative pipeline: steps emit token BATCHES (accepted draft
         # prefix + bonus) — on_tokens shape and TBT accounting branch on it
         self._spec = bool(getattr(self._pipe, "spec", False))
@@ -737,9 +755,17 @@ class ServingFrontend:
         if admitted or self.engine.scheduler.has_pending():
             self._prefill(admitted)
         self.stats.queue_depth = self.admission.queued
+        # KV-pool residency gauges, refreshed at the same cadence as
+        # queue_depth (one admission round): free blocks + tracked
+        # sequences feed the resident-sequence-headroom view the capacity
+        # doubling is read from (docs/SERVING.md "Quantized KV")
+        self.stats.kv_free_blocks = self.engine.allocator.free_blocks
+        self.stats.kv_resident_seqs = len(self.engine.scheduler.seqs)
         if _tracer.enabled:
             _tracer.counter("serve/frontend/queue_depth",
                             self.stats.queue_depth, lane="serve/frontend")
+            _tracer.counter("serve/frontend/kv_free_blocks",
+                            self.stats.kv_free_blocks, lane="serve/frontend")
         return bool(actions)
 
     def _prefill(self, reqs: List[RequestHandle]) -> None:

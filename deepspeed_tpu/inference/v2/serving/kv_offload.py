@@ -76,8 +76,9 @@ class KVOffloadManager:
 
     @property
     def page_nbytes(self) -> int:
-        # non-quantized pools only (quantized is rejected up front), where
-        # bytes_per_block IS the page payload — one source of size truth
+        # bytes_per_block IS the host page payload for every pool layout —
+        # int8 pools ship packed value+scale-tile rows of exactly this size
+        # (engine.page_payload_spec) — one source of size truth
         return self.engine.kv.config.bytes_per_block()
 
     @property
@@ -114,10 +115,13 @@ class KVOffloadManager:
         dtype = None
         nbytes = 0
         if tail:
-            # ONE bucketed gather + ONE host transfer for the whole tail
-            # (engine.fetch_pages) — page content copied out BEFORE the ids
-            # are freed; pinned staging per page so restore can release
-            # buffers back to the pool independent of tail length
+            # ONE bucketed gather for the whole tail (engine.fetch_pages;
+            # fp pools drain in one host transfer, int8 pools in two —
+            # values + scale tiles are separate pool leaves — plus a host
+            # repack into the packed payload) — page content copied out
+            # BEFORE the ids are freed; pinned staging per page so restore
+            # can release buffers back to the pool independent of tail
+            # length
             pages = e.fetch_pages(tail)
             shape, dtype = pages.shape[1:], pages.dtype
             per = int(pages[0].nbytes)

@@ -210,8 +210,11 @@ class SpecDecodePipeline:
         # each step dispatches the SMALLEST (bucket, k) rung covering its
         # longest draft — a mostly-unrepetitive batch pays 2-row verifies,
         # not full-k ones; draft-empty steps (cold history, post-reject
-        # backoff) dispatch the PLAIN fused decode step, bit-identical to
-        # a verify step's row 0. Everything here is on the warmed grid:
+        # backoff) dispatch the PLAIN fused decode step — bit-identical to
+        # a verify step's row 0 for full-precision pools, value-identical
+        # up to cross-kernel float noise for int8 pools (both attend the
+        # quantized pool values; docs/SERVING.md "Quantized KV").
+        # Everything here is on the warmed grid:
         # the ladder tops out at exactly self.k (both read config k), the
         # invariant the zero-compile gate rests on.
         ladder = e.spec_k_ladder

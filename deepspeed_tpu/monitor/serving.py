@@ -236,6 +236,20 @@ class FrontendStats:
         self.classes: Dict[str, _ClassCounters] = {
             name: _ClassCounters() for name in class_names}
         self.queue_depth = 0               # gauge: pending after last round
+        # KV-pool gauges (set_kv_pool at frontend build; residency refreshed
+        # per admission round) — the serve/frontend/kv/* surface that makes
+        # an int8 pool's capacity doubling observable next to the latency
+        # counters it buys (docs/SERVING.md "Quantized KV"). Static facts
+        # are config-derived, not timed, so the stats-equals-spans invariant
+        # is untouched; the per-round residency gauges mirror to trace
+        # counters from the same refresh point.
+        self.kv_pool_dtype_bits = 0
+        self.kv_bytes_per_token = 0.0
+        self.kv_pool_tokens = 0
+        self.kv_max_context = 0
+        self.kv_block_size = 0
+        self.kv_free_blocks = 0            # gauge: after last admission round
+        self.kv_resident_seqs = 0          # gauge: tracked sequences
         self.preemptions = 0               # victims preempted (any mechanism)
         self.recompute_preemptions = 0     # ... of which fell back to recompute
         self.restores = 0
@@ -244,6 +258,16 @@ class FrontendStats:
         self.forced_sheds = 0              # reject-only emergency sheds
 
     # -- recording (engine thread) ------------------------------------- #
+
+    def set_kv_pool(self, dtype_bits: int, bytes_per_token: float,
+                    pool_tokens: int, max_context: int,
+                    block_size: int) -> None:
+        """Static KV-pool facts (one call at frontend construction)."""
+        self.kv_pool_dtype_bits = int(dtype_bits)
+        self.kv_bytes_per_token = float(bytes_per_token)
+        self.kv_pool_tokens = int(pool_tokens)
+        self.kv_max_context = int(max_context)
+        self.kv_block_size = int(block_size)
 
     def record_submit(self, cls: str) -> None:
         self.classes[cls].submitted += 1
@@ -278,8 +302,26 @@ class FrontendStats:
         import numpy as np
         base = "serve/frontend" if self.replica is None \
             else f"serve/frontend/{self.replica}"
+        # how many MORE max_context-length sequences the free pool could
+        # hold right now — the headroom number an int8 pool's capacity
+        # doubling moves (same HBM budget -> more blocks -> more headroom).
+        # Counted in whole BLOCKS: a sequence's last partial block still
+        # consumes a full block, so free_tokens // max_context would
+        # overstate headroom whenever max_context % block_size != 0
+        headroom = (self.kv_free_blocks
+                    // -(-self.kv_max_context // self.kv_block_size)
+                    if self.kv_max_context and self.kv_block_size else 0)
         out: List[Event] = [
             (f"{base}/queue_depth", float(self.queue_depth), step),
+            (f"{base}/kv/pool_dtype_bits",
+             float(self.kv_pool_dtype_bits), step),
+            (f"{base}/kv/bytes_per_token",
+             float(self.kv_bytes_per_token), step),
+            (f"{base}/kv/pool_tokens", float(self.kv_pool_tokens), step),
+            (f"{base}/kv/free_blocks", float(self.kv_free_blocks), step),
+            (f"{base}/kv/resident_seqs",
+             float(self.kv_resident_seqs), step),
+            (f"{base}/kv/resident_seq_headroom", float(headroom), step),
             (f"{base}/preemptions", float(self.preemptions), step),
             (f"{base}/recompute_preemptions",
              float(self.recompute_preemptions), step),

@@ -83,6 +83,44 @@ def kv_quantize_rows(x: jax.Array):
     return q.astype(jnp.int8), s
 
 
+def kv_dequantize_rows(q: jax.Array, s: jax.Array) -> jax.Array:
+    """Inverse of :func:`kv_quantize_rows`: (int8 values ``[..., D]``, f32
+    scales ``[...]``) -> f32 rows. This is the CPU ``lax.*`` reference for
+    what the kernels' in-flight dequant computes — the kernels fold the
+    per-row scale into score/p columns instead of materialising this
+    product, an algebraic identity, so reference attention over
+    ``kv_dequantize_rows(pages)`` is the ground truth the int8 kernel
+    paths are tested against (tests/unit/test_paged_attention.py)."""
+    return q.astype(jnp.float32) * s[..., None]
+
+
+def kv_write_dequant(x: jax.Array) -> jax.Array:
+    """Quantize-then-dequantize: the value an int8 page actually stores and
+    every later reader dequantizes back. The fused decode paths
+    (side-buffer slab, step-kernel registers) pass new K/V rows through
+    this BEFORE attending, so the current token is attended at its POOL
+    value — the same value the verify step's write-then-attend reads from
+    the pages — instead of its raw pre-quantization value (a ~1/254
+    relative semantic gap that would break the spec-on/off byte gates).
+
+    Re-quantizing the result is BYTE-idempotent: the max-abs element maps
+    to exactly +-127, so a second ``kv_quantize_rows`` reproduces the same
+    int8 values AND the same f32 scale — ``s = fl(amax/127)`` satisfies
+    ``fl(fl(127*s)/127) == s`` (the div->mul->div composition is
+    idempotent after the first division; measured over 17.7M f32 bit
+    patterns), so raw-row writers (ragged pass, verify step) and deq'd-row
+    re-quantizers (decode step, sidebuf flush) store bit-identical page
+    bytes for the same token (pinned by tests/unit/test_paged_attention.py).
+
+    Returns f32 — NOT the input dtype: the kernels dequantize pages as
+    int8 * f32 scale in f32, so a bf16 round-trip here would round the
+    attended value away from what every pool read computes (a ~1e-2-class
+    gap on bf16 engines, exactly the kind the pool-value discipline
+    exists to close)."""
+    q, s = kv_quantize_rows(x)
+    return kv_dequantize_rows(q, s)
+
+
 def _scale_tile_rows(h_kv: int, bs: int) -> int:
     """Sublane rows of one page's scale tile, padded to the (8, 128) f32
     tile: a page's 2*Hkv*bs scales (K + V) occupy 2*Hkv*bs/128 lane rows;

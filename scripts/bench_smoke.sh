@@ -35,6 +35,15 @@ timeout -k 10 300 python benchmarks/serving_bench.py --steady-state \
 timeout -k 10 300 python benchmarks/serving_bench.py --frontend --smoke \
     || exit 1
 
+# quantized-KV leg (docs/SERVING.md "Quantized KV"): the same seeded
+# Poisson workload against an fp32 pool and an int8 pool sized from ONE
+# byte budget, both with prefix cache AND spec decode enabled — gating
+# byte-identical quantized streams across cache-hit / spec-on-off /
+# preempt-offload-restore paths, zero timed compiles, and the bytes/token
+# + pool-blocks capacity drop (goodput medians gate full-size, BENCH_r15)
+timeout -k 10 600 python benchmarks/serving_bench.py --frontend --smoke \
+    --kv-dtype int8 || exit 1
+
 # speculative-decoding leg (docs/SERVING.md "Speculative decoding"):
 # spec-off DecodePipeline vs draft-and-verify SpecDecodePipeline on one
 # warmed engine, gating byte-identical greedy streams, zero compiles across

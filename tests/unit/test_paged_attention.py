@@ -543,3 +543,99 @@ class TestAlibi:
                                                        sk, sv, 5, alibi=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-4)
+
+
+class TestKVQuantizeEdgeCases:
+    """kv_quantize_rows / kv_scales_to_tiles edge cases + the pinned
+    round-trip error bound — the numeric contract docs/SERVING.md
+    "Quantized KV" documents (the rtol tier derives from it)."""
+
+    def test_zero_rows(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import kv_quantize_rows
+        q, s = kv_quantize_rows(jnp.zeros((0, 3, 16), jnp.float32))
+        assert q.shape == (0, 3, 16) and q.dtype == jnp.int8
+        assert s.shape == (0, 3)
+
+    def test_all_zero_row_quantizes_to_zero(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            kv_dequantize_rows, kv_quantize_rows)
+        q, s = kv_quantize_rows(jnp.zeros((2, 16), jnp.float32))
+        assert not np.asarray(q).any()
+        assert np.isfinite(np.asarray(s)).all()      # the 1e-20 floor holds
+        assert not np.asarray(kv_dequantize_rows(q, s)).any()
+
+    def test_single_element_extremes_and_saturation(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import kv_quantize_rows
+        # one huge element per row: it maps to EXACTLY +-127 (amax/s == 127
+        # by construction — no clipping needed), tiny siblings round to 0
+        x = np.zeros((2, 128), np.float32)
+        x[0, 3] = 3e4
+        x[0, 7] = 1e-3
+        x[1, 5] = -2e-6
+        q, s = kv_quantize_rows(jnp.asarray(x))
+        q = np.asarray(q)
+        assert q[0, 3] == 127 and q[0, 7] == 0
+        assert q[1, 5] == -127                        # row max-abs element
+        assert np.abs(q).max() <= 127                 # never overflows int8
+        # extreme magnitudes at both ends stay finite
+        x2 = np.full((1, 128), 3.0e38, np.float32)
+        q2, s2 = kv_quantize_rows(jnp.asarray(x2))
+        assert np.isfinite(np.asarray(s2)).all()
+        assert (np.asarray(q2) == 127).all()
+
+    def test_roundtrip_error_bound_pinned(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            kv_dequantize_rows, kv_quantize_rows)
+        rng = np.random.RandomState(0)
+        x = (rng.randn(64, 4, 128) * np.exp(rng.randn(64, 4, 1))
+             ).astype(np.float32)
+        q, s = kv_quantize_rows(jnp.asarray(x))
+        deq = np.asarray(kv_dequantize_rows(q, s))
+        amax = np.abs(x).max(-1, keepdims=True)
+        # |x - deq(q(x))| <= s/2 = amax/254 per element (round-to-nearest
+        # of x/s), the bound the rtol gate tier derives from
+        assert (np.abs(x - deq) <= amax / 254 * (1 + 1e-5)).all()
+
+    def test_write_dequant_value_idempotent(self):
+        # the fused decode paths' invariant: re-quantizing the POOL value
+        # reproduces the identical int8 bytes AND the identical scale
+        # bytes, so every pool writer — raw-row quantizers (ragged pass,
+        # verify step) and deq'd-row re-quantizers (decode step, sidebuf
+        # flush) — stores bit-identical pages for the same token. The
+        # scale exactness is a property of the amax/127 derivation:
+        # s = fl(amax/127) satisfies fl(fl(127*s)/127) == s (verified
+        # over 17.7M f32 bit patterns across the exponent range; the
+        # div->mul->div composition is idempotent after the first
+        # division), and the deq'd row's amax element is exactly
+        # fl(127*s) because its max-abs value quantizes to +-127.
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            kv_quantize_rows, kv_write_dequant)
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(32, 2, 128).astype(np.float32))
+        q1, s1 = kv_quantize_rows(x)
+        deq = kv_write_dequant(x)
+        q2, s2 = kv_quantize_rows(deq)
+        assert np.array_equal(np.asarray(q1), np.asarray(q2))
+        assert np.array_equal(np.asarray(s1), np.asarray(s2))
+
+    def test_scales_to_tiles_layout_and_padding(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            kv_scale_tiles_shape, kv_scales_to_tiles)
+        rng = np.random.RandomState(2)
+        # 2*Hkv*bs = 256 scales -> 2 lane rows, padded to the 8-row tile:
+        # a NON-multiple-of-8 logical row count (the padding case)
+        NB, Hkv, bs = 3, 2, 64
+        s = rng.rand(NB, 2, Hkv, bs).astype(np.float32)
+        tiles = np.asarray(kv_scales_to_tiles(jnp.asarray(s)))
+        assert tiles.shape == kv_scale_tiles_shape(NB, Hkv, bs) == (NB, 8, 128)
+        flat = tiles.reshape(NB, -1)
+        # flat index kv*Hkv*bs + h*bs + t holds scale [kv, h, t]
+        for kv_i in range(2):
+            for h in range(Hkv):
+                idx = kv_i * Hkv * bs + h * bs + np.arange(bs)
+                assert np.array_equal(flat[:, idx], s[:, kv_i, h, :])
+        # the padded lanes are zero (DMA-read, multiplied only under masks)
+        assert not flat[:, 2 * Hkv * bs:].any()
+        # already-tiled input passes through untouched
+        assert np.array_equal(
+            np.asarray(kv_scales_to_tiles(jnp.asarray(tiles))), tiles)
