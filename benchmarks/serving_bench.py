@@ -1232,6 +1232,65 @@ def _clear_prefix_caches(engines):
                 break
 
 
+def _attribution_gate(handles):
+    """SLO-miss attribution gate (docs/OBSERVABILITY.md): every finished
+    request's phase ledger must TILE arrival..last-emission — its stints
+    sum to the client-measured latency (TTFT + Σ TBT) within the shared
+    ``attribution_epsilon`` (the SAME tolerance the serve/slo
+    attr_consistent stat applies). Gated over ALL finished requests (a
+    superset of the SLO-missed ones the acceptance bar names). Returns
+    (checked, bad_records)."""
+    from deepspeed_tpu.inference.v2.serving.frontend import \
+        attribution_epsilon
+    checked = 0
+    bad = []
+    for h in handles:
+        if h.status != "finished":
+            continue
+        attr = h.attribution()
+        client = attr["client_s"]
+        if client is None:
+            continue
+        checked += 1
+        if abs(attr["total_s"] - client) > attribution_epsilon(client):
+            bad.append({"uid": h.uid, "migrated": h.migrated,
+                        "client_s": round(client, 4),
+                        "ledger_s": round(attr["total_s"], 4),
+                        "phases": {k: round(v, 4)
+                                   for k, v in attr["phases"].items()}})
+    return checked, bad
+
+
+def _migrated_chain_gate(handles):
+    """Failover chain gate: every migrated FINISHED request must carry a
+    ``migration`` stint on its ledger, and (tracing on) its flow chain —
+    spans sharing its trace_id — must span >= 2 lanes including the
+    health lane's migrate span: the hops survive the replica death.
+    Returns (migrated_finished, ok_count, bad_uids)."""
+    from deepspeed_tpu.monitor.trace import tracer as _tr
+    migrated = [h for h in handles if h.status == "finished" and h.migrated]
+    if not migrated:
+        return 0, 0, []
+    by_tid = {}
+    if _tr.enabled:
+        for kind, name, _t0, _t1, lane, args in _tr.iter_records():
+            if kind == "X" and args and "trace_id" in args:
+                by_tid.setdefault(args["trace_id"], set()).add((lane, name))
+    ok = 0
+    bad = []
+    for h in migrated:
+        good = any(p == "migration" for p, _, _ in h.timeline())
+        if good and _tr.enabled:
+            recs = by_tid.get(h.trace_id, set())
+            good = (len({lane for lane, _ in recs}) >= 2
+                    and any(n == "serve/health/migrate" for _, n in recs))
+        if good:
+            ok += 1
+        else:
+            bad.append(h.uid)
+    return len(migrated), ok, bad
+
+
 def _check_router_streams(engine, handles, limit, uid_base):
     """Byte-equality: finished router streams vs direct decode_pipeline
     runs of the same prompts on ``engine`` (same weights on every replica,
@@ -1354,6 +1413,7 @@ def run_router(on_tpu: bool, smoke: bool, seed: int = 0, reps: int = 3):
                               ["serve", "serve"], arrivals, duration)
             checked, equal = _check_router_streams(
                 engines[0], res["handles"], 12 if smoke else 32, 170_000)
+            a_checked, a_bad = _attribution_gate(res["handles"])
             out = {
                 "leg": "router", "mode": policy, "rep": r, "rate": rate,
                 "duration": duration, "arrivals": len(arrivals),
@@ -1363,13 +1423,17 @@ def run_router(on_tpu: bool, smoke: bool, seed: int = 0, reps: int = 3):
                 "rebalances": res["rebalances"],
                 "streams_checked": checked, "streams_equal": equal,
                 "outputs_equal": equal == checked,
+                "attribution_checked": a_checked,
+                "attribution_bad": a_bad[:4],
+                "attribution_ok": a_checked > 0 and not a_bad,
                 "compiles_during_timed": res["compiles"],
                 **res["report"],
             }
             routing[policy].append(out)
             print(json.dumps(out), flush=True)
             if not out["outputs_equal"] or any(c != 0 for c in
-                                               res["compiles"]):
+                                               res["compiles"]) \
+                    or not out["attribution_ok"]:
                 ok = False
     results["routing"] = routing
 
@@ -1391,6 +1455,7 @@ def run_router(on_tpu: bool, smoke: bool, seed: int = 0, reps: int = 3):
             # references run there so prefill+decode share one engine
             checked, equal = _check_router_streams(
                 engines[1], res["handles"], 8 if smoke else 24, 180_000)
+            a_checked, a_bad = _attribution_gate(res["handles"])
             out = {
                 "leg": "router_disagg", "mode": topo, "rep": r,
                 "rate": rate, "duration": duration,
@@ -1400,13 +1465,17 @@ def run_router(on_tpu: bool, smoke: bool, seed: int = 0, reps: int = 3):
                 "tbt_p95_ms": res["tbt_p95_ms"],
                 "streams_checked": checked, "streams_equal": equal,
                 "outputs_equal": equal == checked,
+                "attribution_checked": a_checked,
+                "attribution_bad": a_bad[:4],
+                "attribution_ok": a_checked > 0 and not a_bad,
                 "compiles_during_timed": res["compiles"],
                 **res["report"],
             }
             disagg[topo].append(out)
             print(json.dumps(out), flush=True)
             if not out["outputs_equal"] or any(c != 0 for c in
-                                               res["compiles"]):
+                                               res["compiles"]) \
+                    or not out["attribution_ok"]:
                 ok = False
             if topo == "disaggregated" and res["handoffs"] < 1:
                 ok = False
@@ -1583,6 +1652,8 @@ def run_chaos(on_tpu: bool, smoke: bool, seed: int = 0, reps: int = 3):
         hs = res["health"]
         checked, equal, migrated_checked = _check_chaos_streams(
             engines[-1], res["handles"], 16 if smoke else 40, 200_000)
+        a_checked, a_bad = _attribution_gate(res["handles"])
+        m_total, m_ok, m_bad = _migrated_chain_gate(res["handles"])
         crash_dump = (os.path.exists(os.path.join(
             trace_dir, "trace_crash.json")) if trace_dir else None)
         out = {
@@ -1604,6 +1675,12 @@ def run_chaos(on_tpu: bool, smoke: bool, seed: int = 0, reps: int = 3):
             "streams_checked": checked, "streams_equal": equal,
             "migrated_streams_checked": migrated_checked,
             "outputs_equal": equal == checked,
+            "attribution_checked": a_checked,
+            "attribution_bad": a_bad[:4],
+            "attribution_ok": a_checked > 0 and not a_bad,
+            "migrated_finished": m_total,
+            "migrated_chains_ok": m_ok,
+            "migrated_chains_bad": m_bad[:8],
             "compiles_during_timed": res["compiles"],
             "allocator_at_baseline": res["free_ok"],
             "flight_recorder_dump": crash_dump,
@@ -1614,7 +1691,8 @@ def run_chaos(on_tpu: bool, smoke: bool, seed: int = 0, reps: int = 3):
         if not out["outputs_equal"] or any(c != 0 for c in res["compiles"]) \
                 or not all(res["free_ok"]) or not res["all_healthy"] \
                 or hs.liveness_downs < 1 or hs.stall_downs < 1 \
-                or hs.migrations < 1 or hs.rejoins < 2:
+                or hs.migrations < 1 or hs.rejoins < 2 \
+                or not out["attribution_ok"] or m_ok < m_total:
             ok = False
         if crash_dump is False:
             ok = False
@@ -1639,6 +1717,127 @@ def run_chaos(on_tpu: bool, smoke: bool, seed: int = 0, reps: int = 3):
                           "median_goodput_n_minus_1_floor": med_floor,
                           "bar": "chaos >= 0.7 x floor"}), flush=True)
         ok = ok and gate
+    return ok
+
+
+def run_serving_trace_overhead(on_tpu: bool, smoke: bool, seed: int = 0,
+                               reps: int = 5):
+    """Serving-side tracer/attribution overhead leg (the
+    ``train_bench.py --trace-overhead`` discipline applied to the router
+    stack), BENCH_r16. The SAME seeded burst workload (every arrival
+    submitted immediately — the wall time is serving work, not open-loop
+    sleeps) replays against a 2-replica cache-aware router with flow
+    tracing + phase attribution ON vs OFF, orders ALTERNATED per rep.
+
+    Gates, every rep:
+
+      - byte-identical streams: each request finished on both sides
+        produced the same tokens (tracing/attribution must not perturb
+        placement-independent greedy serving);
+      - zero engine compiles on every replica in every timed replay;
+      - attribution consistency on the ON side (ledger sums to the
+        client-measured latency per finished request).
+
+    Full runs additionally gate: median per-rep wall ratio (ON/OFF)
+    <= 1.02 — flow tracing plus the ledger costs at most 2% of serving
+    wall. Smoke: one rep, correctness gates only."""
+    from deepspeed_tpu.inference.v2.serving import (PoissonLoadGen,
+                                                    ServingCluster,
+                                                    ServingRouter,
+                                                    WorkloadComponent,
+                                                    replay)
+    from deepspeed_tpu.monitor.trace import tracer as _tr
+    classes = [{"name": "interactive", "priority": 2,
+                "ttft_slo_ms": 60000.0, "tbt_slo_ms": 20000.0},
+               {"name": "batch", "priority": 0,
+                "ttft_slo_ms": 60000.0, "tbt_slo_ms": 20000.0}]
+    engines = []
+    for _ in range(2):
+        e, vocab = build_frontend_engine(on_tpu, pool_blocks=112, ctx=192,
+                                         prefix_cache=True)
+        _force_paged(e)
+        engines.append(e)
+    n_arrivals = 16 if smoke else 48
+    mix = [WorkloadComponent("interactive", 3.0, [16, 32], [8, 16],
+                             prefix_len=64),
+           WorkloadComponent("batch", 1.0, [32], [24])]
+    arrivals = PoissonLoadGen(rate=8.0, mix=mix, vocab=vocab,
+                              seed=seed).arrivals(n=n_arrivals)
+    if smoke:
+        reps = 1
+
+    def replay_once(attribution: bool):
+        _clear_prefix_caches(engines)
+        serving = {"classes": classes, "decode_slice": 4,
+                   "idle_wait_s": 0.002, "attribution": attribution}
+        cluster = ServingCluster(engines, serving=serving)
+        rt = ServingRouter(cluster, {"policy": "cache_aware",
+                                     "balance": 16.0})
+        c0 = [e.compiles for e in engines]
+        t0 = time.perf_counter()
+        rt.start()
+        handles = replay(rt, arrivals, speed=1e9)   # burst: no pacing sleeps
+        rt.drain(timeout=120.0)
+        wall = time.perf_counter() - t0
+        rt.close()
+        return {"handles": handles, "wall": wall,
+                "compiles": [e.compiles - c for e, c in zip(engines, c0)]}
+
+    was_enabled = _tr.enabled        # $DSTPU_TRACE may have armed it
+    _tr.enabled = False
+    replay_once(False)               # untimed warm: lazy costs absorbed
+    ok = True
+    ratios = []
+    reps_out = []
+    for r in range(reps):
+        order = ("on", "off") if r % 2 == 0 else ("off", "on")
+        res = {}
+        for side in order:
+            if side == "on":
+                _tr.configure(enabled=True)
+            else:
+                _tr.enabled = False
+            res[side] = replay_once(attribution=(side == "on"))
+            _tr.enabled = False
+        checked = equal = 0
+        for a, b in zip(res["on"]["handles"], res["off"]["handles"]):
+            if a.status == "finished" and b.status == "finished":
+                checked += 1
+                equal += a.tokens == b.tokens
+        a_checked, a_bad = _attribution_gate(res["on"]["handles"])
+        ratio = res["on"]["wall"] / res["off"]["wall"]
+        ratios.append(ratio)
+        out = {
+            "leg": "serving_trace_overhead", "rep": r, "order": list(order),
+            "arrivals": len(arrivals),
+            "wall_on_s": round(res["on"]["wall"], 4),
+            "wall_off_s": round(res["off"]["wall"], 4),
+            "ratio": round(ratio, 4),
+            "streams_checked": checked, "streams_equal": equal,
+            "outputs_equal": checked == equal and checked >= int(
+                0.9 * len(arrivals)),
+            "attribution_checked": a_checked,
+            "attribution_ok": a_checked > 0 and not a_bad,
+            "compiles_during_timed": [res[s]["compiles"] for s in order],
+        }
+        reps_out.append(out)
+        print(json.dumps(out), flush=True)
+        if not out["outputs_equal"] or not out["attribution_ok"] \
+                or any(c != 0 for side in ("on", "off")
+                       for c in res[side]["compiles"]):
+            ok = False
+    _tr.enabled = was_enabled
+    for e in engines:
+        _unforce_paged(e)
+    med = float(np.median(ratios))
+    gate = {"gate": "serving_trace_overhead",
+            "median_ratio": round(med, 4), "ratios_per_rep":
+            [round(x, 4) for x in ratios], "bar": 1.02,
+            "enforced": not smoke,
+            "ok": bool(smoke or med <= 1.02)}
+    print(json.dumps(gate), flush=True)
+    if not smoke:
+        ok = ok and med <= 1.02
     return ok
 
 
@@ -1699,6 +1898,13 @@ def main():
                          "incl. rejoin re-warm, allocator baseline on every "
                          "replica, and (full) goodput >= 0.7x an "
                          "N-1-replica no-fault floor")
+    ap.add_argument("--trace-overhead", action="store_true",
+                    help="run the serving tracer/attribution overhead leg: "
+                         "the same seeded burst router workload with flow "
+                         "tracing + phase attribution ON vs OFF (orders "
+                         "alternated per rep), gating byte-identical "
+                         "streams, zero timed compiles, attribution "
+                         "consistency, and (full) median overhead <= 2%")
     ap.add_argument("--spec", action="store_true",
                     help="run the speculative-decoding leg: spec-off "
                          "DecodePipeline vs draft-and-verify "
@@ -1729,9 +1935,9 @@ def main():
     ap.add_argument("--rate", type=float, default=None,
                     help="frontend leg: Poisson arrivals/sec (default: an "
                          "oversubscribing 36/s full, 10/s smoke)")
-    ap.add_argument("--reps", type=int, default=3,
-                    help="frontend leg: replays per mode; the goodput gate "
-                         "compares medians (smoke always runs 1)")
+    ap.add_argument("--reps", type=int, default=None,
+                    help="replays per mode/rep count (default: 3; the "
+                         "trace-overhead leg defaults to 5, its smoke to 1)")
     ap.add_argument("--requests", type=int, default=16,
                     help="shared-prefix leg: number of requests")
     ap.add_argument("--prefix", type=int, default=256,
@@ -1745,12 +1951,15 @@ def main():
     from deepspeed_tpu.utils.compile_cache import setup_compile_cache
     setup_compile_cache(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    # one shared default for every leg's rep count; the trace-overhead
+    # leg overrides to its own 5-rep default below
+    reps = args.reps if args.reps is not None else 3
     if args.spec:
         ok = run_spec(on_tpu, args.smoke, k=args.spec_k,
                       seqs=args.seqs if args.seqs is not None else 4,
                       prompt=args.prompt if args.prompt is not None else 48,
                       gen=args.gen if args.gen is not None else 128,
-                      reps=args.reps)
+                      reps=reps)
         sys.exit(0 if ok else 1)
     if args.gen is None:
         args.gen = 64
@@ -1758,23 +1967,28 @@ def main():
         args.seqs = 32
     if args.prompt is None:
         args.prompt = 128
+    if args.trace_overhead:
+        ok = run_serving_trace_overhead(
+            on_tpu, args.smoke,
+            reps=args.reps if args.reps is not None else 5)
+        sys.exit(0 if ok else 1)
     if args.chaos:
-        ok = run_chaos(on_tpu, args.smoke, reps=args.reps)
+        ok = run_chaos(on_tpu, args.smoke, reps=reps)
         sys.exit(0 if ok else 1)
     if args.router:
-        ok = run_router(on_tpu, args.smoke, reps=args.reps)
+        ok = run_router(on_tpu, args.smoke, reps=reps)
         sys.exit(0 if ok else 1)
     if args.frontend:
         if args.kv_dtype == "int8":
             rate = args.rate or (8.0 if args.smoke else 14.0)
             dur = 3.0 if args.smoke else min(args.duration, 8.0)
             ok = run_kv_dtype(on_tpu, args.smoke, rate=rate, duration=dur,
-                              reps=args.reps)
+                              reps=reps)
             sys.exit(0 if ok else 1)
         rate = args.rate or (10.0 if args.smoke else 36.0)
         dur = 4.0 if args.smoke else min(args.duration, 15.0)
         ok = run_frontend(on_tpu, args.smoke, rate=rate, duration=dur,
-                          reps=args.reps)
+                          reps=reps)
         sys.exit(0 if ok else 1)
     if args.shared_prefix:
         out = run_shared_prefix(on_tpu, args.requests, args.prefix, args.tail,

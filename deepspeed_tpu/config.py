@@ -413,6 +413,29 @@ class CSVConfig(ConfigModel):
 
 
 @dataclass
+class PrometheusConfig(ConfigModel):
+    """Live telemetry endpoint (``monitor/export.py``,
+    docs/OBSERVABILITY.md "Live telemetry"): a pull-based Prometheus-text
+    snapshot of the latest monitor events, served from an embedded HTTP
+    endpoint (``GET /metrics``) so a dashboard scrapes the run without
+    touching CSV files. No reference analog — the reference's monitor is
+    write-side only."""
+
+    enabled: bool = False
+    # bind address/port for the scrape endpoint; port 0 = OS-assigned
+    # (read back from ``PrometheusExporter.port``)
+    addr: str = "127.0.0.1"
+    port: int = 0
+    # metric-name prefix (``serve/frontend/queue_depth`` ->
+    # ``<prefix>_serve_frontend_queue_depth``)
+    prefix: str = "dstpu"
+    # when set, close() writes a final ``metrics.prom`` snapshot under
+    # ``output_path/job_name`` (the CSV convention)
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+@dataclass
 class TraceConfig(ConfigModel):
     """Span tracing (``monitor/trace.py``, docs/OBSERVABILITY.md): a
     Perfetto-exportable timeline across the train/serve/offload/checkpoint
@@ -427,6 +450,10 @@ class TraceConfig(ConfigModel):
     # spans retained per thread — bounded memory AND the flight-recorder
     # window a crash dump preserves
     ring_size: int = 16384
+    # per-request serve/req/u<uid> lanes exported under their own track;
+    # older (retired) requests recycle onto pooled serve/req/recycled/<k>
+    # tracks so a long serving run's timeline stays bounded in rows
+    req_lane_window: int = 64
 
 
 @dataclass
@@ -715,6 +742,7 @@ class DeepSpeedTPUConfig(ConfigModel):
     tensorboard: TensorBoardConfig = field(default_factory=TensorBoardConfig)
     wandb: WandbConfig = field(default_factory=WandbConfig)
     csv_monitor: CSVConfig = field(default_factory=CSVConfig)
+    prometheus: PrometheusConfig = field(default_factory=PrometheusConfig)
     monitor: MonitorConfig = field(default_factory=MonitorConfig)
     flops_profiler: FlopsProfilerConfig = field(default_factory=FlopsProfilerConfig)
     elasticity: ElasticityConfig = field(default_factory=ElasticityConfig)

@@ -305,6 +305,15 @@ class ServingConfig:
     ``max_queue`` bounds the pending queue (beyond = immediate shed);
     ``idle_wait_s`` is the engine thread's block interval when idle.
 
+    ``attribution``: record the per-request phase ledger
+    (``RequestHandle.timeline()`` — queued/admission/prefill/handoff_wait/
+    decode/preempted/restore/migration stints from the same perf stamps the
+    trace spans carry) and bucket SLO misses by dominant phase
+    (``serve/slo/*``; docs/OBSERVABILITY.md "SLO-miss attribution"). A few
+    list appends per phase TRANSITION — nothing per token; ``False``
+    disables both (the A/B lever ``serving_bench.py --trace-overhead``
+    gates).
+
     ``spec``: serve greedy requests through the engine's speculative
     pipeline when ``spec_decode.enabled`` (default). ``False`` pins this
     frontend to the plain ``DecodePipeline`` — a per-frontend A/B lever
@@ -323,6 +332,7 @@ class ServingConfig:
     shed_factor: float = 1.0
     max_queue: int = 1024
     idle_wait_s: float = 0.02
+    attribution: bool = True
 
     def __post_init__(self):
         self.classes = [PriorityClassConfig(**c) if isinstance(c, dict) else c

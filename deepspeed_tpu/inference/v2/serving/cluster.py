@@ -317,9 +317,14 @@ class PrefillWorker:
                             f"fit prompt of {len(req.prompt)} tokens")
                     break              # drain what we have, then continue
                 t = time.perf_counter()
+                # from the phase stamp, not arrival: a failover-requeued
+                # request already attributed arrival..migration — this
+                # stint is only the wait in THIS worker's queue
+                req._ledger_add("queued", req._phase_t0, t)
                 if _tracer.enabled:
-                    _tracer.add("serve/req/queued", req.arrival_t, t,
-                                lane=f"serve/req/u{req.uid}", uid=req.uid)
+                    _tracer.add("serve/req/queued", req._phase_t0, t,
+                                lane=f"serve/req/u{req.uid}", uid=req.uid,
+                                trace_id=req.trace_id)
                 e.scheduler.add_tokens(req.uid, req.prompt)
                 req.status = "prefill"
                 req._phase_t0 = t
@@ -344,9 +349,14 @@ class PrefillWorker:
             # cost model — the router's federation reads this replica's rate
             self.router._note_prefill(self.replica, tokens, t1 - t0)  # jaxlint: disable=JL001
         for req in live:
+            req._ledger_add("prefill", req._phase_t0, t1)
             if _tracer.enabled:
                 _tracer.add("serve/req/prefill", req._phase_t0, t1,
-                            lane=f"serve/req/u{req.uid}", uid=req.uid)
+                            lane=f"serve/req/u{req.uid}", uid=req.uid,
+                            trace_id=req.trace_id)
+            # the decode replica's handoff_wait stint starts here: the
+            # ledger must cover export + fabric wait + import as one span
+            req._phase_t0 = t1
             self._handoff(req)
 
     def _handoff(self, req) -> None:

@@ -35,6 +35,7 @@ per-request trace lane and the aggregate counters in
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
 import time
@@ -62,6 +63,33 @@ SHED = "shed"
 _TERMINAL = (FINISHED, CANCELLED, SHED)
 
 
+#: process-lifetime flow-id mint. trace_id CANNOT be the uid: uid bases
+#: restart with every cluster/frontend lifetime while tracer rings (and
+#: the exporter's flow synthesizer) span the whole process, so uid reuse
+#: across successive clusters — every bench rep, any in-process serving
+#: restart — would merge unrelated requests' hops into one bogus chain.
+#: The pid prefix keeps ids distinct across the subprocess workers whose
+#: files ``trace_merge.py`` stitches into one timeline.
+_TRACE_IDS = itertools.count(1)
+
+
+def _mint_trace_id() -> int:
+    # pid <= 2^22 (linux pid_max ceiling) and a 31-bit counter keep ids
+    # inside the 2^53 exact-double range Chrome-trace ids must survive;
+    # the counter wraps only past 2.1e9 submits per process
+    return (os.getpid() << 31) | (next(_TRACE_IDS) & 0x7FFFFFFF)
+
+
+def attribution_epsilon(client_s: float) -> float:
+    """The ONE tolerance for "this request's ledger sums to its
+    client-measured latency": max(5 ms, 1%). Shared by the
+    ``serve/slo/attr_consistent`` stat (``_finalize``) and the bench
+    attribution gates (``serving_bench._attribution_gate``) so the two can
+    never quietly measure different things (docs/OBSERVABILITY.md
+    "SLO-miss attribution")."""
+    return max(0.005, 0.01 * client_s)
+
+
 class RequestHandle:
     """One submitted request: a thread-safe token stream plus lifecycle
     state. Clients iterate tokens (``for t in handle`` or ``async for t in
@@ -72,6 +100,15 @@ class RequestHandle:
     def __init__(self, uid: int, prompt: np.ndarray, cls, max_new_tokens: int,
                  eos_token_id: Optional[int], arrival_t: float):
         self.uid = uid
+        #: process-unique request flow id, minted at submit and carried by
+        #: every hop span (router placement, prefill, KV handoff, decode
+        #: stints, failover migration) — the exporter binds spans sharing it
+        #: into one Perfetto flow chain across lanes/threads/files. NOT the
+        #: uid (uid bases restart per cluster lifetime; see
+        #: ``_mint_trace_id``) — but like the uid it rides the handle, so a
+        #: migrated request keeps it on the survivor and the chain survives
+        #: failover.
+        self.trace_id = _mint_trace_id()
         self.prompt = prompt
         self.cls = cls                      # PriorityClassConfig
         self.max_new_tokens = max_new_tokens
@@ -102,6 +139,51 @@ class RequestHandle:
         self._last_emit_t: Optional[float] = None
         self._resume_tokens: Optional[np.ndarray] = None   # recompute restore
         self._stop_status = FINISHED            # set on mid-run retirement
+        #: set by failover while a RE-PLANNED cross-replica handoff (pages
+        #: already host-side, no salvage payload) is in flight to a
+        #: survivor: the decode-side import labels its stint ``migration``
+        #: instead of ``handoff_wait`` and clears the flag
+        self._migrating = False
+        #: the phase ledger: (phase, t0, t1) stints built from the SAME
+        #: perf stamps the serve/req trace spans record — where this
+        #: request's time went, summing to the client-measured latency for
+        #: finished requests. ``None`` when attribution is disabled
+        #: (``ServingConfig.attribution``).
+        self._ledger: Optional[List[tuple]] = []
+
+    # -- phase attribution (docs/OBSERVABILITY.md "SLO-miss attribution") -- #
+
+    def _ledger_add(self, phase: str, t0: float, t1: float) -> None:
+        if self._ledger is not None:
+            self._ledger.append((phase, t0, t1))
+
+    def timeline(self) -> List[tuple]:
+        """The per-request phase ledger: ``(phase, t0, t1)`` stints in
+        record order (``time.perf_counter`` endpoints — the same stamps the
+        ``serve/req/*`` trace spans carry). Phases: ``queued``,
+        ``admission``, ``prefill``, ``handoff_wait``, ``decode``,
+        ``preempted``, ``restore``, ``migration``. For a finished request
+        the stints tile ``arrival_t .. last-emission`` with no gaps, so
+        their durations sum to the client-measured latency
+        (TTFT + Σ TBT). Empty when attribution is disabled."""
+        return list(self._ledger or ())
+
+    def attribution(self) -> Dict[str, object]:
+        """Phase attribution summary derived from :meth:`timeline`:
+        per-phase totals, the dominant phase (where most of the latency
+        went — the ``serve/slo/*`` bucketing key for SLO misses), the
+        ledger total, and the client-measured latency (arrival to last
+        emission; ``None`` before any token)."""
+        phases: Dict[str, float] = {}
+        for phase, t0, t1 in (self._ledger or ()):
+            phases[phase] = phases.get(phase, 0.0) + max(0.0, t1 - t0)
+        total = sum(phases.values())
+        client = (self._last_emit_t - self.arrival_t
+                  if self._last_emit_t is not None else None)
+        dominant = max(phases, key=lambda p: phases[p]) if phases else None
+        return {"phases": phases, "dominant": dominant,
+                "total_s": total, "client_s": client,
+                "residual_s": None if client is None else client - total}
 
     # -- client surface ------------------------------------------------ #
 
@@ -171,6 +253,9 @@ class ServingFrontend:
                 "preemption='none'")
         self.engine = engine
         self.config = cfg
+        # phase-ledger recording (RequestHandle.timeline / serve/slo/*);
+        # off = handles carry no ledger and misses go unattributed
+        self._attribution = bool(getattr(cfg, "attribution", True))
         self.stats = FrontendStats([c.name for c in cfg.classes])
         # KV-pool gauges (monitor/serving.py): pool dtype + bytes/token are
         # static facts of the engine build; the capacity doubling an int8
@@ -258,6 +343,8 @@ class ServingFrontend:
         req = RequestHandle(next(self._uid_iter), prompt, cls,
                             int(max_new_tokens), eos_token_id,
                             time.perf_counter())
+        if not self._attribution:
+            req._ledger = None
         with self._inflight_lock:
             self._inflight += 1
         self._ctl.put(("submit", req))
@@ -564,8 +651,13 @@ class ServingFrontend:
             self._reqs[req.uid] = req
             self.stats.record_submit(req.cls.name)
             req._resume_tokens = history
+            now = time.perf_counter()
+            # failover re-home: the ``migration`` stint runs from the seal
+            # stamp (health.py closes the orphaned phase there and re-bases
+            # _phase_t0) to this adoption on the survivor's engine thread
+            self._span(req, "migration", req._phase_t0, now)
             req.status = PREEMPTED
-            req.preempt_t = req._phase_t0 = time.perf_counter()
+            req.preempt_t = req._phase_t0 = now
             self._preempted[req.uid] = req
         # cancellation rides the handle's event (no message): the sweeps /
         # on_tokens observe it within one iteration, and an idle loop ticks
@@ -613,7 +705,17 @@ class ServingFrontend:
     def _finalize(self, req: RequestHandle, status: str) -> None:
         now = time.perf_counter()
         if req.status == DECODING:
-            self._span(req, "decode", req._phase_t0, now)
+            # the ledger's final decode stint ends at the LAST-EMISSION
+            # stamp (the client-visible end the SLOs are defined over), so
+            # a finished request's stints sum to TTFT + Σ TBT exactly; the
+            # trace span keeps the full stint through run-boundary
+            # retirement — both read the same stamp set
+            self._span(req, "decode", req._phase_t0, now, ledger=False)
+            end = req._last_emit_t if (status == FINISHED
+                                       and req._last_emit_t is not None
+                                       and req._last_emit_t >= req._phase_t0) \
+                else now
+            req._ledger_add("decode", req._phase_t0, end)
         req.status = status
         self._reqs.pop(req.uid, None)
         if status == FINISHED:
@@ -624,27 +726,46 @@ class ServingFrontend:
                             <= req.cls.tbt_slo_ms))
             self.stats.record_complete(req.cls.name, req.ttft_ms, req.tbt_ms,
                                        len(req.tokens), slo_met)
+            if not slo_met:
+                # SLO-miss attribution: bucket the miss by where the
+                # latency actually went (serve/slo/* — docs/OBSERVABILITY.md)
+                attr = req.attribution()
+                client = attr["client_s"]
+                consistent = (client is not None
+                              and abs(attr["total_s"] - client)
+                              <= attribution_epsilon(client))
+                self.stats.record_slo_miss(
+                    req.cls.name, attr["dominant"] or "unattributed",
+                    consistent)
         elif status == SHED:
             self.stats.record_shed(req.cls.name)
             if _tracer.enabled:
                 _tracer.instant("serve/req/shed", lane=f"serve/req/u{req.uid}",
-                                uid=req.uid, cls=req.cls.name)
+                                uid=req.uid, trace_id=req.trace_id,
+                                cls=req.cls.name)
         elif status == CANCELLED:
             self.stats.record_cancel(req.cls.name)
             if _tracer.enabled:
                 _tracer.instant("serve/req/cancelled",
-                                lane=f"serve/req/u{req.uid}", uid=req.uid)
+                                lane=f"serve/req/u{req.uid}", uid=req.uid,
+                                trace_id=req.trace_id)
         req._q.put(_DONE)
         req._finished.set()
         with self._inflight_lock:
             self._inflight -= 1
 
     def _span(self, req: RequestHandle, phase: str, t0: float,
-              t1: float) -> None:
+              t1: float, ledger: bool = True) -> None:
+        """One phase stint: a ``serve/req/<phase>`` span on the request's
+        trace lane AND (unless ``ledger=False`` — used where the ledger
+        entry needs different endpoints or a different phase name) an
+        attribution-ledger entry, from one set of perf stamps."""
+        if ledger:
+            req._ledger_add(phase, t0, t1)
         if _tracer.enabled:
             _tracer.add(f"serve/req/{phase}", t0, t1,
                         lane=f"serve/req/u{req.uid}", uid=req.uid,
-                        cls=req.cls.name)
+                        trace_id=req.trace_id, cls=req.cls.name)
 
     def _admit_pipe(self, req: RequestHandle) -> None:
         """Admit to the decode pipeline; a speculative pipeline gets the
@@ -714,7 +835,19 @@ class ServingFrontend:
                 did = True
                 continue
             t1 = time.perf_counter()
-            self._span(req, "handoff", t0, t1)
+            # import-work span first, then the enclosing wait (inner E
+            # before outer E at the shared end ts): ``handoff_wait`` runs
+            # from the prefill replica's last stamp to import completion —
+            # the cross-replica gap the disaggregated ledger must cover; a
+            # failover SALVAGE (history != None) or RE-PLANNED handoff
+            # (req._migrating) is a ``migration`` stint from its seal
+            # stamp instead
+            self._span(req, "handoff", t0, t1, ledger=False)
+            self._span(req,
+                       "migration" if (history is not None or req._migrating)
+                       else "handoff_wait",
+                       req._phase_t0, t1)
+            req._migrating = False
             req.status = DECODING
             req.admit_t = req._phase_t0 = t1
             self.stats.record_admit(req.cls.name)
@@ -747,7 +880,14 @@ class ServingFrontend:
                     self.admission._queues[req.cls.name].appendleft(req)
                     continue
                 t = time.perf_counter()
-                self._span(req, "queued", req.arrival_t, t)
+                # ledger splits the wait at this admission round's plan
+                # stamp: ``queued`` (arrival -> round) + ``admission``
+                # (round -> scheduler attach); the lane span keeps the
+                # whole wait as one ``queued`` stint — same stamps
+                self._span(req, "queued", req.arrival_t, t, ledger=False)
+                if now > req._phase_t0:
+                    req._ledger_add("queued", req._phase_t0, now)
+                req._ledger_add("admission", max(now, req._phase_t0), t)
                 req.status = PREFILL
                 req.admit_t = req._phase_t0 = t
                 self.stats.record_admit(req.cls.name)
@@ -828,6 +968,11 @@ class ServingFrontend:
         t0 = time.perf_counter()
         if self.offload is not None and uid in self.offload._recs:
             self._span(req, "preempted", req._phase_t0, t0)
+            # re-base NOW, not at the end of the restore: a fence landing
+            # mid-restore early-returns before the tail re-base, and the
+            # failover's _close_phase would otherwise append a second,
+            # overlapping 'preempted' stint from the stale stamp
+            req._phase_t0 = t0
             del self._preempted[uid]
             self.stats.restore_bytes += self.offload.restore(uid)
         else:
@@ -836,6 +981,7 @@ class ServingFrontend:
             except RuntimeError:
                 return              # capacity raced the plan: stay preempted
             self._span(req, "preempted", req._phase_t0, t0)
+            req._phase_t0 = t0           # see the offload branch above
             del self._preempted[uid]
             req._resume_tokens = None
             e = self.engine

@@ -345,18 +345,33 @@ class HealthMonitor:
                 self.stats.migration_cancels += 1
                 router._finalize_external(req, CANCELLED)
                 continue
+            self._close_phase(req, t0)
             placed = None
             survivors = sorted(
                 (r for r in router.cluster.prefill_replicas
                  if r.name != exclude and router._routable(r)),
                 key=lambda r: router._workers[r.name].queued)
             for target in survivors:
+                # the migration stint lands on the ledger BEFORE the
+                # publish: the moment submit() succeeds the survivor's
+                # worker thread may pop the handle and record its own
+                # 'queued' stint from _phase_t0 — writing after the
+                # publish would race it (overlapping stints, a clobbered
+                # phase stamp). An unplaceable request sheds terminally,
+                # so a stint recorded for a fenced-then-shed attempt is
+                # never read by the finished-ledger gates.
+                t1 = time.perf_counter()
+                req._ledger_add("migration", t0, t1)
+                req._phase_t0 = t1
                 try:
                     router._workers[target.name].submit(req)
                     placed = target
                     break
                 except RuntimeError:
-                    continue           # fenced in the race window: next
+                    if req._ledger:
+                        req._ledger.pop()   # fenced: the stint never ran
+                    req._phase_t0 = t0
+                    continue           # next survivor
             if placed is not None:
                 req.migrated += 1
                 self.stats.record_migration("reprefill", len(req.prompt))
@@ -365,10 +380,32 @@ class HealthMonitor:
                 self.stats.migration_sheds += 1
                 router._finalize_external(req, SHED)
 
+    #: RequestHandle.status -> ledger phase label for seal-time closes
+    _PHASE_OF = {"queued": "queued", "prefill": "prefill",
+                 "decoding": "decode", "preempted": "preempted"}
+
+    def _close_phase(self, req, t: float, phase: Optional[str] = None) -> None:
+        """Close the phase a dead replica's request was orphaned in: the
+        stint from its last phase stamp to the failover stamp ``t`` lands
+        on the ledger (and the trace lane) — the wedge/crash window is
+        attributed, not lost — and ``_phase_t0`` re-bases to ``t`` so the
+        ``migration`` stint recorded at adoption starts exactly here."""
+        if phase is None:
+            phase = self._PHASE_OF.get(req.status)
+        if phase is not None and t > req._phase_t0:
+            req._ledger_add(phase, req._phase_t0, t)
+            if _tracer.enabled:
+                _tracer.add(f"serve/req/{phase}", req._phase_t0, t,
+                            lane=f"serve/req/u{req.uid}", uid=req.uid,
+                            trace_id=req.trace_id, cls=req.cls.name,
+                            orphaned=True)
+        req._phase_t0 = t
+
     def _migrate_span(self, req, t0: float, mode: str, dst: str) -> None:
         if _tracer.enabled:
             _tracer.add("serve/health/migrate", t0, time.perf_counter(),
-                        lane="serve/health", uid=req.uid, mode=mode, dst=dst)
+                        lane="serve/health", uid=req.uid,
+                        trace_id=req.trace_id, mode=mode, dst=dst)
 
     def _finalize_handle(self, fe, req, status: str) -> None:
         """Terminal-state a handle the dead replica still owned, releasing
@@ -397,6 +434,9 @@ class HealthMonitor:
         t0 = time.perf_counter()
         history = req._seal()
         if req.cancelled:
+            self._close_phase(req, t0,
+                              phase="handoff_wait" if handoff is not None
+                              else None)
             self.stats.migration_cancels += 1
             self._finalize_handle(fe, req, CANCELLED)
             return
@@ -404,15 +444,30 @@ class HealthMonitor:
                 or (req.eos_token_id is not None and req.tokens
                     and req.tokens[-1] == req.eos_token_id))
         if done:
-            # the crash raced the finish line: the stream is complete
+            # the crash raced the finish line: the stream is complete. Its
+            # closing stint ends at the LAST EMISSION — the client-visible
+            # end the finished-ledger tiling invariant is defined over —
+            # not at the seal stamp a failure-detection window later
+            end = req._last_emit_t if req._last_emit_t is not None else t0
+            self._close_phase(req, min(end, t0))
             self._finalize_handle(fe, req, FINISHED)
             return
+        # attribute the orphaned stint (a queued handoff's wait keeps its
+        # handoff_wait label — the status still says prefill) and re-base
+        # the phase clock to the seal: the survivor's adoption records the
+        # migration stint from exactly here, so the ledger stays gapless
+        self._close_phase(req, t0,
+                          phase="handoff_wait" if handoff is not None
+                          else None)
         # pick the payload ONCE (salvage exports destroy the record)
         mode, payload, nbytes = "reprefill", None, 0
         if handoff is not None:
             # a queued cross-replica handoff: pages already host-side —
-            # re-plan it to another decode replica untouched
+            # re-plan it to another decode replica untouched. The import
+            # there is this request's migration landing, not a routine
+            # handoff wait — the flag makes the ledger say so
             mode, payload = "replan", handoff
+            req._migrating = True
         elif fe.offload is not None and fe.offload.salvageable(req.uid):
             pages, logits, nbytes = fe.offload.export_record(req.uid)
             mode, payload = "salvage", (req, pages, logits, history)

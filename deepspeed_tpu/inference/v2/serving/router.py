@@ -359,12 +359,15 @@ class ServingRouter:
                 # routable at all — reject before any prefill burns on it
                 req = RequestHandle(next(self._uids), prompt, cls,
                                     int(max_new_tokens), eos_token_id, t0)
+                if not getattr(self._serving_cfg, "attribution", True):
+                    req._ledger = None
                 with self._lock:
                     self.stats.router_sheds[cls.name] += 1
                 self._finalize_external(req, "shed")
                 if _tracer.enabled:
                     _tracer.add("serve/router/route", t0, t1,
                                 lane="serve/router", outcome="shed",
+                                uid=req.uid, trace_id=req.trace_id,
                                 cls=cls.name)
                 return req
             if self.config.topology == "colocated":
@@ -400,8 +403,11 @@ class ServingRouter:
             if rebalanced:
                 self.stats.rebalances += 1
         if _tracer.enabled:
+            # the flow chain's first hop: trace_id binds this placement
+            # span to every later hop of the request across lanes/threads
             _tracer.add("serve/router/route", t0, t1, lane="serve/router",
                         replica=target.name, cached_tokens=matched,
+                        uid=handle.uid, trace_id=handle.trace_id,
                         cls=cls.name)
         return handle
 
@@ -545,6 +551,8 @@ class ServingRouter:
                 f"prefill pool holds {target.engine.allocator.total_blocks}")
         req = RequestHandle(next(self._uids), prompt, cls, max_new_tokens,
                             eos_token_id, arrival_t)
+        if not getattr(self._serving_cfg, "attribution", True):
+            req._ledger = None
         req._router_counted = True     # in _inflight until handoff or final
         with self._lock:
             self._inflight += 1
@@ -573,7 +581,8 @@ class ServingRouter:
             self.stats.handoff_bytes += nbytes
         if _tracer.enabled:
             _tracer.add("serve/router/handoff", t0, time.perf_counter(),
-                        lane="serve/router", uid=req.uid, src=src.name,
+                        lane="serve/router", uid=req.uid,
+                        trace_id=req.trace_id, src=src.name,
                         dst=dst.name, bytes=nbytes)
 
     def _finalize_external(self, req: RequestHandle, status: str) -> None:

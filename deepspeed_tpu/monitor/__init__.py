@@ -1,8 +1,11 @@
 """Monitoring backends (parity: ``deepspeed/monitor/``), the per-subsystem
-pipeline counters (``serving.PipelineStats`` / ``training.*Stats``), and the
+pipeline counters (``serving.PipelineStats`` / ``training.*Stats``), the
 span tracer (``trace.tracer`` — the Perfetto-exportable timeline the counters
-are per-window aggregations of; docs/OBSERVABILITY.md)."""
+are per-window aggregations of; docs/OBSERVABILITY.md), and the live
+Prometheus-text telemetry exporter (``export.PrometheusExporter``)."""
 
+from deepspeed_tpu.monitor.export import (PrometheusExporter, TelemetryPump,
+                                          sanitize_metric_name)
 from deepspeed_tpu.monitor.monitor import (CsvMonitor, Monitor, MonitorMaster,
                                            TensorBoardMonitor, WandbMonitor)
 from deepspeed_tpu.monitor.serving import PipelineStats
@@ -12,5 +15,6 @@ from deepspeed_tpu.monitor.training import (CheckpointStats,
                                             TrainPipelineStats)
 
 __all__ = ["Monitor", "MonitorMaster", "TensorBoardMonitor", "WandbMonitor",
-           "CsvMonitor", "PipelineStats", "TrainPipelineStats",
+           "CsvMonitor", "PrometheusExporter", "TelemetryPump",
+           "sanitize_metric_name", "PipelineStats", "TrainPipelineStats",
            "OffloadPipelineStats", "CheckpointStats", "Tracer", "tracer"]

@@ -139,13 +139,24 @@ class MonitorMaster(Monitor):
 
     def __init__(self, config):
         # config here is the full DeepSpeedTPUConfig
+        from deepspeed_tpu.monitor.export import PrometheusExporter
+        import deepspeed_tpu.comm as dist
+        self._is_rank0 = dist.get_rank() == 0
         self.tb_monitor = TensorBoardMonitor(config.tensorboard)
         self.wandb_monitor = WandbMonitor(config.wandb)
         self.csv_monitor = CsvMonitor(config.csv_monitor)
+        # live telemetry (monitor/export.py): configs predating the section
+        # (tests building partial trees) degrade to a disabled exporter.
+        # Only rank 0 BINDS — writes are rank-0-gated below, so an exporter
+        # on any other rank would serve a live-but-forever-empty /metrics
+        # (and race rank 0 for a fixed port on shared hosts)
+        prom_cfg = getattr(config, "prometheus", None)
+        self.prom_monitor = PrometheusExporter(
+            prom_cfg if (prom_cfg is not None and self._is_rank0)
+            else type("_Off", (), {"enabled": False})())
         self.enabled = (self.tb_monitor.enabled or self.wandb_monitor.enabled
-                        or self.csv_monitor.enabled)
-        import deepspeed_tpu.comm as dist
-        self._is_rank0 = dist.get_rank() == 0
+                        or self.csv_monitor.enabled
+                        or self.prom_monitor.enabled)
 
     def write_events(self, event_list: Iterable[Event]) -> None:
         if not self.enabled or not self._is_rank0:
@@ -154,13 +165,17 @@ class MonitorMaster(Monitor):
         self.tb_monitor.write_events(event_list)
         self.wandb_monitor.write_events(event_list)
         self.csv_monitor.write_events(event_list)
+        self.prom_monitor.write_events(event_list)
 
     def close(self):
         """Flush and close every backend. ``engine.destroy()`` calls this
         AFTER draining the deferred metric queue, so the final step's events
         are on disk (not buffered in a dangling file handle or an unflushed
         SummaryWriter) without the caller ever touching ``drain_metrics()``
-        — the PR 4 deferred-drain footgun, closed. Idempotent."""
+        — the PR 4 deferred-drain footgun, closed. Idempotent. The live
+        exporter closes FIRST: its final snapshot (``metrics.prom``) is
+        drained before the CSV files shut."""
+        self.prom_monitor.close()
         self.tb_monitor.close()
         self.wandb_monitor.close()
         self.csv_monitor.close()

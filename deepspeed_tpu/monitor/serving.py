@@ -256,6 +256,15 @@ class FrontendStats:
         self.offload_bytes = 0             # KV bytes moved device -> host
         self.restore_bytes = 0             # KV bytes moved host -> device
         self.forced_sheds = 0              # reject-only emergency sheds
+        # SLO-miss attribution (docs/OBSERVABILITY.md "SLO-miss
+        # attribution"): every finished-but-missed request bucketed by the
+        # DOMINANT phase of its ledger (the same perf stamps the serve/req
+        # spans record) — the serve/slo/* surface that answers "where did
+        # the missed requests' time go" per replica
+        self.slo_missed = 0
+        self.slo_missed_by_phase: Dict[str, int] = {}
+        self.slo_missed_by_class: Dict[str, int] = {}
+        self.slo_attr_consistent = 0       # ledger summed to client latency
 
     # -- recording (engine thread) ------------------------------------- #
 
@@ -280,6 +289,18 @@ class FrontendStats:
 
     def record_cancel(self, cls: str) -> None:
         self.classes[cls].cancelled += 1
+
+    def record_slo_miss(self, cls: str, phase: str,
+                        consistent: bool) -> None:
+        """One finished request that missed its class SLO, attributed to
+        the dominant phase of its ledger; ``consistent`` = the ledger's
+        stints summed to the client-measured latency (small epsilon)."""
+        self.slo_missed += 1
+        self.slo_missed_by_phase[phase] = \
+            self.slo_missed_by_phase.get(phase, 0) + 1
+        self.slo_missed_by_class[cls] = \
+            self.slo_missed_by_class.get(cls, 0) + 1
+        self.slo_attr_consistent += bool(consistent)
 
     def record_complete(self, cls: str, ttft_ms: Optional[float],
                         tbt_ms: List[float], tokens: int,
@@ -349,6 +370,20 @@ class FrontendStats:
                         (f"{pre}/{label}_p95_ms",
                          float(np.percentile(xs, 95)), step),
                     ]
+        # serve/slo/*: SLO-miss attribution rollup (snapshot the dicts —
+        # the engine thread inserts first-seen phase keys while a bench
+        # thread reads)
+        slo_base = "serve/slo" if self.replica is None \
+            else f"serve/slo/{self.replica}"
+        by_phase = dict(self.slo_missed_by_phase)
+        by_class = dict(self.slo_missed_by_class)
+        out.append((f"{slo_base}/missed", float(self.slo_missed), step))
+        out.append((f"{slo_base}/attr_consistent",
+                    float(self.slo_attr_consistent), step))
+        for phase, n in sorted(by_phase.items()):
+            out.append((f"{slo_base}/dominant/{phase}", float(n), step))
+        for cls, n in sorted(by_class.items()):
+            out.append((f"{slo_base}/by_class/{cls}", float(n), step))
         return out
 
 
@@ -510,6 +545,22 @@ class RouterStats:
         ]
         for name, n in self.routed.items():
             out.append((f"serve/router/routed/{name}", float(n), step))
+        # cluster-level SLO-miss attribution rollup: sum the replicas'
+        # serve/slo buckets — "what phase is eating the cluster's misses"
+        # in one row set (docs/OBSERVABILITY.md "SLO-miss attribution")
+        missed = consistent = 0
+        by_phase: Dict[str, int] = {}
+        for fs in self._frontends:
+            missed += fs.slo_missed
+            consistent += fs.slo_attr_consistent
+            for phase, n in dict(fs.slo_missed_by_phase).items():
+                by_phase[phase] = by_phase.get(phase, 0) + n
+        out.append(("serve/slo/cluster/missed", float(missed), step))
+        out.append(("serve/slo/cluster/attr_consistent",
+                    float(consistent), step))
+        for phase, n in sorted(by_phase.items()):
+            out.append((f"serve/slo/cluster/dominant/{phase}",
+                        float(n), step))
         # per-class cluster rollup: sum over every registered replica
         for cls in self.router_sheds:
             completed = shed = tokens = slo = 0

@@ -68,17 +68,29 @@ from __future__ import annotations
 import atexit
 import json
 import os
+import re
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from deepspeed_tpu.utils.logging import logger
 
 _ENV_VAR = "DSTPU_TRACE"
 _ENV_RING = "DSTPU_TRACE_RING"
+_ENV_REQ_LANES = "DSTPU_TRACE_REQ_LANES"
 
 #: default spans retained per thread (the flight-recorder window)
 DEFAULT_RING_SIZE = 16384
+
+#: per-request ``serve/req/u<uid>`` lanes exported under their OWN track —
+#: beyond this window (newest by last activity), retired requests' lanes are
+#: recycled onto a bounded pool of ``serve/req/recycled/<k>`` tracks (the
+#: exporter-side mirror of the dead-ring sweep: a long serving run must not
+#: grow one timeline row per uid forever)
+DEFAULT_REQ_LANE_WINDOW = 64
+
+#: lanes subject to the recycling window
+_REQ_LANE_RE = re.compile(r"^serve/req/u\d+$")
 
 # record kinds (Chrome trace phase at export: span -> B/E pair)
 _SPAN, _INSTANT, _COUNTER = "X", "i", "C"
@@ -174,26 +186,35 @@ class Tracer:
         self.enabled = False
         self.trace_dir = ""
         self.ring_size = DEFAULT_RING_SIZE
+        self.req_lane_window = DEFAULT_REQ_LANE_WINDOW
         self._rings: List[_Ring] = []
         self._local = threading.local()
         self._reg_lock = threading.Lock()
         self._atexit_installed = False
         self._crash_path: Optional[str] = None
+        # one simultaneous (perf_counter, unix) pair: trace_merge.py maps
+        # every file's perf-based timestamps onto one wall-clock axis with it
+        self._clock_sync = (time.perf_counter(), time.time())
 
     # ------------------------------------------------------------------ #
     # configuration
     # ------------------------------------------------------------------ #
 
     def configure(self, trace_dir: str = "", enabled: Optional[bool] = None,
-                  ring_size: Optional[int] = None) -> "Tracer":
+                  ring_size: Optional[int] = None,
+                  req_lane_window: Optional[int] = None) -> "Tracer":
         """Enable (or reconfigure) tracing. ``trace_dir`` nonempty implies
         enabled and is where the exporter + flight recorder write; an empty
         dir with ``enabled=True`` records rings without an export target
-        (tests, in-process overhead measurement)."""
+        (tests, in-process overhead measurement). ``req_lane_window`` bounds
+        how many per-request ``serve/req/u<uid>`` lanes export under their
+        own track (older ones recycle onto a pooled track set)."""
         if trace_dir:
             self.trace_dir = trace_dir
         if ring_size:
             self.ring_size = max(16, int(ring_size))
+        if req_lane_window is not None:
+            self.req_lane_window = max(0, int(req_lane_window))
         if enabled is None:
             enabled = bool(trace_dir) or self.enabled
         self.enabled = bool(enabled)
@@ -210,7 +231,10 @@ class Tracer:
         self._local = threading.local()
         self.enabled = False
         self.trace_dir = ""
+        self.ring_size = DEFAULT_RING_SIZE
+        self.req_lane_window = DEFAULT_REQ_LANE_WINDOW
         self._crash_path = None
+        self._clock_sync = (time.perf_counter(), time.time())
 
     # ------------------------------------------------------------------ #
     # recording
@@ -285,9 +309,69 @@ class Tracer:
                 out[name] = (cnt + 1, tot + (t1 - t0))
         return out
 
+    def iter_records(self) -> Iterator[tuple]:
+        """Snapshot every retained raw record ``(kind, name, t0, t1, lane,
+        args)`` across all rings — benches and tests assert on request flow
+        chains (spans sharing a ``trace_id`` arg) without exporting."""
+        with self._reg_lock:
+            rings = list(self._rings)
+        for ring in rings:
+            for rec in ring.snapshot():
+                yield rec
+
     # ------------------------------------------------------------------ #
     # export
     # ------------------------------------------------------------------ #
+
+    def _recycle_req_lanes(self, snaps) -> Dict[str, str]:
+        """Remap retired ``serve/req/u<uid>`` lanes onto a bounded
+        recycled-track pool. Keep/retire is decided over the UNION of all
+        rings (a request's lane is written from several threads — engine,
+        prefill worker, health; a per-ring window would keep a named track
+        in one ring while recycling the same uid in another, splitting one
+        request across rows and growing named rows O(window x rings)): the
+        newest ``req_lane_window`` lanes (by last recorded activity
+        anywhere) keep their name; every older lane is greedily
+        interval-packed onto ``serve/req/recycled/<k>`` such that no two
+        time-overlapping requests share a slot — per-thread tracks render
+        a subset of a slot's lanes, so B/E nesting stays well-formed.
+        Mirrors the dead-ring sweep: a long run's timeline stays bounded
+        in named rows, not one per uid forever."""
+        out: Dict[str, str] = {}
+        window = self.req_lane_window
+        extents: Dict[str, Tuple[float, float]] = {}
+        for _ring, snap in snaps:
+            for rec in snap:
+                lane = rec[4]
+                if not lane or not _REQ_LANE_RE.match(lane):
+                    continue
+                t0, t1 = rec[2], rec[3]
+                if t1 <= t0:           # match the exporter's epsilon E
+                    t1 = t0 + 1e-9
+                lo, hi = extents.get(lane, (t0, t1))
+                extents[lane] = (min(lo, t0), max(hi, t1))
+        if len(extents) <= window:
+            return out
+        by_recent = sorted(extents, key=lambda l: extents[l][1],
+                           reverse=True)
+        keep = set(by_recent[:window])
+        retired = sorted((l for l in extents if l not in keep),
+                         key=lambda l: extents[l][0])
+        pools: List[float] = []            # last span end per recycled track
+        for lane in retired:
+            lo, hi = extents[lane]
+            slot = None
+            for k, end in enumerate(pools):
+                if end <= lo:              # equal-ts boundary is safe: the
+                    slot = k               # sort ties close E before B
+                    break
+            if slot is None:
+                pools.append(hi)
+                slot = len(pools) - 1
+            else:
+                pools[slot] = max(pools[slot], hi)
+            out[lane] = f"serve/req/recycled/{slot}"
+        return out
 
     def _events(self) -> List[dict]:
         """Chrome-trace event list: metadata naming each track, then B/E
@@ -296,14 +380,24 @@ class Tracer:
         B's open first (outer before inner), and record order breaks the
         remaining ties — zero-duration spans (coarse perf_counter ticks)
         get an epsilon-long E so a span's end can never sort ahead of its
-        own begin."""
+        own begin.
+
+        Spans whose args carry a ``trace_id`` additionally emit Perfetto
+        FLOW events (``ph`` s/t/f, one chain per trace_id) binding the
+        request's hops — router placement, prefill, KV handoff, decode
+        stints, failover migration — into one causal chain across lanes and
+        threads (and, through ``scripts/trace_merge.py``, across files)."""
         pid = os.getpid()
         with self._reg_lock:
             rings = list(self._rings)
+        snaps = [(ring, ring.snapshot()) for ring in rings]
+        lane_map = self._recycle_req_lanes(snaps)
         tids: Dict[Tuple[int, Optional[str]], int] = {}
         meta: List[dict] = [{"ph": "M", "name": "process_name", "pid": pid,
                              "tid": 0, "args": {"name": "deepspeed_tpu"}}]
         body: List[Tuple[float, int, float, int, dict]] = []
+        # trace_id -> [(t0, record idx, tid, ts_us)] of its spans
+        flows: Dict[Any, List[Tuple[float, int, int, float]]] = {}
 
         def tid_for(ring: _Ring, lane: Optional[str]) -> int:
             key = (ring.thread_id, lane)
@@ -317,11 +411,16 @@ class Tracer:
             return tid
 
         idx = 0
-        for ring in rings:
-            for rec in ring.snapshot():
+        for ring, snap in snaps:
+            for rec in snap:
                 kind, name, t0, t1, lane, args = rec
+                if lane is not None and lane_map:
+                    lane = lane_map.get(lane, lane)
                 tid = tid_for(ring, lane)
                 ts0 = t0 * 1e6
+                if kind == _SPAN and args and "trace_id" in args:
+                    flows.setdefault(args["trace_id"], []).append(
+                        (t0, idx, tid, ts0))
                 if kind == _SPAN:
                     # coarse clocks can stamp t1 == t0; the E must still
                     # land strictly after its own B
@@ -351,6 +450,21 @@ class Tracer:
                                  {"ph": "C", "name": name, "pid": pid,
                                   "tid": tid, "ts": ts0, "args": args or {}}))
                 idx += 1
+        # flow chains: one s -> t... -> f sequence per trace_id, each event
+        # anchored at its hop-span's begin (rank 2: it sorts after the B it
+        # binds to). Single-hop ids emit nothing — a chain needs two ends.
+        for flow_id, hops in flows.items():
+            if len(hops) < 2:
+                continue
+            hops.sort(key=lambda h: (h[0], h[1]))
+            last = len(hops) - 1
+            for k, (_t0, ridx, tid, ts0) in enumerate(hops):
+                ph = "s" if k == 0 else ("f" if k == last else "t")
+                ev = {"ph": ph, "id": int(flow_id), "name": "serve/req",
+                      "cat": "flow", "pid": pid, "tid": tid, "ts": ts0}
+                if ph == "f":
+                    ev["bp"] = "e"     # bind to the enclosing slice
+                body.append((ts0, 2, 0.0, ridx, ev))
         body.sort(key=lambda item: item[:4])
         return meta + [ev for _, _, _, _, ev in body]
 
@@ -368,9 +482,19 @@ class Tracer:
         tmp = f"{path}.tmp{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump({"traceEvents": self._events(),
-                       "displayTimeUnit": "ms"}, f)
+                       "displayTimeUnit": "ms",
+                       "clockSync": self._clock_sync_doc()}, f)
         os.replace(tmp, path)
         return path
+
+    def _clock_sync_doc(self) -> dict:
+        """One simultaneous (perf_counter, unix) anchor in microseconds —
+        ``scripts/trace_merge.py`` uses it to clock-align trace files from
+        different processes (each process's perf_counter has its own epoch)
+        onto a single merged timeline."""
+        perf_s, unix_s = self._clock_sync
+        return {"perf_us": perf_s * 1e6, "unix_us": unix_s * 1e6,
+                "pid": os.getpid()}
 
     def crash_dump(self, reason: str = "") -> Optional[str]:
         """Flight-recorder dump: write the retained rings to
@@ -391,7 +515,8 @@ class Tracer:
                                "ts": time.perf_counter() * 1e6})
             os.makedirs(self.trace_dir, exist_ok=True)
             with open(path, "w") as f:
-                json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+                json.dump({"traceEvents": events, "displayTimeUnit": "ms",
+                           "clockSync": self._clock_sync_doc()}, f)
         except Exception as e:  # a failing dump must never mask the crash
             logger.warning(f"trace crash dump failed: {type(e).__name__}: {e}")
             return None
@@ -422,7 +547,9 @@ def install_from_env() -> Tracer:
     trace_dir = os.environ.get(_ENV_VAR, "").strip()
     if trace_dir:
         ring = int(os.environ.get(_ENV_RING, "0") or 0)
+        lanes = os.environ.get(_ENV_REQ_LANES, "").strip()
         tracer.configure(trace_dir=trace_dir,
-                         ring_size=ring or None)
+                         ring_size=ring or None,
+                         req_lane_window=int(lanes) if lanes else None)
         logger.info(f"span tracing ARMED from ${_ENV_VAR}: {trace_dir}")
     return tracer

@@ -248,7 +248,8 @@ class DeepSpeedTPUEngine:
         tc = self.config.monitor.trace
         if tc.enabled or tc.dir:
             _tracer.configure(trace_dir=tc.dir, enabled=True,
-                              ring_size=tc.ring_size)
+                              ring_size=tc.ring_size,
+                              req_lane_window=tc.req_lane_window)
 
         # -- rolling checkpoints (preemption tolerance, docs/ELASTICITY.md):
         # the engine owns the cadence so saves interleave correctly with the

@@ -90,11 +90,33 @@ timeout -k 10 300 python benchmarks/train_bench.py --smoke --preempt || exit 1
 timeout -k 10 300 python benchmarks/train_bench.py --smoke --trace-overhead \
     || exit 1
 
+# serving-side tracer/attribution overhead leg (docs/OBSERVABILITY.md):
+# the same router workload with flow tracing + phase attribution ON vs
+# OFF; correctness gates here (byte-identical streams, zero compiles),
+# the <=2% bar runs full-size (BENCH_r16)
+timeout -k 10 300 python benchmarks/serving_bench.py --trace-overhead \
+    --smoke || exit 1
+
 # the timelines the legs above emitted: schema-valid, spans from the train
 # pipeline, decode pipeline, serving-frontend request lanes, speculative
 # decode, multi-replica router, checkpoint, and offload subsystems on
-# distinct tracks, plus a parseable flight-recorder dump from the
-# --preempt kills
+# distinct tracks, cross-lane request flow chains (--require-flows: the
+# router/chaos legs bind each request's hops by trace_id), plus a
+# parseable flight-recorder dump from the --preempt kills
 timeout -k 10 120 python scripts/trace_check.py "$TRACE_DIR" \
     --require train serve serve/req serve/spec serve/router serve/health \
-    ckpt train/offload --expect-crash || exit 1
+    ckpt train/offload --require-flows serve/req --expect-crash || exit 1
+
+# clock-align + merge the per-process trace files into one timeline; the
+# merged file must pass the same flow-aware checks (stitched chains keep
+# exactly one s/f per id)
+timeout -k 10 120 python scripts/trace_merge.py "$TRACE_DIR" \
+    -o "$TRACE_DIR/trace_merged.json" || exit 1
+timeout -k 10 120 python scripts/trace_check.py \
+    "$TRACE_DIR/trace_merged.json" --require-flows serve/req || exit 1
+
+# per-request waterfall over the emitted traces: at least one multi-hop
+# request chain must exist and render (the SLO-miss debugging workflow,
+# docs/OBSERVABILITY.md "SLO-miss attribution")
+timeout -k 10 120 python scripts/request_autopsy.py "$TRACE_DIR" --smoke \
+    || exit 1

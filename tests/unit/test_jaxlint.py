@@ -1044,3 +1044,94 @@ def test_jl007_spec_decode_path_policed():
     assert lint_text(
         clean, path="deepspeed_tpu/inference/v2/spec/pipeline.py",
         config=cfg) == []
+
+
+def test_monitor_paths_policed_by_shipped_config():
+    """The monitor package (the tracer, the stats classes, and the live
+    telemetry exporter ``monitor/export.py``) is hot-path policed: the
+    event/export path runs beside the serving loops, so a stray device
+    fetch there is a serving stall wearing a telemetry hat."""
+    raw = _repo_config()
+    for rule in ("JL007", "JL008"):
+        hot = raw["rules"][rule]["options"]["hot_paths"]
+        assert "deepspeed_tpu/monitor/" in hot, rule
+
+
+def test_jl007_monitor_export_event_path_policed():
+    """A blocking fetch smuggled onto the exporter's ``write_events`` path
+    (materialising a device value 'for the snapshot') fires under the
+    SHIPPED hot_paths; the module's actual discipline — host floats only,
+    rendering deferred to scrape time — is clean."""
+    raw = _repo_config()
+    cfg = LintConfig(rules={"JL007": RuleSettings(
+        options=raw["rules"]["JL007"]["options"])})
+    src = textwrap.dedent("""
+        import numpy as np
+
+        def write_events(self, event_list):
+            for name, value, step in event_list:
+                self._values[name] = (float(np.asarray(value)), int(step))
+    """)
+    findings = lint_text(src, path="deepspeed_tpu/monitor/export.py",
+                         config=cfg)
+    assert rules_of(findings) == ["JL007"]
+    clean = textwrap.dedent("""
+        def write_events(self, event_list):
+            for name, value, step in event_list:
+                self._values[name] = (float(value), int(step))
+
+        def render(self):
+            lines = []
+            for name, (value, step) in sorted(self._values.items()):
+                lines.append(f"{name} {value!r}")
+            return "\\n".join(lines)
+    """)
+    assert lint_text(clean, path="deepspeed_tpu/monitor/export.py",
+                     config=cfg) == []
+
+
+def test_jl008_monitor_stats_span_fetch_policed():
+    """A span wrapped around a device drain in the stats/rollup path (the
+    stats-equals-spans surfaces feeding serve/slo/*) fires under the
+    SHIPPED JL008 options; perf-stamp-only rollups are clean."""
+    raw = _repo_config()
+    cfg = LintConfig(rules={"JL008": RuleSettings(
+        options=raw["rules"]["JL008"]["options"])})
+    src = textwrap.dedent("""
+        import jax
+        from deepspeed_tpu.monitor.trace import tracer
+
+        def events(self, step):
+            with tracer.span("serve/slo/rollup"):
+                return jax.device_get(self.rollup)
+    """)
+    findings = lint_text(src, path="deepspeed_tpu/monitor/serving.py",
+                         config=cfg)
+    assert "JL008" in rules_of(findings)
+    clean = textwrap.dedent("""
+        import time
+        from deepspeed_tpu.monitor.trace import tracer
+
+        def record_slo_miss(self, cls, phase, consistent):
+            t0 = time.perf_counter()
+            with tracer.span("serve/slo/record"):
+                self.slo_missed += 1
+                self.by_phase[phase] = self.by_phase.get(phase, 0) + 1
+            return time.perf_counter() - t0
+    """)
+    assert "JL008" not in rules_of(lint_text(
+        clean, path="deepspeed_tpu/monitor/serving.py", config=cfg))
+
+
+def test_shipped_baseline_stays_empty():
+    """The ratchet: every hot-path expansion (this PR: monitor/) must land
+    with the shipped tree CLEAN under it, never by growing the baseline."""
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(root, ".jaxlint-baseline.json")
+    if not os.path.isfile(path):
+        pytest.skip("source tree layout not available")
+    with open(path) as f:
+        baseline = json.load(f)
+    assert baseline.get("entries") == {}

@@ -673,3 +673,103 @@ def test_preemption_victim_is_newest_lowest_priority(model_params):
     assert h_new.status == "preempted"   # LIFO: newest low-pri goes first
     assert h_old.status != "preempted"
     fe.close()
+
+
+# --------------------------------------------------------------------------- #
+# phase ledger + SLO-miss attribution (docs/OBSERVABILITY.md)
+# --------------------------------------------------------------------------- #
+
+def test_request_handle_ledger_and_attribution_summary():
+    from deepspeed_tpu.inference.v2.serving.frontend import RequestHandle
+    cls = PriorityClassConfig(name="hi", priority=2)
+    h = RequestHandle(7, np.zeros(4, np.int32), cls, 8, None, 100.0)
+    # flow ids are process-unique mints, NOT uids (uid bases restart per
+    # cluster lifetime): two handles never share one, even with equal uids
+    h2 = RequestHandle(7, np.zeros(4, np.int32), cls, 8, None, 100.0)
+    assert h.trace_id != h2.trace_id
+    h._ledger_add("queued", 100.0, 100.25)
+    h._ledger_add("prefill", 100.25, 100.5)
+    h._ledger_add("decode", 100.5, 102.0)
+    h._last_emit_t = 102.0
+    assert h.timeline() == [("queued", 100.0, 100.25),
+                            ("prefill", 100.25, 100.5),
+                            ("decode", 100.5, 102.0)]
+    attr = h.attribution()
+    assert attr["dominant"] == "decode"
+    assert attr["phases"]["queued"] == pytest.approx(0.25)
+    assert attr["total_s"] == pytest.approx(2.0)
+    assert attr["client_s"] == pytest.approx(2.0)
+    assert attr["residual_s"] == pytest.approx(0.0)
+    # timeline() is a copy: mutating it cannot corrupt the ledger
+    h.timeline().append(("bogus", 0.0, 1.0))
+    assert len(h.timeline()) == 3
+
+
+def test_finished_request_ledger_tiles_client_latency(model_params):
+    """The acceptance-bar invariant, at unit scope: a finished request's
+    stints are GAPLESS from arrival to last emission, so their durations
+    sum to the client-measured latency (TTFT + sum TBT)."""
+    e = _build_engine(model_params)
+    fe = e.serving_frontend()
+    rng = _rng()
+    hs = [fe.submit(_prompt(rng, n), priority="hi", max_new_tokens=6)
+          for n in (24, 9)]
+    assert _step_until(fe, lambda: all(h.finished for h in hs))
+    for h in hs:
+        assert h.status == "finished"
+        tl = h.timeline()
+        assert tl[0][0] == "queued" and tl[0][1] == h.arrival_t
+        for (_, _, t1a), (_, t0b, _) in zip(tl, tl[1:]):
+            assert t0b == pytest.approx(t1a, abs=1e-9)   # gapless
+        attr = h.attribution()
+        assert {"queued", "admission", "prefill", "decode"} <= \
+            set(attr["phases"])
+        assert attr["client_s"] is not None
+        assert abs(attr["residual_s"]) <= max(0.005, 0.01 * attr["client_s"])
+    fe.close()
+
+
+def test_slo_miss_buckets_by_dominant_phase(model_params):
+    """An impossible TBT SLO (sheds gate only on TTFT) forces every
+    finished request into the miss buckets: serve/slo/* rows carry the
+    dominant phase and the ledger-consistency count."""
+    tight = [{"name": "hi", "priority": 2,
+              "ttft_slo_ms": 1e6, "tbt_slo_ms": 1e-6},
+             {"name": "lo", "priority": 0,
+              "ttft_slo_ms": 1e6, "tbt_slo_ms": 1e6}]
+    e = _build_engine(model_params, serving={"classes": tight})
+    fe = e.serving_frontend()
+    h = fe.submit(_prompt(_rng(), 24), priority="hi", max_new_tokens=6)
+    assert _step_until(fe, lambda: h.finished)
+    assert h.status == "finished"
+    dom = h.attribution()["dominant"]
+    assert fe.stats.slo_missed == 1
+    assert fe.stats.slo_missed_by_phase == {dom: 1}
+    assert fe.stats.slo_missed_by_class == {"hi": 1}
+    assert fe.stats.slo_attr_consistent == 1   # ledger summed to client
+    names = {n for n, _, _ in fe.stats.events()}
+    assert {"serve/slo/missed", "serve/slo/attr_consistent",
+            f"serve/slo/dominant/{dom}", "serve/slo/by_class/hi"} <= names
+    fe.close()
+
+
+def test_attribution_off_is_inert(model_params):
+    """The A/B lever: ``attribution: false`` records no ledger (misses
+    bucket as unattributed) — the zero-overhead OFF side the
+    serving_bench --trace-overhead leg compares against."""
+    tight = [{"name": "hi", "priority": 2,
+              "ttft_slo_ms": 1e6, "tbt_slo_ms": 1e-6},
+             {"name": "lo", "priority": 0,
+              "ttft_slo_ms": 1e6, "tbt_slo_ms": 1e6}]
+    e = _build_engine(model_params,
+                      serving={"classes": tight, "attribution": False})
+    fe = e.serving_frontend()
+    h = fe.submit(_prompt(_rng(), 24), priority="hi", max_new_tokens=6)
+    assert _step_until(fe, lambda: h.finished)
+    assert h._ledger is None and h.timeline() == []
+    attr = h.attribution()
+    assert attr["phases"] == {} and attr["dominant"] is None
+    assert fe.stats.slo_missed == 1
+    assert fe.stats.slo_missed_by_phase == {"unattributed": 1}
+    assert fe.stats.slo_attr_consistent == 0
+    fe.close()

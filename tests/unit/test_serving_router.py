@@ -607,10 +607,12 @@ def test_replica_labels_keep_monitor_rows_distinct():
     names_a = {n for n, _, _ in a.events()}
     names_b = {n for n, _, _ in b.events()}
     assert names_a and not (names_a & names_b)
-    assert all(n.startswith("serve/frontend/r0/") for n in names_a)
+    assert all(n.startswith(("serve/frontend/r0/", "serve/slo/r0/"))
+               for n in names_a)
     # unlabelled stays on the PR 8 names (single-frontend back-compat)
     bare = {n for n, _, _ in FrontendStats(["hi"]).events()}
     assert "serve/frontend/hi/completed" in bare
+    assert "serve/slo/missed" in bare
     # spec stats carry the same label
     s = SpecDecodeStats(replica="r1")
     s.record_step(1, 2, 1, 2, 0.0, 0.0, 8)

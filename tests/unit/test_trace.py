@@ -7,6 +7,7 @@ import glob
 import importlib.util
 import json
 import os
+import sys
 import threading
 import time
 
@@ -20,15 +21,15 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def _load_trace_check():
+def _load_script(name):
     spec = importlib.util.spec_from_file_location(
-        "trace_check", os.path.join(REPO_ROOT, "scripts", "trace_check.py"))
+        name, os.path.join(REPO_ROOT, "scripts", f"{name}.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
 
 
-trace_check = _load_trace_check()
+trace_check = _load_script("trace_check")
 
 
 @pytest.fixture(autouse=True)
@@ -47,7 +48,7 @@ def _validate(path):
     """Full trace_check schema pass over one file; returns (events, tracks)
     and asserts no errors."""
     errors = []
-    events, tracks = trace_check.check_file(path, errors)
+    events, tracks, *_ = trace_check.check_file(path, errors)
     assert errors == [], errors
     return events, tracks
 
@@ -344,3 +345,286 @@ def test_dead_thread_rings_are_bounded():
     assert n_rings <= MAX_DEAD_RINGS + 2   # bound + live main + slack
     # the NEWEST dead threads' spans are still exportable
     assert f"churn/{n - 1}" in tracer.summary()
+
+
+# --------------------------------------------------------------------------- #
+# request flow chains: trace_id args -> Perfetto flow events
+# --------------------------------------------------------------------------- #
+
+def _flow_events(doc):
+    return [e for e in doc["traceEvents"] if e.get("ph") in ("s", "t", "f")]
+
+
+def _emit_chain(tid, t, lanes=("serve/router", "serve/req/u{}")):
+    """One request's hop spans across two lanes, all stamped trace_id."""
+    req_lane = lanes[1].format(tid)
+    tracer.add("serve/router/route", t, t + 1e-3, lane=lanes[0],
+               uid=tid, trace_id=tid)
+    tracer.add("serve/req/prefill", t + 1e-3, t + 2e-3, lane=req_lane,
+               uid=tid, trace_id=tid)
+    tracer.add("serve/req/decode", t + 2e-3, t + 4e-3, lane=req_lane,
+               uid=tid, trace_id=tid)
+
+
+def test_flow_events_bind_hops_across_lanes(tmp_path):
+    tracer.configure(trace_dir=str(tmp_path))
+    t = time.perf_counter()
+    _emit_chain(9, t)
+    # single-hop id: a chain needs two ends, so no flow events at all
+    tracer.add("serve/req/queued", t, t + 1e-3, lane="serve/req/u8",
+               uid=8, trace_id=8)
+    path = tracer.export()
+    with open(path) as f:
+        doc = json.load(f)
+    flows = _flow_events(doc)
+    assert {e["id"] for e in flows} == {9}
+    phs = [e["ph"] for e in sorted(flows, key=lambda e: e["ts"])]
+    assert phs == ["s", "t", "f"]       # exactly one s, one f, steps between
+    assert all(e["name"] == "serve/req" for e in flows)
+    # the finish binds to its ENCLOSING slice, not the next one
+    assert [e for e in flows if e["ph"] == "f"][0]["bp"] == "e"
+    # the chain crosses lanes: router hop and req-lane hops sit on
+    # different tracks
+    assert len({e["tid"] for e in flows}) == 2
+    # the full schema pass (incl. flow checks: matched s/f, no dangling
+    # bindings, steps inside [s, f]) holds
+    errors = []
+    _events, _tracks, _spans, flow_info = trace_check.check_file(path, errors)
+    assert errors == [], errors
+    bound_tracks, bound_names = flow_info[9]
+    assert len(bound_tracks) >= 2
+    assert any(n.startswith("serve/req") for n in bound_names)
+
+
+def test_trace_check_require_flows_gate(tmp_path, monkeypatch, capsys):
+    """--require-flows passes only on a CROSS-LANE chain: a chain confined
+    to one lane (or no chain) must fail the gate."""
+    cross = tmp_path / "cross"
+    flat = tmp_path / "flat"
+    for d in (cross, flat):
+        d.mkdir()
+    tracer.configure(trace_dir=str(cross))
+    _emit_chain(3, time.perf_counter())
+    tracer.export()
+    tracer.reset()
+    tracer.configure(trace_dir=str(flat))
+    t = time.perf_counter()   # two hops, ONE lane: no cross-lane chain
+    tracer.add("serve/req/queued", t, t + 1e-3, lane="serve/req/u1",
+               uid=1, trace_id=1)
+    tracer.add("serve/req/decode", t + 1e-3, t + 2e-3, lane="serve/req/u1",
+               uid=1, trace_id=1)
+    tracer.export()
+    monkeypatch.setattr(sys, "argv", ["trace_check", str(cross),
+                                      "--require-flows", "serve/req"])
+    assert trace_check.main() == 0
+    monkeypatch.setattr(sys, "argv", ["trace_check", str(flat),
+                                      "--require-flows", "serve/req"])
+    assert trace_check.main() == 1
+    assert "no cross-lane flow chain" in capsys.readouterr().out
+
+
+def test_trace_check_flags_broken_flows(tmp_path):
+    """Dangling s (no f), backwards chains, and non-binding flow events
+    are each schema errors."""
+    meta = [{"ph": "M", "name": "thread_name", "pid": 1, "tid": 1,
+             "args": {"name": "lane"}}]
+    span = [{"ph": "B", "name": "serve/req/decode", "pid": 1, "tid": 1,
+             "ts": 10.0},
+            {"ph": "E", "name": "serve/req/decode", "pid": 1, "tid": 1,
+             "ts": 20.0}]
+
+    def _check(events):
+        p = tmp_path / "t.json"
+        p.write_text(json.dumps({"traceEvents": meta + events}))
+        errors = []
+        trace_check.check_file(str(p), errors)
+        return errors
+
+    fl = {"name": "serve/req", "cat": "flow", "pid": 1, "tid": 1, "id": 4}
+    # dangling: an s with no matching f
+    errs = _check(span + [dict(fl, ph="s", ts=10.0)])
+    assert any("1 's' and 0 'f'" in e for e in errs)
+    # backwards: f strictly before s
+    errs = _check(span + [dict(fl, ph="f", ts=12.0, bp="e"),
+                          dict(fl, ph="s", ts=15.0)])
+    assert any("BACKWARDS" in e for e in errs)
+    # non-binding: flow event outside every span on its track
+    errs = _check(span + [dict(fl, ph="s", ts=10.0),
+                          dict(fl, ph="f", ts=99.0, bp="e")])
+    assert any("binds to no span" in e for e in errs)
+
+
+# --------------------------------------------------------------------------- #
+# bounded per-request lanes: retired uids recycle onto pooled tracks
+# --------------------------------------------------------------------------- #
+
+def test_req_lane_window_recycles_retired_lanes(tmp_path):
+    tracer.configure(trace_dir=str(tmp_path), req_lane_window=2)
+    base = time.perf_counter()
+    for k in range(5):   # disjoint in time: u0 oldest ... u4 newest
+        tracer.add("serve/req/decode", base + k, base + k + 0.5,
+                   lane=f"serve/req/u{k}", uid=k)
+    path = tracer.export()
+    events, tracks = _validate(path)   # recycled tracks still nest B/E
+    names = set(tracks.values())
+    # the newest `window` lanes keep their own named track
+    assert {"serve/req/u3", "serve/req/u4"} <= names
+    assert not names & {"serve/req/u0", "serve/req/u1", "serve/req/u2"}
+    # disjoint retired lanes interval-pack onto ONE pooled track
+    assert "serve/req/recycled/0" in names
+    assert "serve/req/recycled/1" not in names
+    # nothing was dropped: every span survives the remap
+    assert len(_span_events({"traceEvents": events})) == 5
+
+
+def test_req_lane_recycling_never_overlaps_one_track(tmp_path):
+    """Time-overlapping retired requests must land on DIFFERENT pooled
+    tracks — B/E nesting per track stays well-formed."""
+    tracer.configure(trace_dir=str(tmp_path), req_lane_window=0)
+    base = time.perf_counter()
+    tracer.add("serve/req/decode", base, base + 2.0,
+               lane="serve/req/u0", uid=0)
+    tracer.add("serve/req/decode", base + 1.0, base + 3.0,   # overlaps u0
+               lane="serve/req/u1", uid=1)
+    tracer.add("serve/req/decode", base + 2.5, base + 4.0,   # fits after u0
+               lane="serve/req/u2", uid=2)
+    path = tracer.export()
+    events, tracks = _validate(path)   # would fail on an overlapped track
+    names = set(tracks.values())
+    assert "serve/req/recycled/0" in names and "serve/req/recycled/1" in names
+    assert not any(n.startswith("serve/req/u") for n in names)
+
+
+def test_req_lane_window_env_and_config(tmp_path, monkeypatch):
+    from deepspeed_tpu.monitor.trace import DEFAULT_REQ_LANE_WINDOW
+    assert tracer.req_lane_window == DEFAULT_REQ_LANE_WINDOW
+    monkeypatch.setenv("DSTPU_TRACE", str(tmp_path))
+    monkeypatch.setenv("DSTPU_TRACE_REQ_LANES", "7")
+    tr = install_from_env()
+    assert tr.req_lane_window == 7 and tr.enabled
+
+
+# --------------------------------------------------------------------------- #
+# clock sync + trace_merge: one timeline across processes
+# --------------------------------------------------------------------------- #
+
+def test_export_carries_clock_sync_anchor(tmp_path):
+    tracer.configure(trace_dir=str(tmp_path))
+    tracer.add("x", 0.0, 1.0)
+    path = tracer.export()
+    with open(path) as f:
+        sync = json.load(f)["clockSync"]
+    assert sync["pid"] == os.getpid()
+    # the anchor really maps perf time onto the wall clock
+    off_s = (sync["unix_us"] - sync["perf_us"]) / 1e6
+    assert abs(off_s + time.perf_counter() - time.time()) < 5.0
+
+
+def _fake_trace(path, pid, lane, span_name, sync_unix_us, flow_id,
+                ts0=10.0, ts1=20.0):
+    """One well-formed single-chain trace file with a clockSync anchor
+    (perf epoch 0) — two flow ends so the per-file chain is complete."""
+    events = [
+        {"ph": "M", "name": "thread_name", "pid": pid, "tid": 1,
+         "args": {"name": lane}},
+        {"ph": "B", "name": span_name, "pid": pid, "tid": 1, "ts": ts0,
+         "args": {"trace_id": flow_id}},
+        {"ph": "s", "name": "serve/req", "cat": "flow", "pid": pid,
+         "tid": 1, "ts": ts0, "id": flow_id},
+        {"ph": "f", "name": "serve/req", "cat": "flow", "pid": pid,
+         "tid": 1, "ts": ts1 - 1.0, "id": flow_id, "bp": "e"},
+        {"ph": "E", "name": span_name, "pid": pid, "tid": 1, "ts": ts1},
+    ]
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events,
+                   "clockSync": {"perf_us": 0.0, "unix_us": sync_unix_us,
+                                 "pid": pid}}, f)
+
+
+def test_trace_merge_clock_aligns_and_stitches(tmp_path):
+    """Two files with different perf epochs merge onto one wall-clock axis,
+    and a flow id crossing files is stitched into ONE chain (one global s,
+    one global f, steps between)."""
+    trace_merge = _load_script("trace_merge")
+    a, b = str(tmp_path / "trace_1.json"), str(tmp_path / "trace_2.json")
+    # same flow id 5: file A's hops are 1s earlier on the wall clock
+    _fake_trace(a, pid=1, lane="serve/req/u5", span_name="serve/req/prefill",
+                sync_unix_us=1_000_000.0, flow_id=5)
+    _fake_trace(b, pid=2, lane="serve/req/u5", span_name="serve/req/decode",
+                sync_unix_us=2_000_000.0, flow_id=5)
+    doc = trace_merge.merge([a, b])
+    ts = [e["ts"] for e in doc["traceEvents"]
+          if isinstance(e.get("ts"), (int, float)) and e.get("ph") != "M"]
+    assert min(ts) == 0.0                       # rebased near zero
+    assert max(ts) == pytest.approx(1_000_010.0)   # the 1s epoch gap survived
+    flows = sorted(_flow_events(doc), key=lambda e: e["ts"])
+    assert [e["ph"] for e in flows] == ["s", "t", "t", "f"]
+    assert flows[0]["pid"] == 1 and flows[-1]["pid"] == 2
+    assert flows[-1]["bp"] == "e"
+    # the merged doc passes the flow-aware schema checks
+    errors = []
+    tracks, spans, flows_raw = trace_check.check_events(
+        doc["traceEvents"], errors)
+    trace_check.check_flows(flows_raw, spans, tracks, errors)
+    assert errors == [], errors
+
+
+def test_trace_merge_cli_output_passes_flow_check(tmp_path, monkeypatch):
+    trace_merge = _load_script("trace_merge")
+    _fake_trace(str(tmp_path / "trace_1.json"), pid=1, lane="serve/router",
+                span_name="serve/router/route", sync_unix_us=0.0, flow_id=7)
+    _fake_trace(str(tmp_path / "trace_2.json"), pid=2, lane="serve/req/u7",
+                span_name="serve/req/decode", sync_unix_us=500_000.0,
+                flow_id=7)
+    merged = str(tmp_path / "trace_merged.json")
+    monkeypatch.setattr(sys, "argv", ["trace_merge", str(tmp_path),
+                                      "-o", merged])
+    assert trace_merge.main() == 0
+    monkeypatch.setattr(sys, "argv", ["trace_check", merged,
+                                      "--require-flows", "serve/req"])
+    assert trace_check.main() == 0
+    # re-merging skips the merged output itself (no event duplication)
+    monkeypatch.setattr(sys, "argv", ["trace_merge", str(tmp_path),
+                                      "-o", str(tmp_path / "m2.json")])
+    assert trace_merge.main() == 0
+    with open(tmp_path / "m2.json") as f:
+        doc2 = json.load(f)
+    assert sorted(doc2["mergedFrom"]) == ["trace_1.json", "trace_2.json"]
+
+
+# --------------------------------------------------------------------------- #
+# request_autopsy: the offline waterfall + attribution view
+# --------------------------------------------------------------------------- #
+
+def test_request_autopsy_smoke_renders_worst_chain(tmp_path, monkeypatch,
+                                                   capsys):
+    autopsy = _load_script("request_autopsy")
+    tracer.configure(trace_dir=str(tmp_path))
+    t = time.perf_counter()
+    _emit_chain(11, t)                    # 3 hops over ~4 ms
+    tracer.add("serve/req/queued", t, t + 1e-4, lane="serve/req/u12",
+               uid=12, trace_id=12)       # single-hop: not a chain
+    tracer.export()
+    monkeypatch.setattr(sys, "argv",
+                        ["request_autopsy", str(tmp_path), "--smoke"])
+    assert autopsy.main() == 0
+    out = capsys.readouterr().out
+    assert "trace_id 11" in out           # the worst (only) multi-hop chain
+    assert "phase attribution" in out and "dominant phase: decode" in out
+    # --trace-id renders a specific chain; unknown ids fail loudly
+    monkeypatch.setattr(sys, "argv", ["request_autopsy", str(tmp_path),
+                                      "--trace-id", "11"])
+    assert autopsy.main() == 0
+    monkeypatch.setattr(sys, "argv", ["request_autopsy", str(tmp_path),
+                                      "--trace-id", "404"])
+    assert autopsy.main() == 1
+
+
+def test_request_autopsy_smoke_fails_without_chains(tmp_path, monkeypatch):
+    autopsy = _load_script("request_autopsy")
+    tracer.configure(trace_dir=str(tmp_path))
+    tracer.add("train/step", 0.0, 1.0)    # spans, but no trace_id args
+    tracer.export()
+    monkeypatch.setattr(sys, "argv",
+                        ["request_autopsy", str(tmp_path), "--smoke"])
+    assert autopsy.main() == 1
