@@ -637,11 +637,13 @@ def run_spec(on_tpu: bool, smoke: bool, seqs: int = 4, prompt: int = 48,
 
 def build_frontend_engine(on_tpu: bool, pool_blocks: int, ctx: int,
                           rows: int = 4, block_size: int = 16,
-                          prefix_cache: bool = False):
+                          prefix_cache: bool = False, lora: dict = None):
     """A warmed engine sized so the frontend workload SATURATES the KV pool
     (the regime preemption policy differentiates in): a deliberately small
     page pool, the full pow2 decode grid pre-compiled. ``prefix_cache``
-    turns the radix tree on (the --router leg's routing substrate)."""
+    turns the radix tree on (the --router leg's routing substrate);
+    ``lora`` enables the adapter pool (the --lora leg — warmup then also
+    pre-compiles the (bucket, rank-bucket) program ladder)."""
     import jax
     import jax.numpy as jnp
     from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
@@ -670,6 +672,8 @@ def build_frontend_engine(on_tpu: bool, pool_blocks: int, ctx: int,
              "compile": {"warmup": True}}
     if prefix_cache:
         econf["prefix_cache"] = {"enabled": True}
+    if lora:
+        econf["lora"] = dict(lora, enabled=True)
     if not on_tpu:
         econf["dtype"] = jnp.float32
     engine = InferenceEngineV2(model=model, model_parameters=params,
@@ -853,6 +857,200 @@ def run_frontend(on_tpu: bool, smoke: bool, rate: float, duration: float,
             and med["offload"] >= med["none"]
         print(json.dumps({"gate": "goodput_under_slo", "ok": gate,
                           "median_goodput": med, "reps": reps}), flush=True)
+        ok = ok and gate
+    return ok
+
+
+def _register_bench_adapters(engine, ranks):
+    """Register one seeded random adapter per entry of ``ranks`` (names
+    ``ad0, ad1, ...``); deltas are small (~2% weight scale) so streams stay
+    well-formed but DO diverge from base decodes."""
+    from deepspeed_tpu.module_inject.lora import load_lora_adapter
+    spec = engine.spec
+    din = spec.hidden_size
+    douts = {"q": spec.num_heads * spec.head_dim,
+             "k": spec.num_kv_heads * spec.head_dim,
+             "v": spec.num_kv_heads * spec.head_dim,
+             "o": spec.hidden_size}
+    names = []
+    for i, r in enumerate(ranks):
+        g = np.random.RandomState(1000 + i)
+        state = {"alpha": float(r)}
+        for t in engine.config.lora.targets:
+            state[t] = {
+                "A": (g.standard_normal((din, r)) * 0.02).astype(np.float32),
+                "B": (g.standard_normal((r, douts[t])) * 0.02).astype(
+                    np.float32)}
+        name = f"ad{i}"
+        load_lora_adapter(engine, name, state)
+        names.append(name)
+    return names
+
+
+def _serve_lora_plain(engine, uid, prompt, gen, adapter):
+    """Direct plain-pipeline reference serve under an adapter binding —
+    the byte-equality oracle for the --lora leg's mixed-tenant streams."""
+    from deepspeed_tpu.inference.v2.pipeline import DecodePipeline
+    if adapter is not None:
+        engine.lora.acquire(uid, adapter)
+    try:
+        engine._put_nofetch([uid], [np.asarray(prompt, np.int32)])
+        out = DecodePipeline(engine, [uid]).run(gen)
+        engine.flush([uid])
+    finally:
+        if adapter is not None:
+            engine.lora.release(uid)
+    return [int(t) for t in out[0]]
+
+
+def _lora_pool_baseline(engine):
+    """(ok, detail): adapter pool consistency at idle — every refcount 0,
+    free + resident pages account for the whole pool, no pinned swap
+    buffers outstanding."""
+    reg = engine.lora
+    resident = sum(reg.rank(n) for n in reg.names if reg.is_resident(n))
+    free = reg.pool.free_pages
+    detail = {"free_pages": free, "resident_pages": resident,
+              "pool_pages": reg.pool.num_pages,
+              "refcounts": {n: reg.refcount(n) for n in reg.names},
+              "swap_outstanding": reg.swap.outstanding}
+    ok = (free + resident == reg.pool.num_pages
+          and all(v == 0 for v in detail["refcounts"].values())
+          and reg.swap.outstanding == 0)
+    return ok, detail
+
+
+def run_lora(on_tpu: bool, smoke: bool, rate: float, duration: float,
+             seed: int = 0, reps: int = 3):
+    """The multi-tenant LoRA leg (BENCH_r17; docs/SERVING.md "Multi-tenant
+    LoRA"): a seeded Poisson mix where arrivals draw tenants from MORE
+    registered adapters than the adapter pool holds at once — admission
+    faults cold adapters in and LRU-evicts idle ones while one ragged
+    decode batch mixes tenants. Gates, every rep:
+
+      - byte-equality: finished mixed-batch streams == direct per-adapter
+        DecodePipeline runs on the same warmed engine,
+      - zero engine compiles during every timed phase (the warmed
+        (bucket, rank-bucket) ladder absorbs adapter churn),
+      - allocator AND adapter pool at baseline after drain (refcounts 0,
+        free + resident pages == pool, no pinned buffers outstanding),
+
+    and (full runs) goodput-under-SLO >= 1.5x a NAIVE one-adapter-at-a-time
+    baseline: the same arrivals grouped by adapter and each group served
+    sequentially to drain (group-relative arrival stamps — generous to the
+    baseline, which never pays cross-tenant queueing), on the same engine.
+    Spec decode stays OFF: one variable (grouped adapter matmul) per leg."""
+    import dataclasses
+    from deepspeed_tpu.inference.v2.serving import (PoissonLoadGen,
+                                                    WorkloadComponent,
+                                                    goodput_report, replay)
+    engine, vocab = build_frontend_engine(
+        on_tpu, pool_blocks=20, ctx=160,
+        lora={"pool_pages": 8, "max_rank": 4, "swap_buffers": 16})
+    # 4 adapters totalling 13 pages against an 8-page pool: at most two of
+    # the rank-4 tenants are resident with a third's pages in flight, so a
+    # saturating mix MUST evict/restore to serve everyone
+    adapters = _register_bench_adapters(engine, ranks=[4, 4, 3, 2])
+    mix = [WorkloadComponent("interactive", 3.0, [16, 32], [8, 16],
+                             adapter_id=adapters),
+           WorkloadComponent("interactive", 1.0, [16], [8]),   # base tenant
+           WorkloadComponent("batch", 1.0, [32], [24],
+                             adapter_id=adapters[0])]
+    arrivals = PoissonLoadGen(rate=rate, mix=mix, vocab=vocab,
+                              seed=seed).arrivals(duration=duration)
+    serving = {"classes": _frontend_classes(), "decode_slice": 4,
+               "preemption": "offload", "idle_wait_s": 0.002,
+               "spec": False}
+    if smoke:
+        reps = 1
+    ok = True
+    mixed_good, naive_good = [], []
+    for r in range(reps):
+        kv_free0 = engine.allocator.free_blocks
+        # -- mixed multi-tenant replay (the subsystem under test) ---------
+        fe = engine.serving_frontend(config=serving)
+        c0 = engine.compiles
+        t0 = time.time()
+        fe.start()
+        handles = replay(fe, arrivals)
+        fe.drain(timeout=2.5 * duration + 20)
+        wall = time.time() - t0
+        fe.close()
+        compiles_mixed = engine.compiles - c0
+        rep = goodput_report(handles, wall)
+        faults = engine.lora.stats
+        # byte-equality: mixed-batch streams vs direct per-adapter serves
+        finished = [(h, a) for h, a in zip(handles, arrivals)
+                    if h.status == "finished" and h.tokens]
+        check = finished[:16] if smoke else finished[:32]
+        c1 = engine.compiles
+        equal = 0
+        for i, (h, a) in enumerate(check):
+            got = _serve_lora_plain(engine, 91_000 + i, h.prompt,
+                                    len(h.tokens), a.adapter)
+            equal += got == h.tokens
+        compiles_ref = engine.compiles - c1
+        pool_ok, pool_detail = _lora_pool_baseline(engine)
+        kv_ok = engine.allocator.free_blocks == kv_free0
+        out = {
+            "leg": "lora", "mode": "mixed", "rep": r, "rate": rate,
+            "duration": duration, "arrivals": len(arrivals),
+            "adapters": len(adapters),
+            "adapter_pool_pages": engine.lora.pool.num_pages,
+            "adapter_faults": sum(c.faults
+                                  for c in faults.adapters.values()),
+            "adapter_evictions": sum(c.evictions
+                                     for c in faults.adapters.values()),
+            "adapter_hit_fraction": round(faults.hit_fraction, 3),
+            "streams_checked": len(check), "streams_equal": equal,
+            "outputs_equal": equal == len(check),
+            "compiles_during_timed": compiles_mixed + compiles_ref,
+            "allocator_at_baseline": kv_ok,
+            "adapter_pool_at_baseline": pool_ok,
+            "adapter_pool": pool_detail,
+            **rep,
+        }
+        print(json.dumps(out), flush=True)
+        mixed_good.append(rep["goodput_tokens_per_sec"])
+        ok = ok and out["outputs_equal"] and kv_ok and pool_ok \
+            and out["compiles_during_timed"] == 0
+        # -- naive one-adapter-at-a-time baseline -------------------------
+        groups = {}
+        for a in arrivals:
+            groups.setdefault(a.adapter, []).append(a)
+        naive_wall = 0.0
+        naive_tokens = 0
+        compiles_naive = 0
+        for key in sorted(groups, key=lambda k: groups[k][0].t):
+            grp = [dataclasses.replace(a, t=a.t - groups[key][0].t)
+                   for a in groups[key]]
+            fe = engine.serving_frontend(config=serving)
+            c0 = engine.compiles
+            t0 = time.time()
+            fe.start()
+            hs = replay(fe, grp)
+            fe.drain(timeout=2.5 * duration + 20)
+            naive_wall += time.time() - t0
+            fe.close()
+            compiles_naive += engine.compiles - c0
+            naive_tokens += goodput_report(hs, 1.0)["good_tokens"]
+        naive = round(naive_tokens / naive_wall, 1)
+        out = {"leg": "lora", "mode": "naive_sequential", "rep": r,
+               "groups": len(groups), "wall_s": round(naive_wall, 2),
+               "goodput_tokens_per_sec": naive,
+               "compiles_during_timed": compiles_naive}
+        print(json.dumps(out), flush=True)
+        naive_good.append(naive)
+        ok = ok and compiles_naive == 0
+    if not smoke:
+        med_m = float(np.median(mixed_good))
+        med_n = float(np.median(naive_good))
+        gate = med_m >= 1.5 * med_n
+        print(json.dumps({"gate": "lora_goodput_vs_naive", "ok": gate,
+                          "median_mixed": med_m, "median_naive": med_n,
+                          "required_ratio": 1.5,
+                          "ratio": round(med_m / max(med_n, 1e-9), 2)}),
+              flush=True)
         ok = ok and gate
     return ok
 
@@ -1898,6 +2096,15 @@ def main():
                          "incl. rejoin re-warm, allocator baseline on every "
                          "replica, and (full) goodput >= 0.7x an "
                          "N-1-replica no-fault floor")
+    ap.add_argument("--lora", action="store_true",
+                    help="run the multi-tenant LoRA leg: a seeded Poisson "
+                         "mix drawing tenants from more registered adapters "
+                         "than the adapter pool holds, served through the "
+                         "grouped LoRA decode matmul — gating byte-identical "
+                         "streams vs direct per-adapter runs, zero timed "
+                         "compiles across adapter churn, allocator + adapter "
+                         "pool at baseline every rep, and (full) goodput >= "
+                         "1.5x a naive one-adapter-at-a-time baseline")
     ap.add_argument("--trace-overhead", action="store_true",
                     help="run the serving tracer/attribution overhead leg: "
                          "the same seeded burst router workload with flow "
@@ -1971,6 +2178,11 @@ def main():
         ok = run_serving_trace_overhead(
             on_tpu, args.smoke,
             reps=args.reps if args.reps is not None else 5)
+        sys.exit(0 if ok else 1)
+    if args.lora:
+        rate = args.rate or (8.0 if args.smoke else 16.0)
+        dur = 3.0 if args.smoke else min(args.duration, 10.0)
+        ok = run_lora(on_tpu, args.smoke, rate=rate, duration=dur, reps=reps)
         sys.exit(0 if ok else 1)
     if args.chaos:
         ok = run_chaos(on_tpu, args.smoke, reps=reps)

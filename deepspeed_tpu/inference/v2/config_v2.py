@@ -242,6 +242,61 @@ class SpecDecodeConfig:
 
 
 @dataclass
+class LoraConfig:
+    """Multi-tenant LoRA serving (``inference/v2/lora/``; docs/SERVING.md
+    "Multi-tenant LoRA"). One base model plus per-tenant low-rank adapters —
+    the S-LoRA/Punica pattern — served from a paged adapter-weight pool
+    managed exactly like the KV pool: fixed-size weight pages (one page per
+    rank slice), refcounted per in-flight request, LRU-evicted to pinned
+    host buffers under pool pressure and restored byte-exactly.
+
+    ``pool_pages``: device pages in the adapter pool. One adapter of rank r
+    occupies r pages, so the pool holds ``pool_pages / mean_rank`` adapters
+    resident; registering more than fit is the POINT — cold adapters park on
+    host and fault back in on demand. Must hold at least one ``max_rank``
+    adapter.
+
+    ``max_rank``: the largest adapter rank this engine accepts. Ranks are
+    bucketed to powers of two for dispatch: the decode/verify program grid
+    is keyed by (bucket, rank-bucket) and ``warmup`` pre-compiles every
+    rung, so adapter churn never compiles. The grouped-matmul rank operand
+    runs at ``next_pow2(max registered rank)``; smaller adapters pad their
+    page tables with the pool's zero page (an exact zero contribution).
+
+    ``targets``: which projections carry deltas — a subset of
+    ``("q", "k", "v", "o")``. Deltas apply inside the DECODE and VERIFY
+    programs (the serving hot path this subsystem exists for); prefill
+    passes run the base model (docs/SERVING.md "Multi-tenant LoRA" states
+    the resulting decode-scope semantics).
+
+    ``swap_buffers`` caps the pinned host bounce-buffer pool
+    (``runtime/swap_tensor/buffer_pool.py``) evicted adapters park in."""
+    enabled: bool = False
+    pool_pages: int = 64
+    max_rank: int = 16
+    targets: Any = ("q", "v")
+    swap_buffers: int = 16
+
+    def __post_init__(self):
+        self.targets = tuple(self.targets)
+        bad = [t for t in self.targets if t not in ("q", "k", "v", "o")]
+        if bad:
+            raise ValueError(f"lora.targets must be a subset of "
+                             f"('q', 'k', 'v', 'o'), got {self.targets!r}")
+        if not self.targets:
+            raise ValueError("lora.targets must name at least one projection")
+        if self.max_rank < 1:
+            raise ValueError(f"lora.max_rank must be >= 1, got {self.max_rank}")
+        if self.pool_pages < self.max_rank:
+            raise ValueError(
+                f"lora.pool_pages ({self.pool_pages}) must hold at least one "
+                f"max_rank ({self.max_rank}) adapter")
+        if self.swap_buffers < 1:
+            raise ValueError("lora.swap_buffers must be >= 1, got "
+                             f"{self.swap_buffers}")
+
+
+@dataclass
 class PriorityClassConfig:
     """One tenant priority class for the serving frontend
     (``inference/v2/serving/``): a strict-priority level plus the latency
@@ -322,8 +377,17 @@ class ServingConfig:
     agree only up to cross-kernel float noise (~1e-4/token argmax flips on
     a random-init model — docs/SERVING.md "Quantized KV" gate taxonomy),
     so a replay gated bit-exactly against a plain reference serves plain.
+
+    ``tenant_classes``: explicit tenant -> priority-class mapping (tenant
+    here = LoRA adapter name, the multi-tenant identity of docs/SERVING.md
+    "Multi-tenant LoRA"). Per-request ``priority=`` stays the override, but
+    a submit that names an adapter WITHOUT naming a class defaults to the
+    tenant's mapped class instead of ``"standard"`` — mixed benches stop
+    misclassifying traffic whose class lives in workload config rather
+    than on each request. Every value must name a configured class.
     """
     classes: Any = field(default_factory=_default_classes)
+    tenant_classes: Any = field(default_factory=dict)
     decode_slice: int = 8
     spec: bool = True
     preemption: str = "offload"
@@ -347,6 +411,12 @@ class ServingConfig:
                              f"'recompute' or 'none', got {self.preemption!r}")
         if self.decode_slice < 1:
             raise ValueError("serving.decode_slice must be >= 1")
+        self.tenant_classes = dict(self.tenant_classes)
+        for tenant, cls_name in self.tenant_classes.items():
+            if cls_name not in names:
+                raise ValueError(
+                    f"serving.tenant_classes[{tenant!r}] = {cls_name!r} names "
+                    f"no configured priority class (configured: {names})")
 
     def get_class(self, name: str) -> PriorityClassConfig:
         for c in self.classes:
@@ -354,6 +424,16 @@ class ServingConfig:
                 return c
         raise KeyError(f"unknown priority class {name!r}; configured: "
                        f"{[c.name for c in self.classes]}")
+
+    def class_for(self, priority: Optional[str],
+                  tenant: Optional[str] = None) -> PriorityClassConfig:
+        """Resolve a request's class: explicit ``priority`` wins, else the
+        tenant's ``tenant_classes`` mapping, else ``"standard"``."""
+        if priority is not None:
+            return self.get_class(priority)
+        if tenant is not None and tenant in self.tenant_classes:
+            return self.get_class(self.tenant_classes[tenant])
+        return self.get_class("standard")
 
 
 @dataclass
@@ -492,6 +572,7 @@ class RaggedInferenceEngineConfig:
     compile: CompileConfig = field(default_factory=CompileConfig)
     serving: ServingConfig = field(default_factory=ServingConfig)
     spec_decode: SpecDecodeConfig = field(default_factory=SpecDecodeConfig)
+    lora: LoraConfig = field(default_factory=LoraConfig)
     tensor_parallel: int = 1
     dtype: Any = jnp.bfloat16
     seed: int = 0
@@ -523,9 +604,11 @@ class RaggedInferenceEngineConfig:
             sv = ServingConfig(**sv) if isinstance(sv, dict) else sv
             sd = d.pop("spec_decode", {})
             sd = SpecDecodeConfig(**sd) if isinstance(sd, dict) else sd
+            lr = d.pop("lora", {})
+            lr = LoraConfig(**lr) if isinstance(lr, dict) else lr
             cfg = cls(state_manager=sm, kv_cache=kv, quantization=qz,
                       kv_quant=kq, prefix_cache=pc, compile=co, serving=sv,
-                      spec_decode=sd, **d)
+                      spec_decode=sd, lora=lr, **d)
         if cfg.state_manager.chunk_budget <= 0:
             raise ValueError("max_ragged_batch_size must exceed max_ragged_sequence_count")
         return cfg

@@ -253,6 +253,32 @@ class InferenceEngineV2:
                                                    SpecDecodeStats)
         self.pipeline_stats = PipelineStats()
         self.spec_stats = SpecDecodeStats()
+        # multi-tenant LoRA: adapter registry + paged weight pool
+        # (inference/v2/lora/; docs/SERVING.md "Multi-tenant LoRA"). The
+        # decode/verify program grid grows a rank-bucket axis; the pool's
+        # host movers count compiles through the engine counter so the
+        # zero-steady-state-compile gate covers adapter churn too.
+        self.lora = None
+        if cfg.lora.enabled:
+            if tp > 1:
+                # the grouped-matmul pages pack WHOLE projection columns/rows
+                # per rank slice; under head-sharded TP each shard would need
+                # its slice of every page — refuse until the pool is sharded
+                raise NotImplementedError(
+                    "multi-tenant LoRA with tensor_parallel > 1 is not wired "
+                    "(adapter pages are unsharded whole-projection slices); "
+                    "run lora at tp=1")
+            from deepspeed_tpu.inference.v2.lora import (LoraAdapterRegistry,
+                                                         LoraPagePool)
+
+            def _count_compile():
+                self.compiles += 1
+
+            self.lora = LoraAdapterRegistry(
+                LoraPagePool(self.spec, cfg.lora.targets, cfg.lora.pool_pages,
+                             compile_hook=_count_compile),
+                swap_buffers=cfg.lora.swap_buffers,
+                max_rank=cfg.lora.max_rank)
         # serving runs don't pass through deepspeed_tpu.initialize — arm the
         # span tracer from $DSTPU_TRACE here (no-op when unset/armed)
         _trace_from_env()
@@ -472,10 +498,20 @@ class InferenceEngineV2:
             return ids_t if db.bucket == S else ids_t[:S]
         return fetch_to_host(out_ids).T[:S]    # [S, n_steps]
 
-    def _decode_step_prog(self, bucket: int, do_sample: bool, top_k: int):
+    def _decode_step_prog(self, bucket: int, do_sample: bool, top_k: int,
+                          rb: int = 0):
         """The fused single-step decode program (forward + on-device sampling,
         ragged_model.build_decode_step) for one bucket — the DecodePipeline's
-        hot program. LRU-cached per (bucket, do_sample, top_k)."""
+        hot program. LRU-cached per (bucket, do_sample, top_k, rb).
+
+        ``rb`` is the LoRA rank bucket (``lora.rank_bucket`` — pow2, engine-
+        stable after registration): rb > 0 builds the grouped-matmul variant
+        taking the ``(lora_pool, adapter_pt [bucket, rb])`` trailing operands;
+        rb = 0 is EXACTLY the pre-LoRA program, so adapter-free engines are
+        byte-unchanged. Distinct rb values are distinct keys — a separate jit
+        wrapper each — so every compile stays witnessed by the counter (one
+        shared jit re-specializing on the page-table shape would compile
+        silently)."""
         def _build():
             from deepspeed_tpu.inference.v2.ragged_model import (
                 build_decode_step)
@@ -483,12 +519,42 @@ class InferenceEngineV2:
             fwd = build_decode_step(self.spec, mesh=self.topology.mesh,
                                     tp=tp if tp > 1 else 1,
                                     do_sample=do_sample, top_k=top_k,
-                                    window_ring_ok=self.scheduler.ring_covers(2))
+                                    window_ring_ok=self.scheduler.ring_covers(2),
+                                    lora_targets=self._lora_targets(rb))
             self.compiles += 1
             return jax.jit(fwd, donate_argnums=(1,))
 
         return self._step_progs.get_or_create(
-            (bucket, bool(do_sample), int(top_k)), _build)
+            (bucket, bool(do_sample), int(top_k), int(rb)), _build)
+
+    def _lora_targets(self, rb: int):
+        """The ``lora_targets`` builder knob for a rank bucket: the engine's
+        configured projection set when rb > 0, None (base program) at rb=0."""
+        if rb == 0:
+            return None
+        assert self.lora is not None, "rank-bucketed program without LoRA"
+        return self.config.lora.targets
+
+    @property
+    def lora_rank_bucket(self) -> int:
+        """The rank bucket current decode dispatch runs at: the registry's
+        ``rank_bucket`` (0 when LoRA is off or only rank-0 adapters exist —
+        the base programs)."""
+        return self.lora.rank_bucket if self.lora is not None else 0
+
+    def _lora_operands(self, uids: Sequence[int], bucket: int,
+                       rb: Optional[int] = None) -> tuple:
+        """The trailing ``*lora_args`` for a rank-bucketed program: the pool
+        array plus the device page table for these rows. Empty at rb=0 so
+        callers can splat unconditionally. Built once per pipeline RUN (the
+        batch's adapter bindings are frozen for the run, like block tables —
+        the in-jit gather is hoisted out of the step scan on that
+        invariant)."""
+        rb = self.lora_rank_bucket if rb is None else rb
+        if rb == 0:
+            return ()
+        pt = self.lora.page_table(uids, bucket, rb)
+        return (self.lora.pool.pool, jnp.asarray(pt))
 
     @property
     def spec_k_ladder(self) -> List[int]:
@@ -507,21 +573,26 @@ class InferenceEngineV2:
         ks.append(k)
         return sorted(set(ks))
 
-    def _verify_prog(self, bucket: int, k: int):
+    def _verify_prog(self, bucket: int, k: int, rb: int = 0):
         """The fused speculative verify-step program (draft scoring in ONE
         ragged forward, ragged_model.build_verify_step) for one (bucket, k)
         grid point — the SpecDecodePipeline's hot program. LRU-cached;
-        warmup() pre-compiles the whole grid."""
+        warmup() pre-compiles the whole grid. ``rb`` as in
+        :meth:`_decode_step_prog` — rb > 0 verifies WITH each row's adapter
+        delta (the K+1 token rows share the sequence's adapter), keeping
+        accepted spec tokens byte-identical to plain LoRA decode."""
         def _build():
             from deepspeed_tpu.inference.v2.ragged_model import (
                 build_verify_step)
             tp = self.topology.tp_world_size
             fwd = build_verify_step(self.spec, k, mesh=self.topology.mesh,
-                                    tp=tp if tp > 1 else 1)
+                                    tp=tp if tp > 1 else 1,
+                                    lora_targets=self._lora_targets(rb))
             self.compiles += 1
             return jax.jit(fwd, donate_argnums=(1,))
 
-        return self._verify_progs.get_or_create((bucket, int(k)), _build)
+        return self._verify_progs.get_or_create((bucket, int(k), int(rb)),
+                                                _build)
 
     def decode_pipeline(self, uids: Sequence[int], do_sample: bool = False,
                         temperature: float = 1.0, top_k: int = 0):
@@ -602,13 +673,23 @@ class InferenceEngineV2:
             spec_ks = self.spec_k_ladder \
                 if self.config.spec_decode.enabled else []
         spec_ks = sorted({int(k) for k in spec_ks})
+        # LoRA rank rungs: pow2 up to next_pow2(lora.max_rank) — the whole
+        # rank-bucket axis of the program grid (registration refuses larger
+        # ranks, so live dispatch can never leave the warmed ladder). rb=0
+        # (the base programs) is the existing grid below.
+        lora_rungs: List[int] = []
+        if self.lora is not None:
+            top = next_pow2(self.config.lora.max_rank)
+            lora_rungs = [1 << i for i in range(top.bit_length())]
         # the warmed set must FIT its LRUs, or warmup evicts programs it just
         # built and the zero-compiles invariant silently breaks on first use
-        self._step_progs.maxsize = max(self._step_progs.maxsize, len(grid) + 2)
+        self._step_progs.maxsize = max(
+            self._step_progs.maxsize, (len(lora_rungs) + 1) * len(grid) + 2)
         self._multistep.maxsize = max(self._multistep.maxsize,
                                       len(burst_steps) * len(grid) + 2)
-        self._verify_progs.maxsize = max(self._verify_progs.maxsize,
-                                         len(spec_ks) * len(grid) + 2)
+        self._verify_progs.maxsize = max(
+            self._verify_progs.maxsize,
+            (len(lora_rungs) + 1) * len(spec_ks) * len(grid) + 2)
         self._warm_passes()
         mb = self.scheduler.max_blocks
         for b in grid:
@@ -617,6 +698,18 @@ class InferenceEngineV2:
             nxt, _logits, new_kv = prog(self.weights, self.kv.kv, *args)
             self.kv.update(new_kv)
             jax.block_until_ready(nxt)
+        # the LoRA (bucket, rank-bucket) grid: every rung runs once over
+        # all-pad rows with an all-zero-page table (exact-zero deltas — the
+        # same traced shapes live mixed-tenant batches use)
+        for rb in lora_rungs:
+            for b in grid:
+                prog = self._decode_step_prog(b, False, 0, rb)
+                args = self._scratch_step_args(b, mb)
+                lops = self._scratch_lora_args(b, rb)
+                nxt, _logits, new_kv = prog(self.weights, self.kv.kv, *args,
+                                            *lops)
+                self.kv.update(new_kv)
+                jax.block_until_ready(nxt)
         for n_steps in burst_steps:
             for b in grid:
                 fn = self._multistep.get_or_create(
@@ -631,12 +724,14 @@ class InferenceEngineV2:
         # page writes exercise the same traced shapes live traffic uses)
         for k in spec_ks:
             for b in grid:
-                prog = self._verify_prog(b, k)
-                args = self._scratch_verify_args(b, k, mb)
-                _acc, nxt, _fl, new_kv = prog(self.weights, self.kv.kv,
-                                              *args)
-                self.kv.update(new_kv)
-                jax.block_until_ready(nxt)
+                for rb in [0] + lora_rungs:
+                    prog = self._verify_prog(b, k, rb)
+                    args = self._scratch_verify_args(b, k, mb)
+                    lops = self._scratch_lora_args(b, rb)
+                    _acc, nxt, _fl, new_kv = prog(self.weights, self.kv.kv,
+                                                  *args, *lops)
+                    self.kv.update(new_kv)
+                    jax.block_until_ready(nxt)
         # the KV page round-trip pair (preempt-offload / page fabric) over
         # its whole bucket grid: rare path, but a preemption DURING the
         # timed steady state must not compile — warm both ops per bucket
@@ -645,6 +740,10 @@ class InferenceEngineV2:
         for b in self.page_buckets:
             pages = self.fetch_pages([self.scratch_block] * b)
             self.put_pages(pages, [self.scratch_block] * b)
+        # the adapter-pool movers over their own rank-sized bucket grid — a
+        # mid-steady-state adapter fault/evict must never compile either
+        if self.lora is not None:
+            self.lora.pool.warm(self.config.lora.max_rank)
         # the greedy bootstrap sampler over every logits-source shape a
         # serving loop can hand it: without this, the FIRST pipeline run /
         # burst after startup pays a small-but-real compile (an RTT-bound
@@ -686,6 +785,14 @@ class InferenceEngineV2:
         bt = np.full((bucket, max_blocks), self.scratch_block, np.int32)
         ctx = np.ones((bucket,), np.int32)
         return ids, pos, bt, ctx, self._rng_key, jnp.float32(1.0)
+
+    def _scratch_lora_args(self, bucket: int, rb: int) -> tuple:
+        """All-zero-page LoRA operands for warming a rank-bucketed program
+        (every row the null adapter — exact-zero deltas)."""
+        if rb == 0:
+            return ()
+        pt = np.full((bucket, rb), self.lora.pool.zero_page, np.int32)
+        return (self.lora.pool.pool, jnp.asarray(pt))
 
     def _scratch_verify_args(self, bucket: int, k: int, max_blocks: int):
         """All-pad-row inputs for a verify-step program (spec decode
@@ -1042,6 +1149,8 @@ class InferenceEngineV2:
             monitor.write_events(self.pipeline_stats.events(step))
         if self.spec_stats.steps:
             monitor.write_events(self.spec_stats.events(step))
+        if self.lora is not None and self.lora.stats.adapters:
+            monitor.write_events(self.lora.stats.events(step))
 
     # ------------------------------------------------------------------ #
     # continuous-batching generation loop (parity role: MII serving loop)

@@ -1,0 +1,26 @@
+"""Multi-tenant LoRA serving: paged adapter weights + grouped decode.
+
+- :class:`LoraPagePool` — the device page pool + bucketed host movers;
+- :class:`LoraAdapterRegistry` — adapter lifecycle (register / acquire /
+  release / LRU evict / byte-exact restore) and the per-batch page table.
+
+The matmul half lives in ``ragged_model`` (``lora_target_dims``,
+``lora_page_layout``, ``lora_layer_operands`` and the ``lora_targets``
+builder knob); checkpoint loading/validation in ``module_inject.lora``.
+"""
+
+from deepspeed_tpu.inference.v2.lora.pool import LoraPagePool
+from deepspeed_tpu.inference.v2.lora.registry import (
+    EVICTED,
+    REGISTERED,
+    RESIDENT,
+    LoraAdapterRegistry,
+)
+
+__all__ = [
+    "LoraPagePool",
+    "LoraAdapterRegistry",
+    "REGISTERED",
+    "RESIDENT",
+    "EVICTED",
+]

@@ -1,0 +1,166 @@
+"""Paged adapter-weight pool for multi-tenant LoRA serving.
+
+The device half of ``inference/v2/lora/``: ONE dense array
+``[num_pages + 2, elements]`` in the model dtype, managed exactly like the
+KV pool (docs/SERVING.md "Multi-tenant LoRA"):
+
+- a **page** is one rank slice of a whole adapter (column j of every
+  targeted projection's A matrix + row j of its B, all layers —
+  ``ragged_model.lora_page_layout``), so every page has the same size and
+  a rank-r adapter owns r pages anywhere in the pool;
+- index ``num_pages`` is the **zero page**: read-only zeros backing the
+  null adapter, rank padding below the dispatch bucket, and gather pad
+  slots — rows bound to it contribute exact-zero deltas, which is what
+  keeps pad rows inert and adapter-free streams byte-identical;
+- index ``num_pages + 1`` is the **junk page**: the write-only scatter
+  padding target (the scratch-page discipline of the KV movers — pad
+  writes land on the one page no adapter can own);
+- host round-trips run through bucketed jitted gather/scatter movers
+  (pow2-padded id vectors, one dispatch + one transfer per batch, the
+  ``fetch_pages``/``put_pages`` pattern), drained via the policed
+  ``fetch_to_host``; first use of each (op, bucket) signature counts as a
+  compile through ``compile_hook`` so the engine's zero-steady-state-
+  compile gate covers adapter churn, and ``warm()`` pre-compiles the grid.
+
+The decode programs read the pool array directly (``lora_pool[adapter_pt]``
+inside the jit) — it is an OPERAND of the step programs, never donated
+there; only the scatter mover donates it (rebinding ``self.pool``, the
+``put_pages`` discipline).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.v2.engine_v2 import fetch_to_host
+from deepspeed_tpu.inference.v2.ragged_model import lora_page_layout
+from deepspeed_tpu.utils.caching import next_pow2
+
+
+class LoraPagePool:
+    """Fixed-size adapter-weight pages on device + a free-list allocator.
+
+    Allocation/refcount policy lives in :class:`~deepspeed_tpu.inference.v2.
+    lora.registry.LoraAdapterRegistry`; this class owns only the device
+    array, the free list, and the bucketed host movers."""
+
+    def __init__(self, spec, targets: Tuple[str, ...], num_pages: int,
+                 compile_hook: Optional[Callable[[], None]] = None):
+        self.spec = spec
+        self.targets = tuple(targets)
+        self.elements, self.in_max, self.out_max = \
+            lora_page_layout(spec, self.targets)
+        self.num_pages = int(num_pages)
+        self.zero_page = self.num_pages
+        self.junk_page = self.num_pages + 1
+        self.dtype = jnp.dtype(spec.dtype)
+        self.pool = jnp.zeros((self.num_pages + 2, self.elements),
+                              self.dtype)
+        self._free: List[int] = list(range(self.num_pages))
+        self._progs = None
+        self._buckets: set = set()
+        self._compile_hook = compile_hook
+
+    # -- allocator ------------------------------------------------------- #
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def page_nbytes(self) -> int:
+        return self.elements * self.dtype.itemsize
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"LoRA pool exhausted: need {n} pages, {len(self._free)} "
+                f"free of {self.num_pages} — evict an idle adapter first "
+                "(registry handles this; a direct caller raced it)")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, ids: Sequence[int]) -> None:
+        for b in ids:
+            b = int(b)
+            assert 0 <= b < self.num_pages, f"freeing non-pool page {b}"
+            assert b not in self._free, f"double free of LoRA page {b}"
+            self._free.append(b)
+
+    # -- bucketed host movers (the KV page-fabric pattern) --------------- #
+
+    def _programs(self):
+        if self._progs is None:
+
+            @jax.jit
+            def _gather(pool, idx):
+                return pool[idx]
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def _scatter(pool, rows, idx):
+                return pool.at[idx].set(rows)
+
+            self._progs = (_gather, _scatter)
+        return self._progs
+
+    def _bucket(self, kind: str, n: int) -> int:
+        """Pad count for a mover batch; first use of each (op, bucket)
+        signature counts as a compile (engine.compiles via the hook)."""
+        b = next_pow2(n)
+        key = (kind, b)
+        if key not in self._buckets:
+            self._buckets.add(key)
+            if self._compile_hook is not None:
+                self._compile_hook()
+        return b
+
+    def fetch_pages(self, ids: Sequence[int]) -> np.ndarray:
+        """Adapter pages to host, one bucketed gather: ``[n, elements]`` in
+        the pool dtype — the evict half of the swap round trip. Byte-exact
+        with :meth:`put_pages` (same dtype both ways; pinned by
+        tests/unit/test_lora_serving.py). Pad slots read the zero page."""
+        ids = [int(b) for b in ids]
+        gather, _ = self._programs()
+        bucket = self._bucket("gather", len(ids))
+        idx = np.full((bucket,), self.zero_page, np.int32)
+        idx[:len(ids)] = ids
+        return fetch_to_host(gather(self.pool, jnp.asarray(idx)))[:len(ids)]
+
+    def put_pages(self, rows: np.ndarray, ids: Sequence[int]) -> None:
+        """Scatter host rows ``[n, elements]`` into pool pages ``ids`` (one
+        bucketed dispatch) — the restore/fault-in half. Pad slots write
+        zeros into the write-only junk page."""
+        ids = [int(b) for b in ids]
+        if not ids:
+            return
+        _, scatter = self._programs()
+        bucket = self._bucket("scatter", len(ids))
+        idx = np.full((bucket,), self.junk_page, np.int32)
+        idx[:len(ids)] = ids
+        rows = np.asarray(rows, self.dtype)
+        if rows.shape != (len(ids), self.elements):
+            raise ValueError(
+                f"LoRA page payload shape {rows.shape} does not match "
+                f"({len(ids)}, {self.elements}) — pages are fixed-size "
+                "rank slices (lora_page_layout)")
+        if bucket != len(ids):
+            rows = np.concatenate(
+                [rows, np.zeros((bucket - len(ids), self.elements),
+                                rows.dtype)])
+        # direct rebind (the put_pages discipline): the donated pool's
+        # reference is replaced before the next decode step reads it
+        self.pool = scatter(self.pool, jnp.asarray(rows), jnp.asarray(idx))
+
+    def warm(self, max_rank: int) -> None:
+        """Pre-compile both movers over the pow2 bucket grid up to
+        ``next_pow2(max_rank)`` (the largest batch one adapter's fault/evict
+        can move), round-tripping zero-page content into the junk page —
+        a mid-steady-state adapter fault must never observe a compile."""
+        top = next_pow2(max(1, int(max_rank)))
+        for b in [1 << i for i in range(top.bit_length())]:
+            rows = self.fetch_pages([self.zero_page] * b)
+            self.put_pages(rows, [self.junk_page] * b)

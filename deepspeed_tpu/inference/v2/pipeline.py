@@ -142,13 +142,18 @@ class DecodePipeline:
         # stage-0 setup: pre-reserve KV for the whole run; bucketed
         # descriptors; grid-warm program; on-device bootstrap sample
         db = e.scheduler.decode_batch(uids, n_steps + 1, e.scratch_block)
-        prog = e._decode_step_prog(db.bucket, self.do_sample, self.top_k)
+        rb = e.lora_rank_bucket
+        prog = e._decode_step_prog(db.bucket, self.do_sample, self.top_k, rb)
         e._rng_key, base = jax.random.split(e._rng_key)
         temp = jnp.float32(self.temperature)
         # block tables are invariant for the whole run (KV pre-reserved):
         # commit them to device ONCE instead of re-uploading [bucket, MB]
         # ints with every per-token dispatch
         block_tables = jnp.asarray(db.block_tables)
+        # LoRA operands are run-invariant too (adapter bindings are frozen
+        # while a request is in flight — the registry's refcount gate): empty
+        # at rb=0, so adapter-free engines dispatch the identical program
+        lora_args = e._lora_operands(uids, db.bucket, rb)
         ids, _ = e._sample_device_padded(uids, self.do_sample,
                                          self.temperature, self.top_k)
         assert ids.shape[0] == db.bucket
@@ -170,7 +175,8 @@ class DecodePipeline:
                 nxt, logits, new_kv = prog(e.weights, e.kv.kv, ids,
                                            db.positions, block_tables,
                                            db.ctx_lens,
-                                           jax.random.fold_in(base, j), temp)
+                                           jax.random.fold_in(base, j), temp,
+                                           *lora_args)
                 e.kv.update(new_kv)
                 if hasattr(nxt, "copy_to_host_async"):
                     nxt.copy_to_host_async()  # D2H queued behind step j, free

@@ -437,6 +437,102 @@ def _mm(x, w):
     return x @ w
 
 
+# --------------------------------------------------------------------------- #
+# multi-tenant LoRA: paged adapter weights -> per-row grouped delta
+# (inference/v2/lora/; docs/SERVING.md "Multi-tenant LoRA")
+# --------------------------------------------------------------------------- #
+
+#: projections a LoRA adapter may target (attention only — the S-LoRA /
+#: Punica serving pattern; MLP adapters are out of scope for the paged pool)
+LORA_TARGETS = ("q", "k", "v", "o")
+
+
+def lora_target_dims(spec: "RaggedModelSpec",
+                     target: str) -> Tuple[int, int]:
+    """``(d_in, d_out)`` of one LoRA-targeted base projection."""
+    H, Hkv, D = spec.num_heads, spec.num_kv_heads, spec.head_dim
+    hid = spec.hidden_size
+    dims = {"q": (hid, H * D), "k": (hid, Hkv * D), "v": (hid, Hkv * D),
+            "o": (H * D, hid)}
+    if target not in dims:
+        raise ValueError(f"unknown LoRA target {target!r} "
+                         f"(supported: {LORA_TARGETS})")
+    return dims[target]
+
+
+def lora_page_layout(spec: "RaggedModelSpec",
+                     targets: Tuple[str, ...]) -> Tuple[int, int, int]:
+    """``(elements, in_max, out_max)`` of ONE adapter-weight page.
+
+    A page is one RANK SLICE of a whole adapter — for every layer and every
+    targeted projection, column ``j`` of that projection's A matrix (padded
+    to ``in_max``) followed by row ``j`` of its B matrix (padded to
+    ``out_max``, alpha/rank pre-folded in at registration) — flattened to
+    ``[L, nproj, in_max + out_max]`` in ``spec.dtype``. Rank-r adapters own
+    r pages; the pool's zero page pads ranks below the dispatch bucket AND
+    backs the null adapter, so pad reads contribute exact zeros. Same design
+    as a KV page: fixed size from the model spec alone, so the pool is one
+    dense device array and the per-row gather is a single take."""
+    dims = [lora_target_dims(spec, t) for t in targets]
+    in_max = max(d[0] for d in dims)
+    out_max = max(d[1] for d in dims)
+    return (spec.num_layers * len(targets) * (in_max + out_max),
+            in_max, out_max)
+
+
+def lora_layer_operands(spec: "RaggedModelSpec", targets: Tuple[str, ...],
+                        lora_pool, adapter_pt, repeat: int = 1):
+    """Per-row adapter pages gathered on device, shaped for the layer scan.
+
+    ``lora_pool`` ``[P + 2, elements]``, ``adapter_pt`` ``[S, RB]`` page
+    ids (RB = the engine's pow2 rank bucket; rank padding and pad rows
+    point at the pool's zero page) -> ``[L, T, RB, nproj, in_max+out_max]``
+    riding the layer scan as xs. ``repeat`` expands sequence rows to token
+    rows for the verify step's K+1-rows-per-sequence batch."""
+    pages = lora_pool[adapter_pt]                       # [S, RB, E]
+    if repeat > 1:
+        pages = jnp.repeat(pages, repeat, axis=0)
+    T, RB = pages.shape[0], pages.shape[1]
+    _, in_max, out_max = lora_page_layout(spec, targets)
+    sl = pages.reshape(T, RB, spec.num_layers, len(targets),
+                       in_max + out_max)
+    return jnp.moveaxis(sl, 2, 0)
+
+
+def _lora_split(spec: "RaggedModelSpec", targets: Tuple[str, ...], lora_l):
+    """One layer's scanned slice ``[T, RB, nproj, io]`` -> ``{target:
+    (A [T, RB, d_in], B [T, RB, d_out])}`` for :func:`_lora_mm`."""
+    _, in_max, out_max = lora_page_layout(spec, targets)
+    out = {}
+    for p, t in enumerate(targets):
+        din, dout = lora_target_dims(spec, t)
+        out[t] = (lora_l[:, :, p, :din],
+                  lora_l[:, :, p, in_max:in_max + dout])
+    return out
+
+
+def _lora_mm(x, w, lora, name: str):
+    """``_mm(x, w)`` plus the row's grouped LoRA delta ``(x @ A) @ B``.
+
+    The grouped matmul of the multi-tenant decode batch: every token row
+    carries ITS OWN adapter's A/B rank slices (gathered by
+    :func:`lora_layer_operands`), so one einsum pair serves a batch that
+    mixes tenants — no per-adapter dispatch, no batch splitting. Rows bound
+    to the zero page (no adapter, rank padding, scratch pad rows) contribute
+    exact zeros, which keeps pad rows inert and the null-adapter stream
+    byte-identical across batch compositions. fp32 contraction: the rank
+    dim is tiny, and it makes the delta independent of the batch's bucket
+    shape (the byte-equality gate's requirement)."""
+    y = _mm(x, w)
+    if lora is None or name not in lora:
+        return y
+    a, b = lora[name]
+    c = jnp.einsum("ti,tri->tr", x.astype(jnp.float32),
+                   a.astype(jnp.float32))
+    d = jnp.einsum("tr,tro->to", c, b.astype(jnp.float32))
+    return y + d.astype(y.dtype)
+
+
 _QUANT_KEYS = ("wq", "wk", "wv", "wo")
 _QUANT_MLP_KEYS = ("w_gate", "w_up", "w_down")
 
@@ -506,20 +602,23 @@ def _quantize_weight_tree(weights: Dict, q) -> Dict:
     return weights
 
 
-def _transformer_layer(spec: "RaggedModelSpec", w, x, positions, attend):
+def _transformer_layer(spec: "RaggedModelSpec", w, x, positions, attend,
+                       lora=None):
     """Shared per-layer transformer body for BOTH the ragged forward (put
     passes) and the fused multistep decode — one implementation so the two
     paths cannot diverge.  ``attend(q, k, v) -> (attn_raw [N, H, D],
     *state)`` performs the KV page write + attention for its pass shape;
     ``state`` is the caller's carried cache state (pools, or pools + scale
-    pools for int8 KV). Returns ``(x_out, state_tuple)``.
+    pools for int8 KV). ``lora`` (``_lora_split`` output, or None) adds each
+    row's grouped adapter delta to the targeted attention projections.
+    Returns ``(x_out, state_tuple)``.
     """
     H, Hkv, D = spec.num_heads, spec.num_kv_heads, spec.head_dim
     dtype = spec.dtype
     h1 = _norm(x, w["ln1"], spec.norm, spec.eps, dtype, spec.norm_plus_one)
-    q = _mm(h1, w["wq"]).reshape(-1, H, D)
-    k = _mm(h1, w["wk"]).reshape(-1, Hkv, D)
-    v = _mm(h1, w["wv"]).reshape(-1, Hkv, D)
+    q = _lora_mm(h1, w["wq"], lora, "q").reshape(-1, H, D)
+    k = _lora_mm(h1, w["wk"], lora, "k").reshape(-1, Hkv, D)
+    v = _lora_mm(h1, w["wv"], lora, "v").reshape(-1, Hkv, D)
     if "bq" in w:
         q = q + w["bq"].reshape(H, D)
         k = k + w["bk"].reshape(Hkv, D)
@@ -529,7 +628,7 @@ def _transformer_layer(spec: "RaggedModelSpec", w, x, positions, attend):
         k = _rope_flat(k, positions, spec.rope_theta, spec.rotary_dim)
 
     attn_raw, *state = attend(q, k, v)
-    attn_out = _mm(attn_raw.reshape(-1, H * D), w["wo"])
+    attn_out = _lora_mm(attn_raw.reshape(-1, H * D), w["wo"], lora, "o")
     if "bo" in w:
         attn_out = attn_out + w["bo"]
 
@@ -1115,7 +1214,9 @@ def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
                            do_sample: bool = False,
                            top_k: int = 0,
                            window_ring_ok: bool = False,
-                           max_side_bytes: Optional[int] = None) -> Callable:
+                           max_side_bytes: Optional[int] = None,
+                           lora_targets: Optional[Tuple[str, ...]] = None
+                           ) -> Callable:
     """Fused N-step greedy/sampled decode: the sample->embed->forward->sample
     feedback loop runs entirely on device for ``n_steps`` tokens per sequence.
 
@@ -1150,8 +1251,13 @@ def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
     after the last generated one (so the serving loop can continue seamlessly).
     """
     general = _build_multistep_general(spec, n_steps, mesh=mesh, tp=tp,
-                                       do_sample=do_sample, top_k=top_k)
-    fits = (tp == 1 and spec.head_dim % 128 == 0
+                                       do_sample=do_sample, top_k=top_k,
+                                       lora_targets=lora_targets)
+    # LoRA programs take the general (per-step write) loop only: the
+    # side-buffer schedule's decode path is the single-step pipeline's
+    # domain and wiring adapter operands into its frozen-read scan buys
+    # nothing (decode_steps bursts are NOT lora-wired; docs/SERVING.md)
+    fits = (lora_targets is None and tp == 1 and spec.head_dim % 128 == 0
             and (spec.window is None or window_ring_ok))
     if not fits:
         return general
@@ -1191,7 +1297,9 @@ def _sample_logits(logits, key, do_sample: bool, top_k: int, temperature):
 
 def build_decode_step(spec: RaggedModelSpec, mesh=None, tp: int = 1,
                       do_sample: bool = False, top_k: int = 0,
-                      window_ring_ok: bool = False) -> Callable:
+                      window_ring_ok: bool = False,
+                      lora_targets: Optional[Tuple[str, ...]] = None
+                      ) -> Callable:
     """One fused decode step for the double-buffered serving pipeline:
     consume ``ids`` [S] (this step's tokens, already sampled), write their KV,
     run the forward pass, and sample the NEXT token row — all in ONE device
@@ -1211,16 +1319,21 @@ def build_decode_step(spec: RaggedModelSpec, mesh=None, tp: int = 1,
     Returns ``fwd(weights, kv_pages, ids [S], positions [S],
     block_tables [S, MB], ctx [S], key, temperature) ->
     (next_ids [S] int32, logits [S, V], new_kv)`` where ``logits`` predict
-    ``next_ids`` (kept for the engine's continuation refs).
+    ``next_ids`` (kept for the engine's continuation refs). With
+    ``lora_targets`` set, ``fwd`` takes the two REQUIRED trailing LoRA
+    operands ``(lora_pool, adapter_pt)`` after ``temperature`` and each
+    row's grouped adapter delta rides the targeted projections.
     """
     inner = build_multistep_decode(spec, 1, mesh=mesh, tp=tp,
                                    do_sample=do_sample, top_k=top_k,
-                                   window_ring_ok=window_ring_ok)
+                                   window_ring_ok=window_ring_ok,
+                                   lora_targets=lora_targets)
 
     def fwd(weights, kv_pages, ids, positions, block_tables, ctx,
-            key, temperature=1.0):
+            key, temperature=1.0, *lora_args):
         out_ids, logits, new_kv = inner(weights, kv_pages, ids, positions,
-                                        block_tables, ctx, key, temperature)
+                                        block_tables, ctx, key, temperature,
+                                        *lora_args)
         del out_ids  # == ids: the pipeline already holds this step's row
         # same fold as the scan's step 0, so XLA CSEs this with the
         # scan-internal sample
@@ -1232,7 +1345,9 @@ def build_decode_step(spec: RaggedModelSpec, mesh=None, tp: int = 1,
 
 
 def build_verify_step(spec: RaggedModelSpec, k: int, mesh=None,
-                      tp: int = 1) -> Callable:
+                      tp: int = 1,
+                      lora_targets: Optional[Tuple[str, ...]] = None
+                      ) -> Callable:
     """Speculative-decode verify step: score ``k`` draft tokens per sequence
     in ONE ragged forward (``inference/v2/spec/``; docs/SERVING.md
     "Speculative decoding").
@@ -1286,11 +1401,22 @@ def build_verify_step(spec: RaggedModelSpec, k: int, mesh=None,
     ak = AttentionKernelSpec(spec, mesh=mesh, tp=tp)
 
     def fwd(weights, kv_pages, ids, draft, n_draft, positions0,
-            block_tables, ctx0):
+            block_tables, ctx0, *lora_args):
         kv_pages, kv_sc = _kv_unpack(kv_pages)
         kvq = kv_sc is not None
         assert not (kvq and tp > 1), "int8 KV pages + TP not wired"
         S = ids.shape[0]
+        if lora_targets is not None:
+            # each sequence's K+1 token rows share its adapter: repeat the
+            # per-sequence gather to token rows so the verify batch runs the
+            # SAME grouped delta sequential decode runs row-for-row (the
+            # byte-equality induction extends to LoRA streams unchanged)
+            lora_pool, adapter_pt = lora_args
+            lora_ops = lora_layer_operands(spec, lora_targets, lora_pool,
+                                           adapter_pt, repeat=K1)
+        else:
+            assert not lora_args, "lora operands on a non-LoRA program"
+            lora_ops = None
         L, NB, bs = kv_pages.shape[0], kv_pages.shape[1], kv_pages.shape[4]
         MB = block_tables.shape[1]
         kvp0 = kv_pages.reshape(L * NB * 2 * Hkv * bs, D)
@@ -1311,7 +1437,12 @@ def build_verify_step(spec: RaggedModelSpec, k: int, mesh=None,
 
         def layer_fn(carry, scanned):
             x, kvp, sc = carry
-            w, l = scanned
+            if lora_ops is not None:
+                w, l, lora_l = scanned
+                lora = _lora_split(spec, lora_targets, lora_l)
+            else:
+                w, l = scanned
+                lora = None
 
             def attend(q, k_, v):
                 # write-then-attend (the ragged pass's discipline): all K+1
@@ -1335,12 +1466,14 @@ def build_verify_step(spec: RaggedModelSpec, k: int, mesh=None,
                                ctx0 + (K1 - 1), kv_scales=scales)
                 return out.reshape(S * K1, H, D), kvp_, sc_
 
-            x, (kvp, sc) = _transformer_layer(spec, w, x, pos_flat, attend)
+            x, (kvp, sc) = _transformer_layer(spec, w, x, pos_flat, attend,
+                                              lora=lora)
             return (x, kvp, sc), None
 
-        (x, kvp, sc), _ = jax.lax.scan(
-            layer_fn, (x, kvp0, sc0),
-            (weights["layers"], jnp.arange(L, dtype=jnp.int32)))
+        xs = (weights["layers"], jnp.arange(L, dtype=jnp.int32))
+        if lora_ops is not None:
+            xs = xs + (lora_ops,)
+        (x, kvp, sc), _ = jax.lax.scan(layer_fn, (x, kvp0, sc0), xs)
         new_kv = kvp.reshape(L, NB, 2, Hkv, bs, D)
         if kvq:
             new_kv = (new_kv, sc.reshape(L, NB, r8, 128))
@@ -1367,24 +1500,38 @@ def build_verify_step(spec: RaggedModelSpec, k: int, mesh=None,
 def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
                              mesh=None, tp: int = 1,
                              do_sample: bool = False,
-                             top_k: int = 0) -> Callable:
+                             top_k: int = 0,
+                             lora_targets: Optional[Tuple[str, ...]] = None
+                             ) -> Callable:
     """The per-step-write multistep loop (fused attention+page-write kernel
     per layer per step): the fallback when the side-buffer schedule's gates
     fail (TP sharding, small head_dim, window-ring capacity, side-buffer HBM
-    budget)."""
+    budget). With ``lora_targets`` the built ``fwd`` takes two REQUIRED
+    trailing operands after ``temperature`` — ``lora_pool [P+2, E]`` and
+    ``adapter_pt [S, RB]`` — and every row's grouped adapter delta rides the
+    targeted projections (docs/SERVING.md "Multi-tenant LoRA")."""
     H, Hkv, D = spec.num_heads, spec.num_kv_heads, spec.head_dim
     dtype = spec.dtype
 
     ak = AttentionKernelSpec(spec, mesh=mesh, tp=tp)
 
     def fwd(weights, kv_pages, ids0, positions0, block_tables, ctx0,
-            key, temperature=1.0):
+            key, temperature=1.0, *lora_args):
         kv_pages, kv_sc = _kv_unpack(kv_pages)
         kvq = kv_sc is not None
         assert not (kvq and tp > 1), "int8 KV pages + TP not wired"
         S = ids0.shape[0]
         L, NB, bs = kv_pages.shape[0], kv_pages.shape[1], kv_pages.shape[4]
         r8 = _scale_tile_rows(Hkv, bs) if kvq else 0
+        if lora_targets is not None:
+            # hoisted out of the step scan: the gather is loop-invariant
+            # (a batch's adapter bindings are frozen for the whole run)
+            lora_pool, adapter_pt = lora_args
+            lora_ops = lora_layer_operands(spec, lora_targets, lora_pool,
+                                           adapter_pt)
+        else:
+            assert not lora_args, "lora operands on a non-LoRA program"
+            lora_ops = None
 
         def one_pass(x_ids, pos, ctx, kvp, sc):
             # kvp flat [L*NB*2*Hkv*bs, D]. The attention + page-write is one
@@ -1396,7 +1543,12 @@ def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
 
             def layer_fn(carry, scanned):
                 x, kvp, sc = carry
-                w, l = scanned
+                if lora_ops is not None:
+                    w, l, lora_l = scanned
+                    lora = _lora_split(spec, lora_targets, lora_l)
+                else:
+                    w, l = scanned
+                    lora = None
 
                 def attend(q, k, v):
                     if kvq:
@@ -1418,12 +1570,14 @@ def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
                         block_tables + l * NB, ctx)
                     return (out, kv5.reshape(L * NB * 2 * Hkv * bs, D), sc)
 
-                x, (kvp, sc) = _transformer_layer(spec, w, x, pos, attend)
+                x, (kvp, sc) = _transformer_layer(spec, w, x, pos, attend,
+                                                  lora=lora)
                 return (x, kvp, sc), None
 
-            (x, kvp, sc), _ = jax.lax.scan(
-                layer_fn, (x, kvp, sc),
-                (weights["layers"], jnp.arange(L, dtype=jnp.int32)))
+            xs = (weights["layers"], jnp.arange(L, dtype=jnp.int32))
+            if lora_ops is not None:
+                xs = xs + (lora_ops,)
+            (x, kvp, sc), _ = jax.lax.scan(layer_fn, (x, kvp, sc), xs)
             x = _norm(x, weights["final_norm"], spec.norm, spec.eps, dtype,
                       spec.norm_plus_one)
             logits = _unembed(spec, weights, x)

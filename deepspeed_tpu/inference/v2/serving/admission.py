@@ -37,6 +37,16 @@ preemption):
   frontend offloads each victim's private KV tail (``kv_offload.py``),
   falling back to recompute when host capacity is exhausted.
 
+Multi-tenant LoRA joins the same plan: a request bound to an adapter
+(``RequestHandle.adapter``) admits/restores only when its adapter is
+fundable in the ADAPTER page pool too (``LoraAdapterRegistry.can_admit`` —
+resident, or free + idle-evictable pages cover its rank), and adapter pool
+pressure preempts strictly-lower-priority binding holders exactly like KV
+pressure preempts block holders. The frontend then acquires the binding in
+the admission round — the fault-in (host -> device page scatter) lands
+there, never inside a decode slice, so a cold adapter can't stall a hot
+tenant's token cadence (docs/SERVING.md "Multi-tenant LoRA").
+
 Everything here is host metadata — the controller never touches a device
 array; block math rides the scheduler's refcounted accounting
 (``scheduler.available_blocks`` / ``blocks_needed``).
@@ -224,12 +234,28 @@ class AdmissionController:
                 self.remove(req)
                 actions.append(("shed", req))
 
+        # adapter-aware planning: admits/restores of LoRA-bound requests
+        # also need their adapter fundable in the ADAPTER page pool
+        # (resident, or free + idle-evictable pages >= rank) — checked with
+        # the same simulate-the-plan discipline as the block budget, where
+        # a planned preempt releases its victim's adapter binding
+        lora = getattr(self.engine, "lora", None)
+        releasing: List[int] = []
+
+        def _adapter_ok(req) -> bool:
+            a = getattr(req, "adapter", None)
+            if a is None or lora is None:
+                return True
+            return lora.can_admit(a, releasing=releasing)
+
         # 1. restores outrank admissions (priority desc, oldest preempt first)
         order = {c.name: i for i, c in enumerate(self._order)}
         for req in sorted(preempted.values(),
                           key=lambda r: (order[r.cls.name], r.preempt_t)):
             if req.cancelled or rows_free <= 0:
                 continue
+            if not _adapter_ok(req):
+                continue      # adapter pool pressure: stay preempted
             # a recompute-preempted victim was flushed — readmitting it
             # re-creates its sequence, so it needs a tracked slot too
             needs_slot = offload is None or req.uid not in offload._recs
@@ -267,6 +293,25 @@ class AdmissionController:
                 actions.append(("preempt", v))
                 budget += gain
                 rows_free += 1
+                releasing.append(v.uid)
+            # adapter pool pressure funds the same way KV pressure does:
+            # preempt strictly-lower-priority rows whose released bindings
+            # make enough idle pages evictable — but only rows that HOLD an
+            # adapter binding (an adapterless victim frees no adapter pages)
+            while not _adapter_ok(req) and cfg.preemption != "none" \
+                    and victims:
+                v = victims[-1]
+                if v.cls.priority >= req.cls.priority:
+                    break
+                victims.pop()
+                if getattr(v, "adapter", None) is None:
+                    continue
+                actions.append(("preempt", v))
+                budget += self._freeable(v.uid)
+                rows_free += 1
+                releasing.append(v.uid)
+            if not _adapter_ok(req):
+                break                 # head-of-line holds; no bypass
             if need <= budget:
                 self.remove(req)
                 actions.append(("admit", req))

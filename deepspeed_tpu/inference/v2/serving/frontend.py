@@ -98,8 +98,14 @@ class RequestHandle:
     boundary and releases its KV through ``scheduler.flush``."""
 
     def __init__(self, uid: int, prompt: np.ndarray, cls, max_new_tokens: int,
-                 eos_token_id: Optional[int], arrival_t: float):
+                 eos_token_id: Optional[int], arrival_t: float,
+                 adapter: Optional[str] = None):
         self.uid = uid
+        #: LoRA adapter (tenant identity) this request decodes under; None =
+        #: the base model. The engine thread acquires/releases the registry
+        #: binding around the request's decoding lifetime (``_lora_held``).
+        self.adapter = adapter
+        self._lora_held = False
         #: process-unique request flow id, minted at submit and carried by
         #: every hop span (router placement, prefill, KV handoff, decode
         #: stints, failover migration) — the exporter binds spans sharing it
@@ -251,6 +257,14 @@ class ServingFrontend:
                 "preemption with a sliding-window page ring is not wired "
                 "(the logical block list aliases physical pages) — run "
                 "preemption='none'")
+        if cfg.preemption == "recompute" and getattr(engine, "lora", None) \
+                is not None:
+            raise NotImplementedError(
+                "preemption='recompute' with LoRA serving is not wired: "
+                "decode-written KV carries the adapter's k/v deltas, and a "
+                "recompute restore re-prefills it base-only — a silently "
+                "byte-divergent stream; run preemption='offload' (byte-exact "
+                "restore) or 'none'")
         self.engine = engine
         self.config = cfg
         # phase-ledger recording (RequestHandle.timeline / serve/slo/*);
@@ -325,24 +339,39 @@ class ServingFrontend:
     # client surface (any thread / asyncio)
     # ------------------------------------------------------------------ #
 
-    def submit(self, prompt: Sequence[int], priority: str = "standard",
+    def submit(self, prompt: Sequence[int], priority: Optional[str] = None,
                max_new_tokens: int = 32,
-               eos_token_id: Optional[int] = None) -> RequestHandle:
+               eos_token_id: Optional[int] = None,
+               adapter: Optional[str] = None,
+               tenant: Optional[str] = None) -> RequestHandle:
         """Enqueue one request; returns immediately with its stream handle.
         ``priority`` names a configured class; admission decides admit /
-        hold / shed against that class's TTFT/TBT SLOs."""
+        hold / shed against that class's TTFT/TBT SLOs. ``adapter`` names a
+        registered LoRA adapter to decode under (the tenant identity);
+        ``tenant`` overrides the identity used for class mapping when it
+        differs from the adapter name. An explicit ``priority`` wins;
+        otherwise ``ServingConfig.tenant_classes`` maps the tenant to its
+        class (default "standard")."""
         if self._closed or self._fenced:
             raise RuntimeError("frontend is closed"
                                if self._closed else
                                "frontend is fenced (replica down)")
-        cls = self.config.get_class(priority)
+        cls = self.config.class_for(priority,
+                                    tenant if tenant is not None else adapter)
+        if adapter is not None:
+            lora = getattr(self.engine, "lora", None)
+            if lora is None:
+                raise RuntimeError(
+                    "this engine serves no LoRA adapters — enable "
+                    "RaggedInferenceEngineConfig.lora")
+            lora.rank(adapter)      # raises for an unregistered adapter
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("empty prompt")
         self.check_budget(len(prompt), int(max_new_tokens))
         req = RequestHandle(next(self._uid_iter), prompt, cls,
                             int(max_new_tokens), eos_token_id,
-                            time.perf_counter())
+                            time.perf_counter(), adapter=adapter)
         if not self._attribution:
             req._ledger = None
         with self._inflight_lock:
@@ -703,6 +732,7 @@ class ServingFrontend:
         self._finalize(req, status)
 
     def _finalize(self, req: RequestHandle, status: str) -> None:
+        self._lora_release(req)
         now = time.perf_counter()
         if req.status == DECODING:
             # the ledger's final decode stint ends at the LAST-EMISSION
@@ -779,6 +809,36 @@ class ServingFrontend:
             self._pipe.admit([req.uid])
 
     # ------------------------------------------------------------------ #
+    # LoRA adapter bindings (engine thread only)
+    # ------------------------------------------------------------------ #
+
+    def _lora_acquire(self, req: RequestHandle) -> bool:
+        """Bind ``req``'s adapter and make its pages resident (fault-in from
+        host under pool pressure happens HERE, in the admission/restore
+        round — never inside a decode slice, so a cold adapter fault cannot
+        stall a hot tenant's token cadence). False means the pool cannot
+        fund the adapter right now (every resident adapter is pinned by
+        in-flight rows): the caller defers the request and retries when
+        refcounts drop. Chaos faults (``serve.lora_fault``) propagate —
+        the loop's crash semantics, same as a KV fetch fault."""
+        if req.adapter is None or req._lora_held:
+            return True
+        try:
+            self.engine.lora.acquire(req.uid, req.adapter)
+        except RuntimeError:          # pool pressure raced the plan: hold
+            return False
+        req._lora_held = True
+        return True
+
+    def _lora_release(self, req: RequestHandle) -> None:
+        """Drop the adapter binding (idempotent). The pages stay resident —
+        LRU-cached for the tenant's next request — until pool pressure
+        evicts them to pinned host buffers."""
+        if req._lora_held:
+            self.engine.lora.release(req.uid)
+            req._lora_held = False
+
+    # ------------------------------------------------------------------ #
     # cross-replica handoffs (disaggregated prefill/decode)
     # ------------------------------------------------------------------ #
 
@@ -818,6 +878,9 @@ class ServingFrontend:
                     or len(self._live) >= sm.max_ragged_sequence_count
                     or len(sched.seqs) >= sm.max_tracked_sequences):
                 held.append(rec)
+                continue
+            if not self._lora_acquire(req):
+                held.append(rec)     # adapter pool pressure: retry later
                 continue
             t0 = time.perf_counter()
             try:
@@ -874,9 +937,15 @@ class ServingFrontend:
             elif kind == "restore":
                 self._restore(req)
             elif kind == "admit":
+                if not self._lora_acquire(req):
+                    # adapter pool pressure raced the plan: hold (refcounts
+                    # drop as live rows finish; the plan retries next round)
+                    self.admission._queues[req.cls.name].appendleft(req)
+                    continue
                 try:
                     self.engine.scheduler.add_tokens(req.uid, req.prompt)
                 except RuntimeError:           # capacity raced the plan: hold
+                    self._lora_release(req)
                     self.admission._queues[req.cls.name].appendleft(req)
                     continue
                 t = time.perf_counter()
@@ -949,6 +1018,15 @@ class ServingFrontend:
         if self.offload is not None and self.offload.can_offload(len(tail)):
             n = self.offload.offload(uid, kept, tail)
             self.stats.offload_bytes += n
+        elif req.adapter is not None:
+            # the host-capacity recompute fallback would re-prefill this
+            # row's decode-written KV base-only, but it carries the
+            # adapter's k/v deltas — a silently byte-divergent stream on
+            # restore; shed honestly instead (base rows recompute fine:
+            # their zero-page deltas are an exact +0.0)
+            self.stats.forced_sheds += 1
+            self._teardown(req, SHED)
+            return
         else:
             # recompute preemption (the configured baseline, or the
             # host-capacity fallback): drop all KV, remember the tokens —
@@ -957,6 +1035,10 @@ class ServingFrontend:
                 [req.prompt, np.asarray(req.tokens, np.int32)])
             self.engine.flush([uid])
             self.stats.recompute_preemptions += 1
+        # binding drops across the preempted window (the request holds no
+        # decode gathers); _restore re-acquires — faulting pages back in if
+        # pressure evicted them meanwhile
+        self._lora_release(req)
         req.status = PREEMPTED
         req.preempt_t = req._phase_t0 = now
         req.preemptions += 1
@@ -965,6 +1047,8 @@ class ServingFrontend:
 
     def _restore(self, req: RequestHandle) -> None:
         uid = req.uid
+        if not self._lora_acquire(req):
+            return       # adapter pool pressure: stay preempted, retry later
         t0 = time.perf_counter()
         if self.offload is not None and uid in self.offload._recs:
             self._span(req, "preempted", req._phase_t0, t0)

@@ -37,12 +37,21 @@ class WorkloadComponent:
     see ``arrivals``) and prepended to every prompt the component emits,
     ``prompt_lens`` then sizing only the unique tail. This is the traffic
     shape cache-aware routing exists for (shared system prompts / few-shot
-    templates), and the ``serving_bench.py --router`` workload."""
+    templates), and the ``serving_bench.py --router`` workload.
+
+    ``adapter_id`` names the LoRA adapter (tenant identity) the component's
+    requests decode under: a string pins every arrival to that tenant; a
+    sequence of names draws one per arrival (seed-keyed, uniform) — the
+    multi-tenant churn ``serving_bench.py --lora`` drives. ``None`` (the
+    default) serves the base model AND consumes no randomness, the same
+    pin discipline as ``prefix_len``: an adapter-free mix replays its
+    pre-LoRA arrival stream byte-for-byte."""
     cls: str
     weight: float
     prompt_lens: Sequence[int]
     gen_lens: Sequence[int]
     prefix_len: int = 0
+    adapter_id: object = None
 
 
 @dataclass
@@ -51,6 +60,7 @@ class Arrival:
     cls: str
     prompt: np.ndarray
     max_new_tokens: int
+    adapter: Optional[str] = None   # LoRA adapter (tenant), None = base
 
 
 class PoissonLoadGen:
@@ -101,8 +111,14 @@ class PoissonLoadGen:
             prompt = rng.randint(0, self.vocab, size=(plen,)).astype(np.int32)
             if prefixes[ci] is not None:
                 prompt = np.concatenate([prefixes[ci], prompt])
+            # tenant draw LAST, and only for components that declare
+            # adapters (a fixed string consumes no randomness either) —
+            # adapter-free mixes keep their exact pre-LoRA RNG stream
+            ad = comp.adapter_id
+            if ad is not None and not isinstance(ad, str):
+                ad = str(ad[int(rng.randint(len(ad)))])
             out.append(Arrival(t=t, cls=comp.cls, prompt=prompt,
-                               max_new_tokens=glen))
+                               max_new_tokens=glen, adapter=ad))
         return out
 
 
@@ -119,8 +135,11 @@ def replay(frontend, arrivals: Sequence[Arrival], speed: float = 1.0) -> List:
         delay = a.t / speed - (time.perf_counter() - t0)
         if delay > 0:
             time.sleep(delay)
+        # adapter-free arrivals call the exact pre-LoRA signature: replay
+        # targets only need submit(adapter=) when the mix names tenants
+        kw = {} if a.adapter is None else {"adapter": a.adapter}
         handles.append(frontend.submit(a.prompt, priority=a.cls,
-                                       max_new_tokens=a.max_new_tokens))
+                                       max_new_tokens=a.max_new_tokens, **kw))
     return handles
 
 

@@ -332,16 +332,29 @@ class ServingRouter:
     # client surface
     # ------------------------------------------------------------------ #
 
-    def submit(self, prompt: Sequence[int], priority: str = "standard",
+    def submit(self, prompt: Sequence[int], priority: Optional[str] = None,
                max_new_tokens: int = 32,
-               eos_token_id: Optional[int] = None) -> RequestHandle:
+               eos_token_id: Optional[int] = None,
+               adapter: Optional[str] = None,
+               tenant: Optional[str] = None) -> RequestHandle:
         """Route one request and submit it; returns the serving replica's
-        stream handle (identical semantics to ``ServingFrontend.submit``).
-        May return an already-SHED handle when federation finds every
-        candidate replica SLO-hopeless for this class."""
+        stream handle (identical semantics to ``ServingFrontend.submit``,
+        including the adapter/tenant multi-tenant identity). May return an
+        already-SHED handle when federation finds every candidate replica
+        SLO-hopeless for this class. Adapter-bound requests route only to
+        replicas with the adapter REGISTERED, and a replica with its pages
+        already RESIDENT scores like a cache hit — the fleet converges on
+        tenant-sticky placement without any explicit pinning."""
         if self._closed:
             raise RuntimeError("router is closed")
-        cls = self._serving_cfg.get_class(priority)
+        cls = self._serving_cfg.class_for(priority,
+                                          tenant if tenant is not None
+                                          else adapter)
+        if adapter is not None and self.config.topology != "colocated":
+            raise NotImplementedError(
+                "LoRA adapters over disaggregated prefill/decode are not "
+                "wired (the handoff record carries no adapter binding); "
+                "run topology='colocated'")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("empty prompt")
@@ -351,7 +364,8 @@ class ServingRouter:
         excluded: List[str] = []
         while True:
             target, matched, rebalanced = self._choose(prompt, cls, matches,
-                                                       exclude=excluded)
+                                                       exclude=excluded,
+                                                       adapter=adapter)
             t1 = time.perf_counter()
             if target is None:
                 # shed at the router: every candidate's predicted TTFT
@@ -376,7 +390,8 @@ class ServingRouter:
                     handle = target.frontend.submit(
                         prompt, priority=priority,
                         max_new_tokens=max_new_tokens,
-                        eos_token_id=eos_token_id)
+                        eos_token_id=eos_token_id,
+                        adapter=adapter, tenant=tenant)
                 except RuntimeError:
                     # the replica went down between _choose and submit (a
                     # failure race, not a validation reject — those raise
@@ -471,13 +486,31 @@ class ServingRouter:
                 + adm.cost.predicted_ttft_s(prompt_len)
         return pred * 1e3 > cls.ttft_slo_ms * self.config.shed_factor
 
+    def _adapter_state(self, r: Replica, adapter: str) -> int:
+        """0 = the replica cannot serve this adapter (LoRA disabled or the
+        adapter unregistered there), 1 = registered, 2 = registered with
+        pages device-RESIDENT right now (no fault-in to admit)."""
+        lora = getattr(r.engine, "lora", None)
+        if lora is None or adapter not in lora.names:
+            return 0
+        return 2 if lora.is_resident(adapter) else 1
+
     def _choose(self, prompt, cls, matches: Dict[str, int],
-                exclude: Sequence[str] = ()) \
+                exclude: Sequence[str] = (),
+                adapter: Optional[str] = None) \
             -> Tuple[Optional[Replica], int, bool]:
         """(target, cached tokens there, rebalanced?). ``None`` target =
         shed (every candidate hot, or no routable replica at all)."""
         cands = [r for r in self._targets
                  if r.name not in exclude and self._routable(r)]
+        if adapter is not None:
+            cands = [r for r in cands if self._adapter_state(r, adapter)]
+            if not cands:
+                raise KeyError(
+                    f"LoRA adapter {adapter!r} is not registered on any "
+                    "routable replica — load it (module_inject."
+                    "load_lora_adapter) on each engine that should serve "
+                    "this tenant")
         if not cands:
             return None, 0, False
         if self.config.policy == "round_robin":
@@ -493,8 +526,15 @@ class ServingRouter:
         # to override a real cached match or a serious load gap.
         bs = self.index.block_size
         aff = cands[hash(tuple(int(t) for t in prompt[:bs])) % len(cands)]
+        # adapter-residency bonus: a replica that already holds the tenant's
+        # pages on device admits without a host->device fault-in — worth a
+        # cached block, same scale as cold-start affinity (enough to break
+        # ties toward tenant stickiness, never enough to override a real
+        # prefix match or a serious load gap)
         scored = [(matches.get(r.name, 0)
                    + (bs if r is aff else 0)
+                   + (bs if adapter is not None
+                      and self._adapter_state(r, adapter) == 2 else 0)
                    - self.config.balance * self._load(r),
                    matches.get(r.name, 0), r) for r in cands]
         pool = scored

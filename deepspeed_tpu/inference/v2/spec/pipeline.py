@@ -218,9 +218,14 @@ class SpecDecodePipeline:
         # the ladder tops out at exactly self.k (both read config k), the
         # invariant the zero-compile gate rests on.
         ladder = e.spec_k_ladder
-        plain = e._decode_step_prog(db.bucket, False, 0)
+        rb = e.lora_rank_bucket
+        plain = e._decode_step_prog(db.bucket, False, 0, rb)
         temp = jnp.float32(1.0)
         block_tables = jnp.asarray(db.block_tables)
+        # run-invariant LoRA operands, like block_tables (empty at rb=0);
+        # verify programs repeat each row's pages over its K+1 token rows
+        # in-jit, so the SAME [bucket, rb] table feeds both program kinds
+        lora_args = e._lora_operands(uids, db.bucket, rb)
         ids, _ = e._sample_device_padded(uids, False, 1.0, 0)
         assert ids.shape[0] == db.bucket
         if hasattr(ids, "copy_to_host_async"):
@@ -251,19 +256,21 @@ class SpecDecodePipeline:
                 kmax = int(n_draft.max())
                 if kmax > 0:
                     k_step = next(k_ for k_ in ladder if k_ >= kmax)
-                    prog = e._verify_prog(db.bucket, k_step)
+                    prog = e._verify_prog(db.bucket, k_step, rb)
                     accept_row, nxt, final_logits, new_kv = prog(
                         e.weights, e.kv.kv, ids,
                         jnp.asarray(draft[:, :k_step]),
                         jnp.asarray(n_draft),
-                        db.positions, block_tables, db.ctx_lens)
+                        db.positions, block_tables, db.ctx_lens,
+                        *lora_args)
                 else:
                     # nothing to verify anywhere: one plain decode step
                     # (greedy ignores the key; bit-identical to a verify
                     # step's row 0)
                     nxt, final_logits, new_kv = plain(
                         e.weights, e.kv.kv, ids, db.positions,
-                        block_tables, db.ctx_lens, e._rng_key, temp)
+                        block_tables, db.ctx_lens, e._rng_key, temp,
+                        *lora_args)
                     accept_row = None
                 e.kv.update(new_kv)
                 drain_src = accept_row if accept_row is not None else nxt

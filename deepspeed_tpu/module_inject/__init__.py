@@ -25,13 +25,17 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.module_inject import containers  # noqa: F401  (registers)
+from deepspeed_tpu.module_inject.lora import (load_lora_adapter,
+                                              pack_lora_pages,
+                                              validate_lora_adapter)
 from deepspeed_tpu.module_inject.policy import (HFInjectionPolicy, get_policy,
                                                 register_policy,
                                                 registered_model_types)
 
 __all__ = ["convert_hf_model", "replace_module", "get_policy",
            "register_policy", "registered_model_types", "HFInjectionPolicy",
-           "is_hf_model"]
+           "is_hf_model", "load_lora_adapter", "validate_lora_adapter",
+           "pack_lora_pages"]
 
 
 def is_hf_model(model: Any) -> bool:

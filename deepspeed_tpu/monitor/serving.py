@@ -500,6 +500,120 @@ class HealthStats:
         return out
 
 
+class _AdapterCounters:
+    """Per-adapter LoRA serving counters (one per registered adapter)."""
+
+    __slots__ = ("active", "resident", "evictions", "faults", "acquires",
+                 "hits", "swap_in_bytes", "swap_out_bytes")
+
+    def __init__(self):
+        self.active = 0            # gauge: in-flight requests bound to it
+        self.resident = 0          # gauge: 0/1 device residency
+        self.evictions = 0
+        self.faults = 0            # device fault-ins (from host/master)
+        self.acquires = 0
+        self.hits = 0              # acquires served without a fault
+        self.swap_in_bytes = 0     # host -> device (fault/restore)
+        self.swap_out_bytes = 0    # device -> host (evict)
+
+
+class LoraStats:
+    """Aggregate counters for one engine's LoRA adapter registry
+    (``inference/v2/lora/registry.py``) — the ``serve/lora/*`` monitor
+    surface (docs/SERVING.md "Multi-tenant LoRA"). Per-window aggregations
+    over the SAME ``perf_counter`` stamps the tracer records as
+    ``serve/lora/{fault,swap}`` timeline spans — one set of perf pairs per
+    fault-in/evict feeds both (docs/OBSERVABILITY.md), so the dashboard's
+    swap traffic and the Perfetto lanes can never disagree. Mutated only on
+    the registry's calling thread (the frontend's engine thread — single
+    writer); ``events()`` snapshots the dict before iterating."""
+
+    def __init__(self):
+        self.adapters: Dict[str, _AdapterCounters] = {}
+        self.fault_ms = 0.0        # cumulative fault-in wall (incl. scatter)
+        self.swap_ms = 0.0         # cumulative evict wall (incl. gather)
+
+    def _c(self, name: str) -> _AdapterCounters:
+        return self.adapters.setdefault(name, _AdapterCounters())
+
+    # -- recording (registry thread) ------------------------------------- #
+
+    def record_acquire(self, name: str, hit: bool) -> None:
+        c = self._c(name)
+        c.acquires += 1
+        c.hits += bool(hit)
+        c.active += 1
+
+    def record_release(self, name: str) -> None:
+        self._c(name).active -= 1
+
+    def record_fault(self, name: str, nbytes: int, dt_s: float) -> None:
+        c = self._c(name)
+        c.faults += 1
+        c.swap_in_bytes += int(nbytes)
+        c.resident = 1
+        self.fault_ms += 1e3 * dt_s
+
+    def record_evict(self, name: str, nbytes: int, dt_s: float) -> None:
+        c = self._c(name)
+        c.evictions += 1
+        c.swap_out_bytes += int(nbytes)
+        c.resident = 0
+        self.swap_ms += 1e3 * dt_s
+
+    def set_resident(self, name: str, resident: bool) -> None:
+        self._c(name).resident = int(bool(resident))
+
+    def drop(self, name: str) -> None:
+        """Forget an unregistered adapter's gauges (counters are lost with
+        it — an unregister mid-window is rare enough not to matter)."""
+        self.adapters.pop(name, None)
+
+    # -- reporting -------------------------------------------------------- #
+
+    @property
+    def hit_fraction(self) -> float:
+        acq = sum(c.acquires for c in self.adapters.values())
+        hits = sum(c.hits for c in self.adapters.values())
+        return hits / acq if acq else 0.0
+
+    def events(self, step: int = 0) -> List[Event]:
+        """``serve/lora/*`` monitor events (docs/SERVING.md glossary):
+        registry-wide rollups plus the per-adapter breakdown."""
+        adapters = dict(self.adapters)
+        out: List[Event] = [
+            ("serve/lora/registered", float(len(adapters)), step),
+            ("serve/lora/resident",
+             float(sum(c.resident for c in adapters.values())), step),
+            ("serve/lora/active",
+             float(sum(c.active for c in adapters.values())), step),
+            ("serve/lora/faults",
+             float(sum(c.faults for c in adapters.values())), step),
+            ("serve/lora/evictions",
+             float(sum(c.evictions for c in adapters.values())), step),
+            ("serve/lora/swap_in_bytes",
+             float(sum(c.swap_in_bytes for c in adapters.values())), step),
+            ("serve/lora/swap_out_bytes",
+             float(sum(c.swap_out_bytes for c in adapters.values())), step),
+            ("serve/lora/hit_fraction", self.hit_fraction, step),
+            ("serve/lora/fault_ms", self.fault_ms, step),
+            ("serve/lora/swap_ms", self.swap_ms, step),
+        ]
+        for name, c in sorted(adapters.items()):
+            pre = f"serve/lora/{name}"
+            out += [
+                (f"{pre}/active", float(c.active), step),
+                (f"{pre}/resident", float(c.resident), step),
+                (f"{pre}/evictions", float(c.evictions), step),
+                (f"{pre}/faults", float(c.faults), step),
+                (f"{pre}/swap_bytes",
+                 float(c.swap_in_bytes + c.swap_out_bytes), step),
+                (f"{pre}/hit_fraction",
+                 c.hits / c.acquires if c.acquires else 0.0, step),
+            ]
+        return out
+
+
 class RouterStats:
     """Aggregate counters for one ``ServingRouter``
     (``inference/v2/serving/router.py``) — the ``serve/router/*`` monitor

@@ -63,6 +63,11 @@ Serving-side sites (ISSUE 12 — the chaos surface ``serving_bench.py
                           preempt-offload, export_kv).
 ``serve.kv_put``          ``engine.put_pages`` (page-fabric scatter:
                           restore, import_kv).
+``serve.lora_fault``      ``LoraAdapterRegistry._ensure_resident`` — inside
+                          an adapter fault-in, after pages are allocated
+                          but before the scatter lands (cancel-while-
+                          faulting must roll refcounts, bindings and free
+                          pages back to baseline).
 ========================  ===================================================
 """
 

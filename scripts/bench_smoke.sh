@@ -62,6 +62,16 @@ timeout -k 10 300 python benchmarks/serving_bench.py --spec --smoke \
 timeout -k 10 300 python benchmarks/serving_bench.py --router --smoke \
     || exit 1
 
+# multi-tenant LoRA leg (docs/SERVING.md "Multi-tenant LoRA"): a seeded
+# Poisson mix drawing tenants from more registered adapters than the
+# adapter pool holds — correctness gates only (byte-identical mixed-batch
+# streams vs direct per-adapter runs, zero compiles across adapter churn,
+# allocator + adapter pool at baseline; the >=1.5x goodput-vs-naive gate
+# runs full-size, BENCH_r17); the cold-adapter fault-ins emit the
+# serve/lora trace lane trace_check requires below
+timeout -k 10 300 python benchmarks/serving_bench.py --lora --smoke \
+    || exit 1
+
 # fault-tolerance leg (docs/SERVING.md "Failure semantics"): 2 replicas
 # behind a health-monitored router replay a seeded Poisson stream while
 # fault injection kills one serving loop and stalls the other — gating
@@ -105,7 +115,8 @@ timeout -k 10 300 python benchmarks/serving_bench.py --trace-overhead \
 # parseable flight-recorder dump from the --preempt kills
 timeout -k 10 120 python scripts/trace_check.py "$TRACE_DIR" \
     --require train serve serve/req serve/spec serve/router serve/health \
-    ckpt train/offload --require-flows serve/req --expect-crash || exit 1
+    serve/lora ckpt train/offload --require-flows serve/req --expect-crash \
+    || exit 1
 
 # clock-align + merge the per-process trace files into one timeline; the
 # merged file must pass the same flow-aware checks (stitched chains keep
