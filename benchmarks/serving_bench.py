@@ -1737,6 +1737,38 @@ def _check_chaos_streams(engine, handles, limit, uid_base):
     return len(check), equal, migrated
 
 
+def locksan_gate(leg: str) -> bool:
+    """Runtime lock-order gate for legs run under ``DSTPU_LOCKSAN=1``
+    (docs/THREADLINT.md): ZERO observed acquisition cycles, and every edge
+    the sanitizer recorded must be predicted by threadlint's static lock
+    graph (static >= observed — the analyzer is never blind to an ordering
+    the runtime actually took). No-op (and passing) when the sanitizer is
+    not armed, so the legs behave identically outside the smoke harness.
+    Blocking-under-lock events are REPORTED but don't flip the gate — the
+    static rule (TL002) owns that class, with annotations for the
+    deliberate handoffs."""
+    from deepspeed_tpu.utils import locksan
+    if not locksan.enabled():
+        return True
+    from deepspeed_tpu.tools.threadlint.config import (ThreadLintConfig,
+                                                       find_config)
+    from deepspeed_tpu.tools.threadlint.model import static_lock_graph
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg_path = find_config(root)
+    config = ThreadLintConfig.load(cfg_path) if cfg_path         else ThreadLintConfig()
+    static = set(static_lock_graph([os.path.join(root, "deepspeed_tpu")],
+                                   config))
+    rep = locksan.report()
+    unexplained = sorted(locksan.check_static(static))
+    out = {"locksan_leg": leg,
+           "observed_edges": sorted(locksan.edges()),
+           "cycles": rep["cycles"],
+           "unexplained_edges": unexplained,
+           "blocking_under_lock": rep["blocking"]}
+    print(json.dumps(out), flush=True)
+    return not rep["cycles"] and not unexplained
+
+
 def run_chaos(on_tpu: bool, smoke: bool, seed: int = 0, reps: int = 3):
     """The fault-tolerance leg (docs/SERVING.md "Failure semantics"),
     BENCH_r14: N colocated replicas behind a health-monitored
@@ -2186,9 +2218,11 @@ def main():
         sys.exit(0 if ok else 1)
     if args.chaos:
         ok = run_chaos(on_tpu, args.smoke, reps=reps)
+        ok = locksan_gate("chaos") and ok
         sys.exit(0 if ok else 1)
     if args.router:
         ok = run_router(on_tpu, args.smoke, reps=reps)
+        ok = locksan_gate("router") and ok
         sys.exit(0 if ok else 1)
     if args.frontend:
         if args.kv_dtype == "int8":

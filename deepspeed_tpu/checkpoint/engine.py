@@ -32,6 +32,7 @@ from deepspeed_tpu.monitor.trace import tracer as _tracer
 from deepspeed_tpu.utils import fault_injection
 from deepspeed_tpu.utils.logging import logger
 from deepspeed_tpu.utils.resilience import retry_call
+from deepspeed_tpu.utils.threads import make_lock
 
 
 class CheckpointEngine:
@@ -50,7 +51,7 @@ class CheckpointEngine:
         # O(state-bytes) checksum scan never runs on the step loop) and
         # collected by commit_checkpoint via take_checksums
         self._checksums: Dict[str, Dict[str, int]] = {}
-        self._ck_lock = threading.Lock()
+        self._ck_lock = make_lock("checkpoint.checksum")
 
     def create(self, tag: str) -> None:
         """Start a checkpoint under ``tag`` (reference: logging/bookkeeping)."""
@@ -129,7 +130,7 @@ class AsyncCheckpointEngine(CheckpointEngine):
         # vanish into k's).
         self._inflight: Dict[Optional[str], List[Future]] = {}
         self._cur_tag: Optional[str] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("checkpoint.async.inflight")
         self._closed = False
         # Process exit must not abandon queued writers: a "completed" save
         # whose bytes never hit disk is the silent-corruption case the

@@ -47,6 +47,7 @@ from deepspeed_tpu.checkpoint.state import (commit_checkpoint,
                                             write_checkpoint_files)
 from deepspeed_tpu.monitor.trace import tracer as _tracer
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.threads import make_semaphore, thread_role
 
 if TYPE_CHECKING:  # pragma: no cover - typing only
     from deepspeed_tpu.config import RollingCheckpointConfig
@@ -76,7 +77,8 @@ class RollingCheckpointer:
         # get()'d is out of the queue but still uncommitted, so queue size
         # alone under-counts pending work by one
         self._jobs: queue.Queue = queue.Queue()
-        self._pending = threading.Semaphore(max(1, int(cfg.max_pending)))
+        self._pending = make_semaphore("checkpoint.rolling.pending",
+                                       max(1, int(cfg.max_pending)))
         self._commit_errs: List[BaseException] = []
         self._committer: Optional[threading.Thread] = None
         self._closed = False
@@ -127,8 +129,17 @@ class RollingCheckpointer:
         # charging it to backpressure_ms would read as committer contention
         # on every save
         t_acq = perf()
-        self._pending.acquire()
-        self._jobs.put((tag, files))
+        # the permit transfers WITH the job: the committer releases it when
+        # the commit lands (or fails) — hence no release on the success
+        # path here. But a put() that raises (teardown race: Queue
+        # subclassed/closed) must hand the permit back, or every failed
+        # save leaks backpressure budget until save() wedges permanently.
+        self._pending.acquire()  # threadlint: disable=TL004  (handoff)
+        try:
+            self._jobs.put((tag, files))
+        except BaseException:
+            self._pending.release()
+            raise
         t2 = perf()
         self._raise_commit_errors()
         if self.stats is not None:
@@ -170,6 +181,7 @@ class RollingCheckpointer:
                                            daemon=True)
         self._committer.start()
 
+    @thread_role("dstpu-ckpt-commit")
     def _commit_loop(self):
         while True:
             job = self._jobs.get()

@@ -46,6 +46,7 @@ import jax
 import numpy as np
 
 from deepspeed_tpu.utils.logging import log_dist, logger
+from deepspeed_tpu.utils.threads import make_lock
 
 MODEL_FILE = "model_states.npz"
 OPTIM_FILE = "optim_states.npz"
@@ -208,7 +209,7 @@ def verify_flat(flat: Dict[str, np.ndarray], manifest: Optional[dict],
     return bad
 
 
-_latest_lock = threading.Lock()
+_latest_lock = make_lock("checkpoint.latest")
 
 
 def _tag_step(tag: Optional[str]) -> int:

@@ -44,6 +44,7 @@ from deepspeed_tpu.inference.v2.scheduler import DynamicSplitFuseScheduler
 from deepspeed_tpu.monitor.trace import install_from_env as _trace_from_env
 from deepspeed_tpu.monitor.trace import tracer as _tracer
 from deepspeed_tpu.utils.caching import LRUCache, next_pow2
+from deepspeed_tpu.utils import locksan as _locksan
 from deepspeed_tpu.utils.fault_injection import maybe_fail as _maybe_fail
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -66,6 +67,9 @@ def fetch_to_host(arr) -> np.ndarray:
     host-sync cost on the serving path is always attributed by name
     (docs/OBSERVABILITY.md).
     """
+    if _locksan.enabled():
+        # runtime TL002 signal: a drain while sanitized locks are held
+        _locksan.note_blocking("fetch_to_host")
     if not _tracer.enabled:
         return np.asarray(arr)  # jaxlint: disable=JL007 -- the intentional drain
     t0 = _time.perf_counter()

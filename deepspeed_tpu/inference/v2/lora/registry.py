@@ -43,6 +43,7 @@ from deepspeed_tpu.monitor.trace import tracer as _tracer
 from deepspeed_tpu.runtime.swap_tensor.buffer_pool import SwapBufferPool
 from deepspeed_tpu.utils.caching import next_pow2
 from deepspeed_tpu.utils.fault_injection import maybe_fail as _maybe_fail
+from deepspeed_tpu.utils.threads import make_lock
 
 REGISTERED = "registered"
 RESIDENT = "resident"
@@ -64,9 +65,15 @@ class _Adapter:
 class LoraAdapterRegistry:
     """Adapter lifecycle over one :class:`LoraPagePool`.
 
-    Single-threaded by design (called from the frontend's engine thread /
-    the bench driver — the same discipline as the scheduler); the engine
-    exposes it as ``engine.lora``."""
+    ONE mutator thread by design (the frontend's engine thread / the bench
+    driver — the same discipline as the scheduler), but the cheap metadata
+    readers (``names``/``rank``/``is_resident``/``can_admit``/``binding``)
+    are called from CLIENT threads (``frontend.submit`` validation) and the
+    router's adapter-state probe, so the maps they iterate are guarded by
+    ``serving.lora.registry``. Device work — fault-in scatter, eviction
+    fetch, the residency sync — always runs OUTSIDE that lock (threadlint
+    TL002): a client thread listing adapters must never wait out a swap.
+    The engine exposes this as ``engine.lora``."""
 
     def __init__(self, pool: LoraPagePool, swap_buffers: int = 16,
                  max_rank: Optional[int] = None,
@@ -75,6 +82,10 @@ class LoraAdapterRegistry:
         self.max_rank = max_rank
         self.swap = SwapBufferPool(max_buffers=swap_buffers)
         self.stats = stats if stats is not None else LoraStats()
+        # guards _adapters/_bindings map SHAPE + adapter metadata fields
+        # (state/refcount/rank) for cross-thread readers; device work and
+        # payload copies stay outside it
+        self._meta = make_lock("serving.lora.registry")
         self._adapters: Dict[str, _Adapter] = {}
         self._bindings: Dict[int, str] = {}   # uid -> adapter name
         self._clock = 0
@@ -117,7 +128,8 @@ class LoraAdapterRegistry:
                 f"({self.max_rank}) — the warmed (bucket, rank-bucket) "
                 "program grid stops there, so admitting it would compile "
                 "mid-steady-state; raise lora.max_rank (and re-warm)")
-        old = self._adapters.get(name)
+        with self._meta:
+            old = self._adapters.get(name)
         if old is not None:
             same = (old.rank == rank
                     and (rows is None if old.master is None
@@ -131,7 +143,9 @@ class LoraAdapterRegistry:
                     "request(s) — a re-register with a DIFFERENT payload "
                     "must wait until they finish (or use a new name)")
             self.unregister(name)
-        self._adapters[name] = _Adapter(name=name, rank=rank, master=rows)
+        with self._meta:
+            self._adapters[name] = _Adapter(name=name, rank=rank,
+                                            master=rows)
         self.stats.set_resident(name, rank == 0)
 
     def unregister(self, name: str) -> None:
@@ -146,7 +160,8 @@ class LoraAdapterRegistry:
             self.pool.free(ad.page_ids)
         for buf in ad.bufs:
             self.swap.put(buf)
-        del self._adapters[name]
+        with self._meta:
+            del self._adapters[name]
         self.stats.drop(name)
 
     def _get(self, name: str) -> _Adapter:
@@ -162,7 +177,8 @@ class LoraAdapterRegistry:
 
     @property
     def names(self) -> List[str]:
-        return sorted(self._adapters)
+        with self._meta:
+            return sorted(self._adapters)
 
     @property
     def rank_bucket(self) -> int:
@@ -171,21 +187,26 @@ class LoraAdapterRegistry:
         exist. Engine-stable after registration (NOT per-batch), so adapter
         churn inside the registered set never changes program signatures —
         the zero-steady-state-compile invariant."""
-        ranks = [a.rank for a in self._adapters.values() if a.rank > 0]
+        with self._meta:
+            ranks = [a.rank for a in self._adapters.values() if a.rank > 0]
         return next_pow2(max(ranks)) if ranks else 0
 
     def rank(self, name: str) -> int:
-        return self._get(name).rank
+        with self._meta:
+            return self._get(name).rank
 
     def is_resident(self, name: str) -> bool:
-        ad = self._get(name)
-        return ad.rank == 0 or ad.state == RESIDENT
+        with self._meta:
+            ad = self._get(name)
+            return ad.rank == 0 or ad.state == RESIDENT
 
     def refcount(self, name: str) -> int:
-        return self._get(name).refcount
+        with self._meta:
+            return self._get(name).refcount
 
     def binding(self, uid: int) -> Optional[str]:
-        return self._bindings.get(int(uid))
+        with self._meta:
+            return self._bindings.get(int(uid))
 
     def can_admit(self, name: str, releasing=()) -> bool:
         """Could ``acquire`` succeed right now without shedding anyone?
@@ -194,17 +215,18 @@ class LoraAdapterRegistry:
         simulates a set of uids whose bindings are about to drop (the
         planner's already-chosen preempt victims): an adapter becomes
         evictable when those releases would take its refcount to zero."""
-        ad = self._get(name)
-        if ad.rank == 0 or ad.state == RESIDENT:
-            return True
-        rel = {int(u) for u in releasing}
-        held = {}
-        for u, n in self._bindings.items():
-            if u not in rel:
-                held[n] = held.get(n, 0) + 1
-        evictable = sum(a.rank for a in self._adapters.values()
-                        if a.state == RESIDENT
-                        and held.get(a.name, 0) == 0)
+        with self._meta:
+            ad = self._get(name)
+            if ad.rank == 0 or ad.state == RESIDENT:
+                return True
+            rel = {int(u) for u in releasing}
+            held = {}
+            for u, n in self._bindings.items():
+                if u not in rel:
+                    held[n] = held.get(n, 0) + 1
+            evictable = sum(a.rank for a in self._adapters.values()
+                            if a.state == RESIDENT
+                            and held.get(a.name, 0) == 0)
         return self.pool.free_pages + evictable >= ad.rank
 
     # -- request lifecycle ------------------------------------------------ #
@@ -217,32 +239,36 @@ class LoraAdapterRegistry:
         any pages allocated, so cancel-while-faulting leaves the registry
         at baseline."""
         uid = int(uid)
-        assert uid not in self._bindings, \
-            f"uid {uid} already bound to {self._bindings[uid]!r}"
-        ad = self._get(name)
-        hit = ad.rank == 0 or ad.state == RESIDENT
-        ad.refcount += 1
-        self._bindings[uid] = name
+        with self._meta:
+            assert uid not in self._bindings, \
+                f"uid {uid} already bound to {self._bindings[uid]!r}"
+            ad = self._get(name)
+            hit = ad.rank == 0 or ad.state == RESIDENT
+            ad.refcount += 1
+            self._bindings[uid] = name
         try:
-            self._ensure_resident(ad)
+            self._ensure_resident(ad)     # device work: NOT under _meta
         except BaseException:
-            ad.refcount -= 1
-            del self._bindings[uid]
+            with self._meta:
+                ad.refcount -= 1
+                del self._bindings[uid]
             raise
-        self._clock += 1
-        ad.last_used = self._clock
+        with self._meta:
+            self._clock += 1
+            ad.last_used = self._clock
         self.stats.record_acquire(name, hit)
 
     def release(self, uid: int) -> None:
         """Unbind a finished/cancelled/shed request. The adapter STAYS
         resident (LRU-cached) until pool pressure evicts it."""
         uid = int(uid)
-        name = self._bindings.pop(uid, None)
-        if name is None:
-            return
-        ad = self._adapters[name]
-        ad.refcount -= 1
-        assert ad.refcount >= 0
+        with self._meta:
+            name = self._bindings.pop(uid, None)
+            if name is None:
+                return
+            ad = self._adapters[name]
+            ad.refcount -= 1
+            assert ad.refcount >= 0
         self.stats.record_release(name)
 
     # -- residency (fault-in / evict) ------------------------------------- #
@@ -275,12 +301,13 @@ class LoraAdapterRegistry:
         except BaseException:
             self.pool.free(ids)
             raise
-        ad.page_ids = ids
-        if ad.state == EVICTED:
-            for buf in ad.bufs:
-                self.swap.put(buf)
-            ad.bufs = []
-        ad.state = RESIDENT
+        with self._meta:
+            ad.page_ids = ids
+            if ad.state == EVICTED:
+                for buf in ad.bufs:
+                    self.swap.put(buf)
+                ad.bufs = []
+            ad.state = RESIDENT
         # sync before the stamp: the fault-in span/counters time the swap-in
         # through device completion, not just the scatter dispatch (this
         # runs in the admission round, never inside a decode slice)
@@ -323,10 +350,11 @@ class LoraAdapterRegistry:
             np.copyto(self.swap.view(buf, (self.pool.elements,),
                                      self.pool.dtype), rows[i])
             bufs.append(buf)
-        self.pool.free(ad.page_ids)
-        ad.page_ids = []
-        ad.bufs = bufs
-        ad.state = EVICTED
+        with self._meta:
+            self.pool.free(ad.page_ids)
+            ad.page_ids = []
+            ad.bufs = bufs
+            ad.state = EVICTED
         t1 = time.perf_counter()
         nbytes = ad.rank * self.pool.page_nbytes
         # timed work already drained: fetch_pages ends in fetch_to_host and

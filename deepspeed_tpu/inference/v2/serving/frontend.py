@@ -49,6 +49,7 @@ from deepspeed_tpu.inference.v2.serving.kv_offload import KVOffloadManager
 from deepspeed_tpu.monitor.serving import FrontendStats
 from deepspeed_tpu.monitor.trace import tracer as _tracer
 from deepspeed_tpu.utils.fault_injection import maybe_fail
+from deepspeed_tpu.utils.threads import make_lock, thread_role
 
 _DONE = object()      # stream sentinel
 
@@ -136,7 +137,7 @@ class RequestHandle:
         # lock, and failover takes it to seal the handle + snapshot
         # ``tokens`` at one exact instant — the stream a survivor resumes
         # from can never race a straggling emission off the dead replica
-        self._emit_lock = threading.Lock()
+        self._emit_lock = make_lock("serving.request.emit")
         self._sealed = False
         # engine-thread bookkeeping (phase stamps for spans + victim order)
         self.admit_t: Optional[float] = None
@@ -316,10 +317,11 @@ class ServingFrontend:
         # where the engine thread has popped the message but not yet filed
         # the handle
         self._inflight = 0
-        self._inflight_lock = threading.Lock()
-        # cross-replica handoffs awaiting KV import (engine thread only):
-        # (req, pages, logits) tuples held until the pool can fund them
-        self._handoffs: List[tuple] = []
+        self._inflight_lock = make_lock("serving.frontend.inflight")
+        # cross-replica handoffs awaiting KV import (engine thread only —
+        # failover's disown() writes too, but only once the loop is fenced
+        # or dead, so the two writers are temporally exclusive by design)
+        self._handoffs: List[tuple] = []  # threadlint: guarded-by=none
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._loop_exc: Optional[BaseException] = None
@@ -603,6 +605,7 @@ class ServingFrontend:
     # the engine thread
     # ------------------------------------------------------------------ #
 
+    @thread_role("dstpu-serve")
     def _loop(self) -> None:
         try:
             while not self._stop.is_set():

@@ -65,6 +65,7 @@ from deepspeed_tpu.inference.v2.serving.frontend import (CANCELLED, FINISHED,
 from deepspeed_tpu.monitor.serving import HealthStats
 from deepspeed_tpu.monitor.trace import tracer as _tracer
 from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.threads import make_rlock, thread_role
 
 # replica health states (docs/SERVING.md "Failure semantics")
 HEALTHY = "healthy"        # in routing rotation
@@ -76,7 +77,7 @@ REJOINING = "rejoining"    # frontend rebuilt, warming off the hot path
 
 class _ReplicaRecord:
     __slots__ = ("name", "state", "progress", "stall_since", "last_ok",
-                 "handled", "want_rejoin")
+                 "handled", "want_rejoin", "busy")
 
     def __init__(self, name: str):
         self.name = name
@@ -86,6 +87,7 @@ class _ReplicaRecord:
         self.last_ok = time.perf_counter()
         self.handled = False           # a failure this monitor failed over
         self.want_rejoin = False
+        self.busy = False              # claimed by a failover/rejoin actor
 
 
 class HealthMonitor:
@@ -93,9 +95,16 @@ class HealthMonitor:
 
     ``poll()`` is ONE detection pass — the background thread calls it on
     ``HealthConfig.interval_s``, ``router.drain`` calls it through
-    ``check()``, and tests drive it synchronously for determinism. All
-    state transitions, failovers and rejoins run under one lock, so a
-    failure is handled exactly once no matter who observed it."""
+    ``check()``, and tests drive it synchronously for determinism.
+
+    Locking discipline (threadlint TL002 shaped this): detection and every
+    state transition run under ``_lock``, but the BLOCKING legs of a
+    failover/rejoin — fence joins, ``old.close()``, ``engine.warmup()`` —
+    run with the lock RELEASED. A record is CLAIMED (``rec.busy``) under
+    the lock before any actor starts handling it and released when the
+    actor finishes, so a failure is still handled exactly once no matter
+    who observed it, while ``all_healthy()``/``handled_replicas()`` never
+    wait out a wedged replica's join timeout behind the monitor lock."""
 
     def __init__(self, router, config: Optional[HealthConfig] = None):
         cfg = config if config is not None else HealthConfig()
@@ -106,7 +115,7 @@ class HealthMonitor:
         self.stats = HealthStats([r.name for r in router.cluster.replicas])
         self._recs: Dict[str, _ReplicaRecord] = {
             r.name: _ReplicaRecord(r.name) for r in router.cluster.replicas}
-        self._lock = threading.RLock()
+        self._lock = make_rlock("serving.health.monitor")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._exc: Optional[BaseException] = None
@@ -133,6 +142,7 @@ class HealthMonitor:
             self._thread.join()
             self._thread = None
 
+    @thread_role("dstpu-health")
     def _run(self) -> None:
         while not self._stop.wait(self.config.interval_s):
             try:
@@ -207,30 +217,38 @@ class HealthMonitor:
         return snap, fe._inflight > 0
 
     def _transition(self, rec: _ReplicaRecord, new: str) -> None:
-        old = rec.state
-        if old == new:
-            return
-        rec.state = new
-        self.stats.record_transition(rec.name, old, new)
+        with self._lock:
+            old = rec.state
+            if old == new:
+                return
+            rec.state = new
+            self.stats.record_transition(rec.name, old, new)
         if _tracer.enabled:
             _tracer.instant("serve/health/state", lane="serve/health",
                             replica=rec.name, frm=old, to=new)
 
     def poll(self) -> None:
-        """One detection pass over every replica (reentrant-safe)."""
+        """One detection pass over every replica (reentrant-safe). The
+        scan CLAIMS records needing a failover/rejoin under the lock; the
+        blocking handling runs after the lock is released."""
+        actions: List[Tuple[str, object, _ReplicaRecord, str]] = []
         with self._lock:
             now = time.perf_counter()
             for replica in self.router.cluster.replicas:
                 rec = self._recs[replica.name]
+                if rec.busy:
+                    continue           # another actor is mid-handling
                 if rec.state in (DOWN, DRAINING):
                     if rec.want_rejoin:
-                        self._try_rejoin(replica, rec)
+                        rec.busy = True
+                        actions.append(("rejoin", replica, rec, ""))
                     continue
                 if rec.state == REJOINING:
                     continue               # rejoin completes synchronously
                 exc = self._liveness_exc(replica)
                 if exc is not None:
-                    self._declare_down(replica, rec, "liveness", now)
+                    rec.busy = True
+                    actions.append(("down", replica, rec, "liveness"))
                     continue
                 prog, busy = self._progress(replica)
                 if prog != rec.progress or not busy:
@@ -247,25 +265,38 @@ class HealthMonitor:
                 # time since the counters froze — no device work is timed
                 stalled = now - rec.stall_since  # jaxlint: disable=JL001
                 if stalled >= self.config.down_after_s:
-                    self._declare_down(replica, rec, "stall", now)
+                    rec.busy = True
+                    actions.append(("down", replica, rec, "stall"))
                 elif stalled >= self.config.suspect_after_s \
                         and rec.state == HEALTHY:
                     self._transition(rec, SUSPECT)
+        for act, replica, rec, kind in actions:
+            try:
+                if act == "down":
+                    self._declare_down(replica, rec, kind, now)
+                else:
+                    self._try_rejoin(replica, rec)
+            finally:
+                rec.busy = False
 
     def _declare_down(self, replica, rec: _ReplicaRecord, kind: str,
                       now: float) -> None:
+        """Handle one declared failure. The caller has CLAIMED ``rec``
+        (``rec.busy``); everything blocking here runs without the monitor
+        lock."""
         t0 = rec.stall_since if kind == "stall" else rec.last_ok
-        if rec.state != DOWN:
-            self._transition(rec, DOWN)
-        self.stats.record_detection(kind, now - t0)
+        self._transition(rec, DOWN)
+        with self._lock:
+            self.stats.record_detection(kind, now - t0)
         if _tracer.enabled:
             _tracer.add("serve/health/detect", t0, now, lane="serve/health",
                         replica=rec.name, kind=kind)
         log_dist(f"health: replica {rec.name!r} is DOWN ({kind}); "
                  "fencing and migrating its requests", ranks=[0])
         self._failover(replica, rec)
-        rec.handled = True
-        rec.want_rejoin = bool(self.config.auto_rejoin)
+        with self._lock:
+            rec.handled = True
+            rec.want_rejoin = bool(self.config.auto_rejoin)
         if rec.want_rejoin:
             self._try_rejoin(replica, rec)
 
@@ -516,17 +547,26 @@ class HealthMonitor:
     def rejoin(self, name: str) -> bool:
         """Manually rejoin a drained replica (the ``auto_rejoin=False``
         path). True once the replica is back in rotation; False while its
-        old thread is still wedged."""
+        old thread is still wedged (or another actor is mid-rejoin)."""
         with self._lock:
             replica = self.router.cluster.replica(name)
             rec = self._recs[name]
             if rec.state == HEALTHY:
                 return True
-            if rec.state not in (DOWN, DRAINING):
+            if rec.state not in (DOWN, DRAINING) or rec.busy:
                 return False
+            rec.busy = True
+        try:
             return self._try_rejoin(replica, rec)
+        finally:
+            rec.busy = False
 
     def _try_rejoin(self, replica, rec: _ReplicaRecord) -> bool:
+        """Rebuild and re-admit one drained replica. The caller has CLAIMED
+        ``rec``; the joins/warmup below block WITHOUT the monitor lock
+        (router-side readers of ``_workers``/``replica.frontend`` never
+        synchronized on it — the claim is what serializes monitor actors).
+        """
         router = self.router
         if replica.role == "prefill":
             if not router._workers[replica.name].join(0):
@@ -534,7 +574,8 @@ class HealthMonitor:
         else:
             if not replica.frontend.join(0):
                 return False           # still wedged; retry next poll
-        rec.want_rejoin = False
+        with self._lock:
+            rec.want_rejoin = False
         self._transition(rec, REJOINING)
         t0 = time.perf_counter()
         engine = replica.engine
@@ -585,11 +626,12 @@ class HealthMonitor:
             fe.start()
         if replica in router._targets:
             router._register_index_listener(replica)   # replays the tree
-        rec.handled = False
-        rec.progress = None
-        rec.stall_since = None
-        rec.last_ok = time.perf_counter()
-        self.stats.record_rejoin(warmup_s)
+        with self._lock:
+            rec.handled = False
+            rec.progress = None
+            rec.stall_since = None
+            rec.last_ok = time.perf_counter()
+            self.stats.record_rejoin(warmup_s)
         if _tracer.enabled:
             _tracer.add("serve/health/rejoin", t0, time.perf_counter(),
                         lane="serve/health", replica=replica.name,

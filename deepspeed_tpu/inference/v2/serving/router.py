@@ -75,6 +75,7 @@ from deepspeed_tpu.inference.v2.serving.frontend import _DONE, RequestHandle
 from deepspeed_tpu.inference.v2.serving.health import HEALTHY, HealthMonitor
 from deepspeed_tpu.monitor.serving import RouterStats
 from deepspeed_tpu.monitor.trace import tracer as _tracer
+from deepspeed_tpu.utils.threads import make_lock
 
 
 class ClusterPrefixIndex:
@@ -92,7 +93,7 @@ class ClusterPrefixIndex:
 
     def __init__(self, block_size: int):
         self.block_size = int(block_size)
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.router.prefix_index")
         self._chains: Dict[int, set] = {}
 
     def listener(self, replica: str):
@@ -209,7 +210,7 @@ class ServingRouter:
             r.name: CostModel() for r in cluster.prefill_replicas}
         self._workers: Dict[str, PrefillWorker] = {
             r.name: PrefillWorker(r, self) for r in cluster.prefill_replicas}
-        self._lock = threading.Lock()      # stats + rr counter + inflight
+        self._lock = make_lock("serving.router.state")  # stats + rr + inflight
         self._rr = 0
         self._inflight = 0                 # requests held by prefill workers
         self._uids = itertools.count(1 << 44)   # never collides with the

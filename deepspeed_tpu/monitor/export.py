@@ -45,6 +45,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 from deepspeed_tpu.monitor.monitor import Event, Monitor
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.threads import make_lock, thread_role
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -66,7 +67,7 @@ class PrometheusExporter(Monitor):
 
     def __init__(self, config):
         super().__init__(config)
-        self._lock = threading.Lock()
+        self._lock = make_lock("monitor.prom.registry")
         self._values: Dict[str, Tuple[float, int]] = {}
         self._server = None
         self._thread: Optional[threading.Thread] = None
@@ -191,20 +192,26 @@ class TelemetryPump:
         self.monitor = monitor
         self.sources = list(sources)
         self.interval_s = float(interval_s)
-        self.step = 0
+        self.step = 0  # threadlint: guarded-by=monitor.telemetry.step
+        self._step_lock = make_lock("monitor.telemetry.step")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def pump_once(self) -> int:
-        """One synchronous fan-in tick; returns the step it stamped."""
-        step = self.step
+        """One synchronous fan-in tick; returns the step it stamped. The
+        step is RESERVED under its lock up front (the pump thread and a
+        caller-side final drain both tick — threadlint TL003), so
+        concurrent ticks stamp distinct steps; the slow source fan-in
+        itself runs unlocked."""
+        with self._step_lock:
+            step = self.step
+            self.step += 1
         for src in self.sources:
             try:
                 src.write_monitor_events(self.monitor, step)
             except Exception as e:   # telemetry must never kill serving
                 logger.warning(f"telemetry pump source "
                                f"{type(src).__name__} failed: {e}")
-        self.step += 1
         return step
 
     def start(self) -> "TelemetryPump":
@@ -216,6 +223,7 @@ class TelemetryPump:
         self._thread.start()
         return self
 
+    @thread_role("dstpu-telemetry")
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
             self.pump_once()

@@ -74,6 +74,7 @@ import time
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.threads import make_lock
 
 _ENV_VAR = "DSTPU_TRACE"
 _ENV_RING = "DSTPU_TRACE_RING"
@@ -189,7 +190,7 @@ class Tracer:
         self.req_lane_window = DEFAULT_REQ_LANE_WINDOW
         self._rings: List[_Ring] = []
         self._local = threading.local()
-        self._reg_lock = threading.Lock()
+        self._reg_lock = make_lock("monitor.trace.registry")
         self._atexit_installed = False
         self._crash_path: Optional[str] = None
         # one simultaneous (perf_counter, unix) pair: trace_merge.py maps
@@ -514,9 +515,17 @@ class Tracer:
                                "pid": os.getpid(), "tid": 0,
                                "ts": time.perf_counter() * 1e6})
             os.makedirs(self.trace_dir, exist_ok=True)
+            doc = {"traceEvents": events, "displayTimeUnit": "ms",
+                   "clockSync": self._clock_sync_doc()}
+            # when the lock-order sanitizer is armed, its acquisition
+            # graph/cycle/blocking report rides the same dump: the one
+            # postmortem a wedged or crashing run leaves behind
+            # (docs/THREADLINT.md)
+            from deepspeed_tpu.utils import locksan
+            if locksan.enabled():
+                doc["locksan"] = locksan.report()
             with open(path, "w") as f:
-                json.dump({"traceEvents": events, "displayTimeUnit": "ms",
-                           "clockSync": self._clock_sync_doc()}, f)
+                json.dump(doc, f)
         except Exception as e:  # a failing dump must never mask the crash
             logger.warning(f"trace crash dump failed: {type(e).__name__}: {e}")
             return None

@@ -19,6 +19,7 @@ import threading
 from typing import Optional
 
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.threads import make_lock
 
 _SRC = os.path.join(os.path.dirname(__file__), "csrc", "ds_native.cpp")
 _BUILD_DIR = os.environ.get(
@@ -26,7 +27,7 @@ _BUILD_DIR = os.environ.get(
     os.path.join(os.path.dirname(__file__), "_build"))
 _LIB_PATH = os.path.join(_BUILD_DIR, "libds_native.so")
 
-_lock = threading.Lock()
+_lock = make_lock("ops.builder")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 

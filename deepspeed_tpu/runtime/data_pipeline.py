@@ -38,6 +38,7 @@ from typing import Any, Callable, Iterable, Optional
 import numpy as np
 
 from deepspeed_tpu.monitor.trace import tracer as _tracer
+from deepspeed_tpu.utils.threads import thread_role
 
 
 def as_host_tree(batch):
@@ -153,7 +154,10 @@ class PrefetchLoader:
         self.loader = loader
         self.prepare = prepare or (lambda batch, step: batch)
         self.prefetch = int(prefetch)
-        self._next_step = int(start_step)
+        # stepped by the CONSUMER on the prefetch==0 inline path and by
+        # the producer thread when prefetching — the paths are mutually
+        # exclusive by configuration, never concurrent
+        self._next_step = int(start_step)  # threadlint: guarded-by=none
         self._iter = None              # sync-mode iterator
         self._queue: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
@@ -214,6 +218,7 @@ class PrefetchLoader:
                                         name="dstpu-prefetch", daemon=True)
         self._thread.start()
 
+    @thread_role("dstpu-prefetch")
     def _produce(self):
         try:
             for batch in self.loader:

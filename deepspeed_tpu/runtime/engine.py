@@ -51,6 +51,7 @@ from deepspeed_tpu.runtime.loss_scaler import (has_overflow, make_loss_scale_sta
 from deepspeed_tpu.runtime.zero.partition import ZeroPartitioner
 from deepspeed_tpu.runtime.dataloader import DeepSpeedTPUDataLoader
 from deepspeed_tpu.monitor.trace import tracer as _tracer
+from deepspeed_tpu.utils import locksan as _locksan
 from deepspeed_tpu.utils.logging import log_dist, logger
 from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER,
                                        STEP_GLOBAL_TIMER, SynchronizedWallClockTimer,
@@ -79,6 +80,9 @@ def fetch_to_host(tree):
     host-sync cost is ALWAYS attributed on the timeline — whatever code path
     forced the materialisation, the stall shows up here by name.
     """
+    if _locksan.enabled():
+        # runtime TL002 signal: a drain while sanitized locks are held
+        _locksan.note_blocking("fetch_to_host")
     if not _tracer.enabled:
         return jax.device_get(tree)  # jaxlint: disable=JL007 -- the intentional drain
     t0 = time.perf_counter()

@@ -48,6 +48,7 @@ from deepspeed_tpu.monitor.trace import tracer as _tracer
 from deepspeed_tpu.ops.native.cpu_optimizer import HostAdam, HostAdagrad, HostLion
 from deepspeed_tpu.runtime.swap_tensor import PipelinedOptimizerSwapper
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.threads import make_lock
 
 
 def _host_kernel(optimizer) -> Tuple[str, Any]:
@@ -93,7 +94,10 @@ class HostOffloadOptimizer:
                  offload_cfg: OffloadOptimizerConfig):
         self.kind, self.kernel = _host_kernel(optimizer)
         self.cfg = offload_cfg
-        self.step_num = 0
+        # bumped by step()/step_groups() — the serial caller-thread path
+        # and the engine's single-worker offload lane are exclusive by
+        # engine mode (overlap_step), never concurrent
+        self.step_num = 0  # threadlint: guarded-by=none
         self.nvme = offload_cfg.device == OffloadDeviceEnum.nvme
         self._names: List[str] = list(master_leaves)
         self._shapes = {k: v.shape for k, v in master_leaves.items()}
@@ -110,6 +114,7 @@ class HostOffloadOptimizer:
             or min(4, os.cpu_count() or 1)
         self._workers = max(1, workers)
         self._kernel_pool = None   # lazy ThreadPoolExecutor
+        self._pool_lock = make_lock("offload.pool.create")
 
         state_keys = _STATE_KEYS[self.kind]
         if not self.nvme:
@@ -193,10 +198,16 @@ class HostOffloadOptimizer:
         return [list(g) for g in self._groups]
 
     def _pool(self):
+        # double-checked: the serial path and the offload lane can both
+        # reach first use — an unguarded lazy init could build two pools
+        # and leak the loser's threads
         if self._kernel_pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-            self._kernel_pool = ThreadPoolExecutor(
-                max_workers=self._workers, thread_name_prefix="dstpu-hostopt")
+            with self._pool_lock:
+                if self._kernel_pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+                    self._kernel_pool = ThreadPoolExecutor(
+                        max_workers=self._workers,
+                        thread_name_prefix="dstpu-hostopt")
         return self._kernel_pool
 
     def _leaf_tasks(self, p: np.ndarray, g: np.ndarray,
@@ -392,9 +403,10 @@ class HostOffloadOptimizer:
             self.step_num = int(step_num)
 
     def close(self):
-        if self._kernel_pool is not None:
-            self._kernel_pool.shutdown(wait=True)
-            self._kernel_pool = None
+        with self._pool_lock:
+            pool, self._kernel_pool = self._kernel_pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
         if self.swapper is not None:
             self.swapper.close()
 

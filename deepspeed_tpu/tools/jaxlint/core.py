@@ -69,6 +69,23 @@ class Finding:
         return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
 
 
+def _iter_stmts(node):
+    """Yield every statement under ``node`` (bodies, else/finally legs,
+    exception handlers) without descending into expressions."""
+    for name in ("body", "orelse", "finalbody"):
+        for stmt in getattr(node, name, ()):
+            yield stmt
+            yield from _iter_stmts(stmt)
+    for handler in getattr(node, "handlers", ()):
+        for stmt in handler.body:
+            yield stmt
+            yield from _iter_stmts(stmt)
+    for case in getattr(node, "cases", ()):   # match statements
+        for stmt in case.body:
+            yield stmt
+            yield from _iter_stmts(stmt)
+
+
 @dataclass
 class SourceModule:
     """A parsed module plus the pre-computed facts rules share."""
@@ -102,6 +119,10 @@ class SourceModule:
         # suppression syntax must not install one
         import io
         import tokenize
+        # every suppression comment contains the literal marker, so a file
+        # without it never needs the (expensive) tokenize pass at all
+        if "jaxlint:" not in self.source:
+            return
         try:
             tokens = list(tokenize.generate_tokens(
                 io.StringIO(self.source).readline))
@@ -118,7 +139,9 @@ class SourceModule:
                 self.file_suppressions |= _parse_rule_list(m.group(1))
 
     def _scan_imports(self) -> None:
-        for node in ast.walk(self.tree):
+        # imports are statements: walking only statement bodies (not every
+        # expression node) keeps this linear in lines, not AST nodes
+        for node in _iter_stmts(self.tree):
             if isinstance(node, ast.Import):
                 for alias in node.names:
                     if alias.asname:

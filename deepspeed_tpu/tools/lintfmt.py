@@ -1,0 +1,67 @@
+"""Shared finding emitters for the lint CLIs (jaxlint + threadlint).
+
+Human-readable text stays each CLI's default; this module owns the two
+machine formats so both linters emit identical shapes:
+
+- ``json``  — a flat list of ``{rule, path, line, col, message}`` objects
+  (stable, diff-friendly; what the pre-existing ``--format json`` printed)
+- ``sarif`` — SARIF 2.1.0 with one run per invocation, for code-scanning
+  UIs. ``level`` is ``error`` for parse failures (JL000/TL000) and
+  ``warning`` otherwise; fingerprints reuse the baseline fingerprint so a
+  SARIF consumer's dedup matches the baseline's identity.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List
+
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+__all__ = ["render_json", "render_sarif"]
+
+
+def render_json(findings: Iterable) -> str:
+    return json.dumps([{"rule": f.rule, "path": f.path, "line": f.line,
+                        "col": f.col, "message": f.message}
+                       for f in findings], indent=2)
+
+
+def render_sarif(findings: Iterable, tool_name: str,
+                 rule_summaries: Dict[str, str], root: str = ".") -> str:
+    rules_used = sorted({f.rule for f in findings} | set(rule_summaries))
+    driver_rules: List[dict] = [
+        {"id": rid,
+         "shortDescription": {"text": rule_summaries.get(rid, rid)}}
+        for rid in rules_used]
+    index = {rid: i for i, rid in enumerate(rules_used)}
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "ruleIndex": index[f.rule],
+            "level": "error" if f.rule.endswith("000") else "warning",
+            "message": {"text": f.message},
+            "partialFingerprints": {"baselineFingerprint/v1":
+                                    f.fingerprint(root)},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path.replace("\\", "/")},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": max(f.col, 0) + 1},
+                },
+            }],
+        })
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": tool_name,
+                                "informationUri":
+                                    "https://example.invalid/" + tool_name,
+                                "rules": driver_rules}},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)

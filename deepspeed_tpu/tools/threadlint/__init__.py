@@ -1,0 +1,22 @@
+"""threadlint — flow-aware concurrency analysis for the multi-threaded stack.
+
+Where jaxlint is per-statement AST matching, threadlint builds a program
+model: per-function control-flow graphs, a call graph, a thread-role map
+seeded from ``@thread_role(...)`` / ``# threadlint: role=...`` annotations
+and propagated through ``Thread(target=...)`` and executor submits, and a
+cross-module lock-acquisition graph over the named locks minted by
+``utils/threads.make_lock``. See docs/THREADLINT.md for the rule catalog
+and annotation grammar; ``python -m deepspeed_tpu.tools.threadlint
+--list-rules`` for the live registry."""
+
+from deepspeed_tpu.tools.threadlint.config import (ThreadLintConfig,
+                                                   RuleSettings)
+from deepspeed_tpu.tools.threadlint.core import (Finding, ThreadSourceModule,
+                                                 lint_paths, lint_sources)
+from deepspeed_tpu.tools.threadlint.model import Program, static_lock_graph
+from deepspeed_tpu.tools.threadlint.rules import (RULE_REGISTRY, Rule,
+                                                  register)
+
+__all__ = ["Finding", "ThreadSourceModule", "ThreadLintConfig",
+           "RuleSettings", "RULE_REGISTRY", "Rule", "register", "Program",
+           "lint_paths", "lint_sources", "static_lock_graph"]

@@ -1,0 +1,5 @@
+import sys
+
+from deepspeed_tpu.tools.threadlint.cli import main
+
+sys.exit(main())

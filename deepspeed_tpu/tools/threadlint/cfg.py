@@ -1,0 +1,234 @@
+"""Per-function control-flow graphs (the dataflow substrate jaxlint lacks).
+
+One :class:`CFG` per function: statement-level nodes with NORMAL successor
+edges plus EXCEPTION edges (any statement that can raise routes to the
+innermost enclosing handler/finally, or to function exit). try/finally is
+modelled so that both normal and exceptional completion flow THROUGH the
+finally body — which is exactly what TL004 ("is ``release()`` executed on
+every path out of ``acquire()``?") needs to get right.
+
+Deliberate bounds (the satellite test matrix pins them):
+
+- nested ``def``/``class``/``lambda`` bodies are opaque single nodes — they
+  run at another time, on another (possibly different) thread;
+- ``with`` is control-flow-transparent (it catches nothing); the lock
+  semantics of ``with lock:`` are the program model's business, not the
+  CFG's;
+- every statement except ``pass``/``break``/``continue``/bare ``return``
+  is assumed able to raise (conservative: TL004 must see the permit-leak
+  path where a statement between ``acquire`` and the ``try`` blows up).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+__all__ = ["CFG", "Node", "build_cfg"]
+
+
+class Node:
+    """One statement (or the synthetic ENTRY/EXIT)."""
+
+    __slots__ = ("idx", "stmt", "succs", "exc_succs")
+
+    def __init__(self, idx: int, stmt: Optional[ast.stmt]):
+        self.idx = idx
+        self.stmt = stmt
+        self.succs: Set[int] = set()
+        self.exc_succs: Set[int] = set()
+
+    def __repr__(self) -> str:
+        kind = type(self.stmt).__name__ if self.stmt is not None else "SYNTH"
+        return f"Node({self.idx}, {kind})"
+
+
+class CFG:
+    def __init__(self):
+        self.nodes: List[Node] = []
+        self.entry = self._new(None)
+        self.exit = self._new(None)
+
+    def _new(self, stmt: Optional[ast.stmt]) -> Node:
+        n = Node(len(self.nodes), stmt)
+        self.nodes.append(n)
+        return n
+
+    def node_for(self, stmt: ast.stmt) -> Optional[Node]:
+        for n in self.nodes:
+            if n.stmt is stmt:
+                return n
+        return None
+
+    def reachable(self, start: Node, stop: Optional[callable] = None,
+                  include_exc: bool = True,
+                  start_exc: Optional[bool] = None) -> Set[int]:
+        """Node ids reachable FROM ``start`` (exclusive), not traversing
+        past nodes where ``stop(node)`` is true. ``start_exc=False`` skips
+        ``start``'s OWN exception edge while still following downstream
+        ones — TL004's case: an ``acquire()`` that itself raises never took
+        the lock, so that path can't leak it."""
+        if start_exc is None:
+            start_exc = include_exc
+        seen: Set[int] = set()
+        work = list(start.succs | (start.exc_succs if start_exc else set()))
+        while work:
+            i = work.pop()
+            if i in seen:
+                continue
+            seen.add(i)
+            n = self.nodes[i]
+            if stop is not None and n.stmt is not None and stop(n):
+                continue
+            work.extend(n.succs)
+            if include_exc:
+                work.extend(n.exc_succs)
+        return seen
+
+
+def _can_raise(stmt: ast.stmt) -> bool:
+    # ast.Try is a pure gate: the *body* statements carry the exception
+    # edges (to the handler/finally); the try keyword itself cannot raise,
+    # and giving it an edge would fabricate a path that skips the finally
+    if isinstance(stmt, (ast.Pass, ast.Break, ast.Continue, ast.Global,
+                         ast.Nonlocal, ast.Import, ast.ImportFrom, ast.Try)):
+        return False
+    if isinstance(stmt, ast.Return) and stmt.value is None:
+        return False
+    return True
+
+
+class _Builder:
+    """Recursive-descent CFG construction with loop and exception context."""
+
+    def __init__(self, cfg: CFG):
+        self.cfg = cfg
+
+    def build(self, body: List[ast.stmt]) -> None:
+        exits = self._seq(body, [self.cfg.entry.idx], _LoopCtx(None, None),
+                          exc_target=self.cfg.exit.idx)
+        for i in exits:
+            self.cfg.nodes[i].succs.add(self.cfg.exit.idx)
+
+    # ``preds`` are node ids whose NORMAL flow continues into what comes
+    # next; each _stmt/_seq returns the new frontier.
+    def _seq(self, body: List[ast.stmt], preds: List[int], loop: "_LoopCtx",
+             exc_target: int) -> List[int]:
+        for stmt in body:
+            preds = self._stmt(stmt, preds, loop, exc_target)
+        return preds
+
+    def _link(self, preds: List[int], node: Node) -> None:
+        for i in preds:
+            self.cfg.nodes[i].succs.add(node.idx)
+
+    def _stmt(self, stmt: ast.stmt, preds: List[int], loop: "_LoopCtx",
+              exc_target: int) -> List[int]:
+        cfg = self.cfg
+        node = cfg._new(stmt)
+        self._link(preds, node)
+        if _can_raise(stmt):
+            node.exc_succs.add(exc_target)
+
+        if isinstance(stmt, (ast.If,)):
+            then_out = self._seq(stmt.body, [node.idx], loop, exc_target)
+            else_out = self._seq(stmt.orelse, [node.idx], loop, exc_target) \
+                if stmt.orelse else [node.idx]
+            return then_out + else_out
+
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            inner = _LoopCtx(head=node.idx, breaks=[])
+            body_out = self._seq(stmt.body, [node.idx], inner, exc_target)
+            for i in body_out:
+                cfg.nodes[i].succs.add(node.idx)
+            # loop falls through when the condition/iterator ends, plus any
+            # break; a while-else/for-else body runs on normal exhaustion
+            after = [node.idx]
+            if stmt.orelse:
+                after = self._seq(stmt.orelse, after, loop, exc_target)
+            return after + inner.breaks
+
+        if isinstance(stmt, ast.Break):
+            loop.breaks.append(node.idx)
+            node.succs.clear()
+            return []
+
+        if isinstance(stmt, ast.Continue):
+            if loop.head is not None:
+                node.succs.add(loop.head)
+            return []
+
+        if isinstance(stmt, (ast.Return,)):
+            node.succs.add(cfg.exit.idx)
+            return []
+
+        if isinstance(stmt, ast.Raise):
+            node.succs.clear()
+            node.exc_succs.add(exc_target)
+            return []
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._seq(stmt.body, [node.idx], loop, exc_target)
+
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, node, loop, exc_target)
+
+        # FunctionDef/ClassDef/Lambda values and plain statements: opaque
+        return [node.idx]
+
+    def _try(self, stmt: ast.Try, node: Node, loop: "_LoopCtx",
+             exc_target: int) -> List[int]:
+        cfg = self.cfg
+        if stmt.finalbody:
+            # ONE finally subgraph; normal completion exits to what follows,
+            # exceptional entry re-raises to the outer target after running.
+            # (One copy, two exits — an over-approximation of the duplicated
+            # finally the compiler emits, conservative for reachability.)
+            fin_gate = cfg._new(None)   # synthetic join in front of finally
+            fin_out = self._seq(stmt.finalbody, [fin_gate.idx], loop,
+                                exc_target)
+            inner_exc: int = fin_gate.idx
+            for i in fin_out:
+                cfg.nodes[i].succs.add(exc_target)   # re-raise leg
+        else:
+            fin_gate = None
+            fin_out = []
+            inner_exc = exc_target
+
+        handler_entry = inner_exc
+        handler_outs: List[int] = []
+        if stmt.handlers:
+            gate = cfg._new(None)       # synthetic dispatch to handlers
+            handler_entry = gate.idx
+            for h in stmt.handlers:
+                outs = self._seq(h.body, [gate.idx], loop, inner_exc)
+                handler_outs.extend(outs)
+            # an exception no handler matches keeps unwinding
+            cfg.nodes[gate.idx].exc_succs.add(inner_exc)
+
+        body_out = self._seq(stmt.body, [node.idx], loop, handler_entry)
+        if stmt.orelse:
+            body_out = self._seq(stmt.orelse, body_out, loop, handler_entry)
+
+        normal_out = body_out + handler_outs
+        if fin_gate is not None:
+            for i in normal_out:
+                cfg.nodes[i].succs.add(fin_gate.idx)
+            return list(fin_out)
+        return normal_out
+
+
+class _LoopCtx:
+    __slots__ = ("head", "breaks")
+
+    def __init__(self, head: Optional[int], breaks: Optional[List[int]]):
+        self.head = head
+        self.breaks = breaks if breaks is not None else []
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG of one function body (``ast.FunctionDef``/``AsyncFunctionDef``,
+    or any node with a ``body`` list)."""
+    cfg = CFG()
+    _Builder(cfg).build(list(fn.body))
+    return cfg

@@ -1,8 +1,12 @@
-"""``python -m deepspeed_tpu.tools.jaxlint [paths]`` — the CI entry point.
+"""``python -m deepspeed_tpu.tools.threadlint [paths]`` — the CI entry point.
 
-Exit codes: 0 clean (or everything baselined/suppressed), 1 non-baselined
-findings, 2 usage errors. Config discovery: ``--config``, else the first
-``.jaxlint.json`` walking up from the first path."""
+Same contract as jaxlint's CLI: exit 0 clean (or everything baselined /
+suppressed), 1 non-baselined findings, 2 usage errors. Config discovery:
+``--config``, else the first ``.threadlint.json`` walking up from the
+first path. Extras over jaxlint: ``--format sarif`` (shared emitter) and
+``--dump-lock-graph`` (the static acquisition edges, one ``held ->
+acquired`` per line — what locksan's observed edges are checked against).
+"""
 
 from __future__ import annotations
 
@@ -11,32 +15,38 @@ import os
 import sys
 from typing import List, Optional
 
-from deepspeed_tpu.tools import lintfmt
-from deepspeed_tpu.tools.jaxlint.baseline import (apply_baseline, load_baseline,
+from deepspeed_tpu.tools.jaxlint.baseline import (apply_baseline,
+                                                  load_baseline,
                                                   write_baseline)
-from deepspeed_tpu.tools.jaxlint.config import LintConfig, find_config
-from deepspeed_tpu.tools.jaxlint.core import lint_paths
-from deepspeed_tpu.tools.jaxlint.rules import RULE_REGISTRY
+from deepspeed_tpu.tools import lintfmt
+from deepspeed_tpu.tools.threadlint.config import ThreadLintConfig, find_config
+from deepspeed_tpu.tools.threadlint.core import lint_paths
+from deepspeed_tpu.tools.threadlint.rules import RULE_REGISTRY
 
 
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
-        prog="jaxlint",
-        description="Static analysis for jit/sharding/donation hazards.")
+        prog="threadlint",
+        description="Flow-aware concurrency analysis (lock order, blocking "
+                    "under locks, cross-role writes, leak-free acquire).")
     p.add_argument("paths", nargs="*", default=["deepspeed_tpu"],
                    help="files or directories to lint (default: deepspeed_tpu)")
-    p.add_argument("--config", help=".jaxlint.json path (default: discovered)")
+    p.add_argument("--config",
+                   help=".threadlint.json path (default: discovered)")
     p.add_argument("--no-config", action="store_true",
                    help="ignore any discovered config file")
     p.add_argument("--baseline",
                    help="baseline file (default: the config's 'baseline' entry)")
     p.add_argument("--write-baseline", action="store_true",
                    help="write current findings to the baseline and exit 0")
-    p.add_argument("--select", help="comma-separated rule ids to run exclusively")
+    p.add_argument("--select",
+                   help="comma-separated rule ids to run exclusively")
     p.add_argument("--disable", help="comma-separated rule ids to skip")
     p.add_argument("--format", choices=["text", "json", "sarif"],
                    default="text")
     p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--dump-lock-graph", action="store_true",
+                   help="print the static lock-acquisition edges and exit 0")
     return p
 
 
@@ -49,12 +59,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     if args.config:
-        config = LintConfig.load(args.config)
+        config = ThreadLintConfig.load(args.config)
     elif not args.no_config:
         found = find_config(args.paths[0] if args.paths else ".")
-        config = LintConfig.load(found) if found else LintConfig()
+        config = ThreadLintConfig.load(found) if found else ThreadLintConfig()
     else:
-        config = LintConfig()
+        config = ThreadLintConfig()
 
     from deepspeed_tpu.tools.jaxlint.config import RuleSettings
     if args.select or args.disable:
@@ -64,8 +74,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         unknown = requested - set(RULE_REGISTRY)
         if unknown:
             # a typo'd --select would otherwise disable EVERY rule and pass
-            print(f"jaxlint: unknown rule id(s): {', '.join(sorted(unknown))} "
-                  f"(known: {', '.join(sorted(RULE_REGISTRY))})", file=sys.stderr)
+            print(f"threadlint: unknown rule id(s): "
+                  f"{', '.join(sorted(unknown))} "
+                  f"(known: {', '.join(sorted(RULE_REGISTRY))})",
+                  file=sys.stderr)
             return 2
     if args.select:
         wanted = {r.strip() for r in args.select.split(",")}
@@ -78,22 +90,29 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     missing = [p for p in args.paths if not os.path.exists(p)]
     if missing:
-        print(f"jaxlint: no such path: {', '.join(missing)}", file=sys.stderr)
+        print(f"threadlint: no such path: {', '.join(missing)}",
+              file=sys.stderr)
         return 2
+
+    if args.dump_lock_graph:
+        from deepspeed_tpu.tools.threadlint.model import static_lock_graph
+        for a, b in sorted(static_lock_graph(args.paths, config)):
+            print(f"{a} -> {b}")
+        return 0
 
     findings, parse_errors = lint_paths(args.paths, config)
 
     baseline_path = args.baseline or config.baseline_path()
     if args.write_baseline:
         if not baseline_path:
-            print("jaxlint: --write-baseline needs --baseline or a config "
+            print("threadlint: --write-baseline needs --baseline or a config "
                   "'baseline' entry", file=sys.stderr)
             return 2
-        # parse errors (JL000) are never baselined: an unparseable file gets
-        # NO rule coverage at all, so grandfathering it would silently exempt
-        # it from the linter forever
+        # parse errors (TL000) are never baselined — an unparseable file
+        # gets no rule coverage, so grandfathering it would exempt it forever
         write_baseline(baseline_path, findings, root=config.root)
-        print(f"jaxlint: wrote {len(findings)} finding(s) to {baseline_path}")
+        print(f"threadlint: wrote {len(findings)} finding(s) to "
+              f"{baseline_path}")
         for f in parse_errors:
             print(f.render(), file=sys.stderr)
         return 1 if parse_errors else 0
@@ -108,14 +127,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(lintfmt.render_json(findings))
     elif args.format == "sarif":
         print(lintfmt.render_sarif(
-            findings, "jaxlint",
+            findings, "threadlint",
             {rid: cls.summary for rid, cls in RULE_REGISTRY.items()},
             root=config.root))
     else:
         for f in findings:
             print(f.render())
         tail = f", {len(grandfathered)} baselined" if grandfathered else ""
-        print(f"jaxlint: {len(findings)} finding(s){tail}")
+        print(f"threadlint: {len(findings)} finding(s){tail}")
     return 1 if findings else 0
 
 

@@ -1,0 +1,82 @@
+"""threadlint configuration (``.threadlint.json``).
+
+Same shape and discovery as jaxlint's (JSON, walked up from the linted
+tree; the container's Python predates tomllib), plus one top-level key the
+concurrency rules share: ``lock_order`` — the canonical acquisition order
+of the stack's named locks. TL001 checks every static acquisition-graph
+edge against it::
+
+    {
+      "exclude": [],
+      "baseline": ".threadlint-baseline.json",
+      "lock_order": ["serving.health.monitor", "serving.frontend.emit"],
+      "rules": {"TL002": {"options": {"blocking_calls": ["fetch_to_host"]}}}
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.tools.jaxlint.config import RuleSettings
+
+CONFIG_FILENAME = ".threadlint.json"
+
+__all__ = ["ThreadLintConfig", "RuleSettings", "find_config",
+           "CONFIG_FILENAME"]
+
+
+@dataclass
+class ThreadLintConfig:
+    rules: Dict[str, RuleSettings] = field(default_factory=dict)
+    exclude: List[str] = field(default_factory=list)
+    baseline: Optional[str] = None
+    #: canonical lock acquisition order (TL001): earlier names must be
+    #: taken before later ones; locks not listed are unconstrained (cycle
+    #: detection still covers them)
+    lock_order: List[str] = field(default_factory=list)
+    root: str = "."
+
+    def rule(self, rule_id: str) -> RuleSettings:
+        return self.rules.get(rule_id, RuleSettings())
+
+    @classmethod
+    def from_dict(cls, raw: Dict[str, Any], root: str = ".") -> "ThreadLintConfig":
+        rules = {}
+        for rid, spec in (raw.get("rules") or {}).items():
+            rules[rid] = RuleSettings(enabled=bool(spec.get("enabled", True)),
+                                      options=dict(spec.get("options") or {}))
+        return cls(rules=rules,
+                   exclude=list(raw.get("exclude") or []),
+                   baseline=raw.get("baseline"),
+                   lock_order=list(raw.get("lock_order") or []),
+                   root=root)
+
+    @classmethod
+    def load(cls, path: str) -> "ThreadLintConfig":
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+        return cls.from_dict(raw, root=os.path.dirname(os.path.abspath(path)))
+
+    def baseline_path(self) -> Optional[str]:
+        if not self.baseline:
+            return None
+        return self.baseline if os.path.isabs(self.baseline) \
+            else os.path.join(self.root, self.baseline)
+
+
+def find_config(start: str) -> Optional[str]:
+    """Walk up from ``start`` looking for ``.threadlint.json``."""
+    cur = os.path.abspath(start if os.path.isdir(start)
+                          else os.path.dirname(start))
+    while True:
+        cand = os.path.join(cur, CONFIG_FILENAME)
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent

@@ -1,0 +1,134 @@
+"""threadlint core: source model, annotations, and the lint driver.
+
+Reuses jaxlint's :class:`Finding` (same fingerprinting, so baselines are
+interchangeable machinery) but parses its OWN comment grammar:
+
+- ``# threadlint: disable=TL001`` / ``disable=all`` — line suppression
+- ``# threadlint: disable-file=TL003`` — file suppression
+- ``# threadlint: role=serve-loop`` trailing a ``def`` line (or an executor
+  ``submit``/creation line) — declares the thread role that runs it
+- ``# threadlint: guarded-by=serving.frontend.inflight`` trailing the
+  ``self.x = ...`` initialisation of a field — declares which lock guards
+  it (``guarded-by=none`` declares the field deliberately unguarded:
+  single-writer flags, monotonic publishes)
+
+Unlike jaxlint, rules here are WHOLE-PROGRAM: the driver parses every file
+into a :class:`Program` (call graph, roles, lock graph — see ``model.py``)
+and the rules run once over it, attributing findings back to modules for
+suppression."""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from deepspeed_tpu.tools.jaxlint.core import (Finding, SourceModule,
+                                              _parse_rule_list, iter_files)
+
+__all__ = ["Finding", "ThreadSourceModule", "lint_paths", "lint_sources"]
+
+_SUPPRESS_RE = re.compile(r"#\s*threadlint:\s*disable=([A-Za-z0-9_,\s]+|all)")
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*threadlint:\s*disable-file=([A-Za-z0-9_,\s]+|all)")
+_ROLE_RE = re.compile(r"#\s*threadlint:\s*role=([A-Za-z0-9_.\-]+)")
+_GUARD_RE = re.compile(r"#\s*threadlint:\s*guarded-by=([A-Za-z0-9_.\-]+|none)")
+
+
+class ThreadSourceModule(SourceModule):
+    """jaxlint's source model under the threadlint comment grammar, plus
+    the per-line role/guarded-by annotation maps the program model reads."""
+
+    def __post_init_annotations(self) -> None:
+        self.role_annotations: Dict[int, str] = {}
+        self.guard_annotations: Dict[int, str] = {}
+
+    def _scan_suppressions(self) -> None:
+        # same comment-token discipline as jaxlint: docstrings that DOCUMENT
+        # the grammar must not install suppressions or annotations
+        self.__post_init_annotations()
+        # every suppression/role/guard comment contains the literal marker,
+        # so a file without it never needs the (expensive) tokenize pass
+        if "threadlint:" not in self.source:
+            return
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.source).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                self.line_suppressions[tok.start[0]] = \
+                    _parse_rule_list(m.group(1))
+            m = _SUPPRESS_FILE_RE.search(tok.string)
+            if m:
+                self.file_suppressions |= _parse_rule_list(m.group(1))
+            m = _ROLE_RE.search(tok.string)
+            if m:
+                self.role_annotations[tok.start[0]] = m.group(1)
+            m = _GUARD_RE.search(tok.string)
+            if m:
+                self.guard_annotations[tok.start[0]] = m.group(1)
+
+
+def _parse_modules(files_or_sources, in_memory: bool) \
+        -> Tuple[Dict[str, ThreadSourceModule], List[Finding]]:
+    modules: Dict[str, ThreadSourceModule] = {}
+    errors: List[Finding] = []
+    items = files_or_sources.items() if in_memory \
+        else ((p, None) for p in files_or_sources)
+    for path, source in items:
+        try:
+            modules[path] = ThreadSourceModule.parse(path, source)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            line = getattr(e, "lineno", 1) or 1
+            errors.append(Finding(
+                "TL000", path, line, 0,
+                f"could not parse: {e.msg if hasattr(e, 'msg') else e}"))
+    return modules, errors
+
+
+def _lint_program(modules: Dict[str, ThreadSourceModule], config) \
+        -> List[Finding]:
+    from deepspeed_tpu.tools.threadlint.model import Program
+    from deepspeed_tpu.tools.threadlint.rules import RULE_REGISTRY
+    program = Program.build(modules, config)
+    findings: List[Finding] = []
+    for rule_id, rule_cls in sorted(RULE_REGISTRY.items()):
+        settings = config.rule(rule_id)
+        if not settings.enabled:
+            continue
+        options = dict(rule_cls.default_options)
+        options.update(settings.options)
+        for f in rule_cls().check(program, options):
+            mod = modules.get(f.path)
+            if mod is None or not mod.suppressed(f):
+                findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def lint_paths(paths: Iterable[str], config) \
+        -> Tuple[List[Finding], List[Finding]]:
+    """Lint files/dirs as ONE program. Returns ``(findings, parse_errors)``;
+    parse errors surface as rule ``TL000`` and are never baselined."""
+    files = iter_files(paths, exclude=config.exclude)
+    modules, errors = _parse_modules(files, in_memory=False)
+    return _lint_program(modules, config), errors
+
+
+def lint_sources(sources: Dict[str, str], config=None) -> List[Finding]:
+    """Lint an in-memory multi-module project ``{path: source}`` — the unit
+    test entry point (rules are whole-program, so fixtures often need more
+    than one module)."""
+    if config is None:
+        from deepspeed_tpu.tools.threadlint.config import ThreadLintConfig
+        config = ThreadLintConfig()
+    modules, errors = _parse_modules(sources, in_memory=True)
+    if errors:
+        raise SyntaxError(errors[0].message)
+    return _lint_program(modules, config)

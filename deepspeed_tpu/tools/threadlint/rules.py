@@ -1,0 +1,477 @@
+"""The threadlint rule set — flow-aware concurrency hazards.
+
+====== ===============================================================
+TL001  lock-order inversion: cycles in the static acquisition graph,
+       or edges contradicting the canonical ``lock_order`` in config
+TL002  blocking call (host fetch, ``.result()``, ``.join()``,
+       ``.wait()``) while holding a lock — directly or through the
+       call graph
+TL003  attribute written from two or more thread roles with no common
+       lock held and no ``# threadlint: guarded-by=`` declaration
+TL004  bare ``acquire()`` with a CFG path to function exit that never
+       passes the matching ``release()``
+TL005  thread/executor attribute no close-ish method ever joins/drains
+TL006  ``Condition.wait()`` not re-checked inside a ``while`` loop
+====== ===============================================================
+
+Rules are whole-program: ``check(program, options)`` runs once over the
+:class:`~deepspeed_tpu.tools.threadlint.model.Program` (call graph, roles,
+lock facts) instead of per-module.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Set, Tuple, Type
+
+from deepspeed_tpu.tools.jaxlint.core import Finding, call_name, unparse
+from deepspeed_tpu.tools.threadlint.model import (FunctionInfo, Program,
+                                                  MAIN_ROLE)
+
+RULE_REGISTRY: Dict[str, Type["Rule"]] = {}
+
+
+def register(cls: Type["Rule"]) -> Type["Rule"]:
+    RULE_REGISTRY[cls.rule_id] = cls
+    return cls
+
+
+class Rule:
+    rule_id: str = ""
+    summary: str = ""
+    default_options: Dict[str, Any] = {}
+
+    def check(self, program: Program, options: Dict[str, Any]) \
+            -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------------- #
+# shared helpers
+# --------------------------------------------------------------------------- #
+
+def _find_cycles(edges: Set[Tuple[str, str]]) -> List[List[str]]:
+    """Elementary cycles in a directed edge set, canonicalized by rotating
+    the minimum element first (mirrors locksan.find_cycles so static and
+    runtime reports name cycles identically)."""
+    adj: Dict[str, List[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+    cycles: List[List[str]] = []
+    seen: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str], on_path: Set[str]) -> None:
+        for nxt in adj.get(node, ()):  # noqa: B007
+            if nxt == start:
+                i = path.index(min(path))
+                canon = tuple(path[i:] + path[:i])
+                if canon not in seen:
+                    seen.add(canon)
+                    cycles.append(list(canon) + [canon[0]])
+            elif nxt not in on_path and nxt > start:
+                # only explore nodes > start: each cycle found exactly once,
+                # rooted at its minimum element
+                on_path.add(nxt)
+                dfs(start, nxt, path + [nxt], on_path)
+                on_path.discard(nxt)
+
+    for start in sorted(adj):
+        dfs(start, start, [start], {start})
+    return sorted(cycles)
+
+
+def _held_at(fn: FunctionInfo, lexical: Sequence[str]) -> Set[str]:
+    return set(lexical) | fn.always_held
+
+
+def _call_tail(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1]
+
+
+def _receiver(node: ast.Call) -> Optional[ast.AST]:
+    return node.func.value if isinstance(node.func, ast.Attribute) else None
+
+
+class _BlockMatcher:
+    """Shared TL002 matcher: is this call site a blocking primitive?"""
+
+    def __init__(self, program: Program, options: Dict[str, Any]):
+        self.program = program
+        self.calls = set(options.get("blocking_calls") or ())
+        self.methods = set(options.get("blocking_methods") or ())
+
+    def blocking(self, fn: FunctionInfo, site) -> Optional[str]:
+        dotted = site.dotted
+        tail = _call_tail(dotted)
+        if dotted in self.calls or tail in self.calls:
+            return tail
+        if tail in self.methods:
+            recv = _receiver(site.node)
+            if recv is not None:
+                hit = self.program.resolve_lock_expr(fn, recv)
+                if hit and hit[1] == "condition":
+                    return None   # condition wait is TL006's department
+            return f"{unparse(site.node.func)}()"
+        return None
+
+    def may_block(self, fn: FunctionInfo,
+                  _memo: Optional[Dict[str, Optional[str]]] = None,
+                  _stack: Optional[Set[str]] = None) -> Optional[str]:
+        """A blocking primitive reachable from ``fn`` through the call
+        graph (returns a witness description, or None)."""
+        memo = _memo if _memo is not None else {}
+        stack = _stack if _stack is not None else set()
+        if fn.qualname in memo:
+            return memo[fn.qualname]
+        if fn.qualname in stack:
+            return None
+        stack.add(fn.qualname)
+        out: Optional[str] = None
+        for site in fn.calls:
+            hit = self.blocking(fn, site)
+            if hit:
+                out = hit
+                break
+            if site.target is not None:
+                inner = self.may_block(site.target, memo, stack)
+                if inner:
+                    out = f"{_call_tail(site.dotted)} -> {inner}"
+                    break
+        stack.discard(fn.qualname)
+        memo[fn.qualname] = out
+        return out
+
+
+# --------------------------------------------------------------------------- #
+# TL001 — lock-order inversion
+# --------------------------------------------------------------------------- #
+
+@register
+class LockOrderRule(Rule):
+    rule_id = "TL001"
+    summary = ("lock-acquisition cycle, or edge contradicting the canonical "
+               "lock_order")
+    default_options: Dict[str, Any] = {}
+
+    def check(self, program: Program, options: Dict[str, Any]) \
+            -> Iterator[Finding]:
+        edges = program.lock_edges()
+        for cycle in _find_cycles(set(edges)):
+            a, b = cycle[0], cycle[1]
+            path, line = edges[(a, b)]
+            yield Finding(
+                self.rule_id, path, line, 0,
+                f"lock-order cycle: {' -> '.join(cycle)} "
+                f"(acquires '{b}' while holding '{a}' here)")
+
+        order = (program.config.lock_order
+                 if program.config is not None else []) or []
+        rank = {name: i for i, name in enumerate(order)}
+        for (a, b), (path, line) in sorted(edges.items()):
+            if a in rank and b in rank and rank[a] > rank[b]:
+                yield Finding(
+                    self.rule_id, path, line, 0,
+                    f"acquires '{b}' while holding '{a}', but lock_order "
+                    f"declares '{b}' before '{a}'")
+
+
+# --------------------------------------------------------------------------- #
+# TL002 — blocking call under a held lock
+# --------------------------------------------------------------------------- #
+
+@register
+class BlockingUnderLockRule(Rule):
+    rule_id = "TL002"
+    summary = "blocking call while holding a lock (direct or via callees)"
+    default_options: Dict[str, Any] = {
+        "blocking_calls": ["fetch_to_host", "block_until_ready",
+                           "device_get", "sleep"],
+        "blocking_methods": ["result", "join", "wait"],
+    }
+
+    def check(self, program: Program, options: Dict[str, Any]) \
+            -> Iterator[Finding]:
+        matcher = _BlockMatcher(program, options)
+        for fn in program.functions.values():
+            for site in fn.calls:
+                held = _held_at(fn, site.held)
+                if not held:
+                    continue
+                locks = ", ".join(f"'{h}'" for h in sorted(held))
+                hit = matcher.blocking(fn, site)
+                if hit:
+                    yield Finding(
+                        self.rule_id, fn.path, site.node.lineno,
+                        site.node.col_offset,
+                        f"blocking call {hit} while holding {locks}")
+                    continue
+                if site.target is not None:
+                    chain = matcher.may_block(site.target)
+                    if chain:
+                        yield Finding(
+                            self.rule_id, fn.path, site.node.lineno,
+                            site.node.col_offset,
+                            f"call '{_call_tail(site.dotted)}' may block "
+                            f"({chain}) while holding {locks}")
+
+
+# --------------------------------------------------------------------------- #
+# TL003 — cross-role attribute writes with no common lock
+# --------------------------------------------------------------------------- #
+
+@register
+class SharedWriteRule(Rule):
+    rule_id = "TL003"
+    summary = ("attribute written from multiple thread roles with no common "
+               "lock and no guarded-by declaration")
+    default_options: Dict[str, Any] = {}
+
+    def check(self, program: Program, options: Dict[str, Any]) \
+            -> Iterator[Finding]:
+        for ci in sorted(program.classes.values(), key=lambda c: c.name):
+            # flood control: only classes that visibly do concurrency
+            if not (ci.lock_attrs or ci.exec_attrs or ci.thread_attrs):
+                continue
+            writes: Dict[str, List[Tuple[FunctionInfo, Any, Set[str]]]] = {}
+            for fn in ci.methods.values():
+                if fn.name in ("__init__", "__new__"):
+                    continue
+                for w in fn.attr_writes:
+                    writes.setdefault(w.attr, []).append(
+                        (fn, w, _held_at(fn, w.held)))
+            for attr, sites in sorted(writes.items()):
+                guard = ci.guards.get(attr)
+                if guard == "none":
+                    continue
+                if guard is not None:
+                    for fn, w, held in sites:
+                        if guard not in held:
+                            yield Finding(
+                                self.rule_id, fn.path, w.node.lineno, 0,
+                                f"'{ci.name}.{attr}' is declared guarded-by "
+                                f"'{guard}' but written here without it")
+                    continue
+                if attr in ci.lock_attrs:
+                    continue   # the lock object itself
+                roles: Set[str] = set()
+                for fn, _w, _h in sites:
+                    roles |= fn.effective_roles()
+                if len(roles) < 2:
+                    continue
+                common = set.intersection(*(h for _f, _w, h in sites)) \
+                    if sites else set()
+                if common:
+                    continue
+                fn, w, _h = sites[0]
+                yield Finding(
+                    self.rule_id, fn.path, w.node.lineno, 0,
+                    f"'{ci.name}.{attr}' written from roles "
+                    f"{{{', '.join(sorted(roles))}}} with no common lock "
+                    f"(declare '# threadlint: guarded-by=...' or lock it)")
+
+
+# --------------------------------------------------------------------------- #
+# TL004 — acquire() without release on every CFG path
+# --------------------------------------------------------------------------- #
+
+@register
+class AcquireReleaseRule(Rule):
+    rule_id = "TL004"
+    summary = "bare acquire() with a path to exit that skips release()"
+    default_options: Dict[str, Any] = {}
+
+    def check(self, program: Program, options: Dict[str, Any]) \
+            -> Iterator[Finding]:
+        for fn in program.functions.values():
+            for acq in fn.acquire_calls:
+                if acq.lock is None:
+                    # unresolved receiver: `.acquire()` is also a plain
+                    # method name (adapter registries, pools) — only flag
+                    # receivers that provably ARE locks
+                    continue
+                if acq.in_test:
+                    # `if x.acquire(False):` — the untaken branch doesn't
+                    # hold the lock; path-sensitivity beyond this rule
+                    continue
+                cfg = fn.cfg
+                node = cfg.node_for(acq.node)
+                if node is None:
+                    continue
+
+                def releases(n) -> bool:
+                    for sub in ast.walk(n.stmt):
+                        if (isinstance(sub, ast.Call)
+                                and isinstance(sub.func, ast.Attribute)
+                                and sub.func.attr == "release"
+                                and unparse(sub.func.value) == acq.receiver):
+                            return True
+                    return False
+
+                # start_exc=False: if acquire() itself raises, the lock was
+                # never taken — that path can't leak it
+                reach = cfg.reachable(node, stop=releases, include_exc=True,
+                                      start_exc=False)
+                if cfg.exit.idx in reach:
+                    what = acq.lock or acq.receiver
+                    yield Finding(
+                        self.rule_id, fn.path, acq.node.lineno, 0,
+                        f"'{acq.receiver}.acquire()' can reach function exit "
+                        f"without releasing '{what}' (use 'with' or "
+                        f"try/finally; annotate handoffs with "
+                        f"'# threadlint: disable=TL004')")
+
+
+# --------------------------------------------------------------------------- #
+# TL005 — threads/executors that escape close()
+# --------------------------------------------------------------------------- #
+
+@register
+class UnjoinedThreadRule(Rule):
+    rule_id = "TL005"
+    summary = "thread/executor attribute never joined or shut down by a closer"
+    default_options: Dict[str, Any] = {
+        "close_methods": ["close", "shutdown", "stop", "destroy", "join",
+                          "drain", "flush", "__exit__", "__del__"],
+    }
+
+    def check(self, program: Program, options: Dict[str, Any]) \
+            -> Iterator[Finding]:
+        closers = set(options.get("close_methods") or ())
+        for ci in sorted(program.classes.values(), key=lambda c: c.name):
+            owned: Dict[str, ast.stmt] = dict(ci.thread_attrs)
+            for attr in ci.exec_attrs:
+                site = self._creation_site(ci, attr)
+                if site is not None:
+                    owned[attr] = site
+            if not owned:
+                continue
+            drained = self._drained_attrs(program, ci, closers)
+            for attr, site in sorted(owned.items()):
+                if attr in drained:
+                    continue
+                yield Finding(
+                    self.rule_id, ci.module.path, site.lineno, 0,
+                    f"'{ci.name}.{attr}' owns a thread/executor but no "
+                    f"close-ish method ({', '.join(sorted(closers))}) "
+                    f"joins or shuts it down")
+
+    @staticmethod
+    def _creation_site(ci, attr: str) -> Optional[ast.stmt]:
+        for fn in ci.methods.values():
+            for stmt in ast.walk(fn.node):
+                if isinstance(stmt, ast.Assign):
+                    for tgt in stmt.targets:
+                        if (isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"
+                                and tgt.attr == attr):
+                            return stmt
+        return None
+
+    def _drained_attrs(self, program: Program, ci, closers: Set[str]) \
+            -> Set[str]:
+        """Attrs some closer transitively joins/shuts down."""
+        direct: Dict[str, Set[str]] = {}
+        for name, fn in ci.methods.items():
+            attrs: Set[str] = set()
+            for node in ast.walk(fn.node):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("join", "shutdown", "cancel")):
+                    recv = node.func.value
+                    if (isinstance(recv, ast.Attribute)
+                            and isinstance(recv.value, ast.Name)
+                            and recv.value.id == "self"):
+                        attrs.add(recv.attr)
+                    elif isinstance(recv, ast.Name):
+                        # `thr = self._thr` / iteration locals: credit any
+                        # self attr read in the same method — coarse but
+                        # keeps `for t in self._threads: t.join()` clean
+                        for sub in ast.walk(fn.node):
+                            if (isinstance(sub, ast.Attribute)
+                                    and isinstance(sub.value, ast.Name)
+                                    and sub.value.id == "self"):
+                                attrs.add(sub.attr)
+            direct[fn.qualname] = attrs
+
+        out: Set[str] = set()
+        for name, fn in ci.methods.items():
+            if name not in closers:
+                continue
+            seen: Set[str] = set()
+            stack = [fn]
+            while stack:
+                cur = stack.pop()
+                if cur.qualname in seen:
+                    continue
+                seen.add(cur.qualname)
+                out |= direct.get(cur.qualname, set())
+                for site in cur.calls:
+                    if site.target is not None:
+                        stack.append(site.target)
+        return out
+
+
+# --------------------------------------------------------------------------- #
+# TL006 — condition wait outside a re-check loop
+# --------------------------------------------------------------------------- #
+
+@register
+class ConditionWaitRule(Rule):
+    rule_id = "TL006"
+    summary = "Condition.wait() not inside a while re-check loop"
+    default_options: Dict[str, Any] = {}
+
+    def check(self, program: Program, options: Dict[str, Any]) \
+            -> Iterator[Finding]:
+        for fn in program.functions.values():
+            yield from self._check_fn(program, fn)
+
+    def _check_fn(self, program: Program, fn: FunctionInfo) \
+            -> Iterator[Finding]:
+        def walk(body, in_while: bool):
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                here = in_while or isinstance(stmt, ast.While)
+                if not here:
+                    for node in ast.walk(stmt) \
+                            if not self._has_suites(stmt) \
+                            else self._head_walk(stmt):
+                        if (isinstance(node, ast.Call)
+                                and isinstance(node.func, ast.Attribute)
+                                and node.func.attr == "wait"):
+                            hit = program.resolve_lock_expr(fn, node.func.value)
+                            if hit and hit[1] == "condition":
+                                yield Finding(
+                                    self.rule_id, fn.path, node.lineno,
+                                    node.col_offset,
+                                    f"'{unparse(node.func.value)}.wait()' "
+                                    f"outside a while loop — the predicate "
+                                    f"must be re-checked (spurious wakeups; "
+                                    f"or use wait_for)")
+                for name in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, name, None)
+                    if sub:
+                        yield from walk(sub, here)
+                for h in getattr(stmt, "handlers", []) or []:
+                    yield from walk(h.body, here)
+
+        yield from walk(fn.node.body, False)
+
+    @staticmethod
+    def _has_suites(stmt: ast.stmt) -> bool:
+        return bool(getattr(stmt, "body", None))
+
+    @staticmethod
+    def _head_walk(stmt: ast.stmt):
+        for name, value in ast.iter_fields(stmt):
+            if name in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            if isinstance(value, ast.AST):
+                yield from ast.walk(value)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.AST):
+                        yield from ast.walk(v)

@@ -82,6 +82,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.threads import make_lock
 
 #: exit status of an injected ``action=kill`` — distinguishable from a crash
 KILL_EXIT_CODE = 17
@@ -136,7 +137,7 @@ class FaultInjector:
         for s in specs:
             self._specs.setdefault(s.site, []).append(s)
         self._hits: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("utils.fault.hits")
         #: (site, hit, action) tuples of every firing, for assertions
         self.fired: List[tuple] = []
 

@@ -96,8 +96,11 @@ class DeferredCall:
             finally:
                 self._finished.set()
 
-        self._thread = threading.Thread(target=runner, daemon=True,
-                                        name="dstpu-deferred")
+        # deliberately abandonable: a deadline miss leaves the call
+        # running on this daemon thread, and a LATER result() may still
+        # join it — there is no close() by design
+        self._thread = threading.Thread(  # threadlint: disable=TL005
+            target=runner, daemon=True, name="dstpu-deferred")
         self._thread.start()
 
     @property

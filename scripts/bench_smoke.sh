@@ -58,9 +58,14 @@ timeout -k 10 300 python benchmarks/serving_bench.py --spec --smoke \
 # shared-prefix Poisson stream, correctness gates only — every checked
 # stream byte-identical to a direct single-frontend run, at least one
 # forced prefill->decode KV handoff over the page fabric, zero
-# steady-state compiles on every replica; emits serve/router trace lanes
-timeout -k 10 300 python benchmarks/serving_bench.py --router --smoke \
-    || exit 1
+# steady-state compiles on every replica; emits serve/router trace lanes.
+# DSTPU_LOCKSAN=1 arms the runtime lock-order sanitizer
+# (docs/THREADLINT.md): the leg additionally gates zero observed
+# acquisition cycles and static-graph coverage of every observed edge,
+# with the byte-equality / zero-compile gates unchanged — the sanitized
+# locks must not alter behavior
+DSTPU_LOCKSAN=1 timeout -k 10 300 \
+    python benchmarks/serving_bench.py --router --smoke || exit 1
 
 # multi-tenant LoRA leg (docs/SERVING.md "Multi-tenant LoRA"): a seeded
 # Poisson mix drawing tenants from more registered adapters than the
@@ -78,9 +83,12 @@ timeout -k 10 300 python benchmarks/serving_bench.py --lora --smoke \
 # byte-identical non-shed streams vs uninterrupted references, detection of
 # both failure modes, migration, self-healing rejoin with zero compiles,
 # and allocator baseline on every replica; the injected raise also leaves
-# the flight-recorder dump trace_check verifies below
-timeout -k 10 300 python benchmarks/serving_bench.py --chaos --smoke \
-    || exit 1
+# the flight-recorder dump trace_check verifies below. Runs lock-order
+# sanitized (DSTPU_LOCKSAN=1) — the failover/rejoin storm is the stack's
+# richest locking workload, and the injected raise's crash dump carries
+# the locksan report (docs/OBSERVABILITY.md)
+DSTPU_LOCKSAN=1 timeout -k 10 300 \
+    python benchmarks/serving_bench.py --chaos --smoke || exit 1
 
 timeout -k 10 300 python benchmarks/train_bench.py --smoke || exit 1
 

@@ -2,6 +2,18 @@
 # Fast pre-test gate (seconds, not minutes on this 2-core container):
 #   1. compileall  — broken imports/syntax fail immediately
 #   2. jaxlint     — jit/sharding/donation hazards (docs/JAXLINT.md)
+#   3. threadlint  — lock order / blocking-under-lock / cross-thread
+#                    writes (docs/THREADLINT.md)
+# The two linters run CONCURRENTLY — they are independent read-only
+# analyses, and back-to-back they would blow the seconds budget on this
+# 2-core container.
+#
+#   --changed   lint only the .py files the working tree touches vs HEAD
+#               (tracked modifications + untracked files), compileall on
+#               exactly those. jaxlint is per-file and gets just the
+#               diff; threadlint is whole-program — role propagation and
+#               the lock graph cross file boundaries — so ANY changed
+#               .py still reruns it over the full tree.
 # Run from anywhere; operates on the repo this script lives in.
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -9,6 +21,32 @@ cd "$(dirname "$0")/.."
 # pure host-side analysis: never let the lint step grab a TPU
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
+run_both() {   # $1: jaxlint targets (newline-separated), both gates must pass
+    local jl_rc=0 tl_rc=0
+    xargs -d '\n' python -m deepspeed_tpu.tools.jaxlint <<<"$1" &
+    local jl=$!
+    python -m deepspeed_tpu.tools.threadlint deepspeed_tpu &
+    local tl=$!
+    wait "$jl" || jl_rc=$?
+    wait "$tl" || tl_rc=$?
+    return $(( jl_rc > tl_rc ? jl_rc : tl_rc ))
+}
+
+if [[ "${1:-}" == "--changed" ]]; then
+    changed=$( { git diff --name-only --diff-filter=d HEAD -- '*.py';
+                 git ls-files --others --exclude-standard -- '*.py'; } \
+               | sort -u )
+    if [[ -z "$changed" ]]; then
+        echo "lint: no changed .py files"
+        echo "lint: OK"
+        exit 0
+    fi
+    xargs -d '\n' python -m compileall -q <<<"$changed"
+    run_both "$changed"
+    echo "lint: OK (changed: $(wc -l <<<"$changed") file(s))"
+    exit 0
+fi
+
 python -m compileall -q deepspeed_tpu
-python -m deepspeed_tpu.tools.jaxlint deepspeed_tpu
+run_both "deepspeed_tpu"
 echo "lint: OK"

@@ -377,3 +377,50 @@ def test_preempt_restore_releases_and_reacquires_adapter(model_params):
     assert h_lo.result(5) == ref
     assert e.lora.refcount("t-a") == 0
     fe.close()
+
+
+def test_registry_metadata_reads_survive_a_mutating_engine_thread():
+    """Regression (threadlint TL003): ``names``/``can_admit``/``rank`` are
+    called from CLIENT threads (frontend submit validation) and the
+    router's adapter-state probe while the ENGINE thread mutates the
+    adapter map — unguarded, the readers iterated ``_adapters`` /
+    ``_bindings`` mid-resize (``RuntimeError: dictionary changed size
+    during iteration``) or saw half-updated metadata. The ``_meta`` lock
+    now guards map shape + metadata for both sides; this stress drives a
+    register/unregister churn loop against a hot reader and requires zero
+    errors on either side."""
+    import threading
+    import time
+
+    reg = _registry(ranks=(2,))
+    stop = threading.Event()
+    errs = []
+
+    def engine_mutator():
+        i = 0
+        try:
+            while not stop.is_set():
+                name = f"churn{i % 16}"
+                reg.register(name, None)    # rank-0: pure metadata churn
+                reg.acquire(30_000 + i, name)
+                reg.release(30_000 + i)
+                reg.unregister(name)
+                i += 1
+        except BaseException as exc:        # surfaced to the assert below
+            errs.append(exc)
+
+    t = threading.Thread(target=engine_mutator, name="dstpu-engine-fake")
+    t.start()
+    deadline = time.monotonic() + 1.0
+    try:
+        while time.monotonic() < deadline and not errs:
+            assert "a0" in reg.names
+            assert reg.can_admit("a0")
+            assert reg.rank("a0") == 2
+            assert reg.refcount("a0") == 0
+    except BaseException as exc:
+        errs.append(exc)
+    finally:
+        stop.set()
+        t.join(10.0)
+    assert not errs, errs

@@ -324,3 +324,35 @@ def test_latest_never_rolls_backwards_past_user_save(tmp_path):
     assert read_latest_tag(str(tmp_path / "run")) in ("global_step2",
                                                       "rolling_step2")
     eng.destroy()
+
+
+def test_failed_enqueue_hands_the_backpressure_permit_back(tmp_path):
+    """Regression (threadlint TL004): the backpressure permit transfers to
+    the committer WITH the queued job, so ``save()`` never releases it on
+    success — but a ``_jobs.put`` that raises used to leak the permit, and
+    with ``max_pending=1`` the NEXT save wedged forever on acquire. The
+    fix hands the permit back on any enqueue failure."""
+    eng = _mlp_engine(tmp_path, every=100, max_pending=1)
+    eng.train_batch(_batch(0))
+    rc = eng._rolling
+    rc.flush()                       # committer idle, full permit budget
+
+    real_put = rc._jobs.put
+
+    def boom(*a, **k):
+        raise RuntimeError("queue closed under save")
+
+    rc._jobs.put = boom
+    try:
+        with pytest.raises(RuntimeError, match="queue closed under save"):
+            rc.save()
+    finally:
+        rc._jobs.put = real_put
+    # pre-fix: the permit was gone -> this acquire fails (and a real
+    # caller's next save() blocked forever on the backpressure gate)
+    assert rc._pending.acquire(blocking=False)
+    rc._pending.release()
+    # and the subsystem is still fully usable after the failed enqueue
+    rc.save()
+    rc.flush()
+    eng.destroy()

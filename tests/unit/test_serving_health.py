@@ -15,7 +15,8 @@ import pytest
 from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
 from deepspeed_tpu.inference.v2.serving import (ServingCluster, ServingRouter)
 from deepspeed_tpu.inference.v2.serving.health import (DOWN, DRAINING,
-                                                       HEALTHY, SUSPECT)
+                                                       HEALTHY, SUSPECT,
+                                                       HealthMonitor)
 from deepspeed_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from deepspeed_tpu.monitor.serving import HealthStats
 from deepspeed_tpu.utils import fault_injection as fi
@@ -712,3 +713,66 @@ def test_cluster_uid_spaces_disjoint(model_params):
     b1 = next(cluster.replicas[1].frontend._uid_iter)
     assert (b0 >> 24) != (b1 >> 24)
     assert cluster.alloc_uid_base() > max(b0, b1)
+
+
+def test_monitor_reads_never_wait_out_a_blocking_failover():
+    """Regression (threadlint TL002): ``poll()`` used to hold the monitor
+    lock through the whole failover — including ``fe.join(fence_join_s)``
+    on the dead replica's thread — so ``all_healthy()`` /
+    ``handled_replicas()`` from the router or a bench waited out the full
+    fence-join timeout behind it. The restructure CLAIMS the record under
+    the lock and runs the blocking legs with the lock released; this test
+    parks a fake frontend inside the fence join and asserts the read
+    surface still answers immediately."""
+    entered, release = threading.Event(), threading.Event()
+
+    class _FE:
+        _loop_exc = RuntimeError("engine loop died")   # liveness -> down
+        _reqs: dict = {}
+        _inflight_lock = threading.Lock()
+
+        def fence(self):
+            pass
+
+        def join(self, timeout):
+            entered.set()
+            release.wait(timeout)   # honors fence_join_s: pre-fix the
+            # monitor lock stayed held for this whole wait
+
+        def _scrape_control(self):
+            return []
+
+    class _Replica:
+        name, role = "r0", "decode"
+        frontend, engine = _FE(), None
+
+    class _Cluster:
+        replicas = [_Replica()]
+
+    class _Router:
+        cluster = _Cluster()
+        dropped: list = []
+
+        def _drop_replica_routing(self, name):
+            self.dropped.append(name)
+
+    mon = HealthMonitor(_Router(), {
+        "enabled": True, "interval_s": 0.01, "suspect_after_s": 0.25,
+        "down_after_s": 0.6, "fence_join_s": 2.0, "auto_rejoin": False})
+    t = threading.Thread(target=mon.poll, daemon=True)
+    t.start()
+    assert entered.wait(2.0), "failover never reached the fence join"
+    try:
+        # the blocking leg is in flight RIGHT NOW; reads must not queue
+        # behind it (pre-fix: these blocked ~fence_join_s = 2 s)
+        t0 = time.perf_counter()
+        assert mon.all_healthy() is False
+        assert mon.state("r0") == DOWN
+        assert mon.handled_replicas() == []   # claimed, not yet handled
+        assert time.perf_counter() - t0 < 0.5
+    finally:
+        release.set()
+        t.join(5.0)
+    assert not t.is_alive()
+    assert mon.state("r0") == DRAINING
+    assert mon.handled_replicas() == ["r0"]

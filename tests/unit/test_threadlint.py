@@ -1,0 +1,855 @@
+"""threadlint unit tests: one failing and one passing fixture per rule, the
+CFG/dataflow substrate, role propagation, and the suppression/baseline/CLI
+machinery (mirroring test_jaxlint's coverage of the shared conventions)."""
+
+import ast
+import json
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.tools.threadlint import (Program, RULE_REGISTRY,
+                                            RuleSettings, ThreadLintConfig,
+                                            ThreadSourceModule, lint_sources)
+from deepspeed_tpu.tools.threadlint.cfg import build_cfg
+from deepspeed_tpu.tools.threadlint.cli import main as threadlint_main
+
+
+def lint(src, config=None, path="pkg/mod.py", **rule_options):
+    cfg = config or ThreadLintConfig()
+    for rid, opts in rule_options.items():
+        cfg.rules[rid] = RuleSettings(options=opts)
+    return lint_sources({path: textwrap.dedent(src)}, config=cfg)
+
+
+def lint_many(sources, config=None):
+    return lint_sources({p: textwrap.dedent(s) for p, s in sources.items()},
+                        config=config)
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+def build(src, path="pkg/mod.py", config=None):
+    mod = ThreadSourceModule.parse(path, textwrap.dedent(src))
+    return Program.build({path: mod}, config or ThreadLintConfig())
+
+
+def test_registry_has_all_six_rules():
+    assert set(RULE_REGISTRY) == {"TL001", "TL002", "TL003", "TL004",
+                                  "TL005", "TL006"}
+
+
+# --------------------------------------------------------------------------- #
+# TL001 — lock-order inversion
+# --------------------------------------------------------------------------- #
+
+def test_tl001_flags_ab_ba_cycle():
+    findings = lint("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def one(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def two(self):
+                with self.b:
+                    with self.a:
+                        pass
+    """)
+    assert "TL001" in rules_of(findings)
+
+
+def test_tl001_clean_with_consistent_order():
+    findings = lint("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def one(self):
+                with self.a:
+                    with self.b:
+                        pass
+
+            def two(self):
+                with self.a:
+                    with self.b:
+                        pass
+    """)
+    assert findings == []
+
+
+def test_tl001_flags_transitive_cycle_through_call():
+    # one() takes a then calls helper() which takes b; two() inverts —
+    # the cycle only exists through the call graph
+    findings = lint("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self.a = threading.Lock()
+                self.b = threading.Lock()
+
+            def helper_b(self):
+                with self.b:
+                    pass
+
+            def helper_a(self):
+                with self.a:
+                    pass
+
+            def one(self):
+                with self.a:
+                    self.helper_b()
+
+            def two(self):
+                with self.b:
+                    self.helper_a()
+    """)
+    assert "TL001" in rules_of(findings)
+
+
+def test_tl001_flags_canonical_order_contradiction():
+    cfg = ThreadLintConfig(lock_order=["app.outer", "app.inner"])
+    findings = lint("""
+        from deepspeed_tpu.utils.threads import make_lock
+
+        class S:
+            def __init__(self):
+                self.outer = make_lock("app.outer")
+                self.inner = make_lock("app.inner")
+
+            def backwards(self):
+                with self.inner:
+                    with self.outer:
+                        pass
+    """, config=cfg)
+    assert "TL001" in rules_of(findings)
+
+
+def test_tl001_clean_when_order_matches_canon():
+    cfg = ThreadLintConfig(lock_order=["app.outer", "app.inner"])
+    findings = lint("""
+        from deepspeed_tpu.utils.threads import make_lock
+
+        class S:
+            def __init__(self):
+                self.outer = make_lock("app.outer")
+                self.inner = make_lock("app.inner")
+
+            def forwards(self):
+                with self.outer:
+                    with self.inner:
+                        pass
+    """, config=cfg)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# TL002 — blocking call under a held lock
+# --------------------------------------------------------------------------- #
+
+def test_tl002_flags_join_under_lock():
+    findings = lint("""
+        from deepspeed_tpu.utils.threads import make_lock
+
+        class S:
+            def __init__(self):
+                self._lock = make_lock("s.lock")
+                self._worker = None
+
+            def stop(self):
+                with self._lock:
+                    self._worker.join(timeout=5.0)
+    """)
+    assert "TL002" in rules_of(findings)
+
+
+def test_tl002_flags_transitive_blocking_through_callee():
+    findings = lint("""
+        import time
+        from deepspeed_tpu.utils.threads import make_lock
+
+        class S:
+            def __init__(self):
+                self._lock = make_lock("s.lock")
+
+            def _backoff(self):
+                time.sleep(0.5)
+
+            def poll(self):
+                with self._lock:
+                    self._backoff()
+    """)
+    assert "TL002" in rules_of(findings)
+
+
+def test_tl002_clean_when_blocking_moved_outside_lock():
+    findings = lint("""
+        from deepspeed_tpu.utils.threads import make_lock
+
+        class S:
+            def __init__(self):
+                self._lock = make_lock("s.lock")
+                self._worker = None
+
+            def stop(self):
+                with self._lock:
+                    worker = self._worker
+                self._worker = None
+                worker.join(timeout=5.0)
+    """)
+    assert rules_of(findings) == []
+
+
+def test_tl002_condition_wait_is_not_double_reported():
+    # waiting on a Condition is TL006's department (the lock is RELEASED
+    # during the wait), not a TL002 blocking call
+    findings = lint("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self.ready = False
+
+            def wait_ready(self):
+                with self._cv:
+                    while not self.ready:
+                        self._cv.wait()
+    """)
+    assert "TL002" not in rules_of(findings)
+
+
+# --------------------------------------------------------------------------- #
+# TL003 — cross-role writes without a common lock
+# --------------------------------------------------------------------------- #
+
+_TL003_RACE = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self.count = 0
+            self._t = threading.Thread(target=self._run, name="worker")
+
+        def _run(self):
+            self.count += 1
+
+        def bump(self):
+            self.count += 1
+"""
+
+
+def test_tl003_flags_two_role_write_without_lock():
+    findings = lint(_TL003_RACE)
+    assert "TL003" in rules_of(findings)
+
+
+def test_tl003_clean_when_both_writes_hold_a_common_lock():
+    findings = lint("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self.count = 0
+                self._lock = threading.Lock()
+                self._t = threading.Thread(target=self._run, name="worker")
+
+            def _run(self):
+                with self._lock:
+                    self.count += 1
+
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+
+            def close(self):
+                self._t.join()
+    """)
+    assert rules_of(findings) == []
+
+
+def test_tl003_guarded_by_none_annotation_accepts_the_race():
+    findings = lint("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self.count = 0  # threadlint: guarded-by=none
+                self._t = threading.Thread(target=self._run, name="worker")
+
+            def _run(self):
+                self.count += 1
+
+            def bump(self):
+                self.count += 1
+
+            def close(self):
+                self._t.join()
+    """)
+    assert rules_of(findings) == []
+
+
+def test_tl003_declared_guard_enforced_on_every_write():
+    # guarded-by=<lock> is a CONTRACT: a single-role write that skips the
+    # lock still violates it
+    findings = lint("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0  # threadlint: guarded-by=S._lock
+
+            def bump(self):
+                self.count += 1
+    """)
+    assert "TL003" in rules_of(findings)
+
+
+def test_tl003_single_role_class_is_out_of_scope():
+    findings = lint("""
+        class S:
+            def __init__(self):
+                self.count = 0
+
+            def bump(self):
+                self.count += 1
+    """)
+    assert findings == []
+
+
+# --------------------------------------------------------------------------- #
+# TL004 — acquire() without release on every path
+# --------------------------------------------------------------------------- #
+
+def test_tl004_flags_leak_on_exception_path():
+    findings = lint("""
+        from deepspeed_tpu.utils.threads import make_lock
+
+        class S:
+            def __init__(self):
+                self._lock = make_lock("s.lock")
+
+            def bad(self, work):
+                self._lock.acquire()
+                work()
+                self._lock.release()
+    """)
+    assert "TL004" in rules_of(findings)
+
+
+def test_tl004_clean_with_try_finally():
+    findings = lint("""
+        from deepspeed_tpu.utils.threads import make_lock
+
+        class S:
+            def __init__(self):
+                self._lock = make_lock("s.lock")
+
+            def good(self, work):
+                self._lock.acquire()
+                try:
+                    work()
+                finally:
+                    self._lock.release()
+    """)
+    assert rules_of(findings) == []
+
+
+def test_tl004_ignores_acquire_on_non_lock_receivers():
+    # `.acquire()` is also a plain method name (adapter registries, pools)
+    findings = lint("""
+        class S:
+            def __init__(self, registry):
+                self.registry = registry
+
+            def bind(self, uid, name):
+                self.registry.acquire(uid, name)
+    """)
+    assert findings == []
+
+
+def test_tl004_ignores_nonblocking_test_acquire():
+    findings = lint("""
+        from deepspeed_tpu.utils.threads import make_lock
+
+        class S:
+            def __init__(self):
+                self._lock = make_lock("s.lock")
+
+            def try_work(self, work):
+                if self._lock.acquire(False):
+                    try:
+                        work()
+                    finally:
+                        self._lock.release()
+    """)
+    assert rules_of(findings) == []
+
+
+# --------------------------------------------------------------------------- #
+# TL005 — unjoined thread escaping a close-ish method
+# --------------------------------------------------------------------------- #
+
+def test_tl005_flags_close_that_never_joins():
+    findings = lint("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def _run(self):
+                pass
+
+            def close(self):
+                self.closed = True
+    """)
+    assert "TL005" in rules_of(findings)
+
+
+def test_tl005_clean_when_close_joins():
+    findings = lint("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def _run(self):
+                pass
+
+            def close(self):
+                self._t.join(timeout=5.0)
+    """)
+    assert rules_of(findings) == []
+
+
+def test_tl005_join_through_helper_counts():
+    findings = lint("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run)
+                self._t.start()
+
+            def _run(self):
+                pass
+
+            def _stop_worker(self):
+                self._t.join(timeout=5.0)
+
+            def close(self):
+                self._stop_worker()
+    """)
+    assert rules_of(findings) == []
+
+
+# --------------------------------------------------------------------------- #
+# TL006 — condition wait without a while re-check
+# --------------------------------------------------------------------------- #
+
+def test_tl006_flags_if_guarded_wait():
+    findings = lint("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self.ready = False
+
+            def wait_ready(self):
+                with self._cv:
+                    if not self.ready:
+                        self._cv.wait()
+    """)
+    assert "TL006" in rules_of(findings)
+
+
+def test_tl006_clean_with_while_recheck():
+    findings = lint("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self.ready = False
+
+            def wait_ready(self):
+                with self._cv:
+                    while not self.ready:
+                        self._cv.wait()
+    """)
+    assert rules_of(findings) == []
+
+
+def test_tl006_wait_for_is_always_fine():
+    findings = lint("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._cv = threading.Condition()
+                self.ready = False
+
+            def wait_ready(self):
+                with self._cv:
+                    self._cv.wait_for(lambda: self.ready)
+    """)
+    assert rules_of(findings) == []
+
+
+# --------------------------------------------------------------------------- #
+# CFG substrate
+# --------------------------------------------------------------------------- #
+
+def _cfg_of(src):
+    tree = ast.parse(textwrap.dedent(src))
+    fn = tree.body[0]
+    return fn, build_cfg(fn)
+
+
+def test_cfg_finally_is_on_every_path():
+    fn, cfg = _cfg_of("""
+        def f(lock, work):
+            lock.acquire()
+            try:
+                work()
+            finally:
+                lock.release()
+    """)
+    acquire = cfg.node_for(fn.body[0])
+    release_stmt = fn.body[1].finalbody[0]
+    # exit is NOT reachable from the acquire without passing the release
+    # (start_exc=False: acquire's own raise never took the lock)
+    stops = lambda n: n.stmt is release_stmt
+    reach = cfg.reachable(acquire, stop=stops, include_exc=True,
+                          start_exc=False)
+    assert cfg.exit.idx not in reach
+
+
+def test_cfg_exception_path_skips_late_statements():
+    fn, cfg = _cfg_of("""
+        def f(lock, work):
+            lock.acquire()
+            work()
+            lock.release()
+    """)
+    acquire = cfg.node_for(fn.body[0])
+    release_stmt = fn.body[2]
+    stops = lambda n: n.stmt is release_stmt
+    # work() can raise straight past the release to the exit
+    reach = cfg.reachable(acquire, stop=stops, include_exc=True)
+    assert cfg.exit.idx in reach
+
+
+def test_cfg_early_return_reaches_exit():
+    fn, cfg = _cfg_of("""
+        def f(x):
+            if x:
+                return 1
+            return 2
+    """)
+    entry_reach = cfg.reachable(cfg.entry)
+    assert cfg.exit.idx in entry_reach
+
+
+def test_cfg_nested_defs_are_opaque():
+    fn, cfg = _cfg_of("""
+        def f(lock):
+            def inner():
+                lock.release()
+            return inner
+    """)
+    # the nested def is ONE node; its body statements get no nodes
+    inner_release = fn.body[0].body[0]
+    assert cfg.node_for(inner_release) is None
+
+
+def test_cfg_while_loops_back():
+    fn, cfg = _cfg_of("""
+        def f(cv, ready):
+            while not ready():
+                cv.wait()
+    """)
+    loop = cfg.node_for(fn.body[0])
+    wait = cfg.node_for(fn.body[0].body[0])
+    assert loop.idx in cfg.reachable(wait)
+
+
+# --------------------------------------------------------------------------- #
+# role model
+# --------------------------------------------------------------------------- #
+
+def test_roles_seed_from_thread_target_name():
+    program = build("""
+        import threading
+
+        class S:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run, name="pump")
+
+            def _run(self):
+                self._step()
+
+            def _step(self):
+                pass
+    """)
+    run = next(f for q, f in program.functions.items() if q.endswith("._run"))
+    step = next(f for q, f in program.functions.items()
+                if q.endswith("._step"))
+    assert "pump" in run.effective_roles()
+    # propagated through the call graph, not just the entry point
+    assert "pump" in step.effective_roles()
+
+
+def test_roles_seed_from_decorator():
+    program = build("""
+        from deepspeed_tpu.utils.threads import thread_role
+
+        class S:
+            @thread_role("dstpu-health")
+            def _run(self):
+                pass
+    """)
+    run = next(f for q, f in program.functions.items() if q.endswith("._run"))
+    assert run.effective_roles() == {"dstpu-health"}
+
+
+def test_roles_seed_from_comment_annotation():
+    program = build("""
+        class S:
+            def _run(self):  # threadlint: role=bg-worker
+                pass
+    """)
+    run = next(f for q, f in program.functions.items() if q.endswith("._run"))
+    assert "bg-worker" in run.effective_roles()
+
+
+def test_uncalled_functions_default_to_main_role():
+    program = build("""
+        def entry():
+            pass
+    """)
+    fn = next(f for q, f in program.functions.items()
+              if q.endswith("::entry"))
+    assert fn.effective_roles() == {"main"}
+
+
+# --------------------------------------------------------------------------- #
+# suppressions
+# --------------------------------------------------------------------------- #
+
+def test_line_suppression_silences_one_finding():
+    findings = lint("""
+        from deepspeed_tpu.utils.threads import make_lock
+
+        class S:
+            def __init__(self):
+                self._lock = make_lock("s.lock")
+
+            def handoff(self, work):
+                self._lock.acquire()  # threadlint: disable=TL004
+                work()
+    """)
+    assert rules_of(findings) == []
+
+
+def test_file_suppression_silences_the_rule_everywhere():
+    findings = lint("""
+        # threadlint: disable-file=TL004
+        from deepspeed_tpu.utils.threads import make_lock
+
+        class S:
+            def __init__(self):
+                self._lock = make_lock("s.lock")
+
+            def one(self, work):
+                self._lock.acquire()
+                work()
+    """)
+    assert rules_of(findings) == []
+
+
+def test_docstring_mentioning_the_grammar_is_not_a_suppression():
+    findings = lint('''
+        from deepspeed_tpu.utils.threads import make_lock
+
+        class S:
+            """Documents '# threadlint: disable=TL004' without using it."""
+
+            def __init__(self):
+                self._lock = make_lock("s.lock")
+
+            def bad(self, work):
+                self._lock.acquire()
+                work()
+    ''')
+    assert "TL004" in rules_of(findings)
+
+
+# --------------------------------------------------------------------------- #
+# CLI / baseline machinery (shared conventions with jaxlint)
+# --------------------------------------------------------------------------- #
+
+_BAD_SRC = textwrap.dedent("""
+    from deepspeed_tpu.utils.threads import make_lock
+
+    class S:
+        def __init__(self):
+            self._lock = make_lock("s.lock")
+
+        def bad(self, work):
+            self._lock.acquire()
+            work()
+""")
+
+
+def test_cli_exit_codes_and_select(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_BAD_SRC)
+    assert threadlint_main([str(bad), "--no-config"]) == 1
+    out = capsys.readouterr().out
+    assert "TL004" in out
+    # selecting a different rule silences it
+    assert threadlint_main([str(bad), "--no-config",
+                            "--select", "TL001"]) == 0
+    assert threadlint_main([str(bad), "--no-config",
+                            "--disable", "TL004"]) == 0
+
+
+def test_cli_unknown_rule_id_is_usage_error(tmp_path, capsys):
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = 1\n")
+    assert threadlint_main([str(ok), "--no-config", "--select", "TL99"]) == 2
+    assert threadlint_main([str(ok), "--no-config", "--disable", "JL001"]) == 2
+    assert "unknown rule id" in capsys.readouterr().err
+
+
+def test_cli_missing_path_is_usage_error(tmp_path):
+    assert threadlint_main([str(tmp_path / "nope.py"), "--no-config"]) == 2
+
+
+def test_cli_json_and_sarif_formats(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_BAD_SRC)
+    assert threadlint_main([str(bad), "--no-config",
+                            "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload and payload[0]["rule"] == "TL004"
+    assert threadlint_main([str(bad), "--no-config",
+                            "--format", "sarif"]) == 1
+    sarif = json.loads(capsys.readouterr().out)
+    assert sarif["runs"][0]["tool"]["driver"]["name"] == "threadlint"
+    results = sarif["runs"][0]["results"]
+    assert results and results[0]["ruleId"] == "TL004"
+    assert "baselineFingerprint/v1" in results[0]["partialFingerprints"]
+
+
+def test_baseline_roundtrip(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_BAD_SRC)
+    bl = tmp_path / "bl.json"
+    assert threadlint_main([str(bad), "--no-config", "--baseline", str(bl),
+                            "--write-baseline"]) == 0
+    capsys.readouterr()
+    # grandfathered: the same tree is green against its baseline
+    assert threadlint_main([str(bad), "--no-config",
+                            "--baseline", str(bl)]) == 0
+    # a NEW finding still fails
+    bad.write_text(_BAD_SRC + textwrap.dedent("""
+        class T:
+            def __init__(self):
+                self._lock = make_lock("t.lock")
+
+            def worse(self, work):
+                self._lock.acquire()
+                work()
+    """))
+    assert threadlint_main([str(bad), "--no-config",
+                            "--baseline", str(bl)]) == 1
+
+
+def test_parse_errors_are_never_baselined(tmp_path, capsys):
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    bl = tmp_path / "bl.json"
+    assert threadlint_main([str(broken), "--no-config", "--baseline",
+                            str(bl), "--write-baseline"]) == 1
+    from deepspeed_tpu.tools.jaxlint.baseline import load_baseline
+    assert load_baseline(str(bl)) == {}
+    assert threadlint_main([str(broken), "--no-config",
+                            "--baseline", str(bl)]) == 1
+    assert "TL000" in capsys.readouterr().err
+
+
+def test_dump_lock_graph(tmp_path, capsys):
+    mod = tmp_path / "mod.py"
+    mod.write_text(textwrap.dedent("""
+        from deepspeed_tpu.utils.threads import make_lock
+
+        class S:
+            def __init__(self):
+                self.outer = make_lock("g.outer")
+                self.inner = make_lock("g.inner")
+
+            def nested(self):
+                with self.outer:
+                    with self.inner:
+                        pass
+    """))
+    assert threadlint_main([str(mod), "--no-config",
+                            "--dump-lock-graph"]) == 0
+    assert "g.outer -> g.inner" in capsys.readouterr().out
+
+
+def test_config_load_and_discovery(tmp_path):
+    (tmp_path / ".threadlint.json").write_text(json.dumps({
+        "exclude": ["vendored/"],
+        "baseline": "bl.json",
+        "lock_order": ["a.outer", "a.inner"],
+        "rules": {"TL003": {"enabled": False}},
+    }))
+    sub = tmp_path / "pkg"
+    sub.mkdir()
+    from deepspeed_tpu.tools.threadlint.config import find_config
+    found = find_config(str(sub))
+    assert found == str(tmp_path / ".threadlint.json")
+    cfg = ThreadLintConfig.load(found)
+    assert not cfg.rule("TL003").enabled
+    assert cfg.lock_order == ["a.outer", "a.inner"]
+    assert cfg.baseline_path() == str(tmp_path / "bl.json")
+
+
+def test_repo_tree_is_clean():
+    """The shipped tree lints clean under the shipped config with an EMPTY
+    baseline — the CI gate (scripts/lint.sh)."""
+    import os
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    pkg = os.path.join(root, "deepspeed_tpu")
+    cfg_path = os.path.join(root, ".threadlint.json")
+    if not os.path.isdir(pkg) or not os.path.isfile(cfg_path):
+        pytest.skip("source tree layout not available")
+    cfg = ThreadLintConfig.load(cfg_path)
+    bl = cfg.baseline_path()
+    if bl:
+        from deepspeed_tpu.tools.jaxlint.baseline import load_baseline
+        assert load_baseline(bl) == {}, \
+            "the shipped threadlint baseline must stay EMPTY"
+    assert threadlint_main([pkg, "--config", cfg_path]) == 0
