@@ -293,6 +293,14 @@ class ZeroConfig(ConfigModel):
     stage3_max_reuse_distance: int = 1_000_000_000
     stage3_prefetch_bucket_size: int = 50_000_000
     stage3_param_persistence_threshold: int = 100_000
+    # Explicit ZeRO-3 collective schedule (runtime/zero/prefetch.py). None =
+    # unscheduled (implicit XLA placement, bit-for-bit the pre-schedule path).
+    # 0 = serial schedule (each wave's gather tied to its own input: gather-
+    # then-compute, no lookahead); d >= 1 = gathers issued d waves ahead of
+    # compute (double-buffered at d=1). With the schedule armed,
+    # allgather_bucket_size / reduce_bucket_size become the real wave/bucket
+    # byte bounds of the scheduled collectives instead of XLA combiner hints.
+    stage3_prefetch_depth: Optional[int] = None
     stage3_gather_16bit_weights_on_model_save: bool = False
     stage3_module_granularity_threshold: int = 0
     zero_hpz_partition_size: int = 1  # hierarchical (secondary) partition size, ZeRO++
@@ -323,6 +331,15 @@ class ZeroConfig(ConfigModel):
     def __post_init__(self):
         if not 0 <= self.stage <= 3:
             raise ConfigError(f"zero_optimization.stage must be in [0,3], got {self.stage}")
+        if self.stage3_prefetch_depth is not None:
+            if self.stage3_prefetch_depth < 0:
+                raise ConfigError(
+                    "zero_optimization.stage3_prefetch_depth must be >= 0 "
+                    f"(or null to disable the schedule), got {self.stage3_prefetch_depth}")
+            if self.stage != 3:
+                raise ConfigError(
+                    "zero_optimization.stage3_prefetch_depth requires stage 3 "
+                    f"(params are not sharded at stage {self.stage})")
 
 
 # --------------------------------------------------------------------------- #

@@ -475,7 +475,8 @@ class DecoderLM(nn.Module):
                 if cfg.alibi else None)
         x = apply_checkpointed_layers(
             self, x, lambda mdl, h, i: mdl.layers[i](h, positions, bias),
-            cfg.num_hidden_layers, cfg.remat, cfg.remat_policy)
+            cfg.num_hidden_layers, cfg.remat, cfg.remat_policy,
+            layers=self.layers, layer_args=(positions, bias))
         return self.final_norm(x)
 
     def forward_logits(self, input_ids, positions=None):

@@ -147,10 +147,21 @@ class GPT2LMHead(nn.Module):
                 return apply_layer_drop(x_new, h,
                                         pld_keep_prob(i, cfg.n_layer, theta0),
                                         jax.random.fold_in(key0, i))
+
+            def post_layer(x_new, h, i):
+                return apply_layer_drop(x_new, h,
+                                        pld_keep_prob(i, cfg.n_layer, theta0),
+                                        jax.random.fold_in(key0, i))
         else:
             call_layer = lambda mdl, h, i: mdl.blocks[i](h, deterministic)
+            post_layer = None
+        # the scheduled ZeRO-3 walk lifts blocks to pure apply calls, which
+        # cannot thread flax dropout RNGs — only offer it when deterministic
         x = apply_checkpointed_layers(self, x, call_layer, cfg.n_layer,
-                                      cfg.remat, cfg.remat_policy)
+                                      cfg.remat, cfg.remat_policy,
+                                      layers=self.blocks if deterministic else None,
+                                      layer_args=(deterministic,),
+                                      post_layer=post_layer)
         x = self.ln_f(x)
 
         if labels is None and isinstance(batch, dict) and "input_ids" in batch:

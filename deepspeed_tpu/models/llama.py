@@ -457,7 +457,8 @@ class LlamaForCausalLM(nn.Module):
             x = (x.astype(jnp.float32) * (cfg.hidden_size ** 0.5)).astype(x.dtype)
         x = apply_checkpointed_layers(
             self, x, lambda mdl, h, i: mdl.layers[i](h, positions),
-            cfg.num_hidden_layers, cfg.remat, cfg.remat_policy)
+            cfg.num_hidden_layers, cfg.remat, cfg.remat_policy,
+            layers=self.layers, layer_args=(positions,))
         return self.norm(x)
 
     def forward_logits(self, input_ids, positions=None):

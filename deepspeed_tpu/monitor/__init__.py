@@ -12,9 +12,11 @@ from deepspeed_tpu.monitor.serving import PipelineStats
 from deepspeed_tpu.monitor.trace import Tracer, tracer
 from deepspeed_tpu.monitor.training import (CheckpointStats,
                                             OffloadPipelineStats,
-                                            TrainPipelineStats)
+                                            TrainPipelineStats,
+                                            Zero3CommStats)
 
 __all__ = ["Monitor", "MonitorMaster", "TensorBoardMonitor", "WandbMonitor",
            "CsvMonitor", "PrometheusExporter", "TelemetryPump",
            "sanitize_metric_name", "PipelineStats", "TrainPipelineStats",
-           "OffloadPipelineStats", "CheckpointStats", "Tracer", "tracer"]
+           "OffloadPipelineStats", "CheckpointStats", "Zero3CommStats",
+           "Tracer", "tracer"]

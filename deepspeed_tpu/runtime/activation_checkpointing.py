@@ -227,14 +227,36 @@ def layer_chunks(n_layers: int) -> list:
 
 
 def apply_checkpointed_layers(module, carry, call_layer, n_layers: int,
-                              remat: bool = True, policy=None):
+                              remat: bool = True, policy=None, *,
+                              layers=None, layer_args=(), post_layer=None):
     """Apply ``n_layers`` layers with chunked rematerialisation.
 
     ``call_layer(module, carry, i) -> carry`` applies layer ``i``; layers must be
     reachable through ``module`` (setup-defined submodule lists), the flax lifted
     -transform contract. Model builders use this so the
     ``activation_checkpointing`` config block uniformly drives every family.
+
+    When the engine arms a ZeRO-3 collective schedule
+    (``zero_optimization.stage3_prefetch_depth``; ``runtime/zero/prefetch.py``)
+    and the model passes its bound layer stack via ``layers``, the walk routes
+    through the scheduled wave path instead: tie-pinned bucketed all-gathers
+    ``depth`` waves ahead of compute, wave-granular rematerialisation (the
+    schedule subsumes this function's chunked remat — gathered params are
+    never saved, so recompute is what frees them), reverse-order backward
+    re-gathers and reduce-scatter pipelined into each wave's backward.
+    ``layer_args`` are extra positional args for every layer call and
+    ``post_layer(new_x, prev_x, i)`` wraps each layer's output (progressive
+    layer drop). Models whose walk needs flax RNGs or a non-array carry keep
+    ``layers=None`` and always take the unscheduled path.
     """
+    if layers is not None:
+        from deepspeed_tpu.runtime.zero import prefetch
+        if prefetch.current_plan() is not None:
+            out = prefetch.scheduled_layer_walk(
+                list(layers)[:n_layers], carry,
+                layer_args=tuple(layer_args), post_layer=post_layer)
+            if out is not None:
+                return out
     if not remat:
         for i in range(n_layers):
             carry = call_layer(module, carry, i)

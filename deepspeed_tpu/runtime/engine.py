@@ -242,11 +242,15 @@ class DeepSpeedTPUEngine:
         # -- monitor (parity: MonitorMaster wiring, engine.py:249) ---------
         from deepspeed_tpu.monitor import (CheckpointStats, MonitorMaster,
                                            OffloadPipelineStats,
-                                           TrainPipelineStats)
+                                           TrainPipelineStats, Zero3CommStats)
         self.monitor = MonitorMaster(self.config)
         self.train_stats = TrainPipelineStats()
         self.offload_stats = OffloadPipelineStats()
         self.ckpt_stats = CheckpointStats()
+        # ZeRO-3 collective schedule (runtime/zero/prefetch.py): built lazily
+        # once params exist, armed around every trace of the fused step
+        self.zero3_stats = Zero3CommStats()
+        self._zero3_plan = None
         # span tracing (docs/OBSERVABILITY.md): config-reachable alongside
         # the DSTPU_TRACE env path initialize() arms
         tc = self.config.monitor.trace
@@ -422,6 +426,51 @@ class DeepSpeedTPUEngine:
                                  donate_argnums=donate)(model_parameters)
         self._state_shardings = shardings
         self._scaler_dynamic = bool(dynamic and fp16.loss_scale == 0)
+        self._maybe_build_zero3_plan(model_parameters)
+
+    def _maybe_build_zero3_plan(self, model_parameters):
+        """Build the ZeRO-3 collective schedule (runtime/zero/prefetch.py)
+        once params exist. ``stage3_prefetch_depth=None`` (the default) keeps
+        the implicit XLA-scheduled path bit-for-bit untouched. The schedule
+        composes with remat but not (yet) with offload, quantized weights, or
+        TP-sharded params — those combinations stay on the implicit path."""
+        z = self.config.zero_optimization
+        if (z.stage3_prefetch_depth is None or z.stage != 3
+                or self._offload_cfg is not None or self.quantized_weights
+                or self._tp_specs is not None
+                or not isinstance(model_parameters, dict)):
+            return
+        from deepspeed_tpu.runtime.zero import prefetch
+        names = prefetch.layer_stack_names(model_parameters)
+        if names is None:
+            logger.warning(
+                "stage3_prefetch_depth=%d set but no layer stack detected in "
+                "the param tree: staying on the implicit ZeRO-3 path",
+                z.stage3_prefetch_depth)
+            return
+        specs = self.partitioner.param_spec(model_parameters, self._tp_specs)
+        plan = prefetch.build_plan(
+            model_parameters, specs, names, depth=z.stage3_prefetch_depth,
+            allgather_bucket_size=z.allgather_bucket_size,
+            reduce_bucket_size=z.reduce_bucket_size)
+        if plan is None:
+            logger.warning(
+                "stage3_prefetch_depth=%d set but no layer has fsdp-sharded "
+                "leaves (all under stage3_param_persistence_threshold?): "
+                "staying on the implicit ZeRO-3 path", z.stage3_prefetch_depth)
+            return
+        import dataclasses as _dc
+        if _tracer.enabled:
+            # bake the taps into the plan BEFORE the step traces: the stamps
+            # feeding train/zero3/* spans + Zero3CommStats are debug callbacks
+            # compiled into the step, not host instrumentation
+            plan = _dc.replace(plan, trace_armed=True)
+        self._zero3_plan = plan
+        logger.info(
+            "zero3 collective schedule: %d waves over %d layers, depth=%d, "
+            "%.1f MB gathered/step, %.1f MB persistent",
+            plan.n_waves, len(names), plan.depth,
+            plan.gather_bytes_per_step / 1e6, plan.persistent_bytes / 1e6)
 
     # ------------------------------------------------------------------ #
     # ZeRO-Offload state + step (host/NVMe optimizer; parity: cpu_offload +
@@ -1067,7 +1116,11 @@ class DeepSpeedTPUEngine:
             return None
         z = self.config.zero_optimization
         opts = {}
-        if z.stage >= 1:
+        if z.stage >= 1 and self._zero3_plan is None:
+            # the explicit collective schedule retires these hints: bucket
+            # sizes bound the scheduled waves/buckets directly, and leaving
+            # XLA's combiner free to re-fuse them would fight the barriers
+            # (see runtime/zero/partition.py xla_bucket_flags deprecation note)
             from deepspeed_tpu.runtime.zero.partition import xla_bucket_flags
             opts.update(xla_bucket_flags(z.reduce_bucket_size,
                                          z.allgather_bucket_size))
@@ -1156,6 +1209,12 @@ class DeepSpeedTPUEngine:
         # callbacks read curriculum_scheduler.current_difficulty)
         if self.curriculum_scheduler is not None:
             self.curriculum_scheduler.update_difficulty(self.global_steps)
+        if self._zero3_plan is not None:
+            # arm the ambient schedule the model walk reads; re-armed every
+            # step so late (re)traces — shape changes, a second engine on this
+            # thread — still see THIS engine's plan
+            from deepspeed_tpu.runtime.zero import prefetch as zero3_prefetch
+            zero3_prefetch.configure(self._zero3_plan)
         if self._fused_step is None and self._offload is None:
             self._fused_step = jax.jit(self._build_fused_step(), donate_argnums=(0,),
                                        compiler_options=self._compiler_options())
@@ -1209,6 +1268,12 @@ class DeepSpeedTPUEngine:
             if queue_depth:
                 _tracer.counter("train/prefetch/queue_depth", queue_depth,
                                 lane="train/step")
+        if self._zero3_plan is not None and self._zero3_plan.trace_armed:
+            # stamps stream in from the step's debug callbacks as it executes;
+            # drain whatever segments have completed (the in-flight step's
+            # partial segment stays queued for the next drain)
+            from deepspeed_tpu.runtime.zero import prefetch as zero3_prefetch
+            zero3_prefetch.drain(_tracer, self.zero3_stats, self._zero3_plan)
         return metrics["loss"]
 
     def train_steps(self, n_steps: int, data_iter=None) -> np.ndarray:
@@ -1289,6 +1354,10 @@ class DeepSpeedTPUEngine:
         exit, and ``destroy()``; call it manually before reading monitor
         output mid-run."""
         self._drain_metric_queue(0)
+        if self._zero3_plan is not None and self._zero3_plan.trace_armed:
+            from deepspeed_tpu.runtime.zero import prefetch as zero3_prefetch
+            zero3_prefetch.drain(_tracer, self.zero3_stats, self._zero3_plan,
+                                 barrier=True)
 
     def _drain_metric_queue(self, leave: int):
         while len(self._pending_metrics) > leave:
@@ -1326,6 +1395,8 @@ class DeepSpeedTPUEngine:
                         self.offload_stats.events(samples))
                 if self.ckpt_stats.saves:
                     self.monitor.write_events(self.ckpt_stats.events(samples))
+                if self.zero3_stats.steps:
+                    self.monitor.write_events(self.zero3_stats.events(samples))
         if printing:
             loss = float(vals["loss"]) if "loss" in vals else float("nan")
             lr = float(vals["lr"])
@@ -1655,6 +1726,9 @@ class DeepSpeedTPUEngine:
         sh = NamedSharding(mesh, P(BATCH_AXES))
         mb = jax.tree_util.tree_map(lambda x: jax.device_put(x, sh),
                                     as_host_tree(batch))
+        if self._zero3_plan is not None:
+            from deepspeed_tpu.runtime.zero import prefetch as zero3_prefetch
+            zero3_prefetch.configure(self._zero3_plan)
         if self._eval_step is None:
             self._eval_step = jax.jit(self._loss_of)
         return float(self._eval_step(params, mb))

@@ -22,9 +22,15 @@ the reference hand-writes:
 Knob mapping:
   stage3_param_persistence_threshold -> small params stay replicated (same meaning
       as the reference: avoid allgather latency for tiny tensors).
-  reduce_bucket_size / allgather_bucket_size -> XLA combiner thresholds, exported
-      via xla_bucket_flags() (applied by the engine as jit compiler_options on
-      the fused step; TPU backend only — see Engine._compiler_options).
+  reduce_bucket_size / allgather_bucket_size -> unscheduled path: XLA combiner
+      thresholds, exported via xla_bucket_flags() (applied by the engine as jit
+      compiler_options on the fused step; TPU backend only — see
+      Engine._compiler_options). With stage3_prefetch_depth set they instead
+      become the wave/bucket sizes of the explicit collective schedule
+      (runtime/zero/prefetch.py) and the flag hints are dropped.
+  stage3_prefetch_depth -> arms the explicit schedule: tie-pinned bucketed
+      all-gathers `depth` waves ahead of compute, backward re-gathers in
+      reverse order, reduce-scatter pipelined into each wave's backward.
 """
 
 from __future__ import annotations
@@ -173,6 +179,44 @@ class ZeroPartitioner:
         return out
 
 
+def sharded_axes_of(spec: Any, axes) -> Optional[tuple]:
+    """Locate the dimension of ``spec`` sharded over any of ``axes``.
+
+    Returns ``(dim, matched_axes)`` for the first (and, for specs this
+    partitioner emits, only) dimension whose entry names one or more of the
+    given mesh axes, or None when the spec never touches them (replicated,
+    persistence-threshold, or tp-only leaves). ``matched_axes`` preserves the
+    entry's axis order — the tile order a gather must reassemble."""
+    if spec is None:
+        return None
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, (tuple, list)) else (entry,)
+        matched = tuple(n for n in names if n in axes)
+        if matched:
+            return dim, matched
+    return None
+
+
+def gathered_spec(spec: Any, axes) -> P:
+    """``spec`` with the given mesh axes stripped — the layout of a fully
+    gathered leaf (replicated over fsdp, still sharded over any tp axes)."""
+    if spec is None:
+        return P()
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+            continue
+        names = entry if isinstance(entry, (tuple, list)) else (entry,)
+        kept = tuple(n for n in names if n not in axes)
+        out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
 def xla_bucket_flags(reduce_bucket_size: int, allgather_bucket_size: int) -> dict:
     """Map ZeRO bucket sizes onto XLA collective-combiner thresholds.
 
@@ -181,7 +225,18 @@ def xla_bucket_flags(reduce_bucket_size: int, allgather_bucket_size: int) -> dic
     equivalents are the combine-threshold options of the collective-combiner
     HLO passes. Despite the historical ``xla_gpu_`` prefix these are the
     backend-generic spellings this toolchain's compile-option schema accepts
-    (the ``xla_tpu_*`` variants do not exist — probed on the real chip)."""
+    (the ``xla_tpu_*`` variants do not exist — probed on the real chip).
+
+    .. deprecated:: The flag hints only *suggest* granularity to XLA's
+       combiner passes and apply solely to the implicit (unscheduled) stage-3
+       path. When ``stage3_prefetch_depth`` arms the explicit collective
+       schedule (``runtime/zero/prefetch.py``), the same two config knobs
+       become the REAL wave/bucket sizes of the scheduled gathers and
+       reduce-scatters, and the engine omits these hints entirely — combining
+       a hand-bucketed collective again would undo the schedule. The helper
+       stays for the unscheduled TPU path; ``test_zero_partition.py`` asserts
+       both that it reaches jit compile options and that the scheduled path
+       drops it."""
     return {
         "xla_gpu_all_gather_combine_threshold_bytes": int(allgather_bucket_size),
         "xla_gpu_reduce_scatter_combine_threshold_bytes": int(reduce_bucket_size),

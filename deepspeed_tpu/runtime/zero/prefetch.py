@@ -1,0 +1,726 @@
+"""ZeRO-3 collective schedule: parameter prefetch + pipelined reduce-scatter.
+
+Stage-3 sharding (`ZeroPartitioner`) leaves every gather/reduce placement
+decision to XLA: params carry fsdp-sharded specs, the partitioner emits
+on-demand all-gathers wherever the scheduler likes, and grad reductions land
+after the whole backward. This module builds the *explicit* schedule instead
+(parity: DeepSpeed's ``PartitionedParameterCoordinator`` +
+``parameter_offload`` prefetch machinery, reference
+``runtime/zero/partitioned_param_coordinator.py``):
+
+* the model's layer stack is grouped into **waves** — consecutive layers whose
+  fsdp-sharded bytes fit ``allgather_bucket_size`` — and every wave's sharded
+  leaves are gathered by ONE bucketed all-gather (ravel → concat → all-gather
+  → split), not one collective per tensor;
+* wave ``w``'s gather is pinned into a two-sided issue window: a
+  ``lax.optimization_barrier`` tie to the activation entering wave
+  ``w - prefetch_depth`` is the lower bound (never issued earlier — the hard
+  residency bound), and a 1-element probe of the gather barriered into wave
+  ``w - prefetch_depth``'s compute INPUT is the upper bound (always finished
+  before that compute runs). The lookahead is forced by dataflow, not
+  best-effort hoisting — the program must prefetch even on a serial executor;
+* the backward re-gathers each wave's params tied to the **incoming
+  cotangent** (reverse layer order, inside the backward window) and recomputes
+  the wave forward from sharded residuals (wave-granular rematerialisation —
+  gathered params are never saved, so full-size buffers die at last use and
+  HBM stays at sharded + ``depth + 1`` waves);
+* grad reduce-scatter is the **transpose of the bucketed gather**: the wave
+  backward differentiates with respect to the *sharded* params, so shard_map
+  transposes the bucket's ``all_gather`` into a ``psum_scatter`` over the same
+  bucket layout — a true bucketed reduce-scatter pipelined into each wave's
+  backward, with ``reduce_bucket_size`` bounding the backward bucket size.
+
+Everything is expressed INSIDE the jitted step — there is no host
+orchestration and no extra compiled program; ``prefetch_depth=None`` keeps the
+implicit path bit-for-bit untouched.
+
+Scheduling changes placement, never math: gather bucketing is pure data
+movement and the transpose reduce-scatter sums the same partials in the same
+participant order, so per-step loss streams are byte-identical across depth
+0/1/2 and any bucket size (the train_bench ``--zero3-overlap`` gate).
+
+Observability (PR 7 stats-equals-spans discipline): when tracing is armed at
+compile time, each gather / free / reduce-scatter emits a
+``jax.debug.callback`` stamp. Static tags are bound with ``functools.partial``
+and the only operand is a 1-element **explicitly replicated** probe slice —
+passing python values as callback operands deadlocks under the forced-host
+8-device mesh, and an unconstrained probe fires per-shard. The host drains the
+stamp ledger into ``train/zero3/{gather,free,reduce_scatter}`` tracer spans
+and the same segments feed ``monitor.training.Zero3CommStats``.
+
+Known lowering honesty: spans and stats name the *logical* collective. On the
+forced-host CPU backend the bucketed gather lowers to a real ``all-gather``
+and the transpose to a real ``reduce-scatter`` HLO; per-tensor
+``with_sharding_constraint`` reductions (the implicit path) instead lower to
+``all-reduce + slice`` because XLA:CPU lacks the rewrite pass.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.mesh import FSDP_AXES
+from deepspeed_tpu.runtime.zero.partition import gathered_spec, sharded_axes_of
+
+__all__ = [
+    "Zero3Wave", "Zero3Plan", "build_plan", "configure", "current_plan",
+    "scheduled_layer_walk", "drain", "stamps_per_step", "clear_stamps",
+    "layer_stack_names",
+]
+
+
+def layer_stack_names(params: Any) -> Optional[List[str]]:
+    """Detect the model's layer stack among top-level param keys.
+
+    Flax scans name repeated submodules ``{prefix}_{i}`` (gpt2 ``h_0..h_N``,
+    llama/decoder ``layers_0..N``); the largest contiguous integer-suffixed
+    group IS the stack. Returns the keys in model order, or None when no
+    group of >= 2 consecutive layers exists (nothing to schedule)."""
+    import re
+    if not isinstance(params, dict):
+        return None
+    groups: Dict[str, List[Tuple[int, str]]] = {}
+    for k in params:
+        m = re.fullmatch(r"(.+?)_(\d+)", str(k))
+        if m:
+            groups.setdefault(m.group(1), []).append((int(m.group(2)), str(k)))
+    if not groups:
+        return None
+    members = max(groups.values(), key=len)
+    members.sort()
+    if len(members) < 2 or [i for i, _ in members] != list(range(len(members))):
+        return None
+    return [k for _, k in members]
+
+
+# --------------------------------------------------------------------------- #
+# Plan
+# --------------------------------------------------------------------------- #
+
+@dataclasses.dataclass(frozen=True)
+class _LeafPlan:
+    """One fsdp-sharded leaf inside a wave bucket."""
+    layer: str                 # top-level param key, e.g. "h_3"
+    path: Tuple[str, ...]      # path inside the layer's param dict
+    spec: Any                  # full PartitionSpec (fsdp + any tp axes)
+    out_spec: Any              # spec with fsdp axes stripped (the gathered spec)
+    dim: int                   # dimension carrying the fsdp axes
+    axes: Tuple[str, ...]      # the fsdp mesh axes sharding `dim`
+    nbytes: int                # full (gathered) size in bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class Zero3Wave:
+    index: int
+    layers: Tuple[str, ...]          # layer names, model order
+    leaves: Tuple[_LeafPlan, ...]    # gatherable leaves of those layers
+    gather_bytes: int                # sum of leaf nbytes
+
+
+@dataclasses.dataclass(frozen=True)
+class Zero3Plan:
+    """Static collective schedule for one model's layer stack."""
+    waves: Tuple[Zero3Wave, ...]
+    depth: int                       # prefetch lookahead in waves (>= 0)
+    layer_wave: Dict[str, int]       # layer name -> wave index
+    allgather_bucket_size: int
+    reduce_bucket_size: int
+    # leaves NOT gathered (replicated / persistence-threshold / tp-only):
+    # schedule leaves them alone; recorded for the residency/bench story.
+    persistent_bytes: int
+    trace_armed: bool = False        # baked at first trace; taps emitted iff True
+
+    @property
+    def n_waves(self) -> int:
+        return len(self.waves)
+
+    @property
+    def gather_bytes_per_step(self) -> int:
+        # forward gather + backward re-gather of every wave
+        return 2 * sum(w.gather_bytes for w in self.waves)
+
+
+def _leaf_paths(tree) -> List[Tuple[Tuple[str, ...], Any]]:
+    """Flatten a (nested-dict) param tree to (path, leaf) with string keys."""
+    out: List[Tuple[Tuple[str, ...], Any]] = []
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k in sorted(node):
+                walk(prefix + (str(k),), node[k])
+        else:
+            out.append((prefix, node))
+
+    walk((), tree)
+    return out
+
+
+def build_plan(params: Any, specs: Any, layer_names: Sequence[str], *,
+               depth: int, allgather_bucket_size: int,
+               reduce_bucket_size: int, mesh=None) -> Optional[Zero3Plan]:
+    """Build the wave schedule from a param tree + aligned spec tree.
+
+    ``layer_names`` are the top-level keys of the model's layer stack in
+    model order (e.g. ``["h_0", "h_1", ...]``). Consecutive layers are packed
+    into one wave while the wave's gatherable bytes stay within
+    ``allgather_bucket_size`` (every wave holds at least one layer, so a
+    bucket size smaller than a single layer degrades to per-layer waves).
+    Returns None when no layer has a gatherable leaf (nothing to schedule).
+    """
+    waves: List[Zero3Wave] = []
+    cur_layers: List[str] = []
+    cur_leaves: List[_LeafPlan] = []
+    cur_bytes = 0
+    persistent_bytes = 0
+
+    def flush():
+        nonlocal cur_layers, cur_leaves, cur_bytes
+        if cur_layers:
+            waves.append(Zero3Wave(len(waves), tuple(cur_layers),
+                                   tuple(cur_leaves), cur_bytes))
+            cur_layers, cur_leaves, cur_bytes = [], [], 0
+
+    for name in layer_names:
+        lp = params[name]
+        ls = specs[name]
+        flat_p = _leaf_paths(lp)
+        flat_s = dict(_leaf_paths(ls))
+        layer_leaves: List[_LeafPlan] = []
+        for path, leaf in flat_p:
+            spec = flat_s.get(path, P())
+            dim_axes = sharded_axes_of(spec, FSDP_AXES)
+            if dim_axes is None:
+                # replicated or tp-only: persistence threshold / small params —
+                # never gathered, never reduced by the schedule
+                persistent_bytes += leaf.size * leaf.dtype.itemsize
+                continue
+            dim, axes = dim_axes
+            layer_leaves.append(_LeafPlan(
+                layer=name, path=path, spec=spec,
+                out_spec=gathered_spec(spec, FSDP_AXES), dim=dim, axes=axes,
+                nbytes=int(leaf.size) * leaf.dtype.itemsize))
+        lbytes = sum(l.nbytes for l in layer_leaves)
+        if cur_layers and cur_bytes + lbytes > allgather_bucket_size:
+            flush()
+        cur_layers.append(name)
+        cur_leaves.extend(layer_leaves)
+        cur_bytes += lbytes
+    flush()
+
+    if not any(w.leaves for w in waves):
+        return None
+    layer_wave = {name: w.index for w in waves for name in w.layers}
+    return Zero3Plan(waves=tuple(waves), depth=int(depth),
+                     layer_wave=layer_wave,
+                     allgather_bucket_size=int(allgather_bucket_size),
+                     reduce_bucket_size=int(reduce_bucket_size),
+                     persistent_bytes=persistent_bytes)
+
+
+# --------------------------------------------------------------------------- #
+# Ambient plan state (mirrors activation_checkpointing.configure/current_policy)
+# --------------------------------------------------------------------------- #
+
+class _PrefetchState(threading.local):
+    def __init__(self):
+        super().__init__()
+        self.plan: Optional[Zero3Plan] = None
+
+
+_STATE = _PrefetchState()
+
+
+def configure(plan: Optional[Zero3Plan]) -> None:
+    """Arm (or clear, with None) the ambient schedule the model walk reads."""
+    _STATE.plan = plan
+
+
+def current_plan() -> Optional[Zero3Plan]:
+    return _STATE.plan
+
+
+# --------------------------------------------------------------------------- #
+# Stamp ledger (host side of the in-jit taps)
+# --------------------------------------------------------------------------- #
+
+# (wave_index, kind, perf_counter). Kinds, in per-wave program order:
+#   fwd:  "gather_start" "gather_end" "free"
+#   bwd:  "bwd_gather_start" "bwd_gather_end" "rs_start" "rs_end"
+_LEDGER: List[Tuple[int, str, float]] = []
+_LEDGER_LOCK = threading.Lock()
+
+_FWD_KINDS = ("gather_start", "gather_end", "free")
+_BWD_KINDS = ("bwd_gather_start", "bwd_gather_end", "rs_start", "rs_end")
+
+
+def stamps_per_step(plan: Zero3Plan, with_backward: bool = True) -> int:
+    per = len(_FWD_KINDS) + (len(_BWD_KINDS) if with_backward else 0)
+    return per * plan.n_waves
+
+
+def clear_stamps() -> None:
+    with _LEDGER_LOCK:
+        _LEDGER.clear()
+
+
+def _record(wave: int, kind: str, _probe) -> None:
+    # Host callback target. Static tags arrive partial-bound; the jax operand
+    # is only the replicated probe establishing the device-timeline dependency.
+    with _LEDGER_LOCK:
+        _LEDGER.append((wave, kind, time.perf_counter()))
+
+
+def _tap(tree, mesh, wave: int, kind: str):
+    """Stamp the moment `tree` becomes available on the device timeline.
+
+    The probe is a 1-element slice explicitly constrained replicated: the
+    callback then fires exactly once per execution (not per shard) and its
+    host timestamp tracks the producing op's completion. Returns `tree`
+    unchanged — taps are read-only and never alter math.
+    """
+    leaf = jax.tree_util.tree_leaves(tree)[0]
+    probe = jax.lax.with_sharding_constraint(
+        jnp.ravel(leaf)[:1], NamedSharding(mesh, P()))
+    jax.debug.callback(functools.partial(_record, wave, kind), probe)
+    return tree
+
+
+# --------------------------------------------------------------------------- #
+# Bucketed differentiable gather
+# --------------------------------------------------------------------------- #
+
+@jax.custom_vjp
+def _tied(lv, t):
+    out = jax.lax.optimization_barrier(tuple(lv) + (t,))
+    return tuple(out[:-1])
+
+
+def _tied_fwd(lv, t):
+    return _tied(lv, t), t
+
+
+def _tied_bwd(t, ct):
+    return tuple(ct), jnp.zeros_like(t)
+
+
+_tied.defvjp(_tied_fwd, _tied_bwd)
+
+
+def _tie_barrier(leaves: Sequence[Any], tie):
+    """Pin `leaves` behind `tie` with an optimization_barrier, opaque to AD.
+
+    The barrier makes `tie` a data dependency of every leaf, so XLA cannot
+    issue the op consuming them before `tie` exists — that placement IS the
+    schedule. ``optimization_barrier`` has no differentiation rule, so the
+    custom_vjp routes cotangents straight through (identity) and sends `tie`
+    a symbolic zero. `tie` is a formal argument, not a closure: closing a
+    custom_vjp over a tracer from the surrounding differentiation scope
+    leaks it (UnexpectedTracerError under grad-of-walk).
+    """
+    return _tied(tuple(leaves), tie)
+
+
+def _bucketize(leaves: Sequence[_LeafPlan], limit: int) -> List[List[int]]:
+    """Group leaf indices into buckets of <= limit bytes (>= 1 leaf each),
+    keyed by (fsdp axes, dtype-compatible ravel) — one fused collective per
+    bucket. Leaves with different fsdp axes cannot share an all-gather."""
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    cur_axes: Optional[Tuple[str, ...]] = None
+    for i, lp in enumerate(leaves):
+        if cur and (lp.axes != cur_axes or cur_bytes + lp.nbytes > limit):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_axes = lp.axes
+        cur_bytes += lp.nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def _fused_allgather(*locals_, plans: Sequence[_LeafPlan],
+                     n_shards: int, axes: Tuple[str, ...]):
+    """shard_map inner: one all-gather for the whole bucket.
+
+    Ravel every local shard into one flat buffer, gather once, then carve each
+    leaf back out and reassemble its sharded dimension (shard s owns block s
+    of dim `lp.dim`, row-major over the fsdp axes — GSPMD's tile order).
+    """
+    flat = jnp.concatenate([jnp.ravel(l) for l in locals_])
+    full = jax.lax.all_gather(flat, axes)          # (n_shards, bucket_local)
+    outs = []
+    off = 0
+    for l, lp in zip(locals_, plans):
+        seg = full[:, off:off + l.size].reshape((n_shards,) + l.shape)
+        outs.append(jnp.concatenate(
+            [seg[s] for s in range(n_shards)], axis=lp.dim))
+        off += l.size
+    return tuple(outs)
+
+
+def _gather_wave(plan: Zero3Plan, wave: Zero3Wave, ptrees: Dict[str, Any],
+                 tie, mesh, *, bucket_limit: int, tap_prefix: Optional[str]):
+    """Gather a wave's sharded leaves (bucketed, differentiable, tie-pinned).
+
+    Returns per-layer param dicts with gathered leaves substituted. The
+    transpose of each bucket's all_gather is a psum_scatter over the same
+    bucket — differentiating through this function w.r.t. the sharded leaves
+    yields the bucketed reduce-scatter of their grads.
+    """
+    from ...utils.jax_compat import shard_map
+
+    leaves = [ptrees[lp.layer] for lp in wave.leaves]
+    for i, lp in enumerate(wave.leaves):
+        node = leaves[i]
+        for k in lp.path:
+            node = node[k]
+        leaves[i] = node
+
+    leaves = list(_tie_barrier(leaves, tie))
+    if tap_prefix is not None:
+        leaves[0] = _tap(leaves[0], mesh, wave.index, tap_prefix + "_start")
+
+    gathered: List[Any] = [None] * len(leaves)
+    for bucket in _bucketize(wave.leaves, bucket_limit):
+        plans = [wave.leaves[i] for i in bucket]
+        axes = plans[0].axes
+        n_shards = 1
+        for a in axes:
+            n_shards *= mesh.shape[a]
+        fn = shard_map(
+            functools.partial(_fused_allgather, plans=plans,
+                              n_shards=n_shards, axes=axes),
+            mesh=mesh,
+            in_specs=tuple(lp.spec for lp in plans),
+            out_specs=tuple(lp.out_spec for lp in plans),
+            check_vma=False)
+        outs = fn(*[leaves[i] for i in bucket])
+        for i, g in zip(bucket, outs):
+            gathered[i] = g
+
+    if tap_prefix is not None:
+        gathered[0] = _tap(gathered[0], mesh, wave.index, tap_prefix + "_end")
+
+    out = {name: ptrees[name] for name in wave.layers}
+    for lp, g in zip(wave.leaves, gathered):
+        node = out[lp.layer] = dict(out[lp.layer])
+        for k in lp.path[:-1]:
+            node[k] = dict(node[k])
+            node = node[k]
+        node[lp.path[-1]] = g
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# The scheduled wave (custom_vjp)
+# --------------------------------------------------------------------------- #
+
+def _make_gather_fn(plan: Zero3Plan, wave: Zero3Wave, mesh):
+    """custom_vjp gather: fwd = tie-pinned bucketed all-gather of the wave's
+    sharded leaves; bwd = the bucketed reduce-scatter (the gather's transpose
+    over ``reduce_bucket_size`` buckets), so grads arriving on the gathered
+    buffers leave this node already reduced + scattered to the param
+    sharding — pipelined into the backward at this wave's position."""
+    taps = plan.trace_armed
+
+    @jax.custom_vjp
+    def gather_fn(ptrees, tie):
+        return _gather_wave(plan, wave, ptrees, tie, mesh,
+                            bucket_limit=plan.allgather_bucket_size,
+                            tap_prefix="gather" if taps else None)
+
+    def gather_fwd(ptrees, tie):
+        return gather_fn(ptrees, tie), (ptrees, tie)
+
+    def gather_bwd(res, ct):
+        ptrees, tie = res
+        if taps:
+            ct = _tap(ct, mesh, wave.index, "rs_start")
+        # transpose of the bucketed gather = bucketed psum_scatter: jax.vjp
+        # of a fresh (untapped) gather gives it over reduce_bucket_size
+        # buckets; the unused primal all-gather is dead code XLA removes.
+        _, vjp_fn = jax.vjp(
+            lambda pt: _gather_wave(plan, wave, pt, tie, mesh,
+                                    bucket_limit=plan.reduce_bucket_size,
+                                    tap_prefix=None), ptrees)
+        (gp,) = vjp_fn(ct)
+        if taps:
+            gp = _tap(gp, mesh, wave.index, "rs_end")
+        return gp, jnp.zeros_like(tie)
+
+    gather_fn.defvjp(gather_fwd, gather_bwd)
+    return gather_fn
+
+
+def _make_compute_fn(plan: Zero3Plan, wave: Zero3Wave, mesh,
+                     layer_call: Callable[[str, Any, Any], Any]):
+    """custom_vjp wave compute: fwd consumes the (prefetched) gathered params
+    and saves only SHARDED residuals — the gathered buffers' last use is this
+    wave's forward, so XLA's liveness frees them here (the HBM bound). bwd
+    re-gathers tied to the incoming cotangent (reverse order, inside the
+    backward window), recomputes the wave (wave-granular remat), and routes
+    the param grads out through the ``gathered`` input's cotangent — i.e.
+    into the gather node's transpose reduce-scatter."""
+    taps = plan.trace_armed
+
+    def run(gathered, x):
+        for name in wave.layers:
+            x = layer_call(name, gathered[name], x)
+        return x
+
+    @jax.custom_vjp
+    def compute_fn(gathered, ptrees, x):
+        return run(gathered, x)
+
+    def compute_fwd(gathered, ptrees, x):
+        y = run(gathered, x)
+        if taps:
+            # y's readiness marks the gathered buffers' last forward use:
+            # nothing downstream references them (residuals are sharded)
+            y = _tap(y, mesh, wave.index, "free")
+        return y, (ptrees, x)
+
+    def compute_bwd(res, ct):
+        ptrees, x = res
+        if taps:
+            ct = _tap(ct, mesh, wave.index, "bwd_gather_start_pre")
+        regathered = _gather_wave(plan, wave, ptrees, ct, mesh,
+                                  bucket_limit=plan.reduce_bucket_size,
+                                  tap_prefix="bwd_gather" if taps else None)
+        _, vjp_fn = jax.vjp(run, regathered, x)
+        g_gathered, gx = vjp_fn(ct)
+        # param grads leave via g_gathered (the gather node reduce-scatters
+        # them); the direct ptrees input only feeds the bwd re-gather
+        g_ptrees = jax.tree_util.tree_map(jnp.zeros_like, ptrees)
+        return g_gathered, g_ptrees, gx
+
+    compute_fn.defvjp(compute_fwd, compute_bwd)
+    return compute_fn
+
+
+def scheduled_layer_walk(layers: Sequence[Any], carry, *,
+                         layer_args: Tuple[Any, ...] = (),
+                         post_layer: Optional[Callable[[Any, Any, int], Any]] = None):
+    """Walk a flax layer stack under the ambient Zero3Plan.
+
+    ``layers`` are the parent's BOUND submodules (e.g. ``self.blocks``);
+    each is unbound so the wave can call it as a pure function of its
+    (gathered) params. ``layer_args`` are extra positional args passed to
+    every layer call; ``post_layer(new_x, prev_x, i)`` wraps each layer's
+    output (progressive layer drop). Layers needing flax RNGs (live dropout)
+    are not supported — callers gate on deterministic.
+
+    Returns None when the ambient plan does not cover these layers, in which
+    case the caller must fall back to the unscheduled walk.
+    """
+    plan = current_plan()
+    if plan is None:
+        return None
+    names = []
+    for m in layers:
+        name = getattr(m, "name", None)
+        if name is None or name not in plan.layer_wave:
+            return None          # plan built for a different model: fall back
+        names.append(name)
+    if [w for w in sorted({plan.layer_wave[n] for n in names})] != \
+            list(range(plan.n_waves)):
+        return None
+
+    from deepspeed_tpu.comm.mesh import get_topology
+    mesh = get_topology().mesh
+
+    unbound: Dict[str, Any] = {}
+    other_vars: Dict[str, Any] = {}
+    ptrees: Dict[str, Any] = {}
+    index_of: Dict[str, int] = {}
+    try:
+        for i, m in enumerate(layers):
+            mod, variables = m.unbind()
+            if "params" not in variables:
+                return None      # init pass: params are being created
+            ptrees[m.name] = variables["params"]
+            other_vars[m.name] = {k: v for k, v in variables.items()
+                                  if k != "params"}
+            unbound[m.name] = mod
+            index_of[m.name] = i
+    except Exception:
+        return None              # unbound/unbindable context: unscheduled walk
+
+    def layer_call(name: str, pv, x):
+        y = unbound[name].apply({"params": pv, **other_vars[name]},
+                                x, *layer_args)
+        if post_layer is not None:
+            y = post_layer(y, x, index_of[name])
+        return y
+
+    # Software-pipelined walk: entering wave w, issue gathers up through wave
+    # w + depth (tie = the CURRENT carry, i.e. the activation entering wave w
+    # — the lower bound on issue), then pin this wave's compute input on a
+    # 1-element probe of the newly issued gathers. The pin is the upper
+    # bound: the compiled program MUST finish gather w+depth before compute w
+    # can run, so the lookahead is forced by dataflow, not left to the
+    # scheduler's goodwill — gather windows land under the previous waves'
+    # residency windows even on a serial executor, and overlap compute for
+    # real wherever collectives run async.
+    n_w = plan.n_waves
+    pending: Dict[int, Any] = {}
+    for w, wave in enumerate(plan.waves):
+        issued: List[int] = []
+        for v in range(w, min(w + plan.depth, n_w - 1) + 1):
+            if v not in pending:
+                gf = _make_gather_fn(plan, plan.waves[v], mesh)
+                pending[v] = gf(
+                    {n: ptrees[n] for n in plan.waves[v].layers}, carry)
+                issued.append(v)
+        gathered = pending.pop(w)
+        probes = [jnp.ravel(jax.tree_util.tree_leaves(pending[v])[0])[:1]
+                  for v in issued if v in pending]
+        if probes:
+            (carry,) = _tie_barrier([carry], jnp.concatenate(probes))
+        cf = _make_compute_fn(plan, wave, mesh, layer_call)
+        carry = cf(gathered, {n: ptrees[n] for n in wave.layers}, carry)
+    return carry
+
+
+# --------------------------------------------------------------------------- #
+# Drain: stamps -> tracer spans + Zero3CommStats segments
+# --------------------------------------------------------------------------- #
+
+def drain(tracer=None, stats=None, plan: Optional[Zero3Plan] = None, *,
+          barrier: bool = False) -> int:
+    """Convert accumulated stamps into tracer spans and stats records.
+
+    Stamps arrive in device program order (one execution stream), so a new
+    forward pass is delimited by wave 0's ``gather_start``. A segment that
+    contains backward stamps is a training step; one without is an eval/fwd
+    pass (recorded only as spans). Returns the number of complete segments
+    drained; a trailing partial segment (step still in flight) stays queued.
+    ``barrier=True`` waits for all in-flight debug callbacks first (the final
+    drain: blocking on the step's outputs does NOT flush its callbacks).
+    """
+    plan = plan or current_plan()
+    if plan is None:
+        return 0
+    if barrier:
+        jax.effects_barrier()
+    with _LEDGER_LOCK:
+        stamps = list(_LEDGER)
+    if not stamps:
+        return 0
+
+    # Each tap fires exactly once per execution, so a repeated (wave, kind)
+    # key marks the next execution's first stamp — robust to XLA reordering
+    # same-tie gathers (wave 1's prefetch may legally land before wave 0's).
+    segments: List[Dict[Tuple[int, str], float]] = []
+    cur: Dict[Tuple[int, str], float] = {}
+    for wave, kind, t in stamps:
+        if (wave, kind) in cur:
+            segments.append(cur)
+            cur = {}
+        cur[(wave, kind)] = t
+    # the trailing segment may still be streaming in: flush it only when it
+    # is provably complete — a full training pass (every wave's rs_end), or,
+    # after an effects barrier, a full forward-only pass (eval)
+    n = plan.n_waves
+    full_train = all((w, "rs_end") in cur for w in range(n))
+    full_fwd = (all((w, "free") in cur for w in range(n))
+                and all(k in _FWD_KINDS for _, k in cur))
+    if full_train or (barrier and full_fwd):
+        segments.append(cur)
+        cur = {}
+    if not segments:
+        return 0
+    consumed = len(stamps) - len(cur)
+    with _LEDGER_LOCK:
+        del _LEDGER[:consumed]
+
+    for per in segments:
+        _emit_segment(per, plan, tracer, stats)
+    return len(segments)
+
+
+def _emit_segment(per: Dict[Tuple[int, str], float], plan: Zero3Plan,
+                  tracer, stats) -> None:
+    n = plan.n_waves
+    fwd_gather = bwd_gather = rs = overlap = 0.0
+    spans_gather: List[Tuple[float, float]] = []
+    spans_free: List[Tuple[float, float]] = []
+    has_bwd = any((w, "rs_end") in per for w in range(n))
+    emit: Dict[str, List[Tuple[float, float, str, Dict[str, Any]]]] = {}
+    for w in range(n):
+        gs, ge = per.get((w, "gather_start")), per.get((w, "gather_end"))
+        fr = per.get((w, "free"))
+        wave_bytes = plan.waves[w].gather_bytes
+        if gs is not None and ge is not None:
+            fwd_gather += ge - gs
+            spans_gather.append((gs, ge))
+            emit.setdefault("train/zero3/gather", []).append(
+                (gs, ge, f"train/zero3/gather/w{w}",
+                 dict(wave=w, phase="fwd", bytes=wave_bytes)))
+        if ge is not None and fr is not None:
+            # residency window of the gathered buffers: gather done -> last use
+            spans_free.append((ge, fr))
+            emit.setdefault("train/zero3/free", []).append(
+                (ge, fr, f"train/zero3/free/w{w}",
+                 dict(wave=w, bytes=wave_bytes)))
+        bs = per.get((w, "bwd_gather_start"),
+                     per.get((w, "bwd_gather_start_pre")))
+        be = per.get((w, "bwd_gather_end"))
+        if bs is not None and be is not None:
+            bwd_gather += be - bs
+            spans_gather.append((bs, be))
+            emit.setdefault("train/zero3/gather", []).append(
+                (bs, be, f"train/zero3/gather/w{w}.bwd",
+                 dict(wave=w, phase="bwd", bytes=wave_bytes)))
+        r0, r1 = per.get((w, "rs_start")), per.get((w, "rs_end"))
+        if r0 is not None and r1 is not None:
+            rs += r1 - r0
+            emit.setdefault("train/zero3/reduce_scatter", []).append(
+                (r0, r1, f"train/zero3/reduce_scatter/w{w}",
+                 dict(wave=w, bytes=wave_bytes)))
+    if tracer is not None and tracer.enabled:
+        # spans on one lane CAN overlap (depth+1 residency windows live at
+        # once — that's the schedule working); Chrome-trace B/E pairs on one
+        # track must nest, so pack each lane's spans greedily onto
+        # overlap-free slot sub-lanes. Slot 0 keeps the bare lane name; the
+        # number of slots a lane needs IS the concurrency it exhibited
+        # (free: depth+1 rows = the double-buffer bound, made visible).
+        for base, items in emit.items():
+            slot_ends: List[float] = []
+            for t0, t1, name, args in sorted(items, key=lambda s: s[:2]):
+                for k, end in enumerate(slot_ends):
+                    if t0 >= end:
+                        slot = k
+                        break
+                else:
+                    slot = len(slot_ends)
+                    slot_ends.append(t1)
+                slot_ends[slot] = t1
+                tracer.add(name, t0, t1,
+                           lane=base if slot == 0 else f"{base}/{slot}",
+                           **args)
+    # overlap: gather windows intersected with OTHER waves' residency/compute
+    # windows (a gather under its own wave's compute is not prefetch)
+    gather_total = 0.0
+    for i, (gs, ge) in enumerate(spans_gather):
+        gather_total += ge - gs
+        for j, (cs, cf) in enumerate(spans_free):
+            lo, hi = max(gs, cs), min(ge, cf)
+            if hi > lo:
+                overlap += hi - lo
+    frac = (overlap / gather_total) if gather_total > 0 else 0.0
+    if stats is not None and has_bwd:
+        stats.record_step(fwd_gather_s=fwd_gather, bwd_gather_s=bwd_gather,
+                          reduce_scatter_s=rs, overlap_s=overlap,
+                          overlap_frac=frac,
+                          gather_bytes=plan.gather_bytes_per_step,
+                          n_waves=n)

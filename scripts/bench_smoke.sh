@@ -108,6 +108,15 @@ timeout -k 10 300 python benchmarks/train_bench.py --smoke --preempt || exit 1
 timeout -k 10 300 python benchmarks/train_bench.py --smoke --trace-overhead \
     || exit 1
 
+# ZeRO-3 collective-schedule leg (docs/TRAINING.md "ZeRO-3 collective
+# schedule"): prefetch depth 0 vs 1/2 over an 8-way forced-host fsdp mesh —
+# gating byte-identical loss streams across depths, zero timed compiles,
+# and span-measured gather/compute overlap (zero at depth 0, nonzero at
+# depth >= 1); emits the train/zero3 trace lanes trace_check requires below
+# (the >=1.15x steps/sec bar applies on async-collective hardware, BENCH_r16)
+timeout -k 10 300 python benchmarks/train_bench.py --smoke --zero3-overlap \
+    || exit 1
+
 # serving-side tracer/attribution overhead leg (docs/OBSERVABILITY.md):
 # the same router workload with flow tracing + phase attribution ON vs
 # OFF; correctness gates here (byte-identical streams, zero compiles),
@@ -123,8 +132,8 @@ timeout -k 10 300 python benchmarks/serving_bench.py --trace-overhead \
 # parseable flight-recorder dump from the --preempt kills
 timeout -k 10 120 python scripts/trace_check.py "$TRACE_DIR" \
     --require train serve serve/req serve/spec serve/router serve/health \
-    serve/lora ckpt train/offload --require-flows serve/req --expect-crash \
-    || exit 1
+    serve/lora ckpt train/offload train/zero3 --require-flows serve/req \
+    --expect-crash || exit 1
 
 # clock-align + merge the per-process trace files into one timeline; the
 # merged file must pass the same flow-aware checks (stitched chains keep

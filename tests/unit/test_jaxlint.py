@@ -1135,3 +1135,85 @@ def test_shipped_baseline_stays_empty():
     with open(path) as f:
         baseline = json.load(f)
     assert baseline.get("entries") == {}
+
+
+def test_jl007_zero3_prefetch_path_policed():
+    """The ZeRO-3 collective schedule (runtime/zero/prefetch.py) is hot-path
+    policed by the SHIPPED config: a stray blocking fetch while draining the
+    stamp ledger re-serialises the very gather/compute overlap the schedule
+    exists to create."""
+    raw = _repo_config()
+    assert "deepspeed_tpu/runtime/zero/prefetch.py" in \
+        raw["rules"]["JL007"]["options"]["hot_paths"]
+    assert "deepspeed_tpu/runtime/zero/prefetch.py" in \
+        raw["rules"]["JL008"]["options"]["hot_paths"]
+    cfg = LintConfig(rules={"JL007": RuleSettings(
+        options=raw["rules"]["JL007"]["options"])})
+    src = textwrap.dedent("""
+        import numpy as np
+
+        def drain(ledger):
+            return [np.asarray(t) for t in ledger]
+    """)
+    findings = lint_text(src, path="deepspeed_tpu/runtime/zero/prefetch.py",
+                         config=cfg)
+    assert rules_of(findings) == ["JL007"]
+
+
+def test_jl007_zero3_prefetch_discipline_clean():
+    # the module's actual discipline: stamps are host floats recorded by
+    # debug-callback taps; the drain aggregates them without ever touching
+    # a device array
+    raw = _repo_config()
+    cfg = LintConfig(rules={"JL007": RuleSettings(
+        options=raw["rules"]["JL007"]["options"])})
+    src = textwrap.dedent("""
+        import time
+
+        _LEDGER = []
+
+        def _record(wave, kind, _probe):
+            _LEDGER.append((wave, kind, time.perf_counter()))
+
+        def drain(tracer, plan):
+            stamps = list(_LEDGER)
+            for wave, kind, t in stamps:
+                tracer.add("train/zero3/gather", t, t,
+                           lane="train/zero3/gather", wave=wave)
+            return len(stamps)
+    """)
+    findings = lint_text(src, path="deepspeed_tpu/runtime/zero/prefetch.py",
+                         config=cfg)
+    assert findings == []
+
+
+def test_jl008_zero3_prefetch_span_policed():
+    """Under the SHIPPED config a device fetch inside a train/zero3 span
+    fires (the span would time the fetch, not the collective); the drain's
+    actual shape — host-float spans emitted after the fact — is clean."""
+    raw = _repo_config()
+    cfg = LintConfig(rules={"JL008": RuleSettings(
+        options=raw["rules"]["JL008"]["options"])})
+    src = textwrap.dedent("""
+        import jax
+        from deepspeed_tpu.monitor.trace import tracer
+
+        def emit(probe):
+            with tracer.span("train/zero3/gather"):
+                return jax.device_get(probe)
+    """)
+    findings = lint_text(src, path="deepspeed_tpu/runtime/zero/prefetch.py",
+                         config=cfg)
+    assert "JL008" in rules_of(findings)
+    clean = textwrap.dedent("""
+        from deepspeed_tpu.monitor.trace import tracer
+
+        def emit(segments):
+            for per in segments:
+                with tracer.span("train/zero3/drain"):
+                    for (wave, kind), t in per.items():
+                        tracer.add("train/zero3/gather", t, t,
+                                   lane="train/zero3/gather", wave=wave)
+    """)
+    assert "JL008" not in rules_of(lint_text(
+        clean, path="deepspeed_tpu/runtime/zero/prefetch.py", config=cfg))
