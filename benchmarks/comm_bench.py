@@ -61,8 +61,10 @@ def run_overlap(args):
     buffer and runs a matmul chain consuming it.  ``serial`` ties every
     gather behind the previous round's compute output (depth-0 schedule);
     ``pipelined`` issues gathers ``--depth`` rounds ahead and pins each
-    round's compute input on a probe of the newly issued gathers — exactly
-    the two-sided issue window ``scheduled_layer_walk`` compiles for ZeRO-3.
+    round's compute input on a probe of the *next* round's gather (gather
+    r+1 completes before compute r; deeper prefetches stay unpinned until
+    their own consumer-minus-one round) — exactly the two-sided issue
+    window ``scheduled_layer_walk`` compiles for ZeRO-3.
     Overlap fraction comes from in-jit stamp spans: gather windows
     intersected with OTHER rounds' residency windows (gather_end ->
     compute_start), the span-derived overlap discipline ``Zero3CommStats``
@@ -112,18 +114,19 @@ def run_overlap(args):
             y = y0
             pending = {}
             for r in range(R):
-                issued = []
                 for v in range(r, min(r + depth, R - 1) + 1):
                     if v not in pending:
                         (src,) = tied([bufs[v]], y)
                         src = tap(src, ("gs", v))
                         pending[v] = tap(gather_sm(src), ("ge", v))
-                        issued.append(v)
                 g = pending.pop(r)
-                probes = [jnp.ravel(pending[v])[:1]
-                          for v in issued if v in pending]
-                if probes:
-                    (y,) = tied([y], jnp.concatenate(probes))
+                # completion pin one round ahead of use (the walk's deferred
+                # pin): round r+1's gather must finish before compute r, while
+                # deeper prefetches stay unpinned until their own r-1 — free
+                # to run under intervening computes where collectives are
+                # async
+                if r + 1 in pending:
+                    (y,) = tied([y], pending[r + 1])
                 w = g[: m * m].reshape(m, m).astype(jnp.float32)
                 y = tap(y, ("cs", r))
                 for _ in range(iters):

@@ -231,6 +231,11 @@ class HealthMonitor:
         """One detection pass over every replica (reentrant-safe). The
         scan CLAIMS records needing a failover/rejoin under the lock; the
         blocking handling runs after the lock is released."""
+        # the scan's SUSPECT/HEALTHY transitions emit tracer instants while
+        # the monitor lock is held; a thread's first record registers its
+        # ring under monitor.trace.registry — pre-register outside the lock
+        # so that acquisition order never exists
+        _tracer.register_thread()
         actions: List[Tuple[str, object, _ReplicaRecord, str]] = []
         with self._lock:
             now = time.perf_counter()

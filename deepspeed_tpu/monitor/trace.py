@@ -262,6 +262,16 @@ class Tracer:
         if self.enabled:
             self._ring().add(rec)
 
+    def register_thread(self) -> None:
+        """Pre-register the calling thread's ring so later records are
+        lock-free appends. A thread's FIRST record otherwise acquires the
+        registry lock at whatever call site it happens to land on — callers
+        that record under their own locks use this to keep the registry
+        acquisition outside them (lock-order hygiene; the locksan bench gate
+        demands every observed acquisition order be statically explained)."""
+        if self.enabled:
+            self._ring()
+
     def add(self, name: str, t0: float, t1: float, lane: Optional[str] = None,
             **args: Any) -> None:
         """Record a completed span from ``time.perf_counter()`` endpoints the

@@ -949,11 +949,20 @@ class DeepSpeedTPUEngine:
         fp16 = self.config.fp16
 
         def step_fn(state, batch):
-            params = self._current_params(state)
-            scale = state["scaler"]["scale"] if fp16.enabled else jnp.float32(1.0)
-            grads, losses = self._accumulate_grads(params, scale, batch)
-            new_state, metrics = self._apply_grads(state, grads)
-            metrics["loss"] = jnp.mean(losses)
+            # stash the device step counter for the ZeRO-3 schedule taps
+            # traced inside this step (stamps carry it so drain() segments
+            # by execution, not host callback arrival order); trace-scoped —
+            # the finally clears the tracer before it goes stale
+            from deepspeed_tpu.runtime.zero import prefetch as zero3_prefetch
+            zero3_prefetch.set_step_operand(state["step"])
+            try:
+                params = self._current_params(state)
+                scale = state["scaler"]["scale"] if fp16.enabled else jnp.float32(1.0)
+                grads, losses = self._accumulate_grads(params, scale, batch)
+                new_state, metrics = self._apply_grads(state, grads)
+                metrics["loss"] = jnp.mean(losses)
+            finally:
+                zero3_prefetch.set_step_operand(None)
             return new_state, metrics
 
         return step_fn
@@ -1209,12 +1218,12 @@ class DeepSpeedTPUEngine:
         # callbacks read curriculum_scheduler.current_difficulty)
         if self.curriculum_scheduler is not None:
             self.curriculum_scheduler.update_difficulty(self.global_steps)
-        if self._zero3_plan is not None:
-            # arm the ambient schedule the model walk reads; re-armed every
-            # step so late (re)traces — shape changes, a second engine on this
-            # thread — still see THIS engine's plan
-            from deepspeed_tpu.runtime.zero import prefetch as zero3_prefetch
-            zero3_prefetch.configure(self._zero3_plan)
+        # arm (or clear) the ambient schedule the model walk reads; re-set
+        # every step — including to None — so late (re)traces (shape changes,
+        # a second engine on this thread) see exactly THIS engine's setting,
+        # never a plan left armed by a previous scheduled engine
+        from deepspeed_tpu.runtime.zero import prefetch as zero3_prefetch
+        zero3_prefetch.configure(self._zero3_plan)
         if self._fused_step is None and self._offload is None:
             self._fused_step = jax.jit(self._build_fused_step(), donate_argnums=(0,),
                                        compiler_options=self._compiler_options())
@@ -1416,6 +1425,10 @@ class DeepSpeedTPUEngine:
         and buffers the (scaled) gradient and ``backward`` is bookkeeping."""
         from deepspeed_tpu.runtime.data_pipeline import as_host_tree
         self._ensure_state(batch)
+        # same contract as train_batch: the micro-step trace sees exactly
+        # this engine's schedule setting (None clears a stale ambient plan)
+        from deepspeed_tpu.runtime.zero import prefetch as zero3_prefetch
+        zero3_prefetch.configure(self._zero3_plan)
         if self._micro_step is None:
             self._build_micro_steps()
         leading = int(np.shape(jax.tree_util.tree_leaves(batch)[0])[0])
@@ -1473,12 +1486,18 @@ class DeepSpeedTPUEngine:
         gas = self.gas_
 
         def micro(state, buf, mb):
-            params = self._current_params(state)
-            scale = state["scaler"]["scale"] if fp16.enabled else jnp.float32(1.0)
-            loss, grads = self._grad_fn(params, mb, scale)
-            grads = tree_cast(grads, accum_dtype)
-            grads = self._constrain_grads(grads)
-            buf = jax.tree_util.tree_map(jnp.add, buf, grads)
+            # step operand for the ZeRO-3 schedule taps (see _build_fused_step)
+            from deepspeed_tpu.runtime.zero import prefetch as zero3_prefetch
+            zero3_prefetch.set_step_operand(state["step"])
+            try:
+                params = self._current_params(state)
+                scale = state["scaler"]["scale"] if fp16.enabled else jnp.float32(1.0)
+                loss, grads = self._grad_fn(params, mb, scale)
+                grads = tree_cast(grads, accum_dtype)
+                grads = self._constrain_grads(grads)
+                buf = jax.tree_util.tree_map(jnp.add, buf, grads)
+            finally:
+                zero3_prefetch.set_step_operand(None)
             return loss, buf
 
         def apply(state, buf):
@@ -1598,6 +1617,12 @@ class DeepSpeedTPUEngine:
         """Release host-side resources (parity: ``DeepSpeedEngine.destroy``):
         the prefetch producer, deferred metrics, the offload optimizer's AIO
         pools/swap files, and monitor writers."""
+        # disarm the ambient ZeRO-3 schedule: the documented contract is that
+        # stage3_prefetch_depth=None engines are bit-for-bit untouched, so a
+        # destroyed engine must never leave its plan for a later engine's
+        # trace (train_batch/eval_loss also re-set it defensively each call)
+        from deepspeed_tpu.runtime.zero import prefetch as zero3_prefetch
+        zero3_prefetch.configure(None)
         self._reset_data_iterator()
         self.drain_metrics()
         rolling_err = None
@@ -1726,9 +1751,10 @@ class DeepSpeedTPUEngine:
         sh = NamedSharding(mesh, P(BATCH_AXES))
         mb = jax.tree_util.tree_map(lambda x: jax.device_put(x, sh),
                                     as_host_tree(batch))
-        if self._zero3_plan is not None:
-            from deepspeed_tpu.runtime.zero import prefetch as zero3_prefetch
-            zero3_prefetch.configure(self._zero3_plan)
+        # always re-set (even to None): the eval trace must see this
+        # engine's schedule setting, not a plan another engine left armed
+        from deepspeed_tpu.runtime.zero import prefetch as zero3_prefetch
+        zero3_prefetch.configure(self._zero3_plan)
         if self._eval_step is None:
             self._eval_step = jax.jit(self._loss_of)
         return float(self._eval_step(params, mb))

@@ -15,10 +15,15 @@ after the whole backward. This module builds the *explicit* schedule instead
 * wave ``w``'s gather is pinned into a two-sided issue window: a
   ``lax.optimization_barrier`` tie to the activation entering wave
   ``w - prefetch_depth`` is the lower bound (never issued earlier — the hard
-  residency bound), and a 1-element probe of the gather barriered into wave
-  ``w - prefetch_depth``'s compute INPUT is the upper bound (always finished
-  before that compute runs). The lookahead is forced by dataflow, not
-  best-effort hoisting — the program must prefetch even on a serial executor;
+  residency bound), and a 1-element probe of a *gathered* leaf barriered into
+  wave ``w - 1``'s compute INPUT is the upper bound (always finished one wave
+  ahead of use). Completion is forced by dataflow, not best-effort hoisting —
+  the program must prefetch even on a serial executor — while the issue
+  window spans computes ``w - prefetch_depth .. w - 2``, so at depth >= 2 the
+  gather genuinely runs concurrently with intervening waves' compute wherever
+  collectives are async (depth 1 double-buffers residency but its window sits
+  between two computes: one wave of lookahead leaves no compute to hide
+  under);
 * the backward re-gathers each wave's params tied to the **incoming
   cotangent** (reverse layer order, inside the backward window) and recomputes
   the wave forward from sharded residuals (wave-granular rematerialisation —
@@ -42,11 +47,19 @@ participant order, so per-step loss streams are byte-identical across depth
 Observability (PR 7 stats-equals-spans discipline): when tracing is armed at
 compile time, each gather / free / reduce-scatter emits a
 ``jax.debug.callback`` stamp. Static tags are bound with ``functools.partial``
-and the only operand is a 1-element **explicitly replicated** probe slice —
-passing python values as callback operands deadlocks under the forced-host
-8-device mesh, and an unconstrained probe fires per-shard. The host drains the
-stamp ledger into ``train/zero3/{gather,free,reduce_scatter}`` tracer spans
-and the same segments feed ``monitor.training.Zero3CommStats``.
+and the operands are a 1-element **explicitly replicated** probe slice plus a
+replicated step counter — passing python values as callback operands
+deadlocks under the forced-host 8-device mesh, and an unconstrained probe
+fires per-shard. The step counter (armed by the engine's step builders via
+:func:`set_step_operand`; ``-1`` for step-less traces like eval forwards)
+keys :func:`drain`'s segmentation: ``jax.debug.callback`` is unordered and
+``ordered=True`` is rejected on multi-device meshes, so stamps of consecutive
+steps may interleave on the host — grouping by the device-side step id keeps
+segment boundaries exact regardless of arrival order (stamps sharing a step
+id — the micro facade's per-microbatch executions, fp16 overflow-skipped
+steps, eval passes — still fall back to per-key arrival order). The host
+drains the ledger into ``train/zero3/{gather,free,reduce_scatter}`` tracer
+spans and the same segments feed ``monitor.training.Zero3CommStats``.
 
 Known lowering honesty: spans and stats name the *logical* collective. On the
 forced-host CPU backend the bucketed gather lowers to a real ``all-gather``
@@ -71,8 +84,8 @@ from deepspeed_tpu.runtime.zero.partition import gathered_spec, sharded_axes_of
 
 __all__ = [
     "Zero3Wave", "Zero3Plan", "build_plan", "configure", "current_plan",
-    "scheduled_layer_walk", "drain", "stamps_per_step", "clear_stamps",
-    "layer_stack_names",
+    "set_step_operand", "scheduled_layer_walk", "drain", "stamps_per_step",
+    "clear_stamps", "layer_stack_names",
 ]
 
 
@@ -232,6 +245,7 @@ class _PrefetchState(threading.local):
     def __init__(self):
         super().__init__()
         self.plan: Optional[Zero3Plan] = None
+        self.step = None         # traced step scalar while a step fn traces
 
 
 _STATE = _PrefetchState()
@@ -246,14 +260,28 @@ def current_plan() -> Optional[Zero3Plan]:
     return _STATE.plan
 
 
+def set_step_operand(step) -> None:
+    """Stash the device step counter for the duration of a step fn's trace.
+
+    The engine's step builders call this with ``state["step"]`` (a tracer of
+    the enclosing jit) on entry and ``None`` in a ``finally`` — the taps pick
+    it up as an extra callback operand so every stamp carries the step it
+    belongs to. The stash is trace-scoped: leaving it set after the trace
+    would leak a dead tracer into the next traced walk (eval, another
+    engine), hence the mandatory clear."""
+    _STATE.step = step
+
+
 # --------------------------------------------------------------------------- #
 # Stamp ledger (host side of the in-jit taps)
 # --------------------------------------------------------------------------- #
 
-# (wave_index, kind, perf_counter). Kinds, in per-wave program order:
+# (wave_index, kind, step, perf_counter); step is the device step counter the
+# stamp executed under (-1 for step-less traces). Kinds, in per-wave program
+# order:
 #   fwd:  "gather_start" "gather_end" "free"
 #   bwd:  "bwd_gather_start" "bwd_gather_end" "rs_start" "rs_end"
-_LEDGER: List[Tuple[int, str, float]] = []
+_LEDGER: List[Tuple[int, str, int, float]] = []
 _LEDGER_LOCK = threading.Lock()
 
 _FWD_KINDS = ("gather_start", "gather_end", "free")
@@ -270,11 +298,12 @@ def clear_stamps() -> None:
         _LEDGER.clear()
 
 
-def _record(wave: int, kind: str, _probe) -> None:
-    # Host callback target. Static tags arrive partial-bound; the jax operand
-    # is only the replicated probe establishing the device-timeline dependency.
+def _record(wave: int, kind: str, _probe, step) -> None:
+    # Host callback target. Static tags arrive partial-bound; the jax
+    # operands are the replicated probe establishing the device-timeline
+    # dependency and the replicated step counter keying segmentation.
     with _LEDGER_LOCK:
-        _LEDGER.append((wave, kind, time.perf_counter()))
+        _LEDGER.append((wave, kind, int(step), time.perf_counter()))
 
 
 def _tap(tree, mesh, wave: int, kind: str):
@@ -282,13 +311,19 @@ def _tap(tree, mesh, wave: int, kind: str):
 
     The probe is a 1-element slice explicitly constrained replicated: the
     callback then fires exactly once per execution (not per shard) and its
-    host timestamp tracks the producing op's completion. Returns `tree`
-    unchanged — taps are read-only and never alter math.
+    host timestamp tracks the producing op's completion. The stashed step
+    operand rides along (replicated too) so drain() can segment stamps by
+    execution without trusting host arrival order. Returns `tree` unchanged
+    — taps are read-only and never alter math.
     """
     leaf = jax.tree_util.tree_leaves(tree)[0]
     probe = jax.lax.with_sharding_constraint(
         jnp.ravel(leaf)[:1], NamedSharding(mesh, P()))
-    jax.debug.callback(functools.partial(_record, wave, kind), probe)
+    step = _STATE.step
+    step = jax.lax.with_sharding_constraint(
+        jnp.asarray(jnp.int32(-1) if step is None else step, jnp.int32),
+        NamedSharding(mesh, P()))
+    jax.debug.callback(functools.partial(_record, wave, kind), probe, step)
     return tree
 
 
@@ -491,8 +526,8 @@ def _make_compute_fn(plan: Zero3Plan, wave: Zero3Wave, mesh,
 
     def compute_bwd(res, ct):
         ptrees, x = res
-        if taps:
-            ct = _tap(ct, mesh, wave.index, "bwd_gather_start_pre")
+        # no tap on ct here: _gather_wave already stamps bwd_gather_start on
+        # the tie-barriered sharded leaf, the same device-timeline moment
         regathered = _gather_wave(plan, wave, ptrees, ct, mesh,
                                   bucket_limit=plan.reduce_bucket_size,
                                   tap_prefix="bwd_gather" if taps else None)
@@ -505,6 +540,20 @@ def _make_compute_fn(plan: Zero3Plan, wave: Zero3Wave, mesh,
 
     compute_fn.defvjp(compute_fwd, compute_bwd)
     return compute_fn
+
+
+def _gathered_probe_leaf(wave: Zero3Wave, gathered: Dict[str, Any]):
+    """1-element probe of the wave's first GATHERED leaf.
+
+    ``gathered`` is a gather node's output (per-layer param dicts); its
+    tree-order first leaf may be a persistent param that bypassed the gather,
+    so the probe indexes by ``wave.leaves[0]`` — by construction an
+    fsdp-sharded leaf the gather substituted."""
+    lp = wave.leaves[0]
+    node = gathered[lp.layer]
+    for k in lp.path:
+        node = node[k]
+    return jnp.ravel(node)[:1]
 
 
 def scheduled_layer_walk(layers: Sequence[Any], carry, *,
@@ -565,27 +614,32 @@ def scheduled_layer_walk(layers: Sequence[Any], carry, *,
     # Software-pipelined walk: entering wave w, issue gathers up through wave
     # w + depth (tie = the CURRENT carry, i.e. the activation entering wave w
     # — the lower bound on issue), then pin this wave's compute input on a
-    # 1-element probe of the newly issued gathers. The pin is the upper
-    # bound: the compiled program MUST finish gather w+depth before compute w
-    # can run, so the lookahead is forced by dataflow, not left to the
-    # scheduler's goodwill — gather windows land under the previous waves'
-    # residency windows even on a serial executor, and overlap compute for
-    # real wherever collectives run async.
+    # 1-element probe of wave w+1's pending gather. The pin is the upper
+    # bound, one wave ahead of use: the compiled program MUST finish gather v
+    # before compute v-1 can run, so the prefetch is forced by dataflow, not
+    # left to the scheduler's goodwill, even on a serial executor — while
+    # gathers deeper in the window (v > w+1) stay unpinned until their own
+    # consumer-minus-one compute, free to run concurrently with computes
+    # w .. v-2 wherever collectives are async. Pinning every newly issued
+    # gather into compute w instead would sandwich each gather between two
+    # consecutive computes and forbid any comm/compute concurrency.
     n_w = plan.n_waves
     pending: Dict[int, Any] = {}
     for w, wave in enumerate(plan.waves):
-        issued: List[int] = []
         for v in range(w, min(w + plan.depth, n_w - 1) + 1):
             if v not in pending:
                 gf = _make_gather_fn(plan, plan.waves[v], mesh)
                 pending[v] = gf(
                     {n: ptrees[n] for n in plan.waves[v].layers}, carry)
-                issued.append(v)
         gathered = pending.pop(w)
-        probes = [jnp.ravel(jax.tree_util.tree_leaves(pending[v])[0])[:1]
-                  for v in issued if v in pending]
-        if probes:
-            (carry,) = _tie_barrier([carry], jnp.concatenate(probes))
+        if w + 1 in pending:
+            # probe a leaf the gather actually produced: wave.leaves holds
+            # only fsdp-sharded leaves, so indexing by its first entry can
+            # never land on a persistence-threshold leaf that passed through
+            # _gather_wave untouched (a probe of one would pin nothing)
+            (carry,) = _tie_barrier(
+                [carry], _gathered_probe_leaf(plan.waves[w + 1],
+                                              pending[w + 1]))
         cf = _make_compute_fn(plan, wave, mesh, layer_call)
         carry = cf(gathered, {n: ptrees[n] for n in wave.layers}, carry)
     return carry
@@ -599,13 +653,20 @@ def drain(tracer=None, stats=None, plan: Optional[Zero3Plan] = None, *,
           barrier: bool = False) -> int:
     """Convert accumulated stamps into tracer spans and stats records.
 
-    Stamps arrive in device program order (one execution stream), so a new
-    forward pass is delimited by wave 0's ``gather_start``. A segment that
-    contains backward stamps is a training step; one without is an eval/fwd
-    pass (recorded only as spans). Returns the number of complete segments
-    drained; a trailing partial segment (step still in flight) stays queued.
-    ``barrier=True`` waits for all in-flight debug callbacks first (the final
-    drain: blocking on the step's outputs does NOT flush its callbacks).
+    ``jax.debug.callback`` is unordered (and ``ordered=True`` is rejected on
+    multi-device meshes), so stamps of consecutive executions may interleave
+    on the host. Segmentation therefore groups by the device-side step
+    counter each stamp carries — exact regardless of arrival order. Stamps
+    sharing a step id (the micro facade runs every microbatch at one step
+    value, fp16 overflow skips the increment, step-less traces all stamp -1)
+    split on repeated (wave, kind) keys: each tap fires exactly once per
+    execution, so a repeat marks the next same-step execution, relying only
+    on per-key arrival order. A segment with backward stamps is a training
+    step; one without is an eval/fwd pass (recorded only as spans). Returns
+    the number of complete segments drained; partial segments (executions
+    still in flight) stay queued. ``barrier=True`` waits for all in-flight
+    debug callbacks first (the final drain: blocking on the step's outputs
+    does NOT flush its callbacks).
     """
     plan = plan or current_plan()
     if plan is None:
@@ -617,35 +678,44 @@ def drain(tracer=None, stats=None, plan: Optional[Zero3Plan] = None, *,
     if not stamps:
         return 0
 
-    # Each tap fires exactly once per execution, so a repeated (wave, kind)
-    # key marks the next execution's first stamp — robust to XLA reordering
-    # same-tie gathers (wave 1's prefetch may legally land before wave 0's).
-    segments: List[Dict[Tuple[int, str], float]] = []
-    cur: Dict[Tuple[int, str], float] = {}
-    for wave, kind, t in stamps:
-        if (wave, kind) in cur:
-            segments.append(cur)
-            cur = {}
-        cur[(wave, kind)] = t
-    # the trailing segment may still be streaming in: flush it only when it
-    # is provably complete — a full training pass (every wave's rs_end), or,
-    # after an effects barrier, a full forward-only pass (eval)
+    groups: Dict[int, List[Dict[Tuple[int, str], float]]] = {}
+    seg_of: List[Tuple[int, int]] = []       # stamp index -> (step, seg#)
+    first_at: Dict[Tuple[int, int], int] = {}  # (step, seg#) -> arrival index
+    for i, (wave, kind, step, t) in enumerate(stamps):
+        segs = groups.setdefault(step, [{}])
+        if (wave, kind) in segs[-1]:
+            segs.append({})
+        segs[-1][(wave, kind)] = t
+        sid = (step, len(segs) - 1)
+        seg_of.append(sid)
+        first_at.setdefault(sid, i)
+    # a segment is drained once provably complete — a full training pass
+    # (every wave's rs_end) or, certifiable only after an effects barrier, a
+    # full forward-only pass — or once a later same-step execution closed it
+    # (duplicate key): whatever stamps it got is all it will ever get
     n = plan.n_waves
-    full_train = all((w, "rs_end") in cur for w in range(n))
-    full_fwd = (all((w, "free") in cur for w in range(n))
-                and all(k in _FWD_KINDS for _, k in cur))
-    if full_train or (barrier and full_fwd):
-        segments.append(cur)
-        cur = {}
-    if not segments:
+    emit: List[Tuple[int, int]] = []
+    for step, segs in groups.items():
+        for si, per in enumerate(segs):
+            closed = si < len(segs) - 1
+            full_train = all((w, "rs_end") in per for w in range(n))
+            full_fwd = (all((w, "free") in per for w in range(n))
+                        and all(k in _FWD_KINDS for _, k in per))
+            if closed or full_train or (barrier and full_fwd):
+                emit.append((step, si))
+    if not emit:
         return 0
-    consumed = len(stamps) - len(cur)
+    emitted = set(emit)
+    keep = [s for s, sid in zip(stamps, seg_of) if sid not in emitted]
     with _LEDGER_LOCK:
-        del _LEDGER[:consumed]
+        # requeue unconsumed stamps ahead of any that arrived since snapshot
+        del _LEDGER[:len(stamps)]
+        _LEDGER[:0] = keep
 
-    for per in segments:
-        _emit_segment(per, plan, tracer, stats)
-    return len(segments)
+    emit.sort(key=lambda sid: first_at[sid])
+    for step, si in emit:
+        _emit_segment(groups[step][si], plan, tracer, stats)
+    return len(emit)
 
 
 def _emit_segment(per: Dict[Tuple[int, str], float], plan: Zero3Plan,
@@ -672,8 +742,7 @@ def _emit_segment(per: Dict[Tuple[int, str], float], plan: Zero3Plan,
             emit.setdefault("train/zero3/free", []).append(
                 (ge, fr, f"train/zero3/free/w{w}",
                  dict(wave=w, bytes=wave_bytes)))
-        bs = per.get((w, "bwd_gather_start"),
-                     per.get((w, "bwd_gather_start_pre")))
+        bs = per.get((w, "bwd_gather_start"))
         be = per.get((w, "bwd_gather_end"))
         if bs is not None and be is not None:
             bwd_gather += be - bs

@@ -28,11 +28,14 @@ def make_batch(bs, seqlen=16, seed=0):
 
 
 def make_engine(depth, n_layer=4, persist=0, remat=False, bucket=100_000,
-                extra=None):
+                extra=None, n_embd=64):
+    """persist=None leaves the config's default persistence threshold."""
     model = GPT2LMHead(GPT2Config.tiny(vocab_size=VOCAB, n_layer=n_layer,
-                                       remat=remat))
+                                       remat=remat, n_embd=n_embd))
     params = model.init(jax.random.PRNGKey(0), make_batch(2))["params"]
-    z = {"stage": 3, "stage3_param_persistence_threshold": persist}
+    z = {"stage": 3}
+    if persist is not None:
+        z["stage3_param_persistence_threshold"] = persist
     if depth is not None:
         z.update({"stage3_prefetch_depth": depth,
                   "allgather_bucket_size": bucket,
@@ -105,22 +108,26 @@ def test_persistence_threshold_params_never_gathered(eight_devices):
 
 def _step_segments(engine, steps=2):
     """Run steps with tracing armed and return the drained stamp segments
-    as {(wave, kind): t} dicts (the drain()-internal view, rebuilt here)."""
+    as {(wave, kind): t} dicts (the drain()-internal view, rebuilt here:
+    grouped by the step operand each stamp carries, duplicate-key split
+    within a step id)."""
     prefetch.clear_stamps()
     for i in range(steps):
         engine.train_batch(make_batch(8, seed=300 + i))
     jax.effects_barrier()
     with prefetch._LEDGER_LOCK:
         stamps = list(prefetch._LEDGER)
-    segments, cur = [], {}
-    for wave, kind, t in stamps:
-        if (wave, kind) in cur:
-            segments.append(cur)
-            cur = {}
-        cur[(wave, kind)] = t
-    if cur:
-        segments.append(cur)
-    return segments
+    groups, order = {}, []
+    for wave, kind, step, t in stamps:
+        if step not in groups:
+            groups[step] = [{}]
+            order.append(groups[step][-1])
+        segs = groups[step]
+        if (wave, kind) in segs[-1]:
+            segs.append({})
+            order.append(segs[-1])
+        segs[-1][(wave, kind)] = t
+    return order
 
 
 @pytest.fixture
@@ -252,6 +259,57 @@ def test_config_validation(eight_devices):
                                       "zero_optimization": {
                                           "stage": 2,
                                           "stage3_prefetch_depth": 1}})
+
+
+def test_default_persistence_threshold_probe_not_masked(eight_devices, traced):
+    """Under the config's DEFAULT stage3_param_persistence_threshold (100k,
+    not the 0 most tests use) each gpt2 layer's path-sorted first leaf
+    (attn/c_attn/bias) is persistent and bypasses the gather — the walk's
+    completion probe must index by wave.leaves (always a gathered leaf), or
+    the pin silently depends on the untouched original param and forces
+    nothing. Asserts the masking precondition, the forced completion the pin
+    guarantees (gather w done before wave w-1's compute finishes), the exact
+    per-step stamp count, and byte-equality vs serial."""
+    engine = make_engine(2, persist=None, n_embd=192)
+    plan = engine._zero3_plan
+    assert plan is not None and plan.persistent_bytes > 0
+    first_paths = {prefetch._leaf_paths(
+        engine.state["master"][layer])[0][0]
+        for wave in plan.waves for layer in wave.layers}
+    gathered_paths = {lp.path for wave in plan.waves for lp in wave.leaves}
+    # the masking precondition: tree-order first leaves are all persistent
+    assert first_paths and not (first_paths & gathered_paths)
+    for wave in plan.waves:
+        assert wave.leaves[0].nbytes > 100_000   # what the probe now pins
+    for seg in _step_segments(engine, steps=1):
+        if not all((w, "rs_end") in seg for w in range(plan.n_waves)):
+            continue                             # partial trailing segment
+        assert len(seg) == prefetch.stamps_per_step(plan)
+        for w in range(1, plan.n_waves):
+            # the deferred pin: gather w completes one wave ahead of use,
+            # i.e. before wave w-1's compute (whose end the free tap stamps)
+            assert seg[(w, "gather_end")] < seg[(w - 1, "free")]
+    # byte-equality on fresh engines (the traced engine above already stepped)
+    assert stream_bytes(run_losses(make_engine(2, persist=None, n_embd=192))) \
+        == stream_bytes(run_losses(make_engine(0, persist=None, n_embd=192)))
+
+
+def test_ambient_plan_never_leaks_across_engines(eight_devices):
+    """The 'stage3_prefetch_depth=None keeps the implicit path bit-for-bit
+    untouched' contract: an unscheduled engine's traces must never see a plan
+    a scheduled engine armed earlier on this thread, and destroy() disarms."""
+    sched = make_engine(1, n_layer=2)
+    run_losses(sched, steps=1)
+    assert prefetch.current_plan() is sched._zero3_plan
+    implicit = make_engine(None, n_layer=2)
+    run_losses(implicit, steps=1)
+    assert prefetch.current_plan() is None
+    assert float(implicit.eval_loss(make_batch(8))) > 0
+    assert prefetch.current_plan() is None
+    run_losses(sched, steps=1)
+    assert prefetch.current_plan() is sched._zero3_plan
+    sched.destroy()
+    assert prefetch.current_plan() is None
 
 
 def test_plan_wave_packing(eight_devices):
