@@ -40,7 +40,7 @@ def build_engine(on_tpu: bool, seqs: int, prompt: int, gen: int,
                  burst: int = 8, int8: bool = False,
                  prefix_cache: bool = False, warmup: bool = False,
                  warmup_bursts: bool = True, spec_k: int = 0,
-                 ctx_slack: int = 0):
+                 ctx_slack: int = 0, extra_config=None):
     import jax
     import jax.numpy as jnp
     from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
@@ -101,6 +101,8 @@ def build_engine(on_tpu: bool, seqs: int, prompt: int, gen: int,
         econf["compile"] = {"warmup": True,
                             "warmup_decode_steps": [burst] if warmup_bursts
                             else []}
+    if extra_config:
+        econf.update(extra_config)
     engine = InferenceEngineV2(model=model, model_parameters=params,
                                config=econf)
     return engine, vocab
@@ -922,7 +924,7 @@ def _lora_pool_baseline(engine):
 
 def run_lora(on_tpu: bool, smoke: bool, rate: float, duration: float,
              seed: int = 0, reps: int = 3):
-    """The multi-tenant LoRA leg (BENCH_r17; docs/SERVING.md "Multi-tenant
+    """The multi-tenant LoRA leg (BENCH_r18; docs/SERVING.md "Multi-tenant
     LoRA"): a seeded Poisson mix where arrivals draw tenants from MORE
     registered adapters than the adapter pool holds at once — admission
     faults cold adapters in and LRU-evicts idle ones while one ragged
@@ -2071,6 +2073,140 @@ def run_serving_trace_overhead(on_tpu: bool, smoke: bool, seed: int = 0,
     return ok
 
 
+def _splitk_op_microbench(on_tpu: bool, splits: int, iters: int = 30):
+    """Op-level split-K point: the paged decode attention op alone, split=1
+    vs split=S, on the path this box actually runs (TPU: Pallas kernel;
+    CPU: the page-granular XLA scan — split=1 walks all NC pages
+    sequentially, split=S walks ceil(NC/S) wider steps, so the win is the
+    scan-iteration overhead the splits amortise). Small batch x long ctx x
+    the bench model's head_dim — the regime the engine leg serves."""
+    import jax
+    import jax.numpy as jnp
+    from functools import partial
+    from deepspeed_tpu.ops.pallas.paged_splitk import (
+        paged_decode_attention_xla)
+    S, H, HKV, D, bs, NC = 4, 4, 2, 16, 16, 64     # ctx 1024/seq
+    rng = np.random.RandomState(0)
+    kv = jnp.asarray(rng.randn(S * NC + 1, 2, HKV, bs, D)
+                     .astype(np.float32))
+    q = jnp.asarray(rng.randn(S, H, D).astype(np.float32))
+    bt = jnp.asarray(np.arange(S * NC).reshape(S, NC) + 1, jnp.int32)
+    ctx = jnp.full((S,), NC * bs, jnp.int32)
+
+    def timed(n_splits):
+        # the XLA fallback at both points: the ONLY difference between the
+        # legs is the split count, so the ratio is pure split-K (comparing
+        # against the chunk-serial Pallas kernel here would conflate the
+        # win with CPU interpret-mode overhead)
+        f = jax.jit(partial(paged_decode_attention_xla,
+                            n_splits=n_splits))
+        f(q, kv, bt, ctx).block_until_ready()      # compile outside timing
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = f(q, kv, bt, ctx)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / iters
+
+    t1, ts = timed(1), timed(splits)
+    return {"op_ctx": NC * bs, "op_seqs": S, "op_head_dim": D,
+            "op_split1_us": round(1e6 * t1, 1),
+            "op_splitS_us": round(1e6 * ts, 1),
+            "op_speedup": round(t1 / ts, 2)}
+
+
+def run_long_context(on_tpu: bool, smoke: bool, seqs=None, prompt=None,
+                     gen=None, splits: int = 4, reps: int = 3):
+    """Flash-decoding long-context leg (docs/SERVING.md "Attention
+    kernels"), BENCH_r17: few sequences x long context — the split-K
+    regime, where grid parallelism over sequences alone leaves the chip
+    (or, on CPU, the scan) serial over each row's pages. ONE warmed engine
+    with the pow2 split ladder ``[1..splits]`` serves the same seeded
+    prompts through the DecodePipeline twice per rep: pinned to the
+    chunk-serial split=1 program (``attn_rung_override``) and under auto
+    rung selection (climbs the ladder as live ctx crosses
+    ``min_ctx_per_split`` multiples).
+
+    Gates: (a) token streams IDENTICAL between split=1 and the ladder —
+    same forward math, different grid decomposition (the op-level LSE-merge
+    equality tests put the two paths within float rtol; greedy argmax over
+    the bench model's logits is byte-stable across that); (b) zero timed
+    compiles — every rung program came out of warmup(); (c) allocator back
+    to baseline each rep; (d) the auto leg actually climbed the ladder
+    (merged_steps > 0; otherwise the comparison is vacuous); (e) full runs
+    only: the op-level point shows >= 1.3x split=S over split=1 on the
+    measurable fallback path (CPU box: the XLA scan)."""
+    seqs = seqs if seqs is not None else (2 if smoke else 3)
+    prompt = prompt if prompt is not None else (96 if smoke else 384)
+    gen = gen if gen is not None else (8 if smoke else 32)
+    min_ctx = 16 if smoke else 64
+    reps = 1 if smoke else reps
+    engine, vocab = build_engine(
+        on_tpu, seqs=seqs, prompt=prompt, gen=gen,
+        warmup=True, warmup_bursts=False,
+        extra_config={
+            # small pages: the long ctx becomes MANY pages per row, the
+            # regime where chunk-serial decode is scan-bound
+            "kv_cache": {"block_size": 16},
+            "attention": {"decode_splits": splits,
+                          "min_ctx_per_split": min_ctx}})
+    _force_paged(engine)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, vocab, size=(prompt,)).astype(np.int32)
+               for _ in range(seqs)]
+    uid_base = [60_000]
+
+    def serve(rung):
+        """One timed decode run at a pinned rung (None = auto ladder)."""
+        engine.attn_rung_override = rung
+        uid_base[0] += seqs
+        uids = list(range(uid_base[0], uid_base[0] + seqs))
+        engine._put_nofetch(uids, prompts)
+        pipe = engine.decode_pipeline(uids)
+        t0 = time.time()
+        out = pipe.run(gen)
+        wall = time.time() - t0
+        engine.flush(uids)
+        engine.attn_rung_override = None
+        return [list(map(int, row)) for row in out], wall
+
+    # untimed: compile-free from here (warmup covered every rung)
+    serve(1)
+    serve(None)
+    free0 = engine.free_blocks
+    c0 = engine.compiles
+    ok = True
+    ladder = engine.attn_split_ladder
+    for rep in range(reps):
+        ref, wall1 = serve(1)
+        engine.attn_stats.reset()
+        got, walls = serve(None)
+        s = engine.attn_stats
+        out = {
+            "leg": "long_context", "rep": rep, "seqs": seqs,
+            "prompt": prompt, "gen": gen, "ladder": ladder,
+            "min_ctx_per_split": min_ctx,
+            "split1_tok_s": round(seqs * gen / wall1, 1),
+            "ladder_tok_s": round(seqs * gen / walls, 1),
+            "engine_speedup": round(wall1 / walls, 2),
+            "outputs_equal": got == ref,
+            "ladder_engaged": s.merged_steps > 0,
+            "splits_per_select": round(s.splits_per_select, 2),
+            "max_live_ctx": s.max_live_ctx,
+            "compiles_during_timed_runs": engine.compiles - c0,
+            "allocator_at_baseline": engine.free_blocks == free0,
+        }
+        print(json.dumps(out), flush=True)
+        ok = ok and out["outputs_equal"] and out["ladder_engaged"] \
+            and out["compiles_during_timed_runs"] == 0 \
+            and out["allocator_at_baseline"]
+    op = _splitk_op_microbench(on_tpu, splits,
+                               iters=(10 if smoke else 30))
+    gate_op = smoke or op["op_speedup"] >= 1.3
+    print(json.dumps({"gate": "splitk_long_context",
+                      "ok": bool(ok and gate_op), **op}), flush=True)
+    return bool(ok and gate_op)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--seqs", type=int, default=None,
@@ -2153,6 +2289,18 @@ def main():
                          "compiles across the (bucket, k) grid, allocator "
                          "baseline after reject-heavy runs, and the "
                          "repetitive-leg tok/s ratio")
+    ap.add_argument("--long-context", action="store_true",
+                    help="run the flash-decoding long-context leg: few "
+                         "sequences x long ctx on ONE warmed engine with "
+                         "the pow2 split ladder — split=1 (chunk-serial) "
+                         "vs auto rung selection, gating identical token "
+                         "streams, zero timed compiles, allocator "
+                         "baseline, ladder engagement, and (full) the "
+                         "op-level split-K point >= 1.3x on the "
+                         "measurable fallback path (BENCH_r17)")
+    ap.add_argument("--splits", type=int, default=4,
+                    help="long-context leg: top rung of the pow2 split "
+                         "ladder")
     ap.add_argument("--spec-k", type=int, default=15,
                     help="spec leg: max draft tokens per verify step (the "
                          "ladder dispatches pow2-minus-1 rungs up to it; "
@@ -2199,6 +2347,11 @@ def main():
                       prompt=args.prompt if args.prompt is not None else 48,
                       gen=args.gen if args.gen is not None else 128,
                       reps=reps)
+        sys.exit(0 if ok else 1)
+    if args.long_context:
+        ok = run_long_context(on_tpu, args.smoke, seqs=args.seqs,
+                              prompt=args.prompt, gen=args.gen,
+                              splits=args.splits, reps=reps)
         sys.exit(0 if ok else 1)
     if args.gen is None:
         args.gen = 64

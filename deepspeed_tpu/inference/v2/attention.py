@@ -40,8 +40,14 @@ from deepspeed_tpu.ops.pallas.flash_attention import flash_attention_packed
 from deepspeed_tpu.ops.pallas.paged_attention import (
     paged_chunk_attention_batched, paged_decode_attention,
     paged_decode_attention_sidebuf, paged_decode_attention_step)
+from deepspeed_tpu.ops.pallas.paged_splitk import (
+    paged_chunk_attention_splitk, paged_decode_attention_splitk,
+    paged_decode_attention_splitk_step, paged_sidebuf_attention_splitk)
 
 _QUANT_TP_MSG = "int8 KV pages + TP not wired"
+_SPLIT_TP_MSG = ("attention.decode_splits > 1 with tensor_parallel > 1 is "
+                 "not wired (the split-K LSE merge would land outside the "
+                 "shard_map body)")
 
 
 class AttentionKernelSpec:
@@ -53,18 +59,43 @@ class AttentionKernelSpec:
     the 'tensor' axis) is applied here — one helper, identical in_specs per
     kernel shape — so no builder carries its own wrapping."""
 
-    def __init__(self, spec: Any, mesh=None, tp: int = 1):
+    def __init__(self, spec: Any, mesh=None, tp: int = 1, n_splits: int = 1):
         self.spec = spec
         self.mesh = mesh
         self.tp = int(tp)
-        self._decode = functools.partial(paged_decode_attention,
-                                         window=spec.window, alibi=spec.alibi)
-        self._chunk = functools.partial(paged_chunk_attention_batched,
-                                        window=spec.window, alibi=spec.alibi)
-        self._step = functools.partial(paged_decode_attention_step,
-                                       window=spec.window, alibi=spec.alibi)
-        self._sidebuf = functools.partial(paged_decode_attention_sidebuf,
-                                          window=spec.window, alibi=spec.alibi)
+        self.n_splits = int(n_splits)
+        if self.n_splits > 1:
+            # flash-decoding rung: every paged caller routes through the
+            # split-K dispatchers so decode, fused step, sidebuf and spec
+            # verify all ride the same ladder rung (ONE compiled program
+            # per rung). tp > 1 keeps the chunk-serial path — refused at
+            # build time by validate_engine_build.
+            assert self.tp == 1, _SPLIT_TP_MSG
+            ns = self.n_splits
+            self._decode = functools.partial(
+                paged_decode_attention_splitk, window=spec.window,
+                alibi=spec.alibi, n_splits=ns)
+            self._chunk = functools.partial(
+                paged_chunk_attention_splitk, window=spec.window,
+                alibi=spec.alibi, n_splits=ns)
+            self._step = functools.partial(
+                paged_decode_attention_splitk_step, window=spec.window,
+                alibi=spec.alibi, n_splits=ns)
+            self._sidebuf = functools.partial(
+                paged_sidebuf_attention_splitk, window=spec.window,
+                alibi=spec.alibi, n_splits=ns)
+        else:
+            self._decode = functools.partial(
+                paged_decode_attention, window=spec.window, alibi=spec.alibi)
+            self._chunk = functools.partial(
+                paged_chunk_attention_batched, window=spec.window,
+                alibi=spec.alibi)
+            self._step = functools.partial(
+                paged_decode_attention_step, window=spec.window,
+                alibi=spec.alibi)
+            self._sidebuf = functools.partial(
+                paged_decode_attention_sidebuf, window=spec.window,
+                alibi=spec.alibi)
         self._packed = functools.partial(flash_attention_packed,
                                          window=spec.window)
 
@@ -95,6 +126,13 @@ class AttentionKernelSpec:
                     "scale-tile lane alignment; got head_dim="
                     f"{spec.head_dim}, num_kv_heads={spec.num_kv_heads}, "
                     f"block_size={cfg.kv_cache.block_size})")
+        attn = getattr(cfg, "attention", None)
+        if attn is not None and attn.decode_splits > 1:
+            if cfg.tensor_parallel > 1:
+                raise NotImplementedError(_SPLIT_TP_MSG)
+            # everything else composes: sliding window / ALiBi mask inside
+            # each split, int8 dequant per gathered page, spec verify rides
+            # the chunk dispatcher, small head dims take the XLA scan
         if cfg.prefix_cache.enabled and spec.window is not None:
             raise NotImplementedError(
                 "prefix_cache with a sliding-window model is not wired: "

@@ -563,6 +563,39 @@ class RouterConfig:
 
 
 @dataclass
+class AttentionConfig:
+    """Flash-decoding split-K knobs (docs/SERVING.md "Attention kernels").
+
+    ``decode_splits``: top rung of the pow2 split ladder. 1 (default) keeps
+    the chunk-serial kernels exactly — split-K never dispatches. S > 1 makes
+    every paged attention caller (ragged decode pass, fused decode
+    step/multistep, sidebuf, spec verify) route through the split-K
+    dispatchers (``ops/pallas/paged_splitk.py``): each sequence's page range
+    is cut into up to S grid-parallel splits emitting ``(acc, lse)``
+    partials, merged by one logsumexp-weighted pass. The engine warms ONE
+    program per ladder rung ``[1, 2, 4, ..., decode_splits]`` so the
+    admission-driven rung choice never compiles on the hot path.
+
+    ``min_ctx_per_split``: rung selection — the engine picks
+    ``min(decode_splits, pow2_floor(max_live_ctx / min_ctx_per_split))``
+    each step, so short-context batches stay on the split=1 (chunk-serial)
+    program where the merge pass is pure overhead, and long tails climb the
+    ladder as context grows."""
+    decode_splits: int = 1
+    min_ctx_per_split: int = 512
+
+    def __post_init__(self):
+        if self.decode_splits < 1 or (
+                self.decode_splits & (self.decode_splits - 1)) != 0:
+            raise ValueError(
+                "attention.decode_splits must be a power of two >= 1 (the "
+                f"warmed pow2 split ladder), got {self.decode_splits}")
+        if self.min_ctx_per_split < 1:
+            raise ValueError("attention.min_ctx_per_split must be >= 1, "
+                             f"got {self.min_ctx_per_split}")
+
+
+@dataclass
 class RaggedInferenceEngineConfig:
     state_manager: DSStateManagerConfig = field(default_factory=DSStateManagerConfig)
     kv_cache: KVCacheSizingConfig = field(default_factory=KVCacheSizingConfig)
@@ -573,6 +606,7 @@ class RaggedInferenceEngineConfig:
     serving: ServingConfig = field(default_factory=ServingConfig)
     spec_decode: SpecDecodeConfig = field(default_factory=SpecDecodeConfig)
     lora: LoraConfig = field(default_factory=LoraConfig)
+    attention: AttentionConfig = field(default_factory=AttentionConfig)
     tensor_parallel: int = 1
     dtype: Any = jnp.bfloat16
     seed: int = 0
@@ -606,9 +640,11 @@ class RaggedInferenceEngineConfig:
             sd = SpecDecodeConfig(**sd) if isinstance(sd, dict) else sd
             lr = d.pop("lora", {})
             lr = LoraConfig(**lr) if isinstance(lr, dict) else lr
+            at = d.pop("attention", {})
+            at = AttentionConfig(**at) if isinstance(at, dict) else at
             cfg = cls(state_manager=sm, kv_cache=kv, quantization=qz,
                       kv_quant=kq, prefix_cache=pc, compile=co, serving=sv,
-                      spec_decode=sd, lora=lr, **d)
+                      spec_decode=sd, lora=lr, attention=at, **d)
         if cfg.state_manager.chunk_budget <= 0:
             raise ValueError("max_ragged_batch_size must exceed max_ragged_sequence_count")
         return cfg

@@ -222,6 +222,23 @@ class InferenceEngineV2:
         fwd = build_ragged_forward(self.spec, mesh=self.topology.mesh, tp=eff_tp)
         self._pass = jax.jit(fwd, donate_argnums=(1,))
         self.compiles += 1
+        # flash-decoding split ladder (config.attention; docs/SERVING.md
+        # "Attention kernels"): one ragged-pass program per pow2 rung.
+        # Rung 1 IS self._pass — the byte-identical chunk-serial program;
+        # higher rungs rebuild the pass with split-K attention bound
+        # (ops/pallas/paged_splitk.py). The fused decode/multistep/verify
+        # grids grow the same rung axis through their cache keys, and
+        # warmup() pre-builds every (grid point x rung) so the
+        # admission-driven rung choice (_attn_rung) never compiles on the
+        # hot path. decode_splits == 1 (default) leaves all of this inert.
+        self._pass_rungs = {1: self._pass}
+        for r in self.attn_split_ladder[1:]:
+            fwd_r = build_ragged_forward(self.spec, mesh=self.topology.mesh,
+                                         tp=eff_tp, n_splits=r)
+            self._pass_rungs[r] = jax.jit(fwd_r, donate_argnums=(1,))
+            self.compiles += 1
+        # bench/test knob: pin the dispatched rung (None = admission-driven)
+        self.attn_rung_override: Optional[int] = None
         self._pass_prefill = None  # built on the first pure-prefill pass
         self._rng = np.random.RandomState(cfg.seed)
         self._rng_key = jax.random.PRNGKey(cfg.seed)
@@ -253,10 +270,14 @@ class InferenceEngineV2:
         self._page_buckets: set = set()
         # aggregate double-buffer pipeline timings (monitor/serving.py);
         # write_monitor_events emits them
-        from deepspeed_tpu.monitor.serving import (PipelineStats,
+        from deepspeed_tpu.monitor.serving import (AttnSplitStats,
+                                                   PipelineStats,
                                                    SpecDecodeStats)
         self.pipeline_stats = PipelineStats()
         self.spec_stats = SpecDecodeStats()
+        # split-ladder rung-selection counters (serve/attn/* events; fed by
+        # the same perf stamps as the serve/attn/select trace spans)
+        self.attn_stats = AttnSplitStats()
         # multi-tenant LoRA: adapter registry + paged weight pool
         # (inference/v2/lora/; docs/SERVING.md "Multi-tenant LoRA"). The
         # decode/verify program grid grows a rank-bucket axis; the pool's
@@ -478,9 +499,10 @@ class InferenceEngineV2:
         # bucketed descriptors: the program below is keyed by the BUCKET, so a
         # serving loop admitting/retiring sequences reuses ~log2 executables
         db = self.scheduler.decode_batch(uids, n_steps + 1, self.scratch_block)
+        sp = self._attn_rung()
         fn = self._multistep.get_or_create(
-            (n_steps, db.bucket, bool(do_sample), int(top_k)),
-            lambda: self._build_multistep(n_steps, do_sample, top_k))
+            (n_steps, db.bucket, bool(do_sample), int(top_k), sp),
+            lambda: self._build_multistep(n_steps, do_sample, top_k, sp))
         # already bucket-padded: pad entries re-sample a real row's logits but
         # run against the scratch page, so they cannot touch live KV
         ids0, _ = self._sample_device_padded(uids, do_sample, temperature,
@@ -503,7 +525,7 @@ class InferenceEngineV2:
         return fetch_to_host(out_ids).T[:S]    # [S, n_steps]
 
     def _decode_step_prog(self, bucket: int, do_sample: bool, top_k: int,
-                          rb: int = 0):
+                          rb: int = 0, sp: Optional[int] = None):
         """The fused single-step decode program (forward + on-device sampling,
         ragged_model.build_decode_step) for one bucket — the DecodePipeline's
         hot program. LRU-cached per (bucket, do_sample, top_k, rb).
@@ -515,7 +537,13 @@ class InferenceEngineV2:
         byte-unchanged. Distinct rb values are distinct keys — a separate jit
         wrapper each — so every compile stays witnessed by the counter (one
         shared jit re-specializing on the page-table shape would compile
-        silently)."""
+        silently).
+
+        ``sp`` is the flash-decoding split rung (None = this step's
+        admission-driven :meth:`_attn_rung`); each rung is its own key so
+        rung swaps reuse warmed executables."""
+        sp = self._attn_rung() if sp is None else int(sp)
+
         def _build():
             from deepspeed_tpu.inference.v2.ragged_model import (
                 build_decode_step)
@@ -524,12 +552,13 @@ class InferenceEngineV2:
                                     tp=tp if tp > 1 else 1,
                                     do_sample=do_sample, top_k=top_k,
                                     window_ring_ok=self.scheduler.ring_covers(2),
-                                    lora_targets=self._lora_targets(rb))
+                                    lora_targets=self._lora_targets(rb),
+                                    n_splits=sp)
             self.compiles += 1
             return jax.jit(fwd, donate_argnums=(1,))
 
         return self._step_progs.get_or_create(
-            (bucket, bool(do_sample), int(top_k), int(rb)), _build)
+            (bucket, bool(do_sample), int(top_k), int(rb), sp), _build)
 
     def _lora_targets(self, rb: int):
         """The ``lora_targets`` builder knob for a rank bucket: the engine's
@@ -561,6 +590,46 @@ class InferenceEngineV2:
         return (self.lora.pool.pool, jnp.asarray(pt))
 
     @property
+    def attn_split_ladder(self) -> List[int]:
+        """The pow2 flash-decoding rung grid attention dispatches over:
+        ``[1, 2, 4, ..., config.attention.decode_splits]``. Rung 1 is the
+        chunk-serial kernel set exactly; each higher rung cuts every
+        sequence's page range into that many grid-parallel split-K partials
+        (docs/SERVING.md "Attention kernels"). warmup() pre-compiles every
+        program grid point at every rung, so the per-step rung choice
+        (:meth:`_attn_rung`) swaps cached executables — never compiles."""
+        top = self.config.attention.decode_splits
+        return [1 << i for i in range(top.bit_length())]
+
+    def _attn_rung(self) -> int:
+        """The split rung for THIS step's dispatch: the largest pow2 rung
+        such that the longest live context keeps ``min_ctx_per_split``
+        tokens per split, clamped to the warmed ladder — short-context
+        batches stay on the split=1 chunk-serial program (the merge pass is
+        pure overhead there) and the long-context tail climbs the ladder as
+        it grows. ``attn_rung_override`` pins the choice (bench A/B legs on
+        one warmed engine). Records the selection through the shared perf
+        stamps: one ``perf_counter`` pair feeds both the
+        ``serve/attn/select`` trace span and ``attn_stats`` (the
+        serve/attn/* monitor events), so timeline and dashboard agree."""
+        top = self.config.attention.decode_splits
+        if top <= 1:
+            return 1
+        if self.attn_rung_override is not None:
+            return max(1, min(int(self.attn_rung_override), top))
+        t0 = _time.perf_counter()
+        live = max((s.seen_tokens for s in self.scheduler.seqs.values()),
+                   default=0)
+        want = max(1, live // self.config.attention.min_ctx_per_split)
+        rung = min(top, 1 << (want.bit_length() - 1))
+        t1 = _time.perf_counter()
+        self.attn_stats.record(rung, live, t1 - t0)  # jaxlint: disable=JL001 -- host-only scheduler scan, nothing dispatched
+        if _tracer.enabled:
+            _tracer.add("serve/attn/select", t0, t1, lane="serve/attn",
+                        rung=rung, live_ctx=live)
+        return rung
+
+    @property
     def spec_k_ladder(self) -> List[int]:
         """The draft-length grid speculation dispatches over: pow2-minus-1
         rungs (K+1 a power of two — the chunk kernel's q-block then covers
@@ -577,26 +646,32 @@ class InferenceEngineV2:
         ks.append(k)
         return sorted(set(ks))
 
-    def _verify_prog(self, bucket: int, k: int, rb: int = 0):
+    def _verify_prog(self, bucket: int, k: int, rb: int = 0,
+                     sp: Optional[int] = None):
         """The fused speculative verify-step program (draft scoring in ONE
         ragged forward, ragged_model.build_verify_step) for one (bucket, k)
         grid point — the SpecDecodePipeline's hot program. LRU-cached;
         warmup() pre-compiles the whole grid. ``rb`` as in
         :meth:`_decode_step_prog` — rb > 0 verifies WITH each row's adapter
         delta (the K+1 token rows share the sequence's adapter), keeping
-        accepted spec tokens byte-identical to plain LoRA decode."""
+        accepted spec tokens byte-identical to plain LoRA decode. ``sp`` as
+        in :meth:`_decode_step_prog` — verify rides the SAME split rung as
+        decode so spec streams stay on warmed programs across the ladder."""
+        sp = self._attn_rung() if sp is None else int(sp)
+
         def _build():
             from deepspeed_tpu.inference.v2.ragged_model import (
                 build_verify_step)
             tp = self.topology.tp_world_size
             fwd = build_verify_step(self.spec, k, mesh=self.topology.mesh,
                                     tp=tp if tp > 1 else 1,
-                                    lora_targets=self._lora_targets(rb))
+                                    lora_targets=self._lora_targets(rb),
+                                    n_splits=sp)
             self.compiles += 1
             return jax.jit(fwd, donate_argnums=(1,))
 
-        return self._verify_progs.get_or_create((bucket, int(k), int(rb)),
-                                                _build)
+        return self._verify_progs.get_or_create(
+            (bucket, int(k), int(rb), sp), _build)
 
     def decode_pipeline(self, uids: Sequence[int], do_sample: bool = False,
                         temperature: float = 1.0, top_k: int = 0):
@@ -685,57 +760,72 @@ class InferenceEngineV2:
         if self.lora is not None:
             top = next_pow2(self.config.lora.max_rank)
             lora_rungs = [1 << i for i in range(top.bit_length())]
+        # the flash-decoding split-rung axis (attn_split_ladder): every
+        # program grid below is warmed at EVERY rung, so the per-step
+        # admission-driven rung choice swaps cached executables — context
+        # growth climbing the ladder adds zero steady-state compiles
+        attn_rungs = self.attn_split_ladder
         # the warmed set must FIT its LRUs, or warmup evicts programs it just
         # built and the zero-compiles invariant silently breaks on first use
         self._step_progs.maxsize = max(
-            self._step_progs.maxsize, (len(lora_rungs) + 1) * len(grid) + 2)
-        self._multistep.maxsize = max(self._multistep.maxsize,
-                                      len(burst_steps) * len(grid) + 2)
+            self._step_progs.maxsize,
+            (len(lora_rungs) + 1) * len(grid) * len(attn_rungs) + 2)
+        self._multistep.maxsize = max(
+            self._multistep.maxsize,
+            len(burst_steps) * len(grid) * len(attn_rungs) + 2)
         self._verify_progs.maxsize = max(
             self._verify_progs.maxsize,
-            (len(lora_rungs) + 1) * len(spec_ks) * len(grid) + 2)
+            (len(lora_rungs) + 1) * len(spec_ks) * len(grid)
+            * len(attn_rungs) + 2)
         self._warm_passes()
         mb = self.scheduler.max_blocks
-        for b in grid:
-            prog = self._decode_step_prog(b, False, 0)
-            args = self._scratch_step_args(b, mb)
-            nxt, _logits, new_kv = prog(self.weights, self.kv.kv, *args)
-            self.kv.update(new_kv)
-            jax.block_until_ready(nxt)
+        for sp in attn_rungs:
+            for b in grid:
+                prog = self._decode_step_prog(b, False, 0, sp=sp)
+                args = self._scratch_step_args(b, mb)
+                nxt, _logits, new_kv = prog(self.weights, self.kv.kv, *args)
+                self.kv.update(new_kv)
+                jax.block_until_ready(nxt)
         # the LoRA (bucket, rank-bucket) grid: every rung runs once over
         # all-pad rows with an all-zero-page table (exact-zero deltas — the
         # same traced shapes live mixed-tenant batches use)
         for rb in lora_rungs:
-            for b in grid:
-                prog = self._decode_step_prog(b, False, 0, rb)
-                args = self._scratch_step_args(b, mb)
-                lops = self._scratch_lora_args(b, rb)
-                nxt, _logits, new_kv = prog(self.weights, self.kv.kv, *args,
-                                            *lops)
-                self.kv.update(new_kv)
-                jax.block_until_ready(nxt)
+            for sp in attn_rungs:
+                for b in grid:
+                    prog = self._decode_step_prog(b, False, 0, rb, sp=sp)
+                    args = self._scratch_step_args(b, mb)
+                    lops = self._scratch_lora_args(b, rb)
+                    nxt, _logits, new_kv = prog(self.weights, self.kv.kv,
+                                                *args, *lops)
+                    self.kv.update(new_kv)
+                    jax.block_until_ready(nxt)
         for n_steps in burst_steps:
-            for b in grid:
-                fn = self._multistep.get_or_create(
-                    (n_steps, b, False, 0),
-                    lambda n=n_steps: self._build_multistep(n, False, 0))
-                args = self._scratch_step_args(b, mb)
-                out_ids, _logits, new_kv = fn(self.weights, self.kv.kv, *args)
-                self.kv.update(new_kv)
-                jax.block_until_ready(out_ids)
+            for sp in attn_rungs:
+                for b in grid:
+                    fn = self._multistep.get_or_create(
+                        (n_steps, b, False, 0, sp),
+                        lambda n=n_steps, s=sp: self._build_multistep(
+                            n, False, 0, s))
+                    args = self._scratch_step_args(b, mb)
+                    out_ids, _logits, new_kv = fn(self.weights, self.kv.kv,
+                                                  *args)
+                    self.kv.update(new_kv)
+                    jax.block_until_ready(out_ids)
         # the speculative (bucket, k) verify grid: every program runs once
         # over all-scratch rows with zero proposed drafts (accept masks and
         # page writes exercise the same traced shapes live traffic uses)
         for k in spec_ks:
             for b in grid:
                 for rb in [0] + lora_rungs:
-                    prog = self._verify_prog(b, k, rb)
-                    args = self._scratch_verify_args(b, k, mb)
-                    lops = self._scratch_lora_args(b, rb)
-                    _acc, nxt, _fl, new_kv = prog(self.weights, self.kv.kv,
-                                                  *args, *lops)
-                    self.kv.update(new_kv)
-                    jax.block_until_ready(nxt)
+                    for sp in attn_rungs:
+                        prog = self._verify_prog(b, k, rb, sp=sp)
+                        args = self._scratch_verify_args(b, k, mb)
+                        lops = self._scratch_lora_args(b, rb)
+                        _acc, nxt, _fl, new_kv = prog(self.weights,
+                                                      self.kv.kv,
+                                                      *args, *lops)
+                        self.kv.update(new_kv)
+                        jax.block_until_ready(nxt)
         # the KV page round-trip pair (preempt-offload / page fabric) over
         # its whole bucket grid: rare path, but a preemption DURING the
         # timed steady state must not compile — warm both ops per bucket
@@ -768,16 +858,19 @@ class InferenceEngineV2:
                  ranks=[0])
         return built
 
-    def _build_multistep(self, n_steps: int, do_sample: bool, top_k: int):
+    def _build_multistep(self, n_steps: int, do_sample: bool, top_k: int,
+                         sp: int = 1):
         """Build (and count) one fused multistep program — the same builder
-        decode_steps uses, shared so warmup pre-compiles identical keys."""
+        decode_steps uses, shared so warmup pre-compiles identical keys.
+        ``sp`` is the flash-decoding split rung the program attends at."""
         from deepspeed_tpu.inference.v2.ragged_model import (
             build_multistep_decode)
         tp = self.topology.tp_world_size
         fwd = build_multistep_decode(
             self.spec, n_steps, mesh=self.topology.mesh,
             tp=tp if tp > 1 else 1, do_sample=do_sample, top_k=top_k,
-            window_ring_ok=self.scheduler.ring_covers(n_steps + 1))
+            window_ring_ok=self.scheduler.ring_covers(n_steps + 1),
+            n_splits=int(sp))
         self.compiles += 1
         return jax.jit(fwd, donate_argnums=(1,))
 
@@ -834,16 +927,19 @@ class InferenceEngineV2:
             return b
 
         # paged/mixed pass: one decode row ticking over in the scratch page
+        # — once per split rung (every rung's pass program is reachable
+        # from steady state, so every one must be warm)
         b = scratch_batch()
         b.decode_block_tables[0] = self.scratch_block
         b.decode_ctx_lens[0] = 1
         b.kv_dest[NC * Cs] = self.kv.flat_write_index(self.scratch_block, 0)
         arrays = b.device_arrays()
-        _, _, new_kv = self._pass(self.weights, self.kv.kv,
-                                  {k: arrays[k] for k in PAGED_PASS_KEYS})
-        # direct rebind (not .update()) so JL003 sees the donated pool's
-        # reference replaced before the next pass reads it
-        self.kv.kv = new_kv
+        for pass_fn in self._pass_rungs.values():
+            _, _, new_kv = pass_fn(self.weights, self.kv.kv,
+                                   {k: arrays[k] for k in PAGED_PASS_KEYS})
+            # direct rebind (not .update()) so JL003 sees the donated pool's
+            # reference replaced before the next pass reads it
+            self.kv.kv = new_kv
         if self.spec.alibi:
             return  # ALiBi engines never take the packed prefill fast path
         # prefill fast path: a one-token prompt prefilling into scratch
@@ -893,7 +989,9 @@ class InferenceEngineV2:
             pass_fn = self._ensure_prefill_pass()
             arrays = {k: arrays[k] for k in PREFILL_PASS_KEYS}
         else:
-            pass_fn = self._pass
+            # rung-keyed paged pass: the decode rows ride this step's
+            # split rung (rung 1 is self._pass — byte-identical)
+            pass_fn = self._pass_rungs.get(self._attn_rung(), self._pass)
             arrays = {k: arrays[k] for k in PAGED_PASS_KEYS}
         chunk_logits, decode_logits, new_kv = pass_fn(
             self.weights, self.kv.kv, arrays)
@@ -1153,6 +1251,8 @@ class InferenceEngineV2:
             monitor.write_events(self.pipeline_stats.events(step))
         if self.spec_stats.steps:
             monitor.write_events(self.spec_stats.events(step))
+        if self.attn_stats.selects:
+            monitor.write_events(self.attn_stats.events(step))
         if self.lora is not None and self.lora.stats.adapters:
             monitor.write_events(self.lora.stats.events(step))
 

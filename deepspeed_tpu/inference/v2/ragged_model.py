@@ -844,7 +844,8 @@ PREFILL_PASS_KEYS = (
 
 def build_ragged_forward(spec: RaggedModelSpec,
                          mesh=None,
-                         tp: int = 1) -> Callable:
+                         tp: int = 1,
+                         n_splits: int = 1) -> Callable:
     """Returns ``fwd(weights, kv_pages, batch) ->
     (chunk_logits [NC, V], decode_logits [S, V], new_kv)`` where
     ``chunk_logits[j]`` holds the logits after slot j's last token.
@@ -859,7 +860,7 @@ def build_ragged_forward(spec: RaggedModelSpec,
     hid = spec.hidden_size
     dtype = spec.dtype
 
-    ak = AttentionKernelSpec(spec, mesh=mesh, tp=tp)
+    ak = AttentionKernelSpec(spec, mesh=mesh, tp=tp, n_splits=n_splits)
 
     def fwd(weights, kv_pages, b):
         kv_pages, kv_sc = _kv_unpack(kv_pages)
@@ -1004,7 +1005,8 @@ def build_prefill_forward(spec: RaggedModelSpec,
 
 
 def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
-                             do_sample: bool, top_k: int) -> Callable:
+                             do_sample: bool, top_k: int,
+                             n_splits: int = 1) -> Callable:
     """Fused multistep decode WITHOUT per-step pool scatters.
 
     The default multistep loop writes each step's K/V into the paged pools
@@ -1044,7 +1046,7 @@ def _build_multistep_sidebuf(spec: RaggedModelSpec, n_steps: int,
     while (Cb * Hkv) % 8 != 0:
         Cb += 1
     scale = 1.0 / (D ** 0.5)
-    ak = AttentionKernelSpec(spec, mesh=None, tp=1)
+    ak = AttentionKernelSpec(spec, mesh=None, tp=1, n_splits=n_splits)
 
     def fwd(weights, kv_pages, ids0, positions0, block_tables, ctx0,
             key, temperature=1.0):
@@ -1215,8 +1217,8 @@ def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
                            top_k: int = 0,
                            window_ring_ok: bool = False,
                            max_side_bytes: Optional[int] = None,
-                           lora_targets: Optional[Tuple[str, ...]] = None
-                           ) -> Callable:
+                           lora_targets: Optional[Tuple[str, ...]] = None,
+                           n_splits: int = 1) -> Callable:
     """Fused N-step greedy/sampled decode: the sample->embed->forward->sample
     feedback loop runs entirely on device for ``n_steps`` tokens per sequence.
 
@@ -1252,7 +1254,8 @@ def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
     """
     general = _build_multistep_general(spec, n_steps, mesh=mesh, tp=tp,
                                        do_sample=do_sample, top_k=top_k,
-                                       lora_targets=lora_targets)
+                                       lora_targets=lora_targets,
+                                       n_splits=n_splits)
     # LoRA programs take the general (per-step write) loop only: the
     # side-buffer schedule's decode path is the single-step pipeline's
     # domain and wiring adapter operands into its frozen-read scan buys
@@ -1261,7 +1264,8 @@ def build_multistep_decode(spec: RaggedModelSpec, n_steps: int,
             and (spec.window is None or window_ring_ok))
     if not fits:
         return general
-    sidebuf = _build_multistep_sidebuf(spec, n_steps, do_sample, top_k)
+    sidebuf = _build_multistep_sidebuf(spec, n_steps, do_sample, top_k,
+                                       n_splits=n_splits)
     if max_side_bytes is None:
         import os
         max_side_bytes = int(float(os.environ.get(
@@ -1298,8 +1302,8 @@ def _sample_logits(logits, key, do_sample: bool, top_k: int, temperature):
 def build_decode_step(spec: RaggedModelSpec, mesh=None, tp: int = 1,
                       do_sample: bool = False, top_k: int = 0,
                       window_ring_ok: bool = False,
-                      lora_targets: Optional[Tuple[str, ...]] = None
-                      ) -> Callable:
+                      lora_targets: Optional[Tuple[str, ...]] = None,
+                      n_splits: int = 1) -> Callable:
     """One fused decode step for the double-buffered serving pipeline:
     consume ``ids`` [S] (this step's tokens, already sampled), write their KV,
     run the forward pass, and sample the NEXT token row — all in ONE device
@@ -1327,7 +1331,8 @@ def build_decode_step(spec: RaggedModelSpec, mesh=None, tp: int = 1,
     inner = build_multistep_decode(spec, 1, mesh=mesh, tp=tp,
                                    do_sample=do_sample, top_k=top_k,
                                    window_ring_ok=window_ring_ok,
-                                   lora_targets=lora_targets)
+                                   lora_targets=lora_targets,
+                                   n_splits=n_splits)
 
     def fwd(weights, kv_pages, ids, positions, block_tables, ctx,
             key, temperature=1.0, *lora_args):
@@ -1346,8 +1351,8 @@ def build_decode_step(spec: RaggedModelSpec, mesh=None, tp: int = 1,
 
 def build_verify_step(spec: RaggedModelSpec, k: int, mesh=None,
                       tp: int = 1,
-                      lora_targets: Optional[Tuple[str, ...]] = None
-                      ) -> Callable:
+                      lora_targets: Optional[Tuple[str, ...]] = None,
+                      n_splits: int = 1) -> Callable:
     """Speculative-decode verify step: score ``k`` draft tokens per sequence
     in ONE ragged forward (``inference/v2/spec/``; docs/SERVING.md
     "Speculative decoding").
@@ -1398,7 +1403,7 @@ def build_verify_step(spec: RaggedModelSpec, k: int, mesh=None,
     dtype = spec.dtype
     K1 = k + 1
 
-    ak = AttentionKernelSpec(spec, mesh=mesh, tp=tp)
+    ak = AttentionKernelSpec(spec, mesh=mesh, tp=tp, n_splits=n_splits)
 
     def fwd(weights, kv_pages, ids, draft, n_draft, positions0,
             block_tables, ctx0, *lora_args):
@@ -1501,8 +1506,8 @@ def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
                              mesh=None, tp: int = 1,
                              do_sample: bool = False,
                              top_k: int = 0,
-                             lora_targets: Optional[Tuple[str, ...]] = None
-                             ) -> Callable:
+                             lora_targets: Optional[Tuple[str, ...]] = None,
+                             n_splits: int = 1) -> Callable:
     """The per-step-write multistep loop (fused attention+page-write kernel
     per layer per step): the fallback when the side-buffer schedule's gates
     fail (TP sharding, small head_dim, window-ring capacity, side-buffer HBM
@@ -1513,7 +1518,7 @@ def _build_multistep_general(spec: RaggedModelSpec, n_steps: int,
     H, Hkv, D = spec.num_heads, spec.num_kv_heads, spec.head_dim
     dtype = spec.dtype
 
-    ak = AttentionKernelSpec(spec, mesh=mesh, tp=tp)
+    ak = AttentionKernelSpec(spec, mesh=mesh, tp=tp, n_splits=n_splits)
 
     def fwd(weights, kv_pages, ids0, positions0, block_tables, ctx0,
             key, temperature=1.0, *lora_args):

@@ -193,6 +193,68 @@ class SpecDecodeStats:
         ]
 
 
+@dataclass
+class AttnSplitStats:
+    """Aggregate counters for the flash-decoding split ladder
+    (``engine_v2._attn_rung``; docs/SERVING.md "Attention kernels").
+    Per-window aggregations over the SAME ``perf_counter`` pairs the tracer
+    records as ``serve/attn/select`` spans — one stamp pair per rung choice
+    feeds both (docs/OBSERVABILITY.md), so the dashboard's selection-cost
+    number and the timeline can never disagree.
+
+    Semantics per selection: ``selects`` counts rung choices made on the
+    hot path; ``splits`` sums the chosen rung so splits/select is the
+    average grid-parallelism decode ran at; ``merged_steps`` counts
+    choices that landed on a rung > 1 — steps whose attention ran split-K
+    partials plus an LSE merge pass (rung 1 is the chunk-serial program:
+    no partials, no merge); ``max_live_ctx`` high-waters the admission
+    signal the rung is keyed on; ``select_ms`` is host time inside the
+    rung choice (scheduler scan + clamp — the overhead the ladder adds to
+    every step)."""
+
+    selects: int = 0
+    splits: int = 0
+    merged_steps: int = 0
+    max_live_ctx: int = 0
+    select_ms: float = 0.0
+    #: replica label (``serving/cluster.py``): when not None, event names
+    #: become ``serve/attn/<replica>/...`` (never cleared by reset())
+    replica: Optional[str] = None
+
+    def record(self, rung: int, live_ctx: int, select_s: float) -> None:
+        self.selects += 1
+        self.splits += int(rung)
+        if rung > 1:
+            self.merged_steps += 1
+        self.max_live_ctx = max(self.max_live_ctx, int(live_ctx))
+        self.select_ms += 1e3 * select_s
+
+    def reset(self) -> None:
+        self.selects = 0
+        self.splits = 0
+        self.merged_steps = 0
+        self.max_live_ctx = 0
+        self.select_ms = 0.0
+
+    @property
+    def splits_per_select(self) -> float:
+        return self.splits / self.selects if self.selects else 0.0
+
+    def events(self, step: int = 0) -> List[Event]:
+        """``serve/attn/*`` monitor events (docs/OBSERVABILITY.md taxonomy);
+        replica-labelled (``serve/attn/<replica>/*``) under a cluster."""
+        n = max(1, self.selects)
+        pre = "serve/attn" if self.replica is None \
+            else f"serve/attn/{self.replica}"
+        return [
+            (f"{pre}/selects", float(self.selects), step),
+            (f"{pre}/splits_per_select", self.splits_per_select, step),
+            (f"{pre}/merged_steps", float(self.merged_steps), step),
+            (f"{pre}/max_live_ctx", float(self.max_live_ctx), step),
+            (f"{pre}/select_ms_per_step", self.select_ms / n, step),
+        ]
+
+
 #: latency samples retained per class (completed requests only); percentiles
 #: below compute over this sliding window
 SAMPLE_WINDOW = 4096

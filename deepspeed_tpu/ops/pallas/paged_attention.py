@@ -174,16 +174,30 @@ def _colscale_pages(mat, tile_ref, n_pages, nsub, off):
 
 
 def _pick_pages_per_chunk(bs: int, h_kv: int, d: int, esize: int,
-                          max_blocks: int, reserve_bytes: int = 0) -> int:
+                          max_blocks: int, reserve_bytes: int = 0,
+                          scale_tile_rows: int = 0, flash_heads: int = 0,
+                          out_bytes: int = 0) -> int:
     """Largest P with the 2-slot combined-KV slabs within ~8 MB of VMEM
-    (~16 MB on v5e; q/o blocks, score tiles and accumulators are small).
-    Fatter chunks amortise the per-grid-step fixed cost, the dominant
-    decode overhead. ``reserve_bytes``: VMEM the caller holds besides the
-    page slabs (the sidebuf kernel's side slabs)."""
+    (~16 MB on v5e; q blocks and score tiles are small). Fatter chunks
+    amortise the per-grid-step fixed cost, the dominant decode overhead.
+
+    ``reserve_bytes``: VMEM the caller holds besides the page slabs (the
+    sidebuf kernel's side slabs). ``flash_heads``: H of the f32 flash
+    scratch ((m, l) [H, 128] pair + [H, D] accumulator) — the running
+    partial state split-K multiplies across virtual rows, reserved off the
+    top so fat chunks can't overrun the budget. ``out_bytes``: the
+    double-buffered output blocks a caller pipelines (the split-K kernel's
+    f32 (out, lse) partial blocks). ``scale_tile_rows``: R8 of an int8
+    page's scale tile — charged PER PAGE (each resident page slot carries
+    its scale-tile slot, so the cost scales with P, not off the top)."""
     import os
     budget = int(os.environ.get("DSTPU_PAGED_VMEM_BUDGET",
-                                8 * 1024 * 1024)) - reserve_bytes
+                                8 * 1024 * 1024)) - reserve_bytes - out_bytes
+    if flash_heads:
+        budget -= (flash_heads * d + 2 * flash_heads * 128) * 4
     per_page = 2 * 2 * bs * h_kv * d * esize     # 2 slots x (K + V)
+    if scale_tile_rows:
+        per_page += 2 * scale_tile_rows * 128 * 4  # 2 slots x scale tile
     return max(1, min(max_blocks, budget // per_page))
 
 
@@ -860,7 +874,9 @@ def paged_decode_attention_sidebuf(q: jax.Array,
     esize = jnp.dtype(kv_pages.dtype).itemsize
     side_vmem = 2 * Cs * Hkv * D * jnp.dtype(side_k.dtype).itemsize
     P = _pick_pages_per_chunk(bs, Hkv, D, esize, MB,
-                              reserve_bytes=side_vmem)
+                              reserve_bytes=side_vmem, flash_heads=H,
+                              scale_tile_rows=_scale_tile_rows(Hkv, bs)
+                              if quant else 0)
     NC = -(-MB // P)
     assert (bs * Hkv) % 8 == 0
     if quant:
@@ -1107,12 +1123,14 @@ def paged_decode_attention(q: jax.Array,
         return _paged_decode_smalld(q, kv_pages, block_tables,
                                     ctx_lens, scale, window=window,
                                     alibi=alibi)
-    P = _pick_pages_per_chunk(bs, Hkv, D, jnp.dtype(kv_pages.dtype).itemsize,
-                              MB)
-    NC = -(-MB // P)
     if quant:
         assert not with_lse, "with_lse + int8 pages not needed by any caller"
         assert (Hkv * bs) % 128 == 0, "scale tiles need lane alignment"
+    P = _pick_pages_per_chunk(bs, Hkv, D, jnp.dtype(kv_pages.dtype).itemsize,
+                              MB, flash_heads=H,
+                              scale_tile_rows=_scale_tile_rows(Hkv, bs)
+                              if quant else 0)
+    NC = -(-MB // P)
 
     kernel = functools.partial(
         _decode_kernel_quant if quant
@@ -1275,7 +1293,9 @@ def paged_decode_attention_step(q: jax.Array,
                                    window=window, alibi=alibi)
         return out, kvf
     P = _pick_pages_per_chunk(bs, Hkv, D, jnp.dtype(kv_pages.dtype).itemsize,
-                              MB)
+                              MB, flash_heads=H,
+                              scale_tile_rows=_scale_tile_rows(Hkv, bs)
+                              if quant else 0)
     NC = -(-MB // P)
     assert (bs * Hkv) % 8 == 0
 

@@ -53,6 +53,16 @@ timeout -k 10 600 python benchmarks/serving_bench.py --frontend --smoke \
 timeout -k 10 300 python benchmarks/serving_bench.py --spec --smoke \
     --spec-k 7 || exit 1
 
+# flash-decoding long-context leg (docs/SERVING.md "Attention kernels"):
+# few sequences x long ctx on ONE engine warmed across the pow2 split
+# ladder — split=1 (chunk-serial) vs auto rung selection, gating identical
+# token streams, zero timed compiles, allocator baseline and ladder
+# engagement; emits the serve/attn rung-selection trace lane trace_check
+# requires below (the >=1.3x op-level split-K bar runs full-size,
+# BENCH_r17)
+timeout -k 10 300 python benchmarks/serving_bench.py --long-context \
+    --smoke || exit 1
+
 # multi-replica router leg (docs/SERVING.md "Multi-replica &
 # disaggregation"): 2 replicas behind a ServingRouter on a seeded
 # shared-prefix Poisson stream, correctness gates only — every checked
@@ -72,7 +82,7 @@ DSTPU_LOCKSAN=1 timeout -k 10 300 \
 # adapter pool holds — correctness gates only (byte-identical mixed-batch
 # streams vs direct per-adapter runs, zero compiles across adapter churn,
 # allocator + adapter pool at baseline; the >=1.5x goodput-vs-naive gate
-# runs full-size, BENCH_r17); the cold-adapter fault-ins emit the
+# runs full-size, BENCH_r18); the cold-adapter fault-ins emit the
 # serve/lora trace lane trace_check requires below
 timeout -k 10 300 python benchmarks/serving_bench.py --lora --smoke \
     || exit 1
@@ -132,7 +142,8 @@ timeout -k 10 300 python benchmarks/serving_bench.py --trace-overhead \
 # parseable flight-recorder dump from the --preempt kills
 timeout -k 10 120 python scripts/trace_check.py "$TRACE_DIR" \
     --require train serve serve/req serve/spec serve/router serve/health \
-    serve/lora ckpt train/offload train/zero3 --require-flows serve/req \
+    serve/lora serve/attn ckpt train/offload train/zero3 \
+    --require-flows serve/req \
     --expect-crash || exit 1
 
 # clock-align + merge the per-process trace files into one timeline; the

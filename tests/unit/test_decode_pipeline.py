@@ -110,7 +110,7 @@ def test_decode_steps_key_rounds_to_bucket():
     e.put([0, 1, 2], PROMPTS)
     e.decode_steps([0, 1, 2], 2)               # S=3 -> bucket 4
     c_after_first = e.compiles
-    assert ((2, 4, False, 0) in e._multistep)  # key carries the BUCKET
+    assert ((2, 4, False, 0, 1) in e._multistep)  # key carries the BUCKET (and split rung)
     e.put([3], [np.array([9, 9, 9], np.int32)])
     e.decode_steps([0, 1, 2, 3], 2)            # S=4 -> same bucket, same prog
     assert e.compiles == c_after_first

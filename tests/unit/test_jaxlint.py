@@ -1217,3 +1217,72 @@ def test_jl008_zero3_prefetch_span_policed():
     """)
     assert "JL008" not in rules_of(lint_text(
         clean, path="deepspeed_tpu/runtime/zero/prefetch.py", config=cfg))
+
+
+def test_jl007_splitk_module_policed():
+    """The split-K dispatchers (ops/pallas/paged_splitk.py) run inside
+    every warmed decode program — the SHIPPED config hot-path polices the
+    module: a stray blocking fetch (e.g. a debug drain of the partials)
+    fires; its actual discipline (pure jnp tracing code, no host
+    conversions) is clean."""
+    raw = _repo_config()
+    hot = raw["rules"]["JL007"]["options"]["hot_paths"]
+    assert "deepspeed_tpu/ops/pallas/paged_splitk.py" in hot
+    cfg = LintConfig(rules={"JL007": RuleSettings(
+        options=raw["rules"]["JL007"]["options"])})
+    src = textwrap.dedent("""
+        import numpy as np
+
+        def merge_debug(out_p, lse_p):
+            return np.asarray(lse_p).max()
+    """)
+    findings = lint_text(src,
+                         path="deepspeed_tpu/ops/pallas/paged_splitk.py",
+                         config=cfg)
+    assert rules_of(findings) == ["JL007"]
+    clean = textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def merge(out_p, lse_p):
+            m = jnp.max(lse_p, axis=0)
+            w = jnp.exp(lse_p - m[None])
+            num = jnp.einsum("sbh,sbhd->bhd", w, out_p)
+            return num / jnp.sum(w, axis=0)[..., None]
+    """)
+    assert lint_text(clean,
+                     path="deepspeed_tpu/ops/pallas/paged_splitk.py",
+                     config=cfg) == []
+
+
+def test_jl008_splitk_module_span_policed():
+    """A serve/attn span must never enclose a blocking fetch — the rung
+    selection span times a host scan, and a device drain inside it would
+    bill kernel wait to the selector. The module's clean shape (span around
+    host-only arithmetic) passes."""
+    raw = _repo_config()
+    assert "deepspeed_tpu/ops/pallas/paged_splitk.py" in \
+        raw["rules"]["JL008"]["options"]["hot_paths"]
+    cfg = LintConfig(rules={"JL008": RuleSettings(
+        options=raw["rules"]["JL008"]["options"])})
+    src = textwrap.dedent("""
+        import jax
+        from deepspeed_tpu.monitor.trace import tracer
+
+        def pick_rung(partials):
+            with tracer.span("serve/attn/select"):
+                return jax.device_get(partials)
+    """)
+    findings = lint_text(src,
+                         path="deepspeed_tpu/ops/pallas/paged_splitk.py",
+                         config=cfg)
+    assert "JL008" in rules_of(findings)
+    clean = textwrap.dedent("""
+        from deepspeed_tpu.monitor.trace import tracer
+
+        def pick_rung(live_ctx, min_ctx, top):
+            with tracer.span("serve/attn/select"):
+                want = max(1, live_ctx // min_ctx)
+                return min(top, 1 << (want.bit_length() - 1))
+    """)
+    assert "JL008" not in rules_of(lint_text(
+        clean, path="deepspeed_tpu/ops/pallas/paged_splitk.py", config=cfg))
