@@ -992,6 +992,10 @@ def run_lora(on_tpu: bool, smoke: bool, rate: float, duration: float,
                                     len(h.tokens), a.adapter)
             equal += got == h.tokens
         compiles_ref = engine.compiles - c1
+        # settle the swap pool before the baseline: adapters that happen to
+        # sit EVICTED here legitimately hold pinned buffers, which the leak
+        # check would misread as outstanding (the --lora --smoke flake)
+        engine.lora.drain_swap()
         pool_ok, pool_detail = _lora_pool_baseline(engine)
         kv_ok = engine.allocator.free_blocks == kv_free0
         out = {

@@ -132,6 +132,13 @@ class InferenceEngineV2:
         if family is None:
             family = _guess_family(model)
         self.family = family
+        # adapter inputs, re-run by the colocated WeightBridge
+        # (runtime/colocated.py) to trace the train->serve reshard program
+        self.model_config = model_config
+        # monotone weight-version stamp: bumped by every swap_weights();
+        # the prefix cache keys/flushes on it (stale-KV refusal) and the
+        # serving frontend tags post-swap streams with it
+        self.weight_version = 0
         if model_parameters is None:
             raise ValueError("InferenceEngineV2 needs model_parameters")
         from deepspeed_tpu.utils.tree import tree_cast
@@ -331,6 +338,82 @@ class InferenceEngineV2:
             lambda s: NamedSharding(topo.mesh, s), specs,
             is_leaf=lambda s: isinstance(s, P))
         return jax.device_put(weights, shardings)
+
+    # ------------------------------------------------------------------ #
+    # in-place weight swap (colocated rollout; runtime/colocated.py)
+    # ------------------------------------------------------------------ #
+
+    def swap_weights(self, new_weights: Any,
+                     version: Optional[int] = None) -> int:
+        """Rebind ``self.weights`` to a new device tree in place — the
+        train->serve sync point of the colocated rollout loop.
+
+        Every device program this engine builds (the pass/decode/multistep/
+        verify grids, warmup() included) takes the weight tree as a RUNTIME
+        operand (``prog(self.weights, self.kv.kv, ...)``), so a swap whose
+        tree matches the old one leaf-for-leaf in structure, shape, dtype
+        and sharding reuses every cached executable: ZERO new compiles, the
+        pow2/split/rank ladders survive untouched. Anything that does not
+        match is refused up front — a silent mismatch would recompile the
+        grid mid-steady-state (or serve garbage).
+
+        The caller must have quiesced the engine first: no live sequences
+        (KV computed under the old weights must never be decoded under the
+        new ones — the ServingFrontend's swap path recompute-preempts
+        in-flight requests at a run boundary exactly like preemption).
+        The prefix cache is flushed by weight-version stamp, and host-side
+        logits snapshots from pre-swap passes are dropped.
+
+        Returns the new ``weight_version``."""
+        if self.scheduler.seqs:
+            raise RuntimeError(
+                f"swap_weights with {len(self.scheduler.seqs)} live "
+                "sequence(s) — their KV was computed under the old weights; "
+                "quiesce first (frontend swap preempts at a run boundary, "
+                "direct drivers flush() every uid)")
+        old_leaves, old_def = jax.tree_util.tree_flatten(self.weights)
+        new_leaves, new_def = jax.tree_util.tree_flatten(new_weights)
+        if new_def != old_def:
+            raise ValueError(
+                "swap_weights tree structure mismatch — the replacement "
+                "tree must come from the same family adapter layout "
+                f"(expected {old_def}, got {new_def})")
+        paths = [jax.tree_util.keystr(kp) for kp, _ in
+                 jax.tree_util.tree_flatten_with_path(self.weights)[0]]
+        for path, o, n in zip(paths, old_leaves, new_leaves):
+            if o.shape != n.shape or o.dtype != n.dtype:
+                raise ValueError(
+                    f"swap_weights leaf {path}: expected "
+                    f"{o.dtype}{list(o.shape)}, got {n.dtype}{list(n.shape)} "
+                    "— a shape/dtype drift would recompile every warmed "
+                    "program")
+            osh = getattr(o, "sharding", None)
+            nsh = getattr(n, "sharding", None)
+            if osh is not None and nsh != osh:
+                raise ValueError(
+                    f"swap_weights leaf {path}: sharding {nsh} != engine "
+                    f"layout {osh} — reshard through WeightBridge "
+                    "(runtime/colocated.py), whose out_shardings are taken "
+                    "from this engine's weights")
+        if version is None:
+            version = self.weight_version + 1
+        elif version <= self.weight_version:
+            raise ValueError(
+                f"swap_weights version {version} is not newer than the "
+                f"current weight_version {self.weight_version} — versions "
+                "are monotone (the prefix cache keys staleness on them)")
+        self.weights = new_weights
+        self.weight_version = version
+        if self.prefix_cache is not None:
+            # flush-by-version: cached KV pages hold old-weight state; a
+            # post-swap match must miss and re-prefill (regression-pinned
+            # by tests/unit/test_colocated.py)
+            self.prefix_cache.set_weight_version(version)
+        # host-side logits snapshots and device row refs from pre-swap
+        # passes are old-weight state: drop, never resample from them
+        self._last_logits.clear()
+        self._last_ref.clear()
+        return version
 
     # ------------------------------------------------------------------ #
     # public API (parity: engine_v2.py put/query/can_schedule/flush)
@@ -1234,6 +1317,15 @@ class InferenceEngineV2:
         (``serving/cluster.py``)."""
         from deepspeed_tpu.inference.v2.serving import ServingFrontend
         return ServingFrontend(self, config=config, uid_base=uid_base)
+
+    def weight_bridge(self, train_engine, **kwargs):
+        """A :class:`~deepspeed_tpu.runtime.colocated.WeightBridge` from a
+        colocated training engine into this engine's weight layout — one
+        jitted device-resident reshard per policy update, swapped in via
+        ``swap_weights`` with zero recompiles (docs/SERVING.md "Colocated
+        rollout")."""
+        from deepspeed_tpu.runtime.colocated import WeightBridge
+        return WeightBridge(train_engine, self, **kwargs)
 
     # ------------------------------------------------------------------ #
     # prefix-cache support

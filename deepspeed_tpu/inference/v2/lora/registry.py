@@ -164,6 +164,31 @@ class LoraAdapterRegistry:
             del self._adapters[name]
         self.stats.drop(name)
 
+    def drain_swap(self) -> int:
+        """Return every EVICTED adapter's pinned buffers to the swap pool;
+        returns the number of buffers drained.
+
+        Byte-safe: the host master rows are retained for the adapter's
+        whole lifetime, so a drained adapter just drops back to
+        REGISTERED and its next fault-in re-uploads from the master
+        instead of the pinned snapshot. Settles the pool to its quiescent
+        baseline (``swap.outstanding == 0``) for leak accounting —
+        benchmarks snapshot their pool baselines after this, otherwise
+        whichever adapters HAPPEN to sit evicted at snapshot time read as
+        leaked buffers (the serving_bench --lora baseline flake)."""
+        with self._meta:
+            evicted = [ad for ad in self._adapters.values()
+                       if ad.state == EVICTED]
+        drained = 0
+        for ad in evicted:
+            for buf in ad.bufs:
+                self.swap.put(buf)
+            drained += len(ad.bufs)
+            with self._meta:
+                ad.bufs = []
+                ad.state = REGISTERED
+        return drained
+
     def _get(self, name: str) -> _Adapter:
         try:
             return self._adapters[name]

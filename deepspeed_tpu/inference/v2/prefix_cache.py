@@ -101,7 +101,7 @@ class PrefixCacheStats:
 
 class _RadixNode:
     __slots__ = ("key", "block_id", "parent", "children", "partials",
-                 "last_access", "chain")
+                 "last_access", "chain", "version")
 
     def __init__(self, key: Tuple[int, ...], block_id: Optional[int],
                  parent: Optional["_RadixNode"]):
@@ -114,6 +114,11 @@ class _RadixNode:
         # root->node chain hash (chain_hash); None for partial leaves — only
         # full-block nodes are routable (the router delta feed skips partials)
         self.chain: Optional[int] = None
+        # weight-version stamp (colocated rollout): the engine weights this
+        # node's KV page was computed under. A node whose stamp trails the
+        # tree's current version is stale-KV — match/match_len refuse it
+        # even if a deferred flush left it in the tree.
+        self.version = 0
 
     @property
     def is_leaf(self) -> bool:
@@ -151,6 +156,14 @@ class RadixPrefixCache:
         # (not routable: adoption is COW, not sharing).
         self._listeners: List[Callable[[str, int], None]] = []
         self.stats = PrefixCacheStats()
+        # the engine-weight version every cached page's KV was computed
+        # under (colocated rollout, runtime/colocated.py): a weight swap
+        # bumps this through ``set_weight_version``, which flushes the tree
+        # — cached KV from the old weights can never satisfy a post-swap
+        # match. Inserts stamp nodes with the current version; matches
+        # refuse any node whose stamp trails it (defense in depth on top of
+        # the eager flush).
+        self.weight_version = 0
 
     # ------------------------------------------------------------------ #
     # introspection
@@ -236,7 +249,7 @@ class RadixPrefixCache:
         i = 0
         while i + bs <= limit:
             child = node.children.get(tuple(tokens[i:i + bs]))
-            if child is None:
+            if child is None or child.version != self.weight_version:
                 break
             node = child
             i += bs
@@ -273,7 +286,10 @@ class RadixPrefixCache:
         i = 0
         while i + bs <= limit:
             child = node.children.get(tuple(tokens[i:i + bs]))
-            if child is None:
+            if child is None or child.version != self.weight_version:
+                # a stale-version child holds KV computed under swapped-out
+                # weights — a hit here would splice wrong KV into a fresh
+                # sequence, so the walk refuses and the tail prefills fresh
                 break
             out.blocks.append(child.block_id)
             node = child
@@ -290,6 +306,7 @@ class RadixPrefixCache:
         for key, leaf in node.partials.items():
             p = len(key)
             if (i + p <= limit and tuple(tokens[i:i + p]) == key
+                    and leaf.version == self.weight_version
                     and (best is None or p > len(best.key))):
                 best = leaf
         if best is not None and self.cow_fn is not None:
@@ -344,16 +361,24 @@ class RadixPrefixCache:
         freed: List[int] = []
         node = self.root
         consumed = 0                      # blocks whose seq-ref we've settled
+        stale_stop = False
         i = 0
         while i + bs <= len(tokens) and consumed < len(blocks):
             key = tuple(tokens[i:i + bs])
             blk = blocks[consumed]
             child = node.children.get(key)
+            if child is not None and child.version != self.weight_version:
+                # a stale-version node survived a deferred flush: never file
+                # fresh pages under it (the path above it is unservable) —
+                # the remaining refs release below and eviction reclaims it
+                stale_stop = True
+                break
             if child is None:
                 # a partial leaf with this key's prefix may exist; it stays —
                 # matches prefer full children, and eviction reclaims it
                 child = _RadixNode(key, blk, node)
                 child.chain = chain_hash(node.chain, key)
+                child.version = self.weight_version
                 node.children[key] = child
                 self._nodes += 1
                 self.stats.insertions += 1
@@ -370,11 +395,15 @@ class RadixPrefixCache:
         # partial tail: remaining known tokens that end mid-page
         tip = node                    # deepest node to LRU-touch at the end
         tail = tuple(tokens[i:])
-        if tail and consumed < len(blocks):
+        stale_leaf = (node.partials.get(tail).version != self.weight_version
+                      if tail and tail in node.partials else False)
+        if tail and consumed < len(blocks) and not stale_stop \
+                and not stale_leaf:
             blk = blocks[consumed]
             leaf = node.partials.get(tail)
             if leaf is None:
                 leaf = _RadixNode(tail, blk, node)
+                leaf.version = self.weight_version
                 node.partials[tail] = leaf
                 self._nodes += 1
                 self.stats.insertions += 1
@@ -403,6 +432,33 @@ class RadixPrefixCache:
         """Flush-time entry point: insert with reference transfer (completed
         sequences' pages return to the tree, not the free list)."""
         return self.insert(tokens, blocks, transfer_refs=True)
+
+    # ------------------------------------------------------------------ #
+    # weight-version flush (colocated rollout weight swap)
+    # ------------------------------------------------------------------ #
+
+    def set_weight_version(self, version: int) -> int:
+        """Stamp the tree with a new engine-weight version and flush every
+        cached page — their KV was computed under the OLD weights, so none
+        may satisfy a post-swap match (the cache-invalidation invariant,
+        docs/SERVING.md "Colocated rollout"). Called by
+        ``engine_v2.swap_weights`` with every sequence already quiesced, so
+        the whole tree is refcount-1 and fully evictable; a page still
+        shared by a live sequence means the caller broke the quiesce
+        contract, and the refusal here surfaces that instead of serving
+        stale KV. Eviction deltas flow to the listeners (the cluster prefix
+        index must stop routing on the flushed chains). Returns pages
+        freed; ``version == weight_version`` is a no-op."""
+        if version == self.weight_version:
+            return 0
+        freed = self.evict(self._nodes) if self._nodes else 0
+        if self._nodes:
+            raise RuntimeError(
+                f"prefix-cache weight-version flush left {self._nodes} "
+                "page(s) pinned by live sequences — quiesce (preempt or "
+                "flush) every sequence before swapping weights")
+        self.weight_version = version
+        return freed
 
     # ------------------------------------------------------------------ #
     # eviction

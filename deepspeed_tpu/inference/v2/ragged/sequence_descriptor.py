@@ -40,6 +40,11 @@ class DSSequenceDescriptor:
     history_valid: "int | None" = None
     cached_tokens: int = 0                    # prompt tokens served from cache
     filed_tokens: int = 0                     # tokens already eager-inserted
+    # engine-weight version this sequence's KV is being computed under
+    # (stamped at admission when a prefix cache is wired): a flush whose
+    # stamp trails the cache's current version frees the pages instead of
+    # filing old-weight KV into a post-swap tree (runtime/colocated.py)
+    weight_version: int = 0
 
     @property
     def cur_allocated_blocks(self) -> int:

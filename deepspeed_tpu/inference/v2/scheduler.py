@@ -113,6 +113,8 @@ class DynamicSplitFuseScheduler:
                 raise RuntimeError(
                     f"max_tracked_sequences={self.config.max_tracked_sequences} exceeded")
             seq = self.seqs[uid] = DSSequenceDescriptor(uid=uid)
+            if self.prefix_cache is not None:
+                seq.weight_version = self.prefix_cache.weight_version
         if self._cache_active or self.record_history_always:
             seq.record_history(tokens)
         if self._cache_active:
@@ -143,10 +145,14 @@ class DynamicSplitFuseScheduler:
             return
         # ring reuse repeats physical ids in the logical list — settle each once
         uniq = list(dict.fromkeys(seq.blocks))
-        if self._cache_active:
+        if self._cache_active \
+                and seq.weight_version == self.prefix_cache.weight_version:
             known = self._cacheable_tokens(seq)
             self.prefix_cache.release(seq.history(known), uniq)
         else:
+            # no cache — or this sequence's KV predates a weight swap
+            # (weight_version stamp trails the tree): old-weight pages must
+            # never be filed into the post-swap tree, so they free instead
             self.allocator.free(uniq)
 
     @staticmethod
@@ -569,7 +575,8 @@ class DynamicSplitFuseScheduler:
                     bs = self.cache.config.block_size
                     known = self._cacheable_tokens(seq)
                     full = (known // bs) * bs
-                    if full > seq.filed_tokens:
+                    if full > seq.filed_tokens and seq.weight_version \
+                            == self.prefix_cache.weight_version:
                         self.prefix_cache.insert(seq.history(full),
                                                  seq.blocks[:full // bs],
                                                  transfer_refs=False)

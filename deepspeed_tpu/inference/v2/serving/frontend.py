@@ -456,6 +456,46 @@ class ServingFrontend:
             self._inflight += 1
         self._ctl.put(("resume", (req, np.asarray(history, np.int32))))
 
+    def swap_weights(self, new_weights, version: Optional[int] = None,
+                     timeout: Optional[float] = None) -> int:
+        """Swap the engine's weights in place at the next run boundary —
+        the serving half of the colocated rollout loop
+        (``runtime/colocated.py``; docs/SERVING.md "Colocated rollout").
+
+        The swap executes ON the engine thread between decode slices,
+        exactly where preemption executes: every live request is
+        recompute-preempted (KV dropped, prompt + tokens-so-far remembered;
+        restore re-prefills under the NEW weights), offload-preempted
+        victims and pending cross-replica handoffs convert to recompute
+        victims too (their parked KV pages are old-weight state), and the
+        prefix cache flushes by weight-version stamp. Adapter-bound live
+        requests shed honestly — the same rule as ``_preempt``'s
+        host-capacity fallback (a base-only re-prefill of adapter-delta KV
+        would silently diverge). No stream is ever silently served across
+        the boundary with stale KV.
+
+        Blocks until the swap is applied (or refused); a refusal raises
+        here and the loop keeps serving the OLD weights — engine validation
+        happens before any rebinding. Called inline when no engine thread
+        is running (synchronous ``step()`` drivers). Returns the new
+        ``weight_version``."""
+        if self._closed or self._fenced:
+            raise RuntimeError("frontend is closed"
+                               if self._closed else
+                               "frontend is fenced (replica down)")
+        if self._thread is None or not self._thread.is_alive():
+            return self._apply_swap(new_weights, version)
+        done = threading.Event()
+        box: Dict[str, object] = {}
+        self._ctl.put(("swap", (new_weights, version, done, box)))
+        if not done.wait(timeout if timeout is not None else 120.0):
+            raise TimeoutError(
+                "weight swap not applied within the timeout — the engine "
+                "thread is wedged or a decode slice is extremely long")
+        if "exc" in box:
+            raise box["exc"]
+        return box["version"]    # type: ignore[return-value]
+
     @property
     def outstanding(self) -> int:
         """Non-terminal requests (queued + prefilling + decoding +
@@ -691,6 +731,18 @@ class ServingFrontend:
             req.status = PREEMPTED
             req.preempt_t = req._phase_t0 = now
             self._preempted[req.uid] = req
+        elif kind == "swap":
+            # weight swap (colocated rollout): executes HERE, on the engine
+            # thread between decode slices — the same run boundary
+            # preemption owns. A refusal (engine-side validation) reports
+            # to the waiting caller and the loop keeps serving old weights.
+            new_weights, version, done, box = payload
+            try:
+                box["version"] = self._apply_swap(new_weights, version)
+            except BaseException as exc:
+                box["exc"] = exc
+            finally:
+                done.set()
         # cancellation rides the handle's event (no message): the sweeps /
         # on_tokens observe it within one iteration, and an idle loop ticks
         # every idle_wait_s — disconnects are never waited on indefinitely
@@ -1041,6 +1093,66 @@ class ServingFrontend:
         # binding drops across the preempted window (the request holds no
         # decode gathers); _restore re-acquires — faulting pages back in if
         # pressure evicted them meanwhile
+        self._lora_release(req)
+        req.status = PREEMPTED
+        req.preempt_t = req._phase_t0 = now
+        req.preemptions += 1
+        self._preempted[uid] = req
+        self.stats.preemptions += 1
+
+    def _apply_swap(self, new_weights, version: Optional[int]) -> int:
+        """Quiesce every holder of old-weight KV, then rebind the engine's
+        weights (engine thread / synchronous driver only). See
+        ``swap_weights`` for the policy; validation failures raise BEFORE
+        any state is touched by the engine, but the quiesce itself is not
+        rolled back — preempted requests simply re-prefill under whichever
+        weights are live when they restore, which is correct either way."""
+        for req in list(self._live.values()):
+            self._preempt_for_swap(req)
+        if self.offload is not None:
+            # offload-preempted victims parked old-weight KV pages on host:
+            # a byte-exact restore would resurrect stale state under the
+            # new weights, so they convert to recompute victims (re-prefill
+            # prompt + generated-so-far; the offload records drop)
+            for uid, req in list(self._preempted.items()):
+                if uid in self.offload._recs:
+                    self.offload.drop(uid)
+                    req._resume_tokens = np.concatenate(
+                        [req.prompt, np.asarray(req.tokens, np.int32)])
+                    self.stats.recompute_preemptions += 1
+        if self._handoffs:
+            # handoffs awaiting import hold another replica's old-weight KV
+            # in host buffers — adopt each as a recompute victim instead
+            # (the same shape the failover "resume" path uses)
+            now = time.perf_counter()
+            for req, _pages, _logits, history in self._handoffs:
+                req._resume_tokens = np.asarray(history, np.int32)
+                req.status = PREEMPTED
+                req.preempt_t = req._phase_t0 = now
+                self._preempted[req.uid] = req
+            self._handoffs = []
+        return self.engine.swap_weights(new_weights, version=version)
+
+    def _preempt_for_swap(self, req: RequestHandle) -> None:
+        """Preempt one live request for a weight swap: ALWAYS recompute
+        (never offload — parked KV would be stale-weight state on restore),
+        and adapter-bound requests shed honestly, the same rule as
+        ``_preempt``'s host-capacity fallback (decode-written KV carries
+        the adapter's k/v deltas; a base-only re-prefill silently
+        diverges)."""
+        uid = req.uid
+        now = time.perf_counter()
+        self._span(req, "decode", req._phase_t0, now)
+        self._pipe.retire([uid])
+        self._live.pop(uid, None)
+        if req.adapter is not None:
+            self.stats.forced_sheds += 1
+            self._teardown(req, SHED)
+            return
+        req._resume_tokens = np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)])
+        self.engine.flush([uid])
+        self.stats.recompute_preemptions += 1
         self._lora_release(req)
         req.status = PREEMPTED
         req.preempt_t = req._phase_t0 = now

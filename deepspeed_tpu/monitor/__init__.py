@@ -12,6 +12,7 @@ from deepspeed_tpu.monitor.serving import PipelineStats
 from deepspeed_tpu.monitor.trace import Tracer, tracer
 from deepspeed_tpu.monitor.training import (CheckpointStats,
                                             OffloadPipelineStats,
+                                            RolloutStats,
                                             TrainPipelineStats,
                                             Zero3CommStats)
 
@@ -19,4 +20,4 @@ __all__ = ["Monitor", "MonitorMaster", "TensorBoardMonitor", "WandbMonitor",
            "CsvMonitor", "PrometheusExporter", "TelemetryPump",
            "sanitize_metric_name", "PipelineStats", "TrainPipelineStats",
            "OffloadPipelineStats", "CheckpointStats", "Zero3CommStats",
-           "Tracer", "tracer"]
+           "RolloutStats", "Tracer", "tracer"]

@@ -320,3 +320,86 @@ class Zero3CommStats:
             ("train/zero3/overlap_frac", self.overlap_frac_sum / n, step),
             ("train/zero3/gather_bytes_per_step", float(self.gather_bytes), step),
         ]
+
+
+@dataclass
+class RolloutStats:
+    """Colocated-rollout loop counters (``runtime/colocated.py``;
+    docs/TRAINING.md "Colocated rollout"). Aggregated from the SAME
+    ``perf_counter`` stamp pairs that become the
+    ``train/rollout/{sync,swap,generate}`` tracer spans (PR 7
+    stats-equals-spans discipline) — one ``record_*`` call per span, so
+    every dashboard aggregate has a matching timeline span to zoom into.
+
+    Phase semantics (per rollout round):
+
+    - ``sync``: the WeightBridge's device-resident reshard — one jitted
+      program from the training engine's sharded optimizer view to the
+      serving engine's layout (dispatch + ``block_until_ready``). Moves
+      ``sync_bytes`` of serving-layout weights per round without a host
+      round-trip; compare against ``ckpt/*`` spans for the disk-path cost
+      this replaces.
+    - ``swap``: in-place rebind of the live serving engine's weights at a
+      run boundary — quiesce (recompute-preempt / shed) of in-flight
+      decode, weight-version bump, prefix-cache flush. ``preempted`` and
+      ``shed`` count the quiesce casualties; on a drained engine both
+      are 0 and the swap is O(validation).
+    - ``generate``: the serving leg of the round — submitting prompts and
+      draining rollouts that feed the next train batch.
+    """
+
+    rounds: int = 0
+    sync_ms: float = 0.0
+    swap_ms: float = 0.0
+    generate_ms: float = 0.0
+    sync_bytes: int = 0
+    preempted: int = 0
+    shed: int = 0
+    requests: int = 0
+    tokens: int = 0
+    weight_version: int = 0
+
+    def record_sync(self, seconds: float, *, nbytes: int = 0) -> None:
+        self.rounds += 1
+        self.sync_ms += 1e3 * seconds
+        self.sync_bytes = int(nbytes)
+
+    def record_swap(self, seconds: float, *, version: int = 0,
+                    preempted: int = 0, shed: int = 0) -> None:
+        self.swap_ms += 1e3 * seconds
+        self.weight_version = int(version)
+        self.preempted += int(preempted)
+        self.shed += int(shed)
+
+    def record_generate(self, seconds: float, *, requests: int = 0,
+                        tokens: int = 0) -> None:
+        self.generate_ms += 1e3 * seconds
+        self.requests += int(requests)
+        self.tokens += int(tokens)
+
+    def reset(self) -> None:
+        self.rounds = 0
+        self.sync_ms = 0.0
+        self.swap_ms = 0.0
+        self.generate_ms = 0.0
+        self.sync_bytes = 0
+        self.preempted = 0
+        self.shed = 0
+        self.requests = 0
+        self.tokens = 0
+        self.weight_version = 0
+
+    def events(self, step: int = 0) -> List[Event]:
+        n = max(1, self.rounds)
+        return [
+            ("train/rollout/rounds", float(self.rounds), step),
+            ("train/rollout/sync_ms_per_round", self.sync_ms / n, step),
+            ("train/rollout/swap_ms_per_round", self.swap_ms / n, step),
+            ("train/rollout/generate_ms_per_round", self.generate_ms / n, step),
+            ("train/rollout/sync_bytes", float(self.sync_bytes), step),
+            ("train/rollout/preempted", float(self.preempted), step),
+            ("train/rollout/shed", float(self.shed), step),
+            ("train/rollout/requests", float(self.requests), step),
+            ("train/rollout/tokens", float(self.tokens), step),
+            ("train/rollout/weight_version", float(self.weight_version), step),
+        ]

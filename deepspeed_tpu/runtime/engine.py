@@ -885,6 +885,37 @@ class DeepSpeedTPUEngine:
     # loss / grads
     # ------------------------------------------------------------------ #
 
+    def rollout_source_params(self):
+        """The device-resident parameter tree the colocated WeightBridge
+        reshards from (``runtime/colocated.py``) — the train half of the
+        train->serve weight sync, chosen to match the universal-checkpoint
+        repartition source byte-for-byte:
+
+        * standard engines: ``state["master"]`` — the fp32 fsdp-sharded
+          master, exactly what ``ds_to_universal`` serialises (so the
+          bridge's cast->adapt is bitwise the disk path minus disk);
+        * cpu-offload engines: ``state["params"]`` after the in-flight
+          delayed host step drains — the master is split device/host there,
+          and the merged device params ARE the post-update view every
+          consumer (next step, checkpoint) reads.
+
+        Both are device trees: nothing here fetches weight bytes to host
+        (the JL007-policed invariant). Refuses engine modes whose params
+        are not plainly device-resident in the model's own tree layout."""
+        if self.quantized_weights:
+            raise NotImplementedError(
+                "colocated weight sync from a quantized-weight (ZeRO++ qwZ) "
+                "engine is not wired — the bridge would have to dequantize "
+                "per sync; train unquantized or sync via checkpoint")
+        if self._compression_plan is not None and self._compression_plan.leaves:
+            raise NotImplementedError(
+                "colocated weight sync with an active compression schedule "
+                "is not wired (masks are step-keyed); sync via checkpoint")
+        if self._offload is not None:
+            self._drain_offload()
+            return self.state["params"]
+        return self.state["master"]
+
     def _current_params(self, state):
         if "params" in state:
             if self.quantized_weights:

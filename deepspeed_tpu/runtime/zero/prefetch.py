@@ -69,6 +69,7 @@ and the transpose to a real ``reduce-scatter`` HLO; per-tensor
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import threading
@@ -258,6 +259,27 @@ def configure(plan: Optional[Zero3Plan]) -> None:
 
 def current_plan() -> Optional[Zero3Plan]:
     return _STATE.plan
+
+
+@contextlib.contextmanager
+def cleared():
+    """Trace-hygiene guard for FOREIGN traces on a scheduled engine's
+    thread: stash the ambient plan, clear it, restore on exit.
+
+    ``train_batch`` re-arms the plan every step, so anything ELSE that
+    traces on the same thread between steps — the colocated WeightBridge's
+    train->serve reshard program (``runtime/colocated.py``) is the
+    motivating case — would otherwise trace under a plan scheduled for a
+    different program's model walk. The reshard touches no model layers, so
+    the taps would not fire today; the guard makes that a guarantee instead
+    of a coincidence (the same hygiene rule engine.py documents at its
+    per-step ``configure`` call)."""
+    prev = _STATE.plan
+    _STATE.plan = None
+    try:
+        yield
+    finally:
+        _STATE.plan = prev
 
 
 def set_step_operand(step) -> None:

@@ -127,6 +127,16 @@ timeout -k 10 300 python benchmarks/train_bench.py --smoke --trace-overhead \
 timeout -k 10 300 python benchmarks/train_bench.py --smoke --zero3-overlap \
     || exit 1
 
+# colocated-rollout leg (docs/TRAINING.md "Colocated rollout"): one
+# train+serve pair on the same devices — the WeightBridge's device-resident
+# reshard vs the universal-checkpoint round-trip (byte-equal weights),
+# >=3 in-place swaps into a warmed engine (zero new compiles, post-swap
+# greedy streams byte-identical to a freshly built engine, KV allocator at
+# baseline), and the full RolloutLoop vs rebuild-per-update (byte-identical
+# rollouts); emits the train/rollout trace lanes trace_check requires below
+# (the >=5x sync bar runs full-size, BENCH_r19)
+timeout -k 10 300 python benchmarks/rollout_bench.py --smoke || exit 1
+
 # serving-side tracer/attribution overhead leg (docs/OBSERVABILITY.md):
 # the same router workload with flow tracing + phase attribution ON vs
 # OFF; correctness gates here (byte-identical streams, zero compiles),
@@ -142,7 +152,7 @@ timeout -k 10 300 python benchmarks/serving_bench.py --trace-overhead \
 # parseable flight-recorder dump from the --preempt kills
 timeout -k 10 120 python scripts/trace_check.py "$TRACE_DIR" \
     --require train serve serve/req serve/spec serve/router serve/health \
-    serve/lora serve/attn ckpt train/offload train/zero3 \
+    serve/lora serve/attn ckpt train/offload train/zero3 train/rollout \
     --require-flows serve/req \
     --expect-crash || exit 1
 
